@@ -1,0 +1,52 @@
+"""Framework benchmark — run on real TPU hardware by the driver.
+
+Headline metric: sustained bf16 matmul TFLOP/s on one chip, from the matmul
+validation workload (the cuda-vector-add/nvidia-smi-analog suite, SURVEY.md
+§2.3). The reference stack's accelerator is a Tesla T4 (reference
+README.md:165); ``vs_baseline`` is the ratio against the T4's 65 TFLOP/s fp16
+tensor-core peak — i.e. how much faster the TPU path this framework enables is
+than the GPU path the reference enables, on the accelerator's own headline
+number.
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+T4_FP16_PEAK_TFLOPS = 65.0
+
+
+def main() -> int:
+    import jax
+
+    from tpu_cluster.workloads import smoke
+
+    platform = jax.devices()[0].platform
+    # Compile warm-up + correctness suite (device enum, vector add) first;
+    # its wall-clock is the BASELINE.json north-star 'smoke Job' time.
+    suite = smoke.run_suite(matmul_dim=1024)
+    if platform == "cpu":
+        # Clusterless fallback: tiny shapes so CI stays fast.
+        mm = smoke.matmul(512, 512, 512, iters=3)
+    else:
+        mm = smoke.matmul(4096, 4096, 4096, iters=20)
+    value = round(mm["tflops"], 2)
+    print(json.dumps({
+        "metric": "bf16_matmul_tflops_1chip",
+        "value": value,
+        "unit": "TFLOP/s",
+        "vs_baseline": round(value / T4_FP16_PEAK_TFLOPS, 3),
+        "platform": platform,
+        "devices": jax.device_count(),
+        "smoke_suite_wall_s": round(suite["wall_s"], 3),
+        "smoke_suite_ok": suite["ok"],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

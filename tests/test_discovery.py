@@ -1,0 +1,62 @@
+"""Device discovery + feature-discovery label tests (fake device tree)."""
+
+import json
+
+from tpu_cluster.discovery import devices, labeler, labels
+
+
+def test_discover_fake_tree(tmp_path):
+    devices.make_fake_tree(str(tmp_path), 8)
+    found = devices.discover("/dev/accel*", devfs_root=str(tmp_path))
+    assert [d.index for d in found] == list(range(8))
+    assert found[3].path.endswith("dev/accel3")
+    assert not found[0].vfio
+
+
+def test_discover_empty(tmp_path):
+    assert devices.discover("/dev/accel*", devfs_root=str(tmp_path)) == []
+
+
+def test_discover_vfio(tmp_path):
+    devices.make_fake_tree(str(tmp_path), 4, vfio=True)
+    found = devices.discover_vfio(devfs_root=str(tmp_path))
+    assert [d.index for d in found] == [0, 1, 2, 3]
+    assert all(d.vfio for d in found)
+
+
+def test_labels_present(tmp_path):
+    devices.make_fake_tree(str(tmp_path), 8)
+    found = devices.discover("/dev/accel*", devfs_root=str(tmp_path))
+    got = labels.compute_labels("v5e-8", found, "node-1")
+    assert got == {
+        "google.com/tpu.present": "true",
+        "google.com/tpu.accelerator-type": "v5e-8",
+        "google.com/tpu.generation": "v5e",
+        "google.com/tpu.topology": "2x4",
+        "google.com/tpu.count": "8",
+        "google.com/tpu.ici-domain": "node-1",
+    }
+
+
+def test_labels_absent_deletes_stale_keys():
+    got = labels.compute_labels("v5e-8", [])
+    assert got["google.com/tpu.present"] == "false"
+    # every other key maps to None -> JSON null -> strategic-merge delete
+    for key in labels.ALL_KEYS:
+        if key != labels.PRESENT:
+            assert got[key] is None
+    patch = labeler.node_patch(got)
+    assert b'"google.com/tpu.count": null' in patch
+
+
+def test_labeler_oneshot_outfile(tmp_path):
+    devices.make_fake_tree(str(tmp_path), 8)
+    out = tmp_path / "labels.jsonl"
+    rc = labeler.main([
+        "--accelerator=v5e-8", f"--devfs-root={tmp_path}",
+        "--oneshot", f"--out-file={out}",
+    ])
+    assert rc == 0
+    rec = json.loads(out.read_text().strip())
+    assert rec["google.com/tpu.present"] == "true"
+    assert rec["google.com/tpu.count"] == "8"

@@ -1,0 +1,156 @@
+"""ClusterSpec loading + tier 1/2/3 renderer tests."""
+
+import pytest
+import yaml
+
+from tpu_cluster import spec as specmod
+from tpu_cluster.render import kubeadm, manifests, nodeprep
+
+EXAMPLE = """
+cluster:
+  name: demo
+  kubernetesVersion: "1.28"
+  podCidr: 10.244.0.0/16
+  controlPlaneEndpoint:
+    source: metadata
+    cloud: aws
+tpu:
+  accelerator: v5e-8
+  namespace: tpu-system
+  operands:
+    metricsExporter: {enabled: true, port: 9400}
+    nodeStatusExporter: {enabled: false}
+"""
+
+
+def test_load_example():
+    s = specmod.load(EXAMPLE)
+    assert s.name == "demo"
+    assert s.control_plane.cloud == "aws"
+    assert s.tpu.accelerator_type.chips_per_host == 8
+    assert not s.tpu.operand("nodeStatusExporter").enabled
+    assert s.tpu.operand("metricsExporter").extra["port"] == 9400
+    assert s.tpu.operand("devicePlugin").enabled  # default on
+
+
+def test_load_acronym_and_empty_sections():
+    # Kubernetes-canonical acronym spelling and the camelCase spelling both work
+    s = specmod.load("cluster: {podCIDR: 10.0.0.0/16}")
+    assert s.pod_cidr == "10.0.0.0/16"
+    s = specmod.load("cluster: {podCidr: 10.1.0.0/16}")
+    assert s.pod_cidr == "10.1.0.0/16"
+    # empty sections parse to None; must not TypeError
+    s = specmod.load("cluster:\n")
+    assert s.name == "tpu-cluster"
+    s = specmod.load("tpu:\n")
+    assert s.tpu.accelerator == "v5e-8"
+
+
+def test_load_rejects_unknowns():
+    with pytest.raises(specmod.SpecError):
+        specmod.load("cluster: {bogusField: 1}")
+    with pytest.raises(specmod.SpecError):
+        specmod.load("tpu:\n  operands:\n    warpDrive: {enabled: true}")
+    with pytest.raises(specmod.SpecError):
+        specmod.load("cluster: {podCidr: not-a-cidr}")
+    with pytest.raises(KeyError):
+        specmod.load("tpu: {accelerator: v99-1}")
+
+
+def test_node_prep_renders_reference_phase1():
+    """Tier-1 parity with reference README.md:5-36."""
+    s = specmod.default_spec()
+    script = nodeprep.render_node_prep(s)
+    assert "overlay" in script and "br_netfilter" in script
+    assert "net.bridge.bridge-nf-call-iptables = 1" in script
+    assert "net.ipv4.ip_forward = 1" in script
+    assert "SystemdCgroup = false/SystemdCgroup = true" in script
+    assert "containerd config default" in script
+    pkgs = nodeprep.render_kubeadm_packages(s)
+    assert "apt-mark hold kubelet kubeadm kubectl" in pkgs
+    assert "v1.28" in pkgs
+
+
+def test_kubeadm_endpoint_sources():
+    s = specmod.default_spec()
+    s.control_plane.cloud = "aws"
+    snip = kubeadm.endpoint_discovery_snippet(s)
+    assert "169.254.169.254" in snip
+    s.control_plane.cloud = "gcp"
+    snip = kubeadm.endpoint_discovery_snippet(s)
+    assert "metadata.google.internal" in snip and "Metadata-Flavor" in snip
+    s.control_plane.source = "static"
+    s.control_plane.address = "10.0.0.5"
+    assert kubeadm.endpoint_discovery_snippet(s) == 'CONTROL_PLANE_IP="10.0.0.5"'
+
+
+def test_kubeadm_init_script():
+    s = specmod.default_spec()
+    script = kubeadm.render_init_script(s)
+    assert "--pod-network-cidr=10.244.0.0/16" in script
+    assert ":6443" in script
+    assert "kubeadm token create --print-join-command" in script
+    assert s.cni_manifest_url in script
+
+
+def test_manifests_render_and_parse():
+    s = specmod.default_spec()
+    docs = list(yaml.safe_load_all(manifests.render_all(s)))
+    kinds = [(d["kind"], d["metadata"]["name"]) for d in docs]
+    names = [n for _, n in kinds]
+    assert ("Namespace", "tpu-system") in kinds
+    for expected in ("tpu-libtpu-prep", "tpu-device-plugin",
+                     "tpu-feature-discovery", "tpu-metrics-exporter",
+                     "tpu-node-status-exporter"):
+        assert expected in names, expected
+    # device plugin mounts the kubelet socket dir and /dev
+    dp = next(d for d in docs if d["metadata"]["name"] == "tpu-device-plugin"
+              and d["kind"] == "DaemonSet")
+    mounts = dp["spec"]["template"]["spec"]["containers"][0]["volumeMounts"]
+    paths = {m["mountPath"] for m in mounts}
+    assert "/var/lib/kubelet/device-plugins" in paths and "/dev" in paths
+    args = dp["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "--accelerator=v5e-8" in args
+    assert "--resource=google.com/tpu" in args
+    # libtpu-prep must no-op (exit 0) on CPU-only nodes, not crash-loop the
+    # gated rollout
+    prep = next(d for d in docs if d["metadata"]["name"] == "tpu-libtpu-prep")
+    init_cmd = prep["spec"]["template"]["spec"]["initContainers"][0]["command"][-1]
+    assert "touch /shared/no-tpu; exit 0" in init_cmd
+    assert "exit 1" not in init_cmd
+
+
+def test_status_exporter_mount_follows_libtpu_path():
+    s = specmod.load("tpu: {libtpuHostPath: /opt/tpu/libtpu.so}")
+    docs = list(yaml.safe_load_all(manifests.render_all(s)))
+    st = next(d for d in docs
+              if d["metadata"]["name"] == "tpu-node-status-exporter")
+    podspec = st["spec"]["template"]["spec"]
+    mounts = {m["mountPath"] for m in podspec["containers"][0]["volumeMounts"]}
+    assert "/opt/tpu" in mounts
+    hostpaths = {v.get("hostPath", {}).get("path") for v in podspec["volumes"]}
+    assert "/opt/tpu" in hostpaths
+
+
+def test_operand_enable_flags():
+    """The Helm --set surface analog (reference README.md:104-110)."""
+    s = specmod.load("""
+tpu:
+  operands:
+    libtpuPrep: {enabled: false}
+    featureDiscovery: {enabled: false}
+    metricsExporter: {enabled: false}
+    nodeStatusExporter: {enabled: false}
+""")
+    docs = list(yaml.safe_load_all(manifests.render_all(s)))
+    names = [d["metadata"]["name"] for d in docs]
+    assert names == ["tpu-system", "tpu-device-plugin"]
+
+
+def test_rollout_groups_ordered():
+    """Rollout order mirrors the operator's dependency order (SURVEY §3.3)."""
+    s = specmod.default_spec()
+    groups = manifests.rollout_groups(s)
+    order = [g[0]["metadata"]["name"] for g in groups]
+    assert order == ["tpu-system", "tpu-libtpu-prep", "tpu-device-plugin",
+                     "tpu-feature-discovery", "tpu-metrics-exporter"]

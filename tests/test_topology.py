@@ -1,0 +1,88 @@
+"""Topology-aligned allocation policy tests (+ golden vectors shared with the
+native C++ implementation — see test_native.py)."""
+
+import json
+import os
+
+import pytest
+
+from tpu_cluster import topology
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "data", "topology_golden.json")
+
+
+def test_v5e8_catalogue():
+    acc = topology.get("v5e-8")
+    assert acc.chips_per_host == 8
+    assert acc.topology == (2, 4)
+    assert acc.aligned_sizes == (1, 4, 8)
+    assert acc.label_topology() == "2x4"
+
+
+def test_unknown_type():
+    with pytest.raises(KeyError):
+        topology.get("v99-1")
+
+
+def test_chip_coords_row_major():
+    acc = topology.get("v5e-8")
+    assert topology.chip_coords(acc) == [
+        (0, 0), (1, 0), (0, 1), (1, 1), (0, 2), (1, 2), (0, 3), (1, 3)
+    ]
+
+
+def test_aligned_subsets_v5e8():
+    acc = topology.get("v5e-8")
+    assert topology.aligned_subsets(acc, 8) == [tuple(range(8))]
+    quads = topology.aligned_subsets(acc, 4)
+    # 2x2 blocks sliding over a 2x4 grid: 3 positions
+    assert quads == [(0, 1, 2, 3), (2, 3, 4, 5), (4, 5, 6, 7)]
+    singles = topology.aligned_subsets(acc, 1)
+    assert len(singles) == 8
+    assert topology.aligned_subsets(acc, 2) == []  # 2 is not aligned on v5e
+
+
+def test_validate_allocation():
+    acc = topology.get("v5e-8")
+    ok, _ = topology.validate_allocation(acc, [0, 1, 2, 3])
+    assert ok
+    ok, reason = topology.validate_allocation(acc, [0, 1, 2, 4])
+    assert not ok and "sub-mesh" in reason
+    ok, reason = topology.validate_allocation(acc, [0, 1])
+    assert not ok and "not aligned" in reason
+    ok, _ = topology.validate_allocation(acc, [7])
+    assert ok
+    ok, _ = topology.validate_allocation(acc, [8])
+    assert not ok
+    ok, _ = topology.validate_allocation(acc, [3, 3, 3, 3])
+    assert not ok
+
+
+def test_preferred_allocation():
+    acc = topology.get("v5e-8")
+    r = topology.preferred_allocation(acc, range(8), [], 4)
+    assert r.device_ids == (0, 1, 2, 3)
+    # chips 0,1 busy -> next free quad
+    r = topology.preferred_allocation(acc, [2, 3, 4, 5, 6, 7], [], 4)
+    assert r.device_ids in ((2, 3, 4, 5), (4, 5, 6, 7))
+    # must_include forces the containing quad
+    r = topology.preferred_allocation(acc, range(8), [5], 4)
+    assert 5 in r.device_ids and r.device_ids in ((2, 3, 4, 5), (4, 5, 6, 7))
+    # impossible: fragmented availability
+    r = topology.preferred_allocation(acc, [0, 3, 5, 6], [], 4)
+    assert r is None
+    # unaligned size
+    assert topology.preferred_allocation(acc, range(8), [], 2) is None
+
+
+def test_golden_vectors_match():
+    """The committed golden file pins Python and C++ to the same policy."""
+    with open(GOLDEN, encoding="utf-8") as f:
+        golden = json.load(f)
+    for entry in golden["accelerators"]:
+        acc = topology.get(entry["name"])
+        for size_str, subsets in entry["aligned_subsets"].items():
+            got = [list(s) for s in topology.aligned_subsets(acc, int(size_str))]
+            assert got == subsets, (entry["name"], size_str)
+        got_cases = topology.all_validation_cases(acc)
+        assert got_cases == entry["validate_cases"], entry["name"]

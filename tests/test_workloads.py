@@ -1,0 +1,94 @@
+"""JAX workload tests on the 8-device virtual CPU mesh (conftest.py)."""
+
+import jax
+import pytest
+
+from tpu_cluster.workloads import burnin, collectives, multihost, smoke
+
+
+def test_virtual_mesh_is_8_devices():
+    assert jax.device_count() == 8
+
+
+def test_device_report():
+    rep = smoke.device_report()
+    assert rep["device_count"] == 8
+    assert len(rep["devices"]) == 8
+    assert rep["devices"][0]["id"] == 0
+
+
+def test_vector_add():
+    assert smoke.vector_add(1 << 12)["ok"]
+
+
+def test_matmul_smoke():
+    r = smoke.matmul(256, 256, 256, iters=2)
+    assert r["ok"] and r["tflops"] > 0
+
+
+def test_run_suite():
+    r = smoke.run_suite(matmul_dim=256)
+    assert r["ok"] and r["wall_s"] > 0
+
+
+def test_psum_check():
+    r = collectives.psum_check()
+    assert r["ok"] and r["devices"] == 8 and r["expected"] == 28.0
+
+
+def test_psum_subset():
+    assert collectives.psum_check(n_devices=4)["ok"]
+
+
+def test_collective_matrix():
+    r = collectives.collective_matrix()
+    assert r["ok"], r
+
+
+def test_allreduce_bandwidth():
+    r = collectives.allreduce_bandwidth(mib=1, iters=2)
+    assert r["busbw_gib_s"] > 0
+
+
+def test_burnin_dp_tp():
+    r = burnin.run(mesh_shape=(2, 4), steps=4)
+    assert r["ok"], r
+    assert r["mesh"] == {"data": 2, "model": 4}
+
+
+def test_burnin_default_mesh():
+    assert burnin.default_mesh_shape(8) == (2, 4)
+    assert burnin.default_mesh_shape(4) == (1, 4)
+    assert burnin.default_mesh_shape(1) == (1, 1)
+    assert burnin.default_mesh_shape(6) == (3, 2)
+
+
+def test_multihost_plan_single():
+    p = multihost.plan({})
+    assert p == {"multihost": False, "num_processes": 1, "process_id": 0}
+
+
+def test_multihost_plan_indexed_job():
+    env = multihost.bootstrap_env(
+        1, ["job-0.tpu-job.default.svc", "job-1.tpu-job.default.svc"])
+    p = multihost.plan(env)
+    assert p["multihost"] and p["num_processes"] == 2 and p["process_id"] == 1
+    assert p["coordinator_address"] == "job-0.tpu-job.default.svc:8476"
+
+
+def test_multihost_job_completion_index_fallback():
+    p = multihost.plan({
+        "JOB_COMPLETION_INDEX": "3",
+        "TPU_WORKER_HOSTNAMES": "a,b,c,d",
+    })
+    assert p["process_id"] == 3 and p["num_processes"] == 4
+
+
+def test_multihost_missing_hosts():
+    with pytest.raises(RuntimeError):
+        multihost.coordinator_address({})
+
+
+def test_multihost_missing_worker_id_is_diagnosable():
+    with pytest.raises(RuntimeError, match="completionMode"):
+        multihost.plan({"TPU_WORKER_HOSTNAMES": "a,b"})

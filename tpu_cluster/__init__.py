@@ -1,0 +1,24 @@
+"""tpu_cluster — TPU-native Kubernetes cluster enablement framework.
+
+Capability-parity replacement for the NVIDIA GPU Operator stack described by the
+reference runbook (reference README.md:99-123): the same kubeadm + containerd +
+Flannel substrate, with the accelerator-enablement layer (L5 in SURVEY.md §1)
+rebuilt TPU-native:
+
+- ``tpud`` (native C++, ``native/plugin``) — topology-aware device plugin
+  advertising ``google.com/tpu`` (replaces nvidia-device-plugin, reference
+  README.md:106,211).
+- libtpu host-prep DaemonSet (replaces nvidia-driver-daemonset, reference
+  README.md:104,212 — no kernel build on TPU VMs; see docs/DELTAS.md).
+- ``tpu-feature-discovery`` labels (replaces gpu-feature-discovery, reference
+  README.md:108,209).
+- ``tpu-metrics-exporter`` (native C++, ``native/exporter``; replaces
+  dcgm-exporter, reference README.md:204,213).
+- JAX/XLA validation workloads (replace nvidia-smi / cuda-vector-add checks,
+  reference README.md:152-168).
+
+The Python package is the glue layer: cluster-spec rendering, topology policy,
+test/fake infrastructure, acceptance runbook, and the JAX workloads themselves.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+"""Device discovery and node-label computation (feature-discovery core)."""

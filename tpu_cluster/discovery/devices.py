@@ -1,0 +1,70 @@
+"""TPU device-node discovery.
+
+The real stack discovers chips from the host device tree: ``/dev/accel*``
+(Google TPU driver) or ``/dev/vfio/*`` (VFIO passthrough). Tests and CI run
+clusterless against a *fake device tree* — a directory with ``accelN`` entries
+— which is the same mechanism the native plugin's ``--fake-devices=N`` mode
+uses (SURVEY.md §4 point 2: fake sysfs/device tree is the
+multi-chip-without-hardware story).
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+import re
+from dataclasses import dataclass
+from typing import List, Optional
+
+
+@dataclass(frozen=True)
+class TpuDevice:
+    index: int
+    path: str      # e.g. /dev/accel3
+    vfio: bool = False
+
+
+_ACCEL_RE = re.compile(r"accel(?:_)?(\d+)$")
+
+
+def discover(device_glob: str = "/dev/accel*", devfs_root: str = "") -> List[TpuDevice]:
+    """Enumerate TPU device nodes matching ``device_glob``.
+
+    ``devfs_root`` re-roots the glob for fake trees (tests): with
+    devfs_root=/tmp/x, /dev/accel* is looked up at /tmp/x/dev/accel*.
+    """
+    pattern = device_glob
+    if devfs_root:
+        pattern = os.path.join(devfs_root, device_glob.lstrip("/"))
+    devices = []
+    for path in sorted(_glob.glob(pattern)):
+        m = _ACCEL_RE.search(os.path.basename(path))
+        if not m:
+            continue
+        devices.append(TpuDevice(index=int(m.group(1)), path=path))
+    return sorted(devices, key=lambda d: d.index)
+
+
+def discover_vfio(devfs_root: str = "") -> List[TpuDevice]:
+    """VFIO-passthrough enumeration: /dev/vfio/<group-number> entries."""
+    root = os.path.join(devfs_root, "dev/vfio") if devfs_root else "/dev/vfio"
+    devices = []
+    for path in sorted(_glob.glob(os.path.join(root, "*"))):
+        name = os.path.basename(path)
+        if name.isdigit():
+            devices.append(TpuDevice(index=int(name), path=path, vfio=True))
+    return sorted(devices, key=lambda d: d.index)
+
+
+def make_fake_tree(root: str, n: int, vfio: bool = False) -> List[str]:
+    """Create a fake device tree with n chips under ``root`` (for tests)."""
+    sub = "dev/vfio" if vfio else "dev"
+    d = os.path.join(root, sub)
+    os.makedirs(d, exist_ok=True)
+    paths = []
+    for i in range(n):
+        p = os.path.join(d, str(i) if vfio else f"accel{i}")
+        with open(p, "w", encoding="utf-8"):
+            pass
+        paths.append(p)
+    return paths

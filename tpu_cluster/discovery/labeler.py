@@ -1,0 +1,89 @@
+"""tpu-feature-discovery daemon entrypoint.
+
+Periodically discovers TPU device nodes and patches the labels from
+``labels.compute_labels`` onto this Node via the Kubernetes API (in-cluster
+ServiceAccount). Clusterless modes for tests: ``--print`` emits the labels as
+JSON; ``--out-file`` appends the would-be patch (the fake-apiserver story).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+from . import devices as devs
+from . import labels as lbl
+
+
+def node_patch(labels: dict) -> bytes:
+    return json.dumps({"metadata": {"labels": labels}}).encode()
+
+
+def patch_node_incluster(node_name: str, labels: dict) -> int:
+    """Strategic-merge-patch the Node using the in-cluster SA token."""
+    host = os.environ["KUBERNETES_SERVICE_HOST"]
+    port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+    sa = "/var/run/secrets/kubernetes.io/serviceaccount"
+    with open(f"{sa}/token", encoding="utf-8") as f:
+        token = f.read().strip()
+    import ssl
+    ctx = ssl.create_default_context(cafile=f"{sa}/ca.crt")
+    req = urllib.request.Request(
+        f"https://{host}:{port}/api/v1/nodes/{node_name}",
+        data=node_patch(labels),
+        method="PATCH",
+        headers={
+            "Authorization": f"Bearer {token}",
+            "Content-Type": "application/strategic-merge-patch+json",
+        },
+    )
+    with urllib.request.urlopen(req, context=ctx) as resp:
+        return resp.status
+
+
+def run_once(args: argparse.Namespace) -> dict:
+    found = devs.discover(args.device_glob, args.devfs_root)
+    if not found:
+        found = devs.discover_vfio(args.devfs_root)
+    labels = lbl.compute_labels(args.accelerator, found,
+                                os.environ.get("NODE_NAME", ""))
+    if args.print_only:
+        print(json.dumps(labels, sort_keys=True))
+    elif args.out_file:
+        with open(args.out_file, "a", encoding="utf-8") as f:
+            f.write(json.dumps(labels, sort_keys=True) + "\n")
+    else:
+        status = patch_node_incluster(os.environ["NODE_NAME"], labels)
+        print(f"patched node {os.environ['NODE_NAME']}: HTTP {status}",
+              file=sys.stderr)
+    return labels
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tpu-feature-discovery")
+    p.add_argument("--accelerator", default="v5e-8")
+    p.add_argument("--device-glob", default="/dev/accel*")
+    p.add_argument("--devfs-root", default="")
+    p.add_argument("--interval", type=float, default=60)
+    p.add_argument("--oneshot", action="store_true")
+    p.add_argument("--print", dest="print_only", action="store_true")
+    p.add_argument("--out-file", default="")
+    args = p.parse_args(argv)
+    while True:
+        try:
+            run_once(args)
+        except Exception as exc:  # keep the daemon alive across apiserver blips
+            if args.oneshot:
+                raise
+            print(f"label refresh failed (will retry): {exc}", file=sys.stderr)
+        if args.oneshot:
+            return 0
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

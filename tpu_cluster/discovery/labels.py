@@ -1,0 +1,47 @@
+"""Node-label computation — gpu-feature-discovery analog.
+
+The reference stack labels GPU nodes ``nvidia.com/gpu.present=true`` so the
+operator and workloads can target them (reference README.md:119,209). The TPU
+label set (SURVEY.md §2.2) additionally publishes accelerator type, per-host
+topology, chip count, and an ICI-domain id, which multi-slice scheduling and
+the JAX validation Jobs select on.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .. import topology
+from .devices import TpuDevice
+
+PRESENT = "google.com/tpu.present"
+TYPE = "google.com/tpu.accelerator-type"
+GENERATION = "google.com/tpu.generation"
+TOPOLOGY = "google.com/tpu.topology"
+COUNT = "google.com/tpu.count"
+ICI_DOMAIN = "google.com/tpu.ici-domain"
+
+ALL_KEYS = (PRESENT, TYPE, GENERATION, TOPOLOGY, COUNT, ICI_DOMAIN)
+
+
+def compute_labels(accelerator: str, devices: List[TpuDevice],
+                   node_name: str = "") -> Dict[str, Optional[str]]:
+    """Labels for a node. When no chips are found, every TPU key except
+    ``present`` maps to None — which serialises to JSON null in the
+    strategic-merge patch, *deleting* the stale key — so a node that loses
+    its TPUs is fully relabeled, not left with a stale type/count."""
+    if not devices:
+        out: Dict[str, Optional[str]] = {k: None for k in ALL_KEYS}
+        out[PRESENT] = "false"
+        return out
+    acc = topology.get(accelerator)
+    return {
+        PRESENT: "true",
+        TYPE: acc.name,
+        GENERATION: acc.generation,
+        TOPOLOGY: acc.label_topology(),
+        COUNT: str(len(devices)),
+        # Per-host slices: the ICI domain is the host itself. Multi-host
+        # slices would share a domain id provisioned by the slice builder.
+        ICI_DOMAIN: node_name or "local",
+    }

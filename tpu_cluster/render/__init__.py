@@ -1,0 +1,8 @@
+"""Renderers: ClusterSpec -> deployable artifacts.
+
+Tier 1 (host prep)     -> nodeprep.render_node_prep
+Tier 2 (kubeadm)       -> kubeadm.render_init_script / render_join_script
+Tier 3 (TPU operands)  -> manifests.render_all
+"""
+
+from . import kubeadm, manifests, nodeprep  # noqa: F401

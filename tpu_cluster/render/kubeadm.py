@@ -1,0 +1,85 @@
+"""Render kubeadm init / join (Phase 2) artifacts.
+
+Reproduces reference README.md:52-75: ``kubeadm init`` with the pod CIDR flag
+and a control-plane endpoint discovered from the cloud metadata service. The
+reference hardcodes AWS IMDSv1 (README.md:54); here the endpoint source is a
+spec field — AWS IMDS, GCE metadata, or a static address (SURVEY.md §2.1 calls
+this seam out as the one cloud-specific piece of Phase 2).
+"""
+
+from __future__ import annotations
+
+from ..spec import METADATA_ENDPOINTS, ClusterSpec
+
+
+def endpoint_discovery_snippet(spec: ClusterSpec) -> str:
+    cp = spec.control_plane
+    if cp.source == "static":
+        return f'CONTROL_PLANE_IP="{cp.address}"'
+    url, headers = METADATA_ENDPOINTS[cp.cloud]
+    hdr = " ".join(f'-H "{h}"' for h in headers)
+    return f'CONTROL_PLANE_IP="$(curl -fsS {hdr} {url})"'.replace("  ", " ")
+
+
+def render_init_script(spec: ClusterSpec) -> str:
+    cp = spec.control_plane
+    return f"""#!/usr/bin/env bash
+# Control-plane bootstrap (Phase 2.2) — rendered by tpuctl from cluster-spec
+# '{spec.name}'. Run as root on the control-plane node.
+set -euxo pipefail
+
+{endpoint_discovery_snippet(spec)}
+
+kubeadm init \\
+  --pod-network-cidr={spec.pod_cidr} \\
+  --control-plane-endpoint="${{CONTROL_PLANE_IP}}:{cp.port}"
+
+# kubectl for the invoking user (reference README.md:56-59)
+USER_HOME="${{SUDO_USER:+/home/$SUDO_USER}}"
+USER_HOME="${{USER_HOME:-$HOME}}"
+mkdir -p "$USER_HOME/.kube"
+cp -i /etc/kubernetes/admin.conf "$USER_HOME/.kube/config"
+chown "$(stat -c '%u:%g' "$USER_HOME")" "$USER_HOME/.kube/config"
+
+# Pod network (Phase 2.3) — CNI carries DCN-side traffic only; TPU ICI traffic
+# never touches the overlay (SURVEY.md §2.1).
+kubectl --kubeconfig /etc/kubernetes/admin.conf apply -f {spec.cni_manifest_url}
+
+# Join command for workers (Phase 2.4, reference README.md:71-74)
+kubeadm token create --print-join-command | tee /root/kubeadm-join-command.sh
+chmod +x /root/kubeadm-join-command.sh
+"""
+
+
+def render_join_script(spec: ClusterSpec) -> str:
+    return f"""#!/usr/bin/env bash
+# Worker join (Phase 2.4) — rendered by tpuctl from cluster-spec '{spec.name}'.
+# Paste the join command printed by the control-plane init (or copy
+# /root/kubeadm-join-command.sh from the control-plane node), then run as root:
+#
+#   kubeadm join <CONTROL_PLANE_IP>:{spec.control_plane.port} \\
+#     --token <token> --discovery-token-ca-cert-hash sha256:<hash>
+#
+set -euxo pipefail
+if [ $# -lt 1 ]; then
+  echo "usage: $0 <join-command...>" >&2
+  exit 2
+fi
+"$@"
+"""
+
+
+def render_smoke_check(spec: ClusterSpec) -> str:
+    """Phase 2.5 verification (reference README.md:77-82) as a script."""
+    return """#!/usr/bin/env bash
+# Cluster smoke check (Phase 2.5 / BASELINE config 1)
+set -euo pipefail
+kubectl get nodes -o wide
+kubectl get pods -n kube-system
+NOT_READY=$(kubectl get nodes --no-headers | awk '$2 != "Ready" {print $1}')
+if [ -n "$NOT_READY" ]; then
+  echo "NOT READY: $NOT_READY" >&2
+  exit 1
+fi
+echo "cluster smoke check: OK"
+"""

@@ -1,0 +1,351 @@
+"""Render the TPU operand manifests (tier 3 of the config system).
+
+Capability-parity with the reference's Helm ``--set`` surface (reference
+README.md:104-110): each operand has an enable switch, and the rendered set
+mirrors the five GPU Operator operands (reference README.md:195-213):
+
+  libtpuPrep          ~ nvidia-driver-daemonset      (README.md:104, 212)
+  devicePlugin        ~ nvidia-device-plugin         (README.md:106, 211)
+  featureDiscovery    ~ gpu-feature-discovery        (README.md:108, 209)
+  metricsExporter     ~ nvidia-dcgm-exporter         (README.md:204, 213)
+  nodeStatusExporter  ~ node-status-exporter         (README.md:107)
+
+There is deliberately **no** container-toolkit analog: the capability the
+toolkit delivers on GPU (containers can see the accelerator, README.md:210) is
+delivered on TPU by the device plugin's Allocate response (device specs, env,
+libtpu mount) — see docs/DELTAS.md.
+
+Rollout order matters (reference README.md:101 ``helm install --wait``; trace
+in SURVEY.md §3.3): ``tpuctl install`` applies these in OPERAND_NAMES order and
+gates each on DaemonSet readiness.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import yaml
+
+from ..spec import ClusterSpec
+
+DEFAULT_IMAGE = "ghcr.io/tpu-native/tpu-stack:0.1.0"
+TPU_PRESENT_LABEL = "google.com/tpu.present"
+KUBELET_DP_DIR = "/var/lib/kubelet/device-plugins"
+METRICS_PORT = 9400
+STATUS_PORT = 9401
+
+
+def _image(spec: ClusterSpec, operand: str) -> str:
+    return spec.tpu.operand(operand).image or DEFAULT_IMAGE
+
+
+def _meta(name: str, spec: ClusterSpec, component: str) -> Dict[str, Any]:
+    return {
+        "name": name,
+        "namespace": spec.tpu.namespace,
+        "labels": {
+            "app.kubernetes.io/name": name,
+            "app.kubernetes.io/part-of": "tpu-stack",
+            "app.kubernetes.io/component": component,
+        },
+    }
+
+
+def _daemonset(spec: ClusterSpec, name: str, component: str,
+               pod_spec: Dict[str, Any]) -> Dict[str, Any]:
+    labels = {"app.kubernetes.io/name": name}
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "DaemonSet",
+        "metadata": _meta(name, spec, component),
+        "spec": {
+            "selector": {"matchLabels": labels},
+            "template": {
+                "metadata": {"labels": dict(labels)},
+                "spec": pod_spec,
+            },
+        },
+    }
+
+
+def _tpu_node_selector() -> Dict[str, str]:
+    return {TPU_PRESENT_LABEL: "true"}
+
+
+def namespace(spec: ClusterSpec) -> Dict[str, Any]:
+    return {
+        "apiVersion": "v1",
+        "kind": "Namespace",
+        "metadata": {"name": spec.tpu.namespace,
+                     "labels": {"app.kubernetes.io/part-of": "tpu-stack"}},
+    }
+
+
+def libtpu_prep(spec: ClusterSpec) -> Dict[str, Any]:
+    """Host-prep DaemonSet — the driver-daemonset analog.
+
+    Unlike nvidia-driver-daemonset (reference README.md:212) there is no kernel
+    module to build: TPU VM images ship the driver. The operand (a) verifies the
+    device nodes exist, (b) stages libtpu.so onto a hostPath for workload pods,
+    (c) runs the native `tpu-info` probe (the nvidia-smi analog,
+    README.md:152-168) and exposes its result as pod readiness.
+    """
+    glob = spec.tpu.device_glob
+    lib = spec.tpu.libtpu_host_path
+    # CPU-only nodes (control plane) are expected on this DaemonSet — it has
+    # no nodeSelector because feature discovery hasn't labeled anything yet.
+    # They must no-op cleanly (exit 0, marker file), not crash-loop, or the
+    # gated rollout would deadlock on the first group.
+    prep_script = (
+        "set -eu\n"
+        f"if ! ls {glob} >/dev/null 2>&1; then\n"
+        f"  echo 'no TPU device nodes ({glob}); marking node non-TPU'\n"
+        "  touch /shared/no-tpu; exit 0\n"
+        "fi\n"
+        f"mkdir -p $(dirname /host{lib})\n"
+        "SRC=$(ls /usr/lib/libtpu.so /opt/libtpu/libtpu.so "
+        "/usr/local/lib/python*/dist-packages/libtpu/libtpu.so 2>/dev/null | head -1 || true)\n"
+        f"if [ -n \"$SRC\" ]; then cp -f \"$SRC\" /host{lib}; "
+        f"echo staged $SRC to {lib}; else echo 'libtpu.so not bundled; assuming host install'; fi\n"
+        "tpu-info --oneline\n"
+    )
+    pod: Dict[str, Any] = {
+        "priorityClassName": "system-node-critical",
+        "initContainers": [{
+            "name": "tpu-host-prep",
+            "image": _image(spec, "libtpuPrep"),
+            "command": ["/bin/sh", "-c", prep_script],
+            "securityContext": {"privileged": True},
+            "volumeMounts": [
+                {"name": "dev", "mountPath": "/dev"},
+                {"name": "shared", "mountPath": "/shared"},
+                {"name": "host-lib", "mountPath": f"/host{lib.rsplit('/', 1)[0]}"},
+            ],
+        }],
+        "containers": [{
+            "name": "tpu-host-ready",
+            "image": _image(spec, "libtpuPrep"),
+            # Stays alive as the readiness signal the next operand gates on
+            # (SURVEY.md §3.3 ordered rollout). Non-TPU nodes are Ready
+            # immediately via the marker the init container left.
+            "command": ["/bin/sh", "-c", "exec sleep infinity"],
+            "readinessProbe": {
+                "exec": {"command": [
+                    "/bin/sh", "-c",
+                    "test -f /shared/no-tpu || tpu-info --oneline"]},
+                "periodSeconds": 30,
+            },
+            "volumeMounts": [
+                {"name": "dev", "mountPath": "/dev"},
+                {"name": "shared", "mountPath": "/shared"},
+            ],
+            "securityContext": {"privileged": True},
+        }],
+        "volumes": [
+            {"name": "dev", "hostPath": {"path": "/dev"}},
+            {"name": "shared", "emptyDir": {}},
+            {"name": "host-lib",
+             "hostPath": {"path": lib.rsplit("/", 1)[0],
+                          "type": "DirectoryOrCreate"}},
+        ],
+        "tolerations": [{"operator": "Exists"}],
+    }
+    return _daemonset(spec, "tpu-libtpu-prep", "host-prep", pod)
+
+
+def device_plugin(spec: ClusterSpec) -> Dict[str, Any]:
+    """tpud DaemonSet — the centerpiece (SURVEY.md §7 step 2).
+
+    Runs on every node; with no TPU device nodes it idles advertising zero
+    devices, so no node selector is needed before feature discovery has
+    labeled anything (bootstrap-order freedom the GPU stack gets from NFD).
+    """
+    acc = spec.tpu.accelerator_type
+    pod: Dict[str, Any] = {
+        "priorityClassName": "system-node-critical",
+        "containers": [{
+            "name": "tpud",
+            "image": _image(spec, "devicePlugin"),
+            "command": ["tpud"],
+            "args": [
+                f"--resource={spec.tpu.resource_name}",
+                f"--accelerator={acc.name}",
+                f"--device-glob={spec.tpu.device_glob}",
+                f"--libtpu-path={spec.tpu.libtpu_host_path}",
+                f"--kubelet-dir={KUBELET_DP_DIR}",
+            ],
+            "securityContext": {"privileged": True},
+            "volumeMounts": [
+                {"name": "device-plugins", "mountPath": KUBELET_DP_DIR},
+                {"name": "dev", "mountPath": "/dev"},
+            ],
+        }],
+        "volumes": [
+            {"name": "device-plugins", "hostPath": {"path": KUBELET_DP_DIR}},
+            {"name": "dev", "hostPath": {"path": "/dev"}},
+        ],
+        "tolerations": [{"operator": "Exists"}],
+    }
+    return _daemonset(spec, "tpu-device-plugin", "device-plugin", pod)
+
+
+def feature_discovery(spec: ClusterSpec) -> List[Dict[str, Any]]:
+    """Label publisher — the gpu-feature-discovery analog (README.md:209).
+
+    Publishes google.com/tpu.present, accelerator type, per-host topology, and
+    chip count (tpu_cluster.discovery.labels computes the set). Needs RBAC to
+    patch its own Node object.
+    """
+    ns = spec.tpu.namespace
+    sa = {
+        "apiVersion": "v1",
+        "kind": "ServiceAccount",
+        "metadata": _meta("tpu-feature-discovery", spec, "feature-discovery"),
+    }
+    role = {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRole",
+        "metadata": {"name": "tpu-feature-discovery"},
+        "rules": [{"apiGroups": [""], "resources": ["nodes"],
+                   "verbs": ["get", "patch", "list"]}],
+    }
+    binding = {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRoleBinding",
+        "metadata": {"name": "tpu-feature-discovery"},
+        "subjects": [{"kind": "ServiceAccount",
+                      "name": "tpu-feature-discovery", "namespace": ns}],
+        "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                    "kind": "ClusterRole", "name": "tpu-feature-discovery"},
+    }
+    pod: Dict[str, Any] = {
+        "serviceAccountName": "tpu-feature-discovery",
+        "containers": [{
+            "name": "tfd",
+            "image": _image(spec, "featureDiscovery"),
+            "command": ["python3", "-m", "tpu_cluster.discovery.labeler"],
+            "args": [f"--accelerator={spec.tpu.accelerator}",
+                     f"--device-glob={spec.tpu.device_glob}",
+                     "--interval=60"],
+            "env": [{"name": "NODE_NAME",
+                     "valueFrom": {"fieldRef": {"fieldPath": "spec.nodeName"}}}],
+            "volumeMounts": [{"name": "dev", "mountPath": "/dev",
+                              "readOnly": True}],
+        }],
+        "volumes": [{"name": "dev", "hostPath": {"path": "/dev"}}],
+        "tolerations": [{"operator": "Exists"}],
+    }
+    ds = _daemonset(spec, "tpu-feature-discovery", "feature-discovery", pod)
+    return [sa, role, binding, ds]
+
+
+def metrics_exporter(spec: ClusterSpec) -> List[Dict[str, Any]]:
+    """tpu-metrics-exporter DaemonSet + Service — dcgm-exporter analog
+    (reference README.md:204,213). Native C++ collector (native/exporter)."""
+    port = int(spec.tpu.operand("metricsExporter").extra.get("port", METRICS_PORT))
+    pod: Dict[str, Any] = {
+        "nodeSelector": _tpu_node_selector(),
+        "containers": [{
+            "name": "exporter",
+            "image": _image(spec, "metricsExporter"),
+            "command": ["tpu-metrics-exporter"],
+            "args": [f"--port={port}",
+                     f"--device-glob={spec.tpu.device_glob}",
+                     f"--accelerator={spec.tpu.accelerator}"],
+            "ports": [{"name": "metrics", "containerPort": port}],
+            "volumeMounts": [
+                {"name": "dev", "mountPath": "/dev", "readOnly": True},
+                {"name": "runtime-metrics", "mountPath": "/run/tpu",
+                 "readOnly": True},
+            ],
+        }],
+        "volumes": [
+            {"name": "dev", "hostPath": {"path": "/dev"}},
+            {"name": "runtime-metrics",
+             "hostPath": {"path": "/run/tpu", "type": "DirectoryOrCreate"}},
+        ],
+        "tolerations": [{"operator": "Exists"}],
+    }
+    ds = _daemonset(spec, "tpu-metrics-exporter", "metrics", pod)
+    svc = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {**_meta("tpu-metrics-exporter", spec, "metrics"),
+                     "annotations": {"prometheus.io/scrape": "true",
+                                     "prometheus.io/port": str(port)}},
+        "spec": {
+            "selector": {"app.kubernetes.io/name": "tpu-metrics-exporter"},
+            "ports": [{"name": "metrics", "port": port, "targetPort": port}],
+            "clusterIP": "None",
+        },
+    }
+    return [ds, svc]
+
+
+def node_status_exporter(spec: ClusterSpec) -> Dict[str, Any]:
+    """Per-node TPU-stack health — node-status-exporter analog (README.md:107).
+
+    Serves /healthz + /status (JSON) + /metrics: libtpu staged?, plugin socket
+    registered?, chip count == expected for the accelerator type.
+    """
+    acc = spec.tpu.accelerator_type
+    lib_dir = spec.tpu.libtpu_host_path.rsplit("/", 1)[0]
+    pod: Dict[str, Any] = {
+        "nodeSelector": _tpu_node_selector(),
+        "containers": [{
+            "name": "status",
+            "image": _image(spec, "nodeStatusExporter"),
+            "command": ["tpu-metrics-exporter"],
+            "args": ["--status-mode",
+                     f"--port={STATUS_PORT}",
+                     f"--device-glob={spec.tpu.device_glob}",
+                     f"--accelerator={acc.name}",
+                     f"--expect-chips={acc.chips_per_host}",
+                     f"--libtpu-path={spec.tpu.libtpu_host_path}",
+                     f"--plugin-socket={KUBELET_DP_DIR}/tpud.sock"],
+            "ports": [{"name": "status", "containerPort": STATUS_PORT}],
+            "volumeMounts": [
+                {"name": "dev", "mountPath": "/dev", "readOnly": True},
+                {"name": "device-plugins", "mountPath": KUBELET_DP_DIR,
+                 "readOnly": True},
+                {"name": "libtpu", "mountPath": lib_dir, "readOnly": True},
+            ],
+        }],
+        "volumes": [
+            {"name": "dev", "hostPath": {"path": "/dev"}},
+            {"name": "device-plugins", "hostPath": {"path": KUBELET_DP_DIR}},
+            {"name": "libtpu", "hostPath": {"path": lib_dir}},
+        ],
+        "tolerations": [{"operator": "Exists"}],
+    }
+    return _daemonset(spec, "tpu-node-status-exporter", "node-status", pod)
+
+
+def render_objects(spec: ClusterSpec) -> List[Dict[str, Any]]:
+    """All enabled operand objects, in rollout (dependency) order."""
+    return [obj for group in rollout_groups(spec) for obj in group]
+
+
+def render_all(spec: ClusterSpec) -> str:
+    return yaml.dump_all(render_objects(spec), sort_keys=False)
+
+
+def rollout_groups(spec: ClusterSpec) -> List[List[Dict[str, Any]]]:
+    """Objects grouped by rollout gate: each group is applied and waited on
+    before the next (helm --wait analog, reference README.md:101)."""
+    t = spec.tpu
+    groups: List[List[Dict[str, Any]]] = [[namespace(spec)]]
+    if t.operand("libtpuPrep").enabled:
+        groups.append([libtpu_prep(spec)])
+    if t.operand("devicePlugin").enabled:
+        groups.append([device_plugin(spec)])
+    if t.operand("featureDiscovery").enabled:
+        groups.append(feature_discovery(spec))
+    tail: List[Dict[str, Any]] = []
+    if t.operand("metricsExporter").enabled:
+        tail.extend(metrics_exporter(spec))
+    if t.operand("nodeStatusExporter").enabled:
+        tail.append(node_status_exporter(spec))
+    if tail:
+        groups.append(tail)
+    return groups

@@ -1,0 +1,94 @@
+"""Render the Phase-1 node-prep script.
+
+Reproduces the reference guide's host preparation (reference README.md:5-36):
+apt baseline, kernel modules ``overlay`` + ``br_netfilter``, the three bridge /
+ip_forward sysctls, and containerd installed with ``SystemdCgroup = true``
+patched into its default config (reference README.md:14-18 — that patch exists
+to prevent the kubelet/containerd cgroup-driver crash-loop, SURVEY.md §3.1).
+"""
+
+from __future__ import annotations
+
+from ..spec import ClusterSpec
+
+KERNEL_MODULES = ("overlay", "br_netfilter")
+SYSCTLS = (
+    ("net.bridge.bridge-nf-call-iptables", "1"),
+    ("net.bridge.bridge-nf-call-ip6tables", "1"),
+    ("net.ipv4.ip_forward", "1"),
+)
+
+
+def render_node_prep(spec: ClusterSpec) -> str:
+    modules = "\n".join(KERNEL_MODULES)
+    sysctls = "\n".join(f"{k} = {v}" for k, v in SYSCTLS)
+    cgroup_patch = ""
+    if spec.containerd_systemd_cgroup:
+        cgroup_patch = """
+# Use the systemd cgroup driver (kubelet default); mismatch causes a
+# kubelet<->containerd crash-loop.
+sed -i 's/SystemdCgroup = false/SystemdCgroup = true/' /etc/containerd/config.toml
+"""
+    return f"""#!/usr/bin/env bash
+# Node preparation (Phase 1) — rendered by tpuctl from cluster-spec
+# '{spec.name}'. Run as root on every node (control plane and workers).
+set -euxo pipefail
+
+# --- 1.1 base packages -------------------------------------------------------
+apt-get update
+apt-get install -y apt-transport-https ca-certificates curl gpg
+
+# --- 1.2 kernel modules + sysctls for bridged pod traffic --------------------
+cat <<'EOF' >/etc/modules-load.d/k8s.conf
+{modules}
+EOF
+modprobe overlay
+modprobe br_netfilter
+
+cat <<'EOF' >/etc/sysctl.d/k8s.conf
+{sysctls}
+EOF
+sysctl --system
+
+# --- 1.3 containerd ----------------------------------------------------------
+apt-get install -y containerd
+mkdir -p /etc/containerd
+containerd config default >/etc/containerd/config.toml
+{cgroup_patch}
+systemctl restart containerd
+systemctl enable containerd
+
+# --- 1.4 TPU host check (driver ships with the TPU VM image; no kernel build,
+# unlike the GPU driver daemonset — see docs/DELTAS.md) -----------------------
+if ls {spec.tpu.device_glob} >/dev/null 2>&1; then
+  echo "TPU device nodes present: $(ls {spec.tpu.device_glob} | tr '\\n' ' ')"
+else
+  echo "NOTE: no TPU device nodes matching {spec.tpu.device_glob} on this host" \\
+       "(fine for control-plane / CPU-only nodes)"
+fi
+"""
+
+
+def render_kubeadm_packages(spec: ClusterSpec) -> str:
+    """Phase 2.1 — pinned kubelet/kubeadm/kubectl from pkgs.k8s.io.
+
+    Mirrors reference README.md:42-48: minor-version-pinned repo plus
+    ``apt-mark hold`` so an unattended upgrade can't skew the cluster.
+    """
+    v = spec.kubernetes_version
+    return f"""#!/usr/bin/env bash
+# Kubernetes packages (Phase 2.1) — rendered by tpuctl. Run as root on every node.
+set -euxo pipefail
+
+mkdir -p /etc/apt/keyrings
+curl -fsSL https://pkgs.k8s.io/core:/stable:/v{v}/deb/Release.key \\
+  | gpg --dearmor -o /etc/apt/keyrings/kubernetes-apt-keyring.gpg
+echo "deb [signed-by=/etc/apt/keyrings/kubernetes-apt-keyring.gpg]" \\
+     "https://pkgs.k8s.io/core:/stable:/v{v}/deb/ /" \\
+  >/etc/apt/sources.list.d/kubernetes.list
+
+apt-get update
+apt-get install -y kubelet kubeadm kubectl
+apt-mark hold kubelet kubeadm kubectl
+systemctl enable kubelet
+"""

@@ -1,0 +1,184 @@
+"""Declarative cluster-spec — the framework's config system.
+
+The reference's configuration is three inline tiers (SURVEY.md §5): host config
+files written by heredoc (reference README.md:16-35), kubeadm CLI flags
+(README.md:54,74), and the Helm ``--set`` operand feature flags
+(README.md:104-110). This module replaces all three with one declarative YAML
+document that renders to:
+
+- tier 1: the node-prep script (render/nodeprep.py),
+- tier 2: kubeadm init/join configuration (render/kubeadm.py),
+- tier 3: the TPU operand manifests with per-operand enable switches
+  (render/manifests.py) — mirroring the reference's
+  driver/toolkit/devicePlugin/gfd/nodeStatusExporter booleans.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+import yaml
+
+from . import topology
+
+DEFAULT_POD_CIDR = "10.244.0.0/16"
+DEFAULT_K8S_VERSION = "1.28"
+DEFAULT_NAMESPACE = "tpu-system"
+DEFAULT_FLANNEL_URL = (
+    "https://github.com/flannel-io/flannel/releases/latest/download/kube-flannel.yml"
+)
+# Cloud metadata endpoints for control-plane address discovery. The reference
+# hardcodes AWS IMDSv1 (README.md:54); we parameterise (SURVEY.md §2.1).
+METADATA_ENDPOINTS = {
+    "aws": ("http://169.254.169.254/latest/meta-data/local-ipv4", ()),
+    "gcp": (
+        "http://metadata.google.internal/computeMetadata/v1/instance/network-interfaces/0/ip",
+        ("Metadata-Flavor: Google",),
+    ),
+}
+
+
+class SpecError(ValueError):
+    pass
+
+
+@dataclass
+class ControlPlaneEndpoint:
+    source: str = "metadata"          # metadata | static
+    cloud: str = "gcp"                # aws | gcp (metadata source only)
+    address: Optional[str] = None     # static source only
+    port: int = 6443
+
+    def validate(self) -> None:
+        if self.source not in ("metadata", "static"):
+            raise SpecError(f"controlPlaneEndpoint.source: {self.source!r}")
+        if self.source == "metadata" and self.cloud not in METADATA_ENDPOINTS:
+            raise SpecError(f"controlPlaneEndpoint.cloud: {self.cloud!r}")
+        if self.source == "static" and not self.address:
+            raise SpecError("controlPlaneEndpoint.address required for static source")
+
+
+@dataclass
+class OperandSpec:
+    enabled: bool = True
+    image: str = ""
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class TpuSpec:
+    accelerator: str = "v5e-8"
+    namespace: str = DEFAULT_NAMESPACE
+    resource_name: str = "google.com/tpu"
+    libtpu_host_path: str = "/var/lib/tpu/libtpu.so"
+    device_glob: str = "/dev/accel*"
+    operands: Dict[str, OperandSpec] = field(default_factory=dict)
+
+    OPERAND_NAMES = (
+        # rollout order — mirrors the reference operator's dependency-ordered,
+        # readiness-gated rollout (reference README.md:101-110, SURVEY.md §3.3)
+        "libtpuPrep",          # ~ nvidia-driver-daemonset
+        "devicePlugin",        # ~ nvidia-device-plugin-daemonset
+        "featureDiscovery",    # ~ gpu-feature-discovery
+        "metricsExporter",     # ~ nvidia-dcgm-exporter
+        "nodeStatusExporter",  # ~ node-status-exporter
+    )
+
+    def validate(self) -> None:
+        topology.get(self.accelerator)  # raises on unknown
+        for name in self.operands:
+            if name not in self.OPERAND_NAMES:
+                raise SpecError(
+                    f"unknown operand {name!r}; known: {list(self.OPERAND_NAMES)}"
+                )
+
+    def operand(self, name: str) -> OperandSpec:
+        if name not in self.OPERAND_NAMES:
+            raise SpecError(f"unknown operand {name!r}")
+        return self.operands.get(name, OperandSpec())
+
+    @property
+    def accelerator_type(self) -> topology.AcceleratorType:
+        return topology.get(self.accelerator)
+
+
+@dataclass
+class ClusterSpec:
+    name: str = "tpu-cluster"
+    kubernetes_version: str = DEFAULT_K8S_VERSION
+    pod_cidr: str = DEFAULT_POD_CIDR
+    control_plane: ControlPlaneEndpoint = field(default_factory=ControlPlaneEndpoint)
+    cni_manifest_url: str = DEFAULT_FLANNEL_URL
+    containerd_systemd_cgroup: bool = True
+    tpu: TpuSpec = field(default_factory=TpuSpec)
+
+    def validate(self) -> "ClusterSpec":
+        if not self.name:
+            raise SpecError("cluster name must be non-empty")
+        parts = self.pod_cidr.split("/")
+        if len(parts) != 2 or not parts[1].isdigit():
+            raise SpecError(f"podCIDR {self.pod_cidr!r} is not a CIDR")
+        self.control_plane.validate()
+        self.tpu.validate()
+        return self
+
+
+def _build(cls, data: Dict[str, Any], path: str):
+    """Construct dataclass ``cls`` from a camelCase-keyed mapping."""
+    if not isinstance(data, dict):
+        raise SpecError(f"{path}: expected mapping, got {type(data).__name__}")
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    def snake(k: str) -> str:
+        # camelCase and acronym spellings both normalise: podCidr and the
+        # Kubernetes-canonical podCIDR -> pod_cidr.
+        import re
+        s = re.sub(r"(?<=[a-z0-9])(?=[A-Z])|(?<=[A-Z])(?=[A-Z][a-z])", "_", k)
+        return s.lower()
+    kwargs = {}
+    for key, value in data.items():
+        name = snake(key)
+        if name not in fields:
+            raise SpecError(f"{path}: unknown field {key!r}")
+        kwargs[name] = value
+    return cls(**kwargs)
+
+
+def load(text: str) -> ClusterSpec:
+    doc = yaml.safe_load(text) or {}
+    if not isinstance(doc, dict):
+        raise SpecError("spec must be a YAML mapping")
+    cluster = dict(doc.get("cluster") or {})
+    cp = _build(ControlPlaneEndpoint, cluster.pop("controlPlaneEndpoint", None) or {},
+                "cluster.controlPlaneEndpoint")
+    spec = _build(ClusterSpec, cluster, "cluster")
+    spec.control_plane = cp
+
+    tpu_doc = dict(doc.get("tpu") or {})
+    operands_doc = tpu_doc.pop("operands", {})
+    tpu = _build(TpuSpec, tpu_doc, "tpu")
+    operands = {}
+    for name, od in (operands_doc or {}).items():
+        od = dict(od or {})
+        operands[name] = OperandSpec(
+            enabled=bool(od.pop("enabled", True)),
+            image=str(od.pop("image", "")),
+            extra=od,
+        )
+    tpu.operands = operands
+    spec.tpu = tpu
+
+    extra_top = set(doc) - {"cluster", "tpu"}
+    if extra_top:
+        raise SpecError(f"unknown top-level keys: {sorted(extra_top)}")
+    return spec.validate()
+
+
+def load_file(path: str) -> ClusterSpec:
+    with open(path, "r", encoding="utf-8") as f:
+        return load(f.read())
+
+
+def default_spec() -> ClusterSpec:
+    return ClusterSpec().validate()

@@ -1,0 +1,218 @@
+"""TPU topology model and topology-aligned allocation policy.
+
+The reference's device plugin (nvidia-device-plugin, reference README.md:106,211)
+advertises a flat count of interchangeable GPUs. TPU chips are NOT
+interchangeable: the chips on a host form an ICI mesh, and a workload that is
+handed an arbitrary subset of chips gets a disconnected (or rectangle-less)
+mesh that XLA cannot lay collectives onto efficiently. This module is the
+single source of truth for:
+
+- the supported accelerator types and their per-host chip topology,
+- which request sizes are *aligned* (allowed) for each type — mirroring the
+  GKE rule that ``google.com/tpu`` requests on v5e must be 1, 4, or 8, and
+- which concrete chip subsets form a valid sub-mesh for an aligned size.
+
+The native C++ plugin (native/plugin/topology.cc) implements the identical
+policy; tests/data/topology_golden.json pins both implementations to the same
+golden vectors so they cannot drift apart.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+@dataclass(frozen=True)
+class AcceleratorType:
+    """One per-host TPU configuration.
+
+    ``topology`` is the per-host chip grid (x, y); ``aligned_sizes`` the
+    request sizes the device plugin will honour; ``sub_mesh_shapes`` maps an
+    aligned size to the rectangle of chips that realises it.
+    """
+
+    name: str                      # e.g. "v5e-8" (accelerator type selector)
+    generation: str                # e.g. "v5e"
+    chips_per_host: int
+    topology: Tuple[int, int]      # per-host chip grid, e.g. (2, 4)
+    hbm_gib_per_chip: int
+    aligned_sizes: Tuple[int, ...]
+    sub_mesh_shapes: Dict[int, Tuple[int, int]] = field(default_factory=dict)
+    peak_bf16_tflops: float = 0.0  # per-chip, for bench reporting
+
+    def label_topology(self) -> str:
+        return f"{self.topology[0]}x{self.topology[1]}"
+
+
+# Per-host accelerator catalogue. Only per-host shapes matter to the device
+# plugin (multi-host slices are composed of per-host groups over DCN; see
+# workloads/multihost.py).
+ACCELERATOR_TYPES: Dict[str, AcceleratorType] = {}
+
+
+def _register(t: AcceleratorType) -> AcceleratorType:
+    ACCELERATOR_TYPES[t.name] = t
+    return t
+
+
+V5E_8 = _register(AcceleratorType(
+    name="v5e-8", generation="v5e", chips_per_host=8, topology=(2, 4),
+    hbm_gib_per_chip=16, aligned_sizes=(1, 4, 8),
+    sub_mesh_shapes={1: (1, 1), 4: (2, 2), 8: (2, 4)},
+    peak_bf16_tflops=197.0,
+))
+
+V5E_4 = _register(AcceleratorType(
+    name="v5e-4", generation="v5e", chips_per_host=4, topology=(2, 2),
+    hbm_gib_per_chip=16, aligned_sizes=(1, 4),
+    sub_mesh_shapes={1: (1, 1), 4: (2, 2)},
+    peak_bf16_tflops=197.0,
+))
+
+V5E_1 = _register(AcceleratorType(
+    name="v5e-1", generation="v5e", chips_per_host=1, topology=(1, 1),
+    hbm_gib_per_chip=16, aligned_sizes=(1,),
+    sub_mesh_shapes={1: (1, 1)},
+    peak_bf16_tflops=197.0,
+))
+
+V4_8 = _register(AcceleratorType(
+    name="v4-8", generation="v4", chips_per_host=4, topology=(2, 2),
+    hbm_gib_per_chip=32, aligned_sizes=(4,),   # v4 allocates whole hosts
+    sub_mesh_shapes={4: (2, 2)},
+    peak_bf16_tflops=275.0,
+))
+
+V5P_8 = _register(AcceleratorType(
+    name="v5p-8", generation="v5p", chips_per_host=4, topology=(2, 2),
+    hbm_gib_per_chip=95, aligned_sizes=(4,),
+    sub_mesh_shapes={4: (2, 2)},
+    peak_bf16_tflops=459.0,
+))
+
+V6E_8 = _register(AcceleratorType(
+    name="v6e-8", generation="v6e", chips_per_host=8, topology=(2, 4),
+    hbm_gib_per_chip=32, aligned_sizes=(1, 4, 8),
+    sub_mesh_shapes={1: (1, 1), 4: (2, 2), 8: (2, 4)},
+    peak_bf16_tflops=918.0,
+))
+
+
+def get(name: str) -> AcceleratorType:
+    try:
+        return ACCELERATOR_TYPES[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown accelerator type {name!r}; known: {sorted(ACCELERATOR_TYPES)}"
+        ) from None
+
+
+def chip_coords(acc: AcceleratorType) -> List[Tuple[int, int]]:
+    """Chip id -> (x, y) coordinate, row-major over the per-host grid.
+
+    Chip ids follow device-node order (/dev/accel0..N-1): id = y * X + x for
+    topology (X, Y). The C++ plugin uses the same mapping.
+    """
+    xdim, ydim = acc.topology
+    return [(i % xdim, i // xdim) for i in range(acc.chips_per_host)]
+
+
+def aligned_subsets(acc: AcceleratorType, size: int) -> List[Tuple[int, ...]]:
+    """All chip-id subsets of ``size`` that form a valid ICI sub-mesh.
+
+    A valid subset is an axis-aligned rectangle of the shape registered in
+    ``sub_mesh_shapes`` (in either orientation). Returned sorted, each subset
+    sorted, for deterministic golden tests.
+    """
+    if size not in acc.aligned_sizes:
+        return []
+    shape = acc.sub_mesh_shapes[size]
+    coords = chip_coords(acc)
+    coord_to_id = {c: i for i, c in enumerate(coords)}
+    xdim, ydim = acc.topology
+    out = set()
+    for (w, h) in {shape, shape[::-1]}:
+        if w > xdim or h > ydim:
+            continue
+        for x0 in range(xdim - w + 1):
+            for y0 in range(ydim - h + 1):
+                ids = tuple(sorted(
+                    coord_to_id[(x0 + dx, y0 + dy)]
+                    for dx in range(w) for dy in range(h)
+                ))
+                out.add(ids)
+    return sorted(out)
+
+
+@dataclass
+class AllocationResult:
+    device_ids: Tuple[int, ...]
+    reason: str = ""
+
+
+def preferred_allocation(
+    acc: AcceleratorType,
+    available: Sequence[int],
+    must_include: Sequence[int],
+    size: int,
+) -> Optional[AllocationResult]:
+    """Pick an aligned chip set: the GetPreferredAllocation policy.
+
+    Mirrors the kubelet DevicePlugin ``GetPreferredAllocation`` contract: pick
+    ``size`` devices from ``available``, including all of ``must_include``.
+    Preference order:
+
+    1. exact aligned sub-mesh fully available and covering must_include,
+       ties broken by lowest chip ids (deterministic),
+    2. otherwise None — the caller (kubelet) falls back to its own pick, and
+       ``validate_allocation`` will reject genuinely unaligned final sets.
+    """
+    avail = set(available)
+    must = set(must_include)
+    if not must <= avail or size < len(must):
+        return None
+    for subset in aligned_subsets(acc, size):
+        s = set(subset)
+        if must <= s and s <= avail:
+            return AllocationResult(device_ids=subset, reason="aligned sub-mesh")
+    return None
+
+
+def validate_allocation(acc: AcceleratorType, device_ids: Sequence[int]) -> Tuple[bool, str]:
+    """Admission check for a final Allocate() device set.
+
+    Returns (ok, reason). Unaligned sizes are rejected outright; aligned sizes
+    with a non-rectangular chip set are rejected with a message naming the
+    nearest valid subsets (surfaced in the pod event by kubelet).
+    """
+    ids = tuple(sorted(device_ids))
+    n = len(ids)
+    if n not in acc.aligned_sizes:
+        return False, (
+            f"request size {n} is not aligned for {acc.name}; "
+            f"allowed sizes: {list(acc.aligned_sizes)}"
+        )
+    if any(i < 0 or i >= acc.chips_per_host for i in ids):
+        return False, f"device ids {ids} out of range for {acc.name}"
+    if len(set(ids)) != n:
+        return False, f"duplicate device ids in {ids}"
+    if ids in aligned_subsets(acc, n):
+        return True, "aligned sub-mesh"
+    return False, (
+        f"device set {ids} is not an ICI-contiguous sub-mesh of {acc.name} "
+        f"({acc.label_topology()}); valid sets of size {n}: "
+        f"{aligned_subsets(acc, n)}"
+    )
+
+
+def all_validation_cases(acc: AcceleratorType) -> List[Dict]:
+    """Exhaustive (size<=chips) validate_allocation cases for golden tests."""
+    cases = []
+    ids = range(acc.chips_per_host)
+    for n in range(1, acc.chips_per_host + 1):
+        for combo in itertools.combinations(ids, n):
+            ok, _ = validate_allocation(acc, combo)
+            cases.append({"ids": list(combo), "ok": ok})
+    return cases

@@ -1,0 +1,9 @@
+"""JAX/XLA validation and burn-in workloads.
+
+These replace the reference's validation workloads (SURVEY.md §2.3):
+  nvidia-smi exec            -> smoke.device_report()      (BASELINE config 2)
+  cuda-vector-add sample     -> smoke.vector_add()         (BASELINE config 3)
+  (matmul smoke)             -> smoke.matmul()
+  2-node NCCL all-reduce     -> collectives.psum_check()   (BASELINE config 5)
+  (burn-in, bench, dry-run)  -> burnin train step over a Mesh
+"""

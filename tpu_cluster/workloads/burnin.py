@@ -1,0 +1,192 @@
+"""Cluster burn-in / acceptance training workload.
+
+The stack's flagship compute workload: a small transformer-block model with a
+jitted training step laid out over a ``jax.sharding.Mesh`` with data- and
+model-parallel axes. It exists to prove, end-to-end, that a pod handed an
+aligned chip set by the device plugin can (a) initialise JAX over those chips,
+(b) run MXU-bound compute, and (c) exercise ICI with real collectives — the
+same role the reference's cuda-vector-add + NCCL test Jobs play
+(BASELINE.json configs 3 & 5), at training-step realism.
+
+TPU-first design notes: parameters are sharded over the ``model`` axis and the
+batch over ``data`` via NamedSharding annotations; XLA inserts the
+all-reduces/all-gathers (no hand-written collectives, SURVEY.md §2.4). Shapes
+are static; the step is one ``jit``.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class BurninConfig:
+    vocab: int = 256
+    d_model: int = 128
+    d_ff: int = 512
+    n_heads: int = 4
+    seq: int = 64
+    batch: int = 8
+    lr: float = 1e-3
+
+    def scaled(self, factor: int) -> "BurninConfig":
+        return BurninConfig(
+            vocab=self.vocab, d_model=self.d_model * factor,
+            d_ff=self.d_ff * factor, n_heads=self.n_heads,
+            seq=self.seq, batch=self.batch, lr=self.lr,
+        )
+
+
+def init_params(cfg: BurninConfig, key) -> Dict[str, Any]:
+    ks = jax.random.split(key, 8)
+    d, f, h = cfg.d_model, cfg.d_ff, cfg.n_heads
+
+    def norm(k, shape, scale):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(jnp.float32)
+
+    return {
+        "embed": norm(ks[0], (cfg.vocab, d), 0.02),
+        "wq": norm(ks[1], (d, d), d ** -0.5),
+        "wk": norm(ks[2], (d, d), d ** -0.5),
+        "wv": norm(ks[3], (d, d), d ** -0.5),
+        "wo": norm(ks[4], (d, d), d ** -0.5),
+        "w1": norm(ks[5], (d, f), d ** -0.5),
+        "w2": norm(ks[6], (f, d), f ** -0.5),
+        "out": norm(ks[7], (d, cfg.vocab), d ** -0.5),
+    }
+
+
+def param_specs() -> Dict[str, P]:
+    """Megatron-style TP layout: attention/FFN first matmul column-sharded,
+    second row-sharded over the 'model' axis; embeddings vocab-sharded."""
+    return {
+        "embed": P("model", None),
+        "wq": P(None, "model"),
+        "wk": P(None, "model"),
+        "wv": P(None, "model"),
+        "wo": P("model", None),
+        "w1": P(None, "model"),
+        "w2": P("model", None),
+        "out": P(None, "model"),
+    }
+
+
+def forward(params: Dict[str, Any], tokens: jnp.ndarray,
+            cfg: BurninConfig) -> jnp.ndarray:
+    """One pre-norm transformer block + LM head, bf16 compute / f32 params."""
+    x = params["embed"].astype(jnp.bfloat16)[tokens]       # [B, S, D]
+    h = cfg.n_heads
+    d_head = cfg.d_model // h
+
+    def rms(v):
+        return v * jax.lax.rsqrt(
+            jnp.mean(jnp.square(v.astype(jnp.float32)), -1, keepdims=True) + 1e-6
+        ).astype(v.dtype)
+
+    y = rms(x)
+    q = (y @ params["wq"].astype(jnp.bfloat16)).reshape(*y.shape[:2], h, d_head)
+    k = (y @ params["wk"].astype(jnp.bfloat16)).reshape(*y.shape[:2], h, d_head)
+    v = (y @ params["wv"].astype(jnp.bfloat16)).reshape(*y.shape[:2], h, d_head)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d_head)
+    mask = jnp.tril(jnp.ones((y.shape[1], y.shape[1]), bool))
+    logits = jnp.where(mask, logits.astype(jnp.float32), -1e30)
+    attn = jax.nn.softmax(logits, axis=-1).astype(jnp.bfloat16)
+    o = jnp.einsum("bhqk,bkhd->bqhd", attn, v).reshape(y.shape)
+    x = x + o @ params["wo"].astype(jnp.bfloat16)
+    y = rms(x)
+    ff = jax.nn.gelu(y @ params["w1"].astype(jnp.bfloat16))
+    x = x + ff @ params["w2"].astype(jnp.bfloat16)
+    return (rms(x) @ params["out"].astype(jnp.bfloat16)).astype(jnp.float32)
+
+
+def loss_fn(params, batch, cfg: BurninConfig):
+    tokens, targets = batch
+    logits = forward(params, tokens, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return jnp.mean(nll)
+
+
+def train_step(params, batch, cfg: BurninConfig):
+    loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg)
+    new_params = jax.tree.map(lambda p, g: p - cfg.lr * g, params, grads)
+    return new_params, loss
+
+
+def make_mesh(shape: Tuple[int, int], devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    dp, tp = shape
+    if dp * tp > len(devices):
+        raise ValueError(f"mesh {shape} needs {dp*tp} devices, have {len(devices)}")
+    return Mesh(np.array(devices[: dp * tp]).reshape(dp, tp), ("data", "model"))
+
+
+def default_mesh_shape(n: int) -> Tuple[int, int]:
+    """DP x TP factorisation: prefer TP up to 4 (rides ICI within a host
+    quadrant on v5e), DP with the rest."""
+    for tp in (4, 2, 1):
+        if n % tp == 0 and tp <= n:
+            return (n // tp, tp)
+    return (n, 1)
+
+
+def make_sharded_step(mesh: Mesh, cfg: BurninConfig):
+    """Returns (step_fn, params, batch) with params sharded over 'model' and
+    batch over 'data'; step jitted with explicit out_shardings so updated
+    params stay put (no host round-trips between steps)."""
+    pspecs = param_specs()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    params = {
+        k: jax.device_put(v, NamedSharding(mesh, pspecs[k]))
+        for k, v in params.items()
+    }
+    batch_spec = NamedSharding(mesh, P("data", None))
+    key = jax.random.PRNGKey(1)
+    tokens = jax.random.randint(key, (cfg.batch, cfg.seq), 0, cfg.vocab)
+    targets = jnp.roll(tokens, -1, axis=1)
+    batch = (jax.device_put(tokens, batch_spec),
+             jax.device_put(targets, batch_spec))
+
+    out_shardings = (
+        {k: NamedSharding(mesh, pspecs[k]) for k in params},
+        NamedSharding(mesh, P()),
+    )
+    step = jax.jit(
+        lambda p, b: train_step(p, b, cfg),
+        out_shardings=out_shardings,
+        donate_argnums=(0,),
+    )
+    return step, params, batch
+
+
+def run(mesh_shape: Tuple[int, int] = None, steps: int = 5,
+        cfg: BurninConfig = BurninConfig()) -> Dict[str, Any]:
+    n = jax.device_count()
+    shape = mesh_shape or default_mesh_shape(n)
+    mesh = make_mesh(shape)
+    step, params, batch = make_sharded_step(mesh, cfg)
+    losses = []
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, loss = step(params, batch)
+        losses.append(float(loss))
+    dt = time.perf_counter() - t0
+    decreasing = losses[-1] < losses[0]
+    return {
+        "check": "burnin", "mesh": {"data": shape[0], "model": shape[1]},
+        "steps": steps, "losses": [round(l, 4) for l in losses],
+        "seconds": dt, "loss_decreasing": bool(decreasing),
+        "ok": bool(decreasing and np.isfinite(losses).all()),
+    }
+
+
+if __name__ == "__main__":
+    import json
+    print(json.dumps(run(), indent=2))

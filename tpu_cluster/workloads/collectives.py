@@ -1,0 +1,137 @@
+"""Collective validation over the device mesh — NCCL all-reduce test analog.
+
+BASELINE.json config 5 maps the reference stack's implied "2-node NCCL
+all-reduce test" to ``jax.lax.psum`` over ICI on a v5e-8 (and over DCN for the
+2-node case via workloads.multihost). Per SURVEY.md §2.4 the framework does
+NOT implement collectives — XLA does — its job is to lay the computation out on
+a Mesh so the collective rides ICI, and to verify correctness + measure
+bandwidth.
+
+Clusterless testing: run under ``XLA_FLAGS=--xla_force_host_platform_device_count=8
+JAX_PLATFORMS=cpu`` (SURVEY.md §4 point 5); the same code path runs on real
+chips unchanged.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_devices: int = 0, axis: str = "chips") -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if n > len(devs):
+        raise ValueError(f"requested {n} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:n]), (axis,))
+
+
+def psum_check(n_devices: int = 0, elems_per_device: int = 1 << 16) -> Dict[str, Any]:
+    """All-reduce correctness: each device contributes its index; psum must
+    yield sum(range(n)) everywhere. shard_map + lax.psum => XLA emits a true
+    all-reduce over the mesh axis (ICI on TPU)."""
+    mesh = make_mesh(n_devices)
+    n = mesh.devices.size
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P("chips"),
+             out_specs=P("chips"))
+    def allreduce(x):
+        return jax.lax.psum(x, "chips")
+
+    x = jnp.broadcast_to(
+        jnp.arange(n, dtype=jnp.float32)[:, None], (n, elems_per_device)
+    )
+    x = jax.device_put(x, NamedSharding(mesh, P("chips")))
+    out = jax.jit(allreduce)(x)
+    expect = float(n * (n - 1) / 2)
+    ok = bool(jnp.all(out == expect))
+    return {"check": "psum", "devices": n, "expected": expect, "ok": ok}
+
+
+def allreduce_bandwidth(n_devices: int = 0, mib: int = 64,
+                        iters: int = 10) -> Dict[str, Any]:
+    """Measured all-reduce bus bandwidth per device (NCCL-tests busbw analog):
+    busbw = 2*(n-1)/n * bytes / time."""
+    mesh = make_mesh(n_devices)
+    n = mesh.devices.size
+    per_dev = mib * 1024 * 1024 // 4
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P("chips"),
+             out_specs=P("chips"))
+    def allreduce(x):
+        return jax.lax.psum(x, "chips")
+
+    x = jax.device_put(
+        jnp.ones((n, per_dev), jnp.float32),
+        NamedSharding(mesh, P("chips")),
+    )
+    f = jax.jit(allreduce)
+    f(x).block_until_ready()
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = f(x)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    bytes_per_iter = per_dev * 4
+    busbw = (2 * (n - 1) / max(n, 1)) * bytes_per_iter * iters / dt
+    return {"check": "allreduce_bw", "devices": n, "mib": mib,
+            "seconds": dt, "busbw_gib_s": busbw / 2**30, "ok": True}
+
+
+def collective_matrix(n_devices: int = 0) -> Dict[str, Any]:
+    """Exercise the full collective family the stack must support: psum,
+    all_gather, reduce_scatter (psum_scatter), ppermute — the XLA analogs of
+    the NCCL op set."""
+    mesh = make_mesh(n_devices)
+    n = mesh.devices.size
+    spec = P("chips")
+
+    def shard(arr):
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+
+    results: Dict[str, Any] = {"devices": n}
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=spec, out_specs=spec)
+    def ag(x):
+        return jax.lax.all_gather(x, "chips").reshape(1, -1)
+
+    x = shard(jnp.arange(n, dtype=jnp.float32)[:, None])
+    out = jax.jit(ag)(x)
+    results["all_gather_ok"] = bool(
+        jnp.all(out == jnp.broadcast_to(jnp.arange(n, dtype=jnp.float32), (n, n)))
+    )
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=spec, out_specs=spec)
+    def rs(x):
+        # per-shard x is (1, n); scatter the length-n axis across chips
+        return jax.lax.psum_scatter(x, "chips", scatter_dimension=1, tiled=True)
+
+    x2 = shard(jnp.ones((n, n), jnp.float32))
+    out2 = jax.jit(rs)(x2)
+    results["reduce_scatter_ok"] = bool(jnp.all(out2 == float(n)))
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=spec, out_specs=spec)
+    def rotate(x):
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        return jax.lax.ppermute(x, "chips", perm)
+
+    x3 = shard(jnp.arange(n, dtype=jnp.float32)[:, None])
+    out3 = jax.jit(rotate)(x3)
+    expect3 = jnp.roll(jnp.arange(n, dtype=jnp.float32), 1)[:, None]
+    results["ppermute_ok"] = bool(jnp.all(out3 == expect3))
+
+    results["psum_ok"] = psum_check(n)["ok"]
+    results["ok"] = all(v for k, v in results.items() if k.endswith("_ok"))
+    return results
+
+
+if __name__ == "__main__":
+    import json
+    print(json.dumps(collective_matrix(), indent=2))

@@ -1,0 +1,81 @@
+"""Multi-host (DCN) bootstrap plumbing for JAX Jobs.
+
+The reference stack's multi-node story is NCCL over the pod network; the TPU
+equivalent (SURVEY.md §2.4, §5) is ``jax.distributed.initialize`` with a
+coordinator address reachable over the CNI (Flannel) network, after which XLA
+runs collectives over ICI within a host and DCN across hosts.
+
+The device plugin's Allocate response and the Job manifest together provide the
+env this module consumes — the deliverable called out in SURVEY.md §2.4(b):
+
+  TPU_WORKER_ID        index of this pod within the Job (0..N-1)
+  TPU_WORKER_HOSTNAMES comma-separated pod DNS names (headless Service)
+  TPU_COORDINATOR_PORT coordinator port (default 8476)
+
+On a Kubernetes Job with completionMode=Indexed, TPU_WORKER_ID maps 1:1 to
+JOB_COMPLETION_INDEX, and the headless Service gives each pod the stable DNS
+name the coordinator address needs.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+DEFAULT_COORDINATOR_PORT = 8476
+
+
+def bootstrap_env(worker_id: int, hostnames: list, port: int = DEFAULT_COORDINATOR_PORT) -> Dict[str, str]:
+    """The env block a multi-host Job manifest injects per pod (rendered by
+    deploy/jobs; mirrored here for tests)."""
+    return {
+        "TPU_WORKER_ID": str(worker_id),
+        "TPU_WORKER_HOSTNAMES": ",".join(hostnames),
+        "TPU_COORDINATOR_PORT": str(port),
+    }
+
+
+def coordinator_address(env: Optional[Dict[str, str]] = None) -> str:
+    env = dict(os.environ if env is None else env)
+    hosts = env.get("TPU_WORKER_HOSTNAMES", "").split(",")
+    if not hosts or not hosts[0]:
+        raise RuntimeError("TPU_WORKER_HOSTNAMES not set; not a multi-host Job?")
+    port = env.get("TPU_COORDINATOR_PORT", str(DEFAULT_COORDINATOR_PORT))
+    return f"{hosts[0]}:{port}"
+
+
+def plan(env: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+    """Resolve the jax.distributed.initialize arguments without side effects
+    (testable clusterless)."""
+    env = dict(os.environ if env is None else env)
+    if "TPU_WORKER_ID" not in env and "JOB_COMPLETION_INDEX" in env:
+        env["TPU_WORKER_ID"] = env["JOB_COMPLETION_INDEX"]
+    hosts = [h for h in env.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
+    if not hosts:
+        return {"multihost": False, "num_processes": 1, "process_id": 0}
+    if "TPU_WORKER_ID" not in env:
+        raise RuntimeError(
+            "TPU_WORKER_HOSTNAMES is set but neither TPU_WORKER_ID nor "
+            "JOB_COMPLETION_INDEX is — is the Job missing "
+            "completionMode: Indexed?"
+        )
+    return {
+        "multihost": True,
+        "coordinator_address": coordinator_address(env),
+        "num_processes": len(hosts),
+        "process_id": int(env["TPU_WORKER_ID"]),
+    }
+
+
+def initialize(env: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+    """Call jax.distributed.initialize per the resolved plan (no-op for
+    single-host Jobs). Must run before any other JAX call in the pod."""
+    p = plan(env)
+    if p["multihost"]:
+        import jax
+        jax.distributed.initialize(
+            coordinator_address=p["coordinator_address"],
+            num_processes=p["num_processes"],
+            process_id=p["process_id"],
+        )
+    return p

@@ -1,0 +1,107 @@
+"""Single-chip smoke workloads — nvidia-smi / cuda-vector-add analogs.
+
+The reference proves the accelerator path works by exec'ing ``nvidia-smi`` in
+the driver pod (reference README.md:152-168) and running a cuda-vector-add
+sample (BASELINE.json config 3). The TPU equivalents below run inside a
+validation Job that requested ``google.com/tpu``; on success their output is
+the golden output the runbook compares against (docs/RUNBOOK.md).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def device_report() -> Dict[str, Any]:
+    """jax.devices() enumeration — the nvidia-smi table analog.
+
+    Reference golden output: driver/CUDA versions + chip model + memory table
+    (README.md:158-167). TPU golden output: platform, device count, per-device
+    kind/id, and HBM stats where the backend exposes them.
+    """
+    devices = jax.devices()
+    report: Dict[str, Any] = {
+        "platform": devices[0].platform if devices else "none",
+        "device_count": jax.device_count(),
+        "local_device_count": jax.local_device_count(),
+        "process_index": jax.process_index(),
+        "devices": [],
+    }
+    for d in devices:
+        entry: Dict[str, Any] = {"id": d.id, "kind": d.device_kind,
+                                 "process": d.process_index}
+        try:
+            stats = d.memory_stats() or {}
+            if "bytes_limit" in stats:
+                entry["hbm_bytes_limit"] = int(stats["bytes_limit"])
+            if "bytes_in_use" in stats:
+                entry["hbm_bytes_in_use"] = int(stats["bytes_in_use"])
+        except Exception:
+            pass  # CPU backend has no memory_stats
+        report["devices"].append(entry)
+    return report
+
+
+def vector_add(n: int = 1 << 20) -> Dict[str, Any]:
+    """cuda-vector-add analog (BASELINE config 3): jnp.add on one chip,
+    verified element-wise against numpy on host."""
+    a = jnp.arange(n, dtype=jnp.float32)
+    b = jnp.full((n,), 2.0, dtype=jnp.float32)
+    out = np.asarray(jax.jit(jnp.add)(a, b))
+    expect = np.arange(n, dtype=np.float32) + 2.0
+    ok = bool(np.array_equal(out, expect))
+    return {"check": "vector_add", "n": n, "ok": ok}
+
+
+def matmul(m: int = 4096, k: int = 4096, n: int = 4096,
+           dtype=jnp.bfloat16, iters: int = 10) -> Dict[str, Any]:
+    """bf16 matmul smoke + throughput: keeps the MXU busy with one large
+    static-shape contraction (SURVEY's idiomatic-TPU rule: big, batched,
+    bfloat16). Returns sustained TFLOP/s over ``iters`` timed steps."""
+    key = jax.random.PRNGKey(0)
+    ka, kb = jax.random.split(key)
+    a = jax.random.normal(ka, (m, k), dtype=dtype)
+    b = jax.random.normal(kb, (k, n), dtype=dtype)
+    f = jax.jit(lambda x, y: x @ y)
+    f(a, b).block_until_ready()  # compile
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = f(a, b)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    flops = 2.0 * m * k * n * iters
+    finite = bool(jnp.isfinite(out.astype(jnp.float32)).all())
+    return {
+        "check": "matmul", "m": m, "k": k, "n": n, "dtype": str(dtype.__name__
+                if hasattr(dtype, "__name__") else dtype),
+        "iters": iters, "seconds": dt,
+        "tflops": flops / dt / 1e12, "ok": finite,
+    }
+
+
+def run_suite(matmul_dim: int = 2048) -> Dict[str, Any]:
+    """The full single-process validation suite, timed — this wall-clock is the
+    BASELINE.json north-star metric ('JAX smoke-test Job wall-clock')."""
+    t0 = time.perf_counter()
+    rep = device_report()
+    add = vector_add()
+    mm = matmul(matmul_dim, matmul_dim, matmul_dim)
+    wall = time.perf_counter() - t0
+    return {
+        "device_report": rep,
+        "vector_add": add,
+        "matmul": mm,
+        "ok": add["ok"] and mm["ok"] and rep["device_count"] >= 1,
+        "wall_s": wall,
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(run_suite(), indent=2))
