@@ -1,0 +1,300 @@
+// tpu-metrics-exporter — dcgm-exporter analog (reference README.md:204,213).
+//
+// Native C++ collector + Prometheus /metrics endpoint (the reference's scrape
+// path is native DCGM C++ under a thin exporter; SURVEY.md §2.2 native-parity
+// rule). Collectors:
+//   - device census: chips discovered from /dev/accel* (or --fake-devices),
+//     presence + count against the accelerator type's expectation;
+//   - runtime metrics relay: Prometheus-style textfile written by the
+//     libtpu/workload side (default /run/tpu/metrics.prom) with per-chip
+//     duty-cycle / HBM gauges — the BASELINE config-4 scrape surface;
+//   - --status-mode adds the node-status-exporter operand's checks
+//     (reference README.md:107): libtpu staged?, plugin socket present?,
+//     chip count == expected; served on /status as JSON, /healthz, and as
+//     metrics.
+//
+// HTTP: deliberately minimal HTTP/1.1 (GET only) over a TCP listener; each
+// request is answered and closed. Single poll loop, no threads.
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <glob.h>
+#include <netinet/in.h>
+#include <poll.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "../plugin/topology.h"
+
+namespace {
+
+volatile sig_atomic_t g_stop = 0;
+void HandleSignal(int) { g_stop = 1; }
+
+struct Options {
+  int port = 9400;
+  std::string device_glob = "/dev/accel*";
+  std::string devfs_root;
+  std::string accelerator = "v5e-8";
+  std::string metrics_file = "/run/tpu/metrics.prom";
+  std::string libtpu_path;   // --status-mode check
+  std::string plugin_socket; // --status-mode check
+  int expect_chips = -1;     // default: accelerator's chips_per_host
+  int fake_devices = -1;
+  bool status_mode = false;
+  bool once = false;         // print metrics to stdout and exit (tests/CLI)
+};
+
+std::vector<std::pair<int, std::string>> DiscoverChips(const Options& opt) {
+  std::vector<std::pair<int, std::string>> chips;
+  if (opt.fake_devices >= 0) {
+    for (int i = 0; i < opt.fake_devices; ++i)
+      chips.push_back({i, "/dev/accel" + std::to_string(i)});
+    return chips;
+  }
+  std::string pattern = opt.device_glob;
+  if (!opt.devfs_root.empty()) {
+    std::string rel = pattern[0] == '/' ? pattern.substr(1) : pattern;
+    pattern = opt.devfs_root + "/" + rel;
+  }
+  glob_t g;
+  memset(&g, 0, sizeof(g));
+  if (glob(pattern.c_str(), 0, nullptr, &g) == 0) {
+    for (size_t i = 0; i < g.gl_pathc; ++i) {
+      std::string path = g.gl_pathv[i];
+      const char* base = strrchr(path.c_str(), '/');
+      base = base ? base + 1 : path.c_str();
+      const char* digits = base;
+      while (*digits && (*digits < '0' || *digits > '9')) ++digits;
+      if (!*digits) continue;
+      chips.push_back({atoi(digits), path});
+    }
+  }
+  globfree(&g);
+  return chips;
+}
+
+// Relay validated lines from the runtime-metrics textfile: only tpu_-prefixed
+// metric lines and comments pass through (prevents a hostile writer from
+// injecting arbitrary series).
+std::string RelayRuntimeMetrics(const std::string& file) {
+  FILE* f = fopen(file.c_str(), "r");
+  if (!f) return "";
+  std::ostringstream os;
+  char line[1024];
+  while (fgets(line, sizeof(line), f)) {
+    if (line[0] == '#' || strncmp(line, "tpu_", 4) == 0) os << line;
+  }
+  fclose(f);
+  std::string s = os.str();
+  if (!s.empty() && s.back() != '\n') s += "\n";
+  return s;
+}
+
+struct StatusChecks {
+  bool libtpu_ok = true;
+  bool plugin_socket_ok = true;
+  bool chip_count_ok = true;
+  size_t chips = 0;
+  int expected = 0;
+  bool healthy() const {
+    return libtpu_ok && plugin_socket_ok && chip_count_ok;
+  }
+};
+
+StatusChecks RunChecks(const Options& opt, const tpud::AcceleratorType* acc) {
+  StatusChecks st;
+  auto chips = DiscoverChips(opt);
+  st.chips = chips.size();
+  st.expected =
+      opt.expect_chips >= 0 ? opt.expect_chips : (acc ? acc->chips_per_host : 0);
+  st.chip_count_ok = static_cast<int>(st.chips) == st.expected;
+  if (!opt.libtpu_path.empty()) {
+    std::string p = opt.libtpu_path;
+    if (!opt.devfs_root.empty()) p = opt.devfs_root + p;
+    st.libtpu_ok = access(p.c_str(), R_OK) == 0;
+  }
+  if (!opt.plugin_socket.empty()) {
+    std::string p = opt.plugin_socket;
+    if (!opt.devfs_root.empty()) p = opt.devfs_root + p;
+    struct stat sb;
+    st.plugin_socket_ok =
+        stat(p.c_str(), &sb) == 0 && S_ISSOCK(sb.st_mode);
+  }
+  return st;
+}
+
+std::string RenderMetrics(const Options& opt,
+                          const tpud::AcceleratorType* acc) {
+  std::ostringstream os;
+  auto chips = DiscoverChips(opt);
+  os << "# HELP tpu_chips_total TPU chips discovered on this node\n"
+     << "# TYPE tpu_chips_total gauge\n"
+     << "tpu_chips_total " << chips.size() << "\n";
+  int expected =
+      opt.expect_chips >= 0 ? opt.expect_chips : (acc ? acc->chips_per_host : 0);
+  os << "# HELP tpu_chips_expected chips expected for the accelerator type\n"
+     << "# TYPE tpu_chips_expected gauge\n"
+     << "tpu_chips_expected " << expected << "\n";
+  os << "# HELP tpu_chip_present device node present (per chip)\n"
+     << "# TYPE tpu_chip_present gauge\n";
+  for (const auto& [idx, path] : chips)
+    os << "tpu_chip_present{chip=\"" << idx << "\",path=\"" << path
+       << "\"} 1\n";
+  if (acc) {
+    os << "# HELP tpu_hbm_capacity_bytes HBM capacity per chip\n"
+       << "# TYPE tpu_hbm_capacity_bytes gauge\n";
+    for (const auto& [idx, path] : chips)
+      os << "tpu_hbm_capacity_bytes{chip=\"" << idx << "\"} "
+         << (int64_t(acc->hbm_gib_per_chip) << 30) << "\n";
+  }
+  os << RelayRuntimeMetrics(opt.metrics_file);
+  if (opt.status_mode) {
+    StatusChecks st = RunChecks(opt, acc);
+    os << "# HELP tpu_stack_check TPU stack health checks (1 = ok)\n"
+       << "# TYPE tpu_stack_check gauge\n"
+       << "tpu_stack_check{check=\"libtpu_staged\"} " << st.libtpu_ok << "\n"
+       << "tpu_stack_check{check=\"plugin_socket\"} " << st.plugin_socket_ok
+       << "\n"
+       << "tpu_stack_check{check=\"chip_count\"} " << st.chip_count_ok << "\n"
+       << "tpu_stack_healthy " << st.healthy() << "\n";
+  }
+  return os.str();
+}
+
+std::string RenderStatusJson(const Options& opt,
+                             const tpud::AcceleratorType* acc) {
+  StatusChecks st = RunChecks(opt, acc);
+  std::ostringstream os;
+  os << "{\"healthy\": " << (st.healthy() ? "true" : "false")
+     << ", \"chips\": " << st.chips << ", \"expected_chips\": " << st.expected
+     << ", \"checks\": {\"libtpu_staged\": " << (st.libtpu_ok ? "true" : "false")
+     << ", \"plugin_socket\": " << (st.plugin_socket_ok ? "true" : "false")
+     << ", \"chip_count\": " << (st.chip_count_ok ? "true" : "false")
+     << "}}\n";
+  return os.str();
+}
+
+void HttpRespond(int fd, int code, const char* ctype,
+                 const std::string& body) {
+  std::ostringstream os;
+  os << "HTTP/1.1 " << code << (code == 200 ? " OK" : " Service Unavailable")
+     << "\r\nContent-Type: " << ctype
+     << "\r\nContent-Length: " << body.size()
+     << "\r\nConnection: close\r\n\r\n"
+     << body;
+  std::string out = os.str();
+  size_t off = 0;
+  while (off < out.size()) {
+    ssize_t n = write(fd, out.data() + off, out.size() - off);
+    if (n <= 0) break;
+    off += n;
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  Options opt;
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    auto val = [&](const char* k) -> const char* {
+      size_t n = strlen(k);
+      if (a.compare(0, n, k) == 0 && a[n] == '=') return a.c_str() + n + 1;
+      return nullptr;
+    };
+    const char* v;
+    if ((v = val("--port"))) opt.port = atoi(v);
+    else if ((v = val("--device-glob"))) opt.device_glob = v;
+    else if ((v = val("--devfs-root"))) opt.devfs_root = v;
+    else if ((v = val("--accelerator"))) opt.accelerator = v;
+    else if ((v = val("--metrics-file"))) opt.metrics_file = v;
+    else if ((v = val("--libtpu-path"))) opt.libtpu_path = v;
+    else if ((v = val("--plugin-socket"))) opt.plugin_socket = v;
+    else if ((v = val("--expect-chips"))) opt.expect_chips = atoi(v);
+    else if ((v = val("--fake-devices"))) opt.fake_devices = atoi(v);
+    else if (a == "--status-mode") opt.status_mode = true;
+    else if (a == "--once") opt.once = true;
+    else {
+      fprintf(stderr,
+              "usage: tpu-metrics-exporter [--port=9400] [--device-glob=G]\n"
+              "  [--devfs-root=D] [--accelerator=T] [--metrics-file=F]\n"
+              "  [--status-mode --libtpu-path=P --plugin-socket=S\n"
+              "   --expect-chips=N] [--fake-devices=N] [--once]\n");
+      return 2;
+    }
+  }
+
+  const tpud::AcceleratorType* acc = tpud::FindAccelerator(opt.accelerator);
+
+  if (opt.once) {
+    printf("%s", RenderMetrics(opt, acc).c_str());
+    return 0;
+  }
+
+  signal(SIGINT, HandleSignal);
+  signal(SIGTERM, HandleSignal);
+  signal(SIGPIPE, SIG_IGN);
+
+  int lfd = socket(AF_INET, SOCK_STREAM, 0);
+  if (lfd < 0) { perror("socket"); return 1; }
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(opt.port));
+  if (bind(lfd, reinterpret_cast<struct sockaddr*>(&addr), sizeof(addr)) != 0) {
+    perror("bind");
+    return 1;
+  }
+  if (listen(lfd, 16) != 0) { perror("listen"); return 1; }
+  fprintf(stderr, "tpu-metrics-exporter: listening on :%d%s\n", opt.port,
+          opt.status_mode ? " (status mode)" : "");
+
+  while (!g_stop) {
+    struct pollfd pfd = {lfd, POLLIN, 0};
+    int rc = poll(&pfd, 1, 500);
+    if (rc <= 0) continue;
+    int cfd = accept(lfd, nullptr, nullptr);
+    if (cfd < 0) continue;
+    char buf[2048];
+    ssize_t n = read(cfd, buf, sizeof(buf) - 1);
+    if (n > 0) {
+      buf[n] = 0;
+      char method[8], path[256];
+      if (sscanf(buf, "%7s %255s", method, path) == 2 &&
+          strcmp(method, "GET") == 0) {
+        if (strcmp(path, "/metrics") == 0) {
+          HttpRespond(cfd, 200, "text/plain; version=0.0.4",
+                      RenderMetrics(opt, acc));
+        } else if (strcmp(path, "/healthz") == 0) {
+          StatusChecks st = RunChecks(opt, acc);
+          bool ok = opt.status_mode ? st.healthy() : true;
+          HttpRespond(cfd, ok ? 200 : 503, "text/plain",
+                      ok ? "ok\n" : "unhealthy\n");
+        } else if (strcmp(path, "/status") == 0) {
+          HttpRespond(cfd, 200, "application/json",
+                      RenderStatusJson(opt, acc));
+        } else {
+          HttpRespond(cfd, 200, "text/plain",
+                      "tpu-metrics-exporter: /metrics /healthz /status\n");
+        }
+      }
+    }
+    close(cfd);
+  }
+  close(lfd);
+  return 0;
+}

@@ -1,0 +1,369 @@
+#include "grpc.h"
+
+#include <errno.h>
+#include <fcntl.h>
+#include <poll.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdio>
+
+namespace grpcmin {
+
+namespace {
+
+bool SetNonBlocking(int fd) {
+  int flags = fcntl(fd, F_GETFL, 0);
+  return flags >= 0 && fcntl(fd, F_SETFL, flags | O_NONBLOCK) == 0;
+}
+
+int64_t NowMs() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return int64_t(ts.tv_sec) * 1000 + ts.tv_nsec / 1000000;
+}
+
+std::string FindHeader(const std::vector<Header>& hs, const std::string& name) {
+  for (const auto& [k, v] : hs)
+    if (k == name) return v;
+  return "";
+}
+
+std::vector<Header> ResponseHeaders() {
+  return {{":status", "200"},
+          {"content-type", "application/grpc"}};
+}
+
+std::vector<Header> Trailers(const Status& st) {
+  std::vector<Header> t = {{"grpc-status", std::to_string(int(st.code))}};
+  if (!st.message.empty()) t.push_back({"grpc-message", st.message});
+  return t;
+}
+
+}  // namespace
+
+// ------------------------------------------------------------- framing
+
+std::string FrameMessage(const std::string& message_bytes) {
+  std::string out;
+  out.reserve(message_bytes.size() + 5);
+  out.push_back('\0');  // no compression
+  uint32_t n = static_cast<uint32_t>(message_bytes.size());
+  out.push_back(char((n >> 24) & 0xff));
+  out.push_back(char((n >> 16) & 0xff));
+  out.push_back(char((n >> 8) & 0xff));
+  out.push_back(char(n & 0xff));
+  out += message_bytes;
+  return out;
+}
+
+bool UnframeMessage(std::string* buf, std::string* out, bool* bad) {
+  *bad = false;
+  if (buf->size() < 5) return false;
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(buf->data());
+  if (p[0] != 0) {
+    *bad = true;
+    return false;
+  }
+  uint32_t n = (uint32_t(p[1]) << 24) | (uint32_t(p[2]) << 16) |
+               (uint32_t(p[3]) << 8) | uint32_t(p[4]);
+  if (buf->size() < 5u + n) return false;
+  out->assign(*buf, 5, n);
+  buf->erase(0, 5u + n);
+  return true;
+}
+
+// ------------------------------------------------------------- ServerStream
+
+bool ServerStream::Send(const std::string& message_bytes) {
+  if (finished_ || !conn_ || !conn_->alive()) return false;
+  H2Stream* s = conn_->GetStream(stream_id_);
+  if (!s || s->reset) return false;
+  if (!started_) {
+    if (!conn_->SendHeaders(stream_id_, ResponseHeaders(), false)) return false;
+    started_ = true;
+  }
+  return conn_->SendData(stream_id_, FrameMessage(message_bytes), false);
+}
+
+void ServerStream::Finish(const Status& status) {
+  if (finished_) return;
+  finished_ = true;
+  if (!conn_ || !conn_->alive()) return;
+  H2Stream* s = conn_->GetStream(stream_id_);
+  if (!s || s->reset) return;
+  if (!started_) {
+    // Trailers-only response.
+    auto hs = ResponseHeaders();
+    for (auto& t : Trailers(status)) hs.push_back(t);
+    conn_->SendHeaders(stream_id_, hs, true);
+    return;
+  }
+  conn_->SendHeaders(stream_id_, Trailers(status), true);
+}
+
+// ------------------------------------------------------------- Server
+
+Server::~Server() { Shutdown(); }
+
+void Server::Shutdown() {
+  if (listen_fd_ >= 0) {
+    close(listen_fd_);
+    listen_fd_ = -1;
+    if (!path_.empty()) unlink(path_.c_str());
+  }
+  conns_.clear();
+}
+
+bool Server::Listen(const std::string& socket_path) {
+  path_ = socket_path;
+  unlink(socket_path.c_str());
+  listen_fd_ = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (listen_fd_ < 0) return false;
+  struct sockaddr_un addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sun_family = AF_UNIX;
+  if (socket_path.size() >= sizeof(addr.sun_path)) return false;
+  strncpy(addr.sun_path, socket_path.c_str(), sizeof(addr.sun_path) - 1);
+  if (bind(listen_fd_, reinterpret_cast<struct sockaddr*>(&addr),
+           sizeof(addr)) != 0)
+    return false;
+  if (listen(listen_fd_, 16) != 0) return false;
+  return SetNonBlocking(listen_fd_);
+}
+
+void Server::SetupConn(ConnEntry* e) {
+  H2Conn* c = e->conn.get();
+  c->on_headers = [this, e](H2Stream* s, bool trailers) {
+    if (!trailers) OnHeaders(e, s);
+  };
+  c->on_data = [this, e](H2Stream* s, const uint8_t* d, size_t n, bool end) {
+    OnData(e, s, d, n, end);
+  };
+  c->on_stream_closed = [e](H2Stream* s) {
+    auto it = e->calls.find(s->id);
+    if (it != e->calls.end()) {
+      CallState* cs = it->second.get();
+      if (cs->stream && !cs->stream->finished()) {
+        cs->stream->finished_ = true;
+        if (cs->stream->on_closed) cs->stream->on_closed();
+      }
+      if (!cs->streaming || !cs->stream || cs->stream->finished()) {
+        e->calls.erase(it);
+        e->conn->ForgetStream(s->id);
+      }
+    } else {
+      e->conn->ForgetStream(s->id);
+    }
+  };
+}
+
+void Server::OnHeaders(ConnEntry* e, H2Stream* s) {
+  auto cs = std::make_unique<CallState>();
+  cs->method = FindHeader(s->headers, ":path");
+  s->user = cs.get();
+  e->calls[s->id] = std::move(cs);
+  MaybeDispatch(e, s);  // handles trailers-only / zero-arg dispatch on END
+}
+
+void Server::OnData(ConnEntry* e, H2Stream* s, const uint8_t* data, size_t len,
+                    bool end_stream) {
+  (void)end_stream;
+  auto it = e->calls.find(s->id);
+  if (it == e->calls.end()) return;
+  CallState* cs = it->second.get();
+  cs->buffer.append(reinterpret_cast<const char*>(data), len);
+  if (!cs->have_message) {
+    bool bad = false;
+    if (UnframeMessage(&cs->buffer, &cs->message, &bad)) {
+      cs->have_message = true;
+    } else if (bad) {
+      e->conn->SendRstStream(s->id, 0x1);
+      return;
+    }
+  }
+  MaybeDispatch(e, s);
+}
+
+void Server::MaybeDispatch(ConnEntry* e, H2Stream* s) {
+  auto it = e->calls.find(s->id);
+  if (it == e->calls.end()) return;
+  CallState* cs = it->second.get();
+  if (cs->dispatched) return;
+  // Dispatch once the request message is complete. For methods whose request
+  // is an empty proto (ListAndWatch!), the message is 5 zero bytes — still a
+  // DATA frame, so have_message flips there. Guard with remote_closed for
+  // clients that half-close without data.
+  if (!cs->have_message && !s->remote_closed) return;
+  cs->dispatched = true;
+
+  auto su = streaming_.find(cs->method);
+  if (su != streaming_.end()) {
+    cs->streaming = true;
+    cs->stream = std::make_unique<ServerStream>(e->conn.get(), s->id);
+    su->second(cs->message, cs->stream.get());
+    return;
+  }
+  auto uu = unary_.find(cs->method);
+  if (uu == unary_.end()) {
+    auto hs = ResponseHeaders();
+    for (auto& t :
+         Trailers({StatusCode::kUnimplemented, "unknown method " + cs->method}))
+      hs.push_back(t);
+    e->conn->SendHeaders(s->id, hs, true);
+    return;
+  }
+  std::string response;
+  Status st = uu->second(cs->message, &response);
+  if (st.code != StatusCode::kOk) {
+    auto hs = ResponseHeaders();
+    for (auto& t : Trailers(st)) hs.push_back(t);
+    e->conn->SendHeaders(s->id, hs, true);
+    return;
+  }
+  e->conn->SendHeaders(s->id, ResponseHeaders(), false);
+  e->conn->SendData(s->id, FrameMessage(response), false);
+  e->conn->SendHeaders(s->id, Trailers(st), true);
+}
+
+void Server::DropConn(size_t index) {
+  // Notify any live streams on this connection.
+  for (auto& [sid, cs] : conns_[index]->calls) {
+    if (cs->stream && !cs->stream->finished()) {
+      cs->stream->finished_ = true;
+      if (cs->stream->on_closed) cs->stream->on_closed();
+    }
+  }
+  conns_.erase(conns_.begin() + index);
+}
+
+bool Server::RunOnce(int timeout_ms) {
+  if (listen_fd_ < 0) return false;
+  std::vector<struct pollfd> pfds;
+  pfds.push_back({listen_fd_, POLLIN, 0});
+  for (auto& e : conns_) {
+    short events = POLLIN;
+    pfds.push_back({e->conn->fd(), events, 0});
+  }
+  int rc = poll(pfds.data(), pfds.size(), timeout_ms);
+  if (rc < 0) return errno == EINTR;
+  if (rc == 0) return true;
+
+  if (pfds[0].revents & POLLIN) {
+    while (true) {
+      int cfd = accept(listen_fd_, nullptr, nullptr);
+      if (cfd < 0) break;
+      SetNonBlocking(cfd);
+      auto e = std::make_unique<ConnEntry>();
+      e->conn = std::make_unique<H2Conn>(cfd, H2Conn::Role::kServer);
+      SetupConn(e.get());
+      if (e->conn->Start()) conns_.push_back(std::move(e));
+    }
+  }
+  // Walk backwards so DropConn doesn't disturb earlier indices.
+  for (size_t i = conns_.size(); i-- > 0;) {
+    size_t pi = i + 1;
+    if (pi >= pfds.size()) continue;
+    if (pfds[pi].revents & (POLLIN | POLLHUP | POLLERR)) {
+      if (!conns_[i]->conn->OnReadable()) {
+        DropConn(i);
+        continue;
+      }
+    }
+    conns_[i]->conn->Flush();
+  }
+  return true;
+}
+
+// ------------------------------------------------------------- Client
+
+bool Client::UnaryCall(const std::string& socket_path,
+                       const std::string& method_path,
+                       const std::string& request_bytes,
+                       std::string* response_bytes, Status* status,
+                       int timeout_ms) {
+  int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (fd < 0) return false;
+  struct sockaddr_un addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sun_family = AF_UNIX;
+  if (socket_path.size() >= sizeof(addr.sun_path)) {
+    close(fd);
+    return false;
+  }
+  strncpy(addr.sun_path, socket_path.c_str(), sizeof(addr.sun_path) - 1);
+  if (connect(fd, reinterpret_cast<struct sockaddr*>(&addr), sizeof(addr)) !=
+      0) {
+    close(fd);
+    return false;
+  }
+  SetNonBlocking(fd);
+
+  H2Conn conn(fd, H2Conn::Role::kClient);
+  bool done = false, ok = false;
+  std::string data_buf;
+
+  conn.on_headers = [&](H2Stream* s, bool trailers) {
+    const std::vector<Header>& hs = trailers ? s->trailers : s->headers;
+    std::string gs = FindHeader(hs, "grpc-status");
+    if (!gs.empty()) {
+      status->code = static_cast<StatusCode>(atoi(gs.c_str()));
+      status->message = FindHeader(hs, "grpc-message");
+      done = true;
+      ok = true;
+    }
+  };
+  conn.on_data = [&](H2Stream* s, const uint8_t* d, size_t n, bool end) {
+    (void)s;
+    (void)end;
+    data_buf.append(reinterpret_cast<const char*>(d), n);
+  };
+
+  if (!conn.Start()) return false;
+  uint32_t sid = conn.NextStreamId();
+  std::vector<Header> req_headers = {
+      {":method", "POST"},
+      {":scheme", "http"},
+      {":path", method_path},
+      {":authority", "localhost"},
+      {"content-type", "application/grpc"},
+      {"te", "trailers"},
+      {"user-agent", "grpcmin/0.1"},
+  };
+  if (!conn.SendHeaders(sid, req_headers, false)) return false;
+  if (!conn.SendData(sid, FrameMessage(request_bytes), true)) return false;
+
+  int64_t deadline = NowMs() + timeout_ms;
+  while (!done && conn.alive()) {
+    int64_t left = deadline - NowMs();
+    if (left <= 0) {
+      status->code = StatusCode::kUnavailable;
+      status->message = "deadline exceeded waiting for response";
+      return false;
+    }
+    struct pollfd pfd = {conn.fd(), POLLIN, 0};
+    int rc = poll(&pfd, 1, static_cast<int>(std::min<int64_t>(left, 100)));
+    if (rc < 0 && errno != EINTR) break;
+    if (rc > 0 && (pfd.revents & (POLLIN | POLLHUP | POLLERR))) {
+      if (!conn.OnReadable()) break;
+    }
+    conn.Flush();
+  }
+  if (!done) {
+    status->code = StatusCode::kUnavailable;
+    status->message = "connection closed before response";
+    return false;
+  }
+  if (response_bytes) {
+    bool bad = false;
+    std::string msg;
+    if (UnframeMessage(&data_buf, &msg, &bad)) *response_bytes = msg;
+  }
+  return ok;
+}
+
+}  // namespace grpcmin

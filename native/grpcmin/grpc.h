@@ -1,0 +1,139 @@
+// Minimal gRPC-over-HTTP/2 server and client for unix domain sockets.
+//
+// Server: serves unary and server-streaming methods (what the kubelet
+// DevicePlugin API needs); wire-compatible with grpc-go (kubelet) and grpcio
+// (test harness) clients. Client: blocking unary calls (Registration).
+#pragma once
+
+#include <cstdint>
+#include <functional>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "h2.h"
+
+namespace grpcmin {
+
+// Canonical gRPC status codes (subset we use).
+enum class StatusCode : int {
+  kOk = 0,
+  kUnknown = 2,
+  kInvalidArgument = 3,
+  kNotFound = 5,
+  kResourceExhausted = 8,
+  kFailedPrecondition = 9,
+  kUnimplemented = 12,
+  kInternal = 13,
+  kUnavailable = 14,
+};
+
+struct Status {
+  StatusCode code = StatusCode::kOk;
+  std::string message;
+  static Status Ok() { return {}; }
+};
+
+// Handle for one live server-streaming call. Owned by the server; user code
+// keeps the pointer only until on_closed fires.
+class ServerStream {
+ public:
+  ServerStream(H2Conn* conn, uint32_t stream_id)
+      : conn_(conn), stream_id_(stream_id) {}
+
+  // Sends one length-prefixed gRPC message. False if the stream is gone.
+  bool Send(const std::string& message_bytes);
+  // Ends the stream with trailers.
+  void Finish(const Status& status);
+  bool finished() const { return finished_; }
+  uint32_t id() const { return stream_id_; }
+
+  std::function<void()> on_closed;  // stream reset / conn death
+
+ private:
+  friend class Server;
+  H2Conn* conn_;
+  uint32_t stream_id_;
+  bool started_ = false;  // response HEADERS sent
+  bool finished_ = false;
+};
+
+using UnaryHandler =
+    std::function<Status(const std::string& request, std::string* response)>;
+using StreamingHandler =
+    std::function<void(const std::string& request, ServerStream* stream)>;
+
+class Server {
+ public:
+  ~Server();
+
+  // Binds + listens on a unix socket path (unlinks stale socket first).
+  bool Listen(const std::string& socket_path);
+
+  void AddUnary(const std::string& method_path, UnaryHandler h) {
+    unary_[method_path] = std::move(h);
+  }
+  void AddServerStreaming(const std::string& method_path, StreamingHandler h) {
+    streaming_[method_path] = std::move(h);
+  }
+
+  // One poll iteration: accepts, reads, dispatches. timeout_ms < 0 blocks.
+  // Returns false only on listener failure.
+  bool RunOnce(int timeout_ms);
+
+  const std::string& socket_path() const { return path_; }
+  size_t connection_count() const { return conns_.size(); }
+  void Shutdown();
+
+ private:
+  struct CallState {
+    std::string method;
+    std::string buffer;       // raw DATA bytes, gRPC-framed
+    std::string message;      // first complete message
+    bool have_message = false;
+    bool dispatched = false;
+    bool streaming = false;
+    std::unique_ptr<ServerStream> stream;
+  };
+  struct ConnEntry {
+    std::unique_ptr<H2Conn> conn;
+    // CallState per stream id (owned here, pointed to by H2Stream::user).
+    std::map<uint32_t, std::unique_ptr<CallState>> calls;
+  };
+
+  void SetupConn(ConnEntry* e);
+  void OnHeaders(ConnEntry* e, H2Stream* s);
+  void OnData(ConnEntry* e, H2Stream* s, const uint8_t* data, size_t len,
+              bool end_stream);
+  void MaybeDispatch(ConnEntry* e, H2Stream* s);
+  void DropConn(size_t index);
+
+  int listen_fd_ = -1;
+  std::string path_;
+  std::vector<std::unique_ptr<ConnEntry>> conns_;
+  std::map<std::string, UnaryHandler> unary_;
+  std::map<std::string, StreamingHandler> streaming_;
+};
+
+// gRPC length-prefixed message framing helpers.
+std::string FrameMessage(const std::string& message_bytes);
+// Extracts the next complete message from buf (erasing it). Returns false if
+// incomplete. Sets *bad on malformed (compressed flag set — we don't support
+// compression, per gRPC spec that's only valid with an encoding we'd have
+// negotiated).
+bool UnframeMessage(std::string* buf, std::string* out, bool* bad);
+
+class Client {
+ public:
+  // Blocking unary call over a fresh connection (fine for Register, which
+  // happens once per kubelet lifetime). Returns transport-level success;
+  // gRPC-level status lands in *status.
+  static bool UnaryCall(const std::string& socket_path,
+                        const std::string& method_path,
+                        const std::string& request_bytes,
+                        std::string* response_bytes, Status* status,
+                        int timeout_ms = 5000);
+};
+
+}  // namespace grpcmin

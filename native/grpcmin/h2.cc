@@ -1,0 +1,412 @@
+#include "h2.h"
+
+#include <errno.h>
+#include <string.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdio>
+
+namespace grpcmin {
+
+namespace {
+
+const char kClientMagic[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+constexpr size_t kMagicLen = 24;
+
+uint32_t ReadU32(const uint8_t* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+void PutU32(uint8_t* p, uint32_t v) {
+  p[0] = v >> 24; p[1] = v >> 16; p[2] = v >> 8; p[3] = v;
+}
+
+}  // namespace
+
+H2Conn::H2Conn(int fd, Role role)
+    : fd_(fd), role_(role), next_stream_id_(1) {}
+
+H2Conn::~H2Conn() {
+  if (fd_ >= 0) close(fd_);
+}
+
+bool H2Conn::Start() {
+  if (role_ == Role::kClient) {
+    if (!WriteRaw(reinterpret_cast<const uint8_t*>(kClientMagic), kMagicLen))
+      return false;
+  }
+  // SETTINGS: HEADER_TABLE_SIZE=4096, INITIAL_WINDOW_SIZE, MAX_FRAME_SIZE.
+  uint8_t s[18];
+  s[0] = 0; s[1] = 0x1; PutU32(s + 2, 4096);
+  s[6] = 0; s[7] = 0x4; PutU32(s + 8, kOurInitialWindow);
+  s[12] = 0; s[13] = 0x5; PutU32(s + 14, kMaxFrameSize);
+  if (!WriteFrame(FrameType::kSettings, 0, 0, s, sizeof(s))) return false;
+  // Grow the connection-level receive window up front so we never stall the
+  // peer; we also replenish per-DATA below.
+  uint8_t w[4];
+  PutU32(w, kOurInitialWindow - kDefaultWindow);
+  return WriteFrame(FrameType::kWindowUpdate, 0, 0, w, 4);
+}
+
+bool H2Conn::WriteRaw(const uint8_t* data, size_t len) {
+  if (!alive_) return false;
+  wbuf_.append(reinterpret_cast<const char*>(data), len);
+  return Flush();
+}
+
+bool H2Conn::Flush() {
+  while (!wbuf_.empty()) {
+    ssize_t n = write(fd_, wbuf_.data(), wbuf_.size());
+    if (n > 0) {
+      wbuf_.erase(0, static_cast<size_t>(n));
+    } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      return true;  // try again when writable
+    } else if (n < 0 && errno == EINTR) {
+      continue;
+    } else {
+      alive_ = false;
+      return false;
+    }
+  }
+  return true;
+}
+
+bool H2Conn::WriteFrame(FrameType type, uint8_t flags, uint32_t stream_id,
+                        const uint8_t* payload, size_t len) {
+  uint8_t hdr[9];
+  hdr[0] = (len >> 16) & 0xff; hdr[1] = (len >> 8) & 0xff; hdr[2] = len & 0xff;
+  hdr[3] = static_cast<uint8_t>(type);
+  hdr[4] = flags;
+  PutU32(hdr + 5, stream_id & 0x7fffffff);
+  if (!alive_) return false;
+  wbuf_.append(reinterpret_cast<const char*>(hdr), 9);
+  if (len) wbuf_.append(reinterpret_cast<const char*>(payload), len);
+  return Flush();
+}
+
+uint32_t H2Conn::NextStreamId() {
+  uint32_t id = next_stream_id_;
+  next_stream_id_ += 2;
+  streams_[id] = std::make_unique<H2Stream>();
+  streams_[id]->id = id;
+  streams_[id]->send_window = peer_initial_window_;
+  return id;
+}
+
+H2Stream* H2Conn::GetStream(uint32_t id) {
+  auto it = streams_.find(id);
+  return it == streams_.end() ? nullptr : it->second.get();
+}
+
+void H2Conn::ForgetStream(uint32_t id) { streams_.erase(id); }
+
+bool H2Conn::SendHeaders(uint32_t stream_id, const std::vector<Header>& headers,
+                         bool end_stream) {
+  std::vector<uint8_t> block;
+  HpackEncoder::EncodeAll(headers, &block);
+  uint8_t flags = kFlagEndHeaders | (end_stream ? kFlagEndStream : 0);
+  if (block.size() > peer_max_frame_) return false;  // we never come close
+  H2Stream* s = GetStream(stream_id);
+  if (s && end_stream) s->local_closed = true;
+  bool ok = WriteFrame(FrameType::kHeaders, flags, stream_id, block.data(),
+                       block.size());
+  if (s) CloseStreamIfDone(s);
+  return ok;
+}
+
+void H2Conn::PumpPending(H2Stream* s) {
+  while (!s->pending_send.empty() && conn_send_window_ > 0 &&
+         s->send_window > 0) {
+    size_t chunk = s->pending_send.size();
+    chunk = std::min<size_t>(chunk, static_cast<size_t>(conn_send_window_));
+    chunk = std::min<size_t>(chunk, static_cast<size_t>(s->send_window));
+    chunk = std::min<size_t>(chunk, peer_max_frame_);
+    bool last = chunk == s->pending_send.size();
+    uint8_t flags = (last && s->pending_end_stream) ? kFlagEndStream : 0;
+    if (!WriteFrame(FrameType::kData, flags, s->id,
+                    reinterpret_cast<const uint8_t*>(s->pending_send.data()),
+                    chunk))
+      return;
+    conn_send_window_ -= chunk;
+    s->send_window -= chunk;
+    s->pending_send.erase(0, chunk);
+    if (last && s->pending_end_stream) s->local_closed = true;
+  }
+  CloseStreamIfDone(s);
+}
+
+bool H2Conn::SendData(uint32_t stream_id, const std::string& payload,
+                      bool end_stream) {
+  H2Stream* s = GetStream(stream_id);
+  if (!s || s->reset || s->local_closed) return false;
+  s->pending_send += payload;
+  s->pending_end_stream = s->pending_end_stream || end_stream;
+  if (end_stream && payload.empty() && s->pending_send.empty()) {
+    // Bare half-close: empty DATA with END_STREAM.
+    bool ok = WriteFrame(FrameType::kData, kFlagEndStream, stream_id,
+                         nullptr, 0);
+    s->local_closed = true;
+    CloseStreamIfDone(s);
+    return ok;
+  }
+  PumpPending(s);
+  return alive_;
+}
+
+bool H2Conn::SendRstStream(uint32_t stream_id, uint32_t error_code) {
+  uint8_t p[4];
+  PutU32(p, error_code);
+  H2Stream* s = GetStream(stream_id);
+  if (s) s->reset = true;
+  return WriteFrame(FrameType::kRstStream, 0, stream_id, p, 4);
+}
+
+bool H2Conn::SendGoAway(uint32_t error_code) {
+  uint8_t p[8];
+  PutU32(p, 0);  // last stream id — we don't resume, 0 is conservative
+  PutU32(p + 4, error_code);
+  return WriteFrame(FrameType::kGoAway, 0, 0, p, 8);
+}
+
+bool H2Conn::SendPingAck(const uint8_t* opaque) {
+  return WriteFrame(FrameType::kPing, kFlagAck, 0, opaque, 8);
+}
+
+void H2Conn::CloseStreamIfDone(H2Stream* s) {
+  if ((s->remote_closed && s->local_closed && s->pending_send.empty()) ||
+      s->reset) {
+    if (on_stream_closed) on_stream_closed(s);
+    // The gRPC layer calls ForgetStream when it is done with user state.
+  }
+}
+
+bool H2Conn::OnReadable() {
+  char buf[16384];
+  while (alive_) {
+    ssize_t n = read(fd_, buf, sizeof(buf));
+    if (n > 0) {
+      rbuf_.append(buf, static_cast<size_t>(n));
+      if (static_cast<size_t>(n) < sizeof(buf)) break;
+    } else if (n == 0) {
+      alive_ = false;
+      return false;
+    } else if (errno == EAGAIN || errno == EWOULDBLOCK) {
+      break;
+    } else if (errno == EINTR) {
+      continue;
+    } else {
+      alive_ = false;
+      return false;
+    }
+  }
+
+  if (role_ == Role::kServer && !got_preface_) {
+    if (rbuf_.size() < kMagicLen) return alive_;
+    if (memcmp(rbuf_.data(), kClientMagic, kMagicLen) != 0) {
+      alive_ = false;
+      return false;
+    }
+    rbuf_.erase(0, kMagicLen);
+    got_preface_ = true;
+  }
+
+  while (rbuf_.size() >= 9) {
+    const uint8_t* p = reinterpret_cast<const uint8_t*>(rbuf_.data());
+    size_t len = (size_t(p[0]) << 16) | (size_t(p[1]) << 8) | p[2];
+    if (len > (1u << 24)) { alive_ = false; return false; }
+    if (rbuf_.size() < 9 + len) break;
+    uint8_t type = p[3], flags = p[4];
+    uint32_t stream_id = ReadU32(p + 5) & 0x7fffffff;
+    if (!ProcessFrame(type, flags, stream_id, p + 9, len)) {
+      SendGoAway(0x1);  // PROTOCOL_ERROR
+      alive_ = false;
+      return false;
+    }
+    rbuf_.erase(0, 9 + len);
+  }
+  return alive_;
+}
+
+bool H2Conn::ProcessFrame(uint8_t type, uint8_t flags, uint32_t stream_id,
+                          const uint8_t* payload, size_t len) {
+  // A header block in flight only admits CONTINUATION for that stream.
+  if (!hdr_block_.empty() || hdr_stream_) {
+    if (type != static_cast<uint8_t>(FrameType::kContinuation) ||
+        stream_id != hdr_stream_)
+      return false;
+  }
+  switch (static_cast<FrameType>(type)) {
+    case FrameType::kSettings:
+      return HandleSettings(flags, payload, len);
+    case FrameType::kPing:
+      if (len != 8) return false;
+      if (!(flags & kFlagAck)) return SendPingAck(payload);
+      return true;
+    case FrameType::kWindowUpdate:
+      return HandleWindowUpdate(stream_id, payload, len);
+    case FrameType::kGoAway:
+      // Peer is going away; finish what we have. Mark not-alive on read EOF.
+      return true;
+    case FrameType::kPriority:
+      return len == 5;
+    case FrameType::kRstStream: {
+      if (len != 4 || stream_id == 0) return false;
+      H2Stream* s = GetStream(stream_id);
+      if (s) {
+        s->reset = true;
+        CloseStreamIfDone(s);
+      }
+      return true;
+    }
+    case FrameType::kHeaders:
+      return HandleHeaders(stream_id, flags, payload, len);
+    case FrameType::kContinuation: {
+      if (stream_id == 0 || stream_id != hdr_stream_) return false;
+      hdr_block_.append(reinterpret_cast<const char*>(payload), len);
+      if (flags & kFlagEndHeaders) return HeaderBlockComplete();
+      return true;
+    }
+    case FrameType::kData: {
+      if (stream_id == 0) return false;
+      H2Stream* s = GetStream(stream_id);
+      size_t data_len = len;
+      const uint8_t* data = payload;
+      if (flags & kFlagPadded) {
+        if (len < 1) return false;
+        uint8_t pad = payload[0];
+        if (pad + 1u > len) return false;
+        data = payload + 1;
+        data_len = len - 1 - pad;
+      }
+      // Replenish receive windows immediately (credit-based).
+      if (len > 0) {
+        uint8_t w[4];
+        PutU32(w, static_cast<uint32_t>(len));
+        WriteFrame(FrameType::kWindowUpdate, 0, 0, w, 4);
+        if (s && !(flags & kFlagEndStream))
+          WriteFrame(FrameType::kWindowUpdate, 0, stream_id, w, 4);
+      }
+      if (!s || s->reset) return true;  // ignore data for unknown streams
+      bool end = flags & kFlagEndStream;
+      if (end) s->remote_closed = true;
+      if (on_data) on_data(s, data, data_len, end);
+      CloseStreamIfDone(s);
+      return true;
+    }
+    case FrameType::kPushPromise:
+      return false;  // we never enable push
+    default:
+      return true;  // ignore unknown frame types (spec requirement)
+  }
+}
+
+bool H2Conn::HandleHeaders(uint32_t stream_id, uint8_t flags,
+                           const uint8_t* frag, size_t len) {
+  if (stream_id == 0) return false;
+  size_t off = 0;
+  if (flags & kFlagPadded) {
+    if (len < 1) return false;
+    uint8_t pad = frag[0];
+    off = 1;
+    if (off + pad > len) return false;
+    len -= pad;
+  }
+  if (flags & kFlagPriority) {
+    if (len < off + 5) return false;
+    off += 5;
+  }
+  H2Stream* s = GetStream(stream_id);
+  if (!s) {
+    if (role_ == Role::kServer) {
+      auto ns = std::make_unique<H2Stream>();
+      ns->id = stream_id;
+      ns->send_window = peer_initial_window_;
+      s = ns.get();
+      streams_[stream_id] = std::move(ns);
+    } else {
+      return false;  // server never opens streams toward us
+    }
+  }
+  hdr_stream_ = stream_id;
+  hdr_block_.assign(reinterpret_cast<const char*>(frag + off), len - off);
+  hdr_end_stream_ = flags & kFlagEndStream;
+  if (flags & kFlagEndHeaders) return HeaderBlockComplete();
+  return true;
+}
+
+bool H2Conn::HeaderBlockComplete() {
+  uint32_t sid = hdr_stream_;
+  hdr_stream_ = 0;
+  H2Stream* s = GetStream(sid);
+  std::vector<Header> headers;
+  bool ok = hpack_.Decode(
+      reinterpret_cast<const uint8_t*>(hdr_block_.data()), hdr_block_.size(),
+      &headers);
+  hdr_block_.clear();
+  if (!ok) return false;
+  if (!s) return true;
+  bool trailers = s->headers_done;
+  if (trailers) {
+    s->trailers = std::move(headers);
+  } else {
+    s->headers = std::move(headers);
+    s->headers_done = true;
+  }
+  if (hdr_end_stream_) s->remote_closed = true;
+  if (on_headers) on_headers(s, trailers);
+  CloseStreamIfDone(s);
+  return true;
+}
+
+bool H2Conn::HandleSettings(uint8_t flags, const uint8_t* payload, size_t len) {
+  if (flags & kFlagAck) return len == 0;
+  if (len % 6 != 0) return false;
+  for (size_t i = 0; i < len; i += 6) {
+    uint16_t id = (uint16_t(payload[i]) << 8) | payload[i + 1];
+    uint32_t value = ReadU32(payload + i + 2);
+    switch (id) {
+      case 0x4: {  // INITIAL_WINDOW_SIZE: adjust all open stream windows
+        if (value > 0x7fffffffu) return false;
+        int64_t delta = int64_t(value) - int64_t(peer_initial_window_);
+        peer_initial_window_ = value;
+        for (auto& [sid, s] : streams_) {
+          s->send_window += delta;
+        }
+        break;
+      }
+      case 0x5:
+        if (value < 16384 || value > 16777215) return false;
+        peer_max_frame_ = value;
+        break;
+      default:
+        break;  // header table size handled implicitly (we never index)
+    }
+  }
+  got_peer_settings_ = true;
+  if (!WriteFrame(FrameType::kSettings, kFlagAck, 0, nullptr, 0)) return false;
+  // New window may unblock pending sends.
+  for (auto& [sid, s] : streams_) PumpPending(s.get());
+  return true;
+}
+
+bool H2Conn::HandleWindowUpdate(uint32_t stream_id, const uint8_t* p,
+                                size_t len) {
+  if (len != 4) return false;
+  uint32_t inc = ReadU32(p) & 0x7fffffff;
+  if (inc == 0) return stream_id != 0;  // conn-level zero increment is fatal
+  if (stream_id == 0) {
+    conn_send_window_ += inc;
+    for (auto& [sid, s] : streams_) PumpPending(s.get());
+  } else {
+    H2Stream* s = GetStream(stream_id);
+    if (s) {
+      s->send_window += inc;
+      PumpPending(s);
+    }
+  }
+  return true;
+}
+
+}  // namespace grpcmin

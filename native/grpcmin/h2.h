@@ -1,0 +1,145 @@
+// Minimal HTTP/2 (RFC 7540) connection for gRPC over unix sockets.
+//
+// Scope: exactly what a kubelet-facing device plugin needs — no TLS, no
+// priorities, no push, no server-initiated streams. Both roles (we serve the
+// DevicePlugin service to kubelet's grpc-go client, and we dial kubelet's
+// Registration service as a client). Single-threaded: the owner runs a poll()
+// loop and calls OnReadable/Flush; all callbacks fire on that thread.
+#pragma once
+
+#include <cstdint>
+#include <functional>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "hpack.h"
+
+namespace grpcmin {
+
+enum class FrameType : uint8_t {
+  kData = 0x0,
+  kHeaders = 0x1,
+  kPriority = 0x2,
+  kRstStream = 0x3,
+  kSettings = 0x4,
+  kPushPromise = 0x5,
+  kPing = 0x6,
+  kGoAway = 0x7,
+  kWindowUpdate = 0x8,
+  kContinuation = 0x9,
+};
+
+constexpr uint8_t kFlagEndStream = 0x1;
+constexpr uint8_t kFlagEndHeaders = 0x4;
+constexpr uint8_t kFlagAck = 0x1;
+constexpr uint8_t kFlagPadded = 0x8;
+constexpr uint8_t kFlagPriority = 0x20;
+
+constexpr uint32_t kDefaultWindow = 65535;
+constexpr uint32_t kOurInitialWindow = 1 << 20;
+constexpr uint32_t kMaxFrameSize = 16384;
+
+// Per-stream state inside a connection.
+struct H2Stream {
+  uint32_t id = 0;
+  std::vector<Header> headers;         // request (server role) or response
+  std::vector<Header> trailers;
+  std::string data;                    // accumulated DATA payload (recv)
+  bool headers_done = false;
+  bool remote_closed = false;          // peer sent END_STREAM
+  bool local_closed = false;           // we sent END_STREAM
+  bool reset = false;
+  int64_t send_window = kDefaultWindow;
+  std::string pending_send;            // DATA bytes waiting on flow control
+  bool pending_end_stream = false;
+  void* user = nullptr;                // owned by the gRPC layer
+};
+
+class H2Conn {
+ public:
+  enum class Role { kServer, kClient };
+
+  // fd must be an open socket; the connection takes ownership (closes it).
+  H2Conn(int fd, Role role);
+  ~H2Conn();
+
+  // Non-copyable.
+  H2Conn(const H2Conn&) = delete;
+  H2Conn& operator=(const H2Conn&) = delete;
+
+  // Sends preface (client role) + our SETTINGS. Call once after construction.
+  bool Start();
+
+  // Drains readable bytes and dispatches complete frames. Returns false when
+  // the connection is dead (EOF, protocol error) — caller should destroy.
+  bool OnReadable();
+
+  // Attempts to write queued bytes (for callers using non-blocking fds).
+  bool Flush();
+
+  // --- sending (any role) ---
+  bool SendHeaders(uint32_t stream_id, const std::vector<Header>& headers,
+                   bool end_stream);
+  // Queues DATA (respecting flow control) — message bytes, not gRPC-framed.
+  bool SendData(uint32_t stream_id, const std::string& payload,
+                bool end_stream);
+  bool SendRstStream(uint32_t stream_id, uint32_t error_code);
+  bool SendGoAway(uint32_t error_code);
+  bool SendPingAck(const uint8_t* opaque);
+
+  // Client role: opens a new stream, returns its id (odd, increasing).
+  uint32_t NextStreamId();
+
+  H2Stream* GetStream(uint32_t id);
+  void ForgetStream(uint32_t id);
+
+  int fd() const { return fd_; }
+  bool alive() const { return alive_; }
+  bool handshake_done() const { return got_peer_settings_; }
+
+  // --- callbacks (set by the gRPC layer) ---
+  // Fired when a header block completes (END_HEADERS). trailers=true when
+  // this is a trailing block on an existing stream.
+  std::function<void(H2Stream*, bool trailers)> on_headers;
+  // Fired per DATA frame after window accounting. end_stream signals
+  // half-close.
+  std::function<void(H2Stream*, const uint8_t* data, size_t len,
+                     bool end_stream)> on_data;
+  std::function<void(H2Stream*)> on_stream_closed;  // reset or END_STREAM
+
+ private:
+  bool ProcessFrame(uint8_t type, uint8_t flags, uint32_t stream_id,
+                    const uint8_t* payload, size_t len);
+  bool HandleHeaders(uint32_t stream_id, uint8_t flags, const uint8_t* frag,
+                     size_t len);
+  bool HandleSettings(uint8_t flags, const uint8_t* payload, size_t len);
+  bool HandleWindowUpdate(uint32_t stream_id, const uint8_t* p, size_t len);
+  bool HeaderBlockComplete();
+  bool WriteRaw(const uint8_t* data, size_t len);
+  bool WriteFrame(FrameType type, uint8_t flags, uint32_t stream_id,
+                  const uint8_t* payload, size_t len);
+  void PumpPending(H2Stream* s);
+  void CloseStreamIfDone(H2Stream* s);
+
+  int fd_;
+  Role role_;
+  bool alive_ = true;
+  bool got_preface_ = false;       // server role: client magic received
+  bool got_peer_settings_ = false;
+  uint32_t next_stream_id_;        // client role
+  std::string rbuf_;               // unparsed inbound bytes
+  std::string wbuf_;               // unwritten outbound bytes
+  HpackDecoder hpack_;
+  int64_t conn_send_window_ = kDefaultWindow;
+  uint32_t peer_initial_window_ = kDefaultWindow;
+  uint32_t peer_max_frame_ = kMaxFrameSize;
+  // In-flight header block (HEADERS + CONTINUATIONs until END_HEADERS).
+  uint32_t hdr_stream_ = 0;
+  std::string hdr_block_;
+  bool hdr_end_stream_ = false;
+  std::map<uint32_t, std::unique_ptr<H2Stream>> streams_;
+};
+
+}  // namespace grpcmin

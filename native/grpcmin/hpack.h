@@ -1,0 +1,60 @@
+// HPACK (RFC 7541) header compression for the minimal gRPC transport.
+//
+// Decoder: full spec — indexed fields against the static + dynamic tables,
+// all literal forms, dynamic-table size updates, and Huffman-coded strings
+// (grpc-go and grpc C-core Huffman-encode header values, so a compliant
+// decoder is mandatory for kubelet interop).
+// Encoder: deliberately minimal — literal-without-indexing with raw (non-
+// Huffman) strings, which is always legal and keeps us stateless on send.
+#pragma once
+
+#include <cstdint>
+#include <deque>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace grpcmin {
+
+using Header = std::pair<std::string, std::string>;
+
+class HpackDecoder {
+ public:
+  explicit HpackDecoder(size_t max_dynamic_size = 4096)
+      : max_dynamic_size_(max_dynamic_size), dynamic_size_(0) {}
+
+  // Decodes one complete header block. Returns false on malformed input
+  // (connection error COMPRESSION_ERROR per RFC 7540 §4.3).
+  bool Decode(const uint8_t* data, size_t len, std::vector<Header>* out);
+
+ private:
+  bool LookupIndex(uint64_t index, Header* out) const;
+  void InsertDynamic(Header h);
+  void EvictTo(size_t target);
+
+  size_t max_dynamic_size_;
+  size_t dynamic_size_;
+  std::deque<Header> dynamic_;  // front = most recent (index 62)
+};
+
+class HpackEncoder {
+ public:
+  // Appends the encoding of one header as literal-without-indexing.
+  static void Encode(const Header& h, std::vector<uint8_t>* out);
+  static void EncodeAll(const std::vector<Header>& hs,
+                        std::vector<uint8_t>* out);
+};
+
+// Huffman decode over the RFC 7541 Appendix B code. Returns false on invalid
+// padding / EOS in stream.
+bool HuffmanDecode(const uint8_t* data, size_t len, std::string* out);
+
+// Variable-length integer with n-bit prefix (RFC 7541 §5.1). Reads from
+// data[*pos..len); *pos advances past the integer. prefix_bits in [1,8];
+// first_byte_mask extracts the prefix from data[*pos].
+bool DecodeInt(const uint8_t* data, size_t len, size_t* pos, int prefix_bits,
+               uint64_t* out);
+void EncodeInt(uint64_t value, int prefix_bits, uint8_t first_byte_flags,
+               std::vector<uint8_t>* out);
+
+}  // namespace grpcmin

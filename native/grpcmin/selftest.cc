@@ -1,0 +1,153 @@
+// grpcmin unit selftest: HPACK integers, Huffman, full header blocks
+// (vectors produced by an independent RFC 7541 implementation, exercising
+// Huffman coding, static-table references and dynamic-table indexing), and
+// gRPC message framing. Exit 0 on success; prints the first failure.
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "grpc.h"
+#include "hpack.h"
+
+using grpcmin::Header;
+using grpcmin::HpackDecoder;
+using grpcmin::HpackEncoder;
+
+static int failures = 0;
+
+#define CHECK(cond)                                              \
+  do {                                                           \
+    if (!(cond)) {                                               \
+      printf("FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond);     \
+      ++failures;                                                \
+    }                                                            \
+  } while (0)
+
+static std::vector<uint8_t> FromHex(const char* hex) {
+  std::vector<uint8_t> out;
+  for (size_t i = 0; hex[i] && hex[i + 1]; i += 2) {
+    auto nib = [](char c) -> int {
+      if (c >= '0' && c <= '9') return c - '0';
+      if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+      return c - 'A' + 10;
+    };
+    out.push_back(uint8_t(nib(hex[i]) << 4 | nib(hex[i + 1])));
+  }
+  return out;
+}
+
+static void TestIntegers() {
+  // RFC 7541 §C.1 examples.
+  std::vector<uint8_t> buf;
+  grpcmin::EncodeInt(10, 5, 0, &buf);
+  CHECK(buf.size() == 1 && buf[0] == 0x0a);
+  buf.clear();
+  grpcmin::EncodeInt(1337, 5, 0, &buf);
+  CHECK(buf.size() == 3 && buf[0] == 0x1f && buf[1] == 0x9a && buf[2] == 0x0a);
+  buf.clear();
+  grpcmin::EncodeInt(42, 8, 0, &buf);
+  CHECK(buf.size() == 1 && buf[0] == 0x2a);
+
+  size_t pos = 0;
+  uint64_t v;
+  uint8_t b1337[] = {0x1f, 0x9a, 0x0a};
+  CHECK(grpcmin::DecodeInt(b1337, 3, &pos, 5, &v) && v == 1337 && pos == 3);
+  // Truncated continuation must fail, not loop.
+  pos = 0;
+  uint8_t trunc[] = {0x1f, 0x9a};
+  CHECK(!grpcmin::DecodeInt(trunc, 2, &pos, 5, &v));
+}
+
+static void TestHuffman() {
+  // "www.example.com" Huffman-coded (RFC 7541 §C.4.1 string).
+  auto bytes = FromHex("f1e3c2e5f23a6ba0ab90f4ff");
+  std::string out;
+  CHECK(grpcmin::HuffmanDecode(bytes.data(), bytes.size(), &out));
+  CHECK(out == "www.example.com");
+  // Bad padding (0 bits where EOS-prefix 1s required).
+  auto bad = FromHex("f1e3c2e5f23a6ba0ab90f400");
+  out.clear();
+  CHECK(!grpcmin::HuffmanDecode(bad.data(), bad.size(), &out));
+}
+
+static void TestHeaderBlocks() {
+  // Two consecutive blocks from one grpc-style encoder connection:
+  // huffman strings + incremental indexing + dynamic-table hits in block 2.
+  const char* v1 =
+      "8386449963b8632a4615ef97b9885d745b31aa633990986a9390d249ff4186a0e41d13"
+      "9d095f8b1d75d0620d263d4c4d65647a8a9acac8b4c7602bb825c14082497f864d8335"
+      "05b11f";
+  const char* v2 =
+      "8386449663b8632a4615ef97b9885d745b31aa621a28390692ffc2c1c0bf40899acac8"
+      "b24d494f6a7f846400053f";
+  HpackDecoder dec;
+  auto b1 = FromHex(v1);
+  std::vector<Header> h1;
+  CHECK(dec.Decode(b1.data(), b1.size(), &h1));
+  CHECK(h1.size() == 7);
+  auto find = [](const std::vector<Header>& hs, const char* k) {
+    for (auto& [n, v] : hs)
+      if (n == k) return v;
+    return std::string("<missing>");
+  };
+  CHECK(find(h1, ":method") == "POST");
+  CHECK(find(h1, ":scheme") == "http");
+  CHECK(find(h1, ":path") == "/v1beta1.DevicePlugin/ListAndWatch");
+  CHECK(find(h1, ":authority") == "localhost");
+  CHECK(find(h1, "content-type") == "application/grpc");
+  CHECK(find(h1, "user-agent") == "grpc-go/1.62.0");
+  CHECK(find(h1, "te") == "trailers");
+
+  auto b2 = FromHex(v2);
+  std::vector<Header> h2;
+  CHECK(dec.Decode(b2.data(), b2.size(), &h2));
+  CHECK(h2.size() == 8);
+  CHECK(find(h2, ":path") == "/v1beta1.DevicePlugin/Allocate");
+  CHECK(find(h2, ":authority") == "localhost");   // dynamic-table hit
+  CHECK(find(h2, "user-agent") == "grpc-go/1.62.0");
+  CHECK(find(h2, "grpc-timeout") == "3000m");
+}
+
+static void TestEncoderRoundTrip() {
+  std::vector<Header> hs = {{":status", "200"},
+                            {"content-type", "application/grpc"},
+                            {"grpc-status", "0"}};
+  std::vector<uint8_t> buf;
+  HpackEncoder::EncodeAll(hs, &buf);
+  HpackDecoder dec;
+  std::vector<Header> out;
+  CHECK(dec.Decode(buf.data(), buf.size(), &out));
+  CHECK(out == hs);
+}
+
+static void TestFraming() {
+  std::string framed = grpcmin::FrameMessage("hello");
+  CHECK(framed.size() == 10 && framed[0] == 0 && framed[4] == 5);
+  std::string buf = framed + grpcmin::FrameMessage("");
+  std::string msg;
+  bool bad;
+  CHECK(grpcmin::UnframeMessage(&buf, &msg, &bad) && msg == "hello" && !bad);
+  CHECK(grpcmin::UnframeMessage(&buf, &msg, &bad) && msg.empty() && !bad);
+  CHECK(buf.empty());
+  // Compressed flag set -> bad.
+  buf = std::string("\x01\x00\x00\x00\x00", 5);
+  CHECK(!grpcmin::UnframeMessage(&buf, &msg, &bad) && bad);
+  // Partial message -> incomplete, not bad.
+  buf = std::string("\x00\x00\x00\x00\x05he", 7);
+  CHECK(!grpcmin::UnframeMessage(&buf, &msg, &bad) && !bad);
+}
+
+int main() {
+  TestIntegers();
+  TestHuffman();
+  TestHeaderBlocks();
+  TestEncoderRoundTrip();
+  TestFraming();
+  if (failures == 0) {
+    printf("grpcmin selftest: all OK\n");
+    return 0;
+  }
+  printf("grpcmin selftest: %d failure(s)\n", failures);
+  return 1;
+}

@@ -1,0 +1,187 @@
+#include "topology.h"
+
+#include <algorithm>
+#include <set>
+#include <sstream>
+
+namespace tpud {
+
+namespace {
+
+const std::vector<AcceleratorType>& Catalogue() {
+  static const std::vector<AcceleratorType> kTypes = {
+      {"v4-8", "v4", 4, 2, 2, 32, {4}, {{4, {2, 2}}}},
+      {"v5e-1", "v5e", 1, 1, 1, 16, {1}, {{1, {1, 1}}}},
+      {"v5e-4", "v5e", 4, 2, 2, 16, {1, 4}, {{1, {1, 1}}, {4, {2, 2}}}},
+      {"v5e-8", "v5e", 8, 2, 4, 16, {1, 4, 8},
+       {{1, {1, 1}}, {4, {2, 2}}, {8, {2, 4}}}},
+      {"v5p-8", "v5p", 4, 2, 2, 95, {4}, {{4, {2, 2}}}},
+      {"v6e-8", "v6e", 8, 2, 4, 32, {1, 4, 8},
+       {{1, {1, 1}}, {4, {2, 2}}, {8, {2, 4}}}},
+  };
+  return kTypes;
+}
+
+// Chip id -> coordinate, row-major: id = y * X + x (matches topology.py).
+inline int CoordToId(const AcceleratorType& acc, int x, int y) {
+  return y * acc.topo_x + x;
+}
+
+}  // namespace
+
+const AcceleratorType* FindAccelerator(const std::string& name) {
+  for (const auto& t : Catalogue())
+    if (t.name == name) return &t;
+  return nullptr;
+}
+
+std::vector<std::string> KnownAccelerators() {
+  std::vector<std::string> out;
+  for (const auto& t : Catalogue()) out.push_back(t.name);
+  return out;
+}
+
+std::vector<std::vector<int>> AlignedSubsets(const AcceleratorType& acc,
+                                             int size) {
+  std::vector<std::vector<int>> out;
+  const std::pair<int, int>* shape = nullptr;
+  for (const auto& [sz, sh] : acc.sub_mesh_shapes)
+    if (sz == size) shape = &sh;
+  if (!shape) return out;
+  std::set<std::vector<int>> uniq;
+  // Both orientations of the rectangle.
+  std::set<std::pair<int, int>> orients = {*shape,
+                                           {shape->second, shape->first}};
+  for (const auto& [w, h] : orients) {
+    if (w > acc.topo_x || h > acc.topo_y) continue;
+    for (int x0 = 0; x0 + w <= acc.topo_x; ++x0) {
+      for (int y0 = 0; y0 + h <= acc.topo_y; ++y0) {
+        std::vector<int> ids;
+        for (int dx = 0; dx < w; ++dx)
+          for (int dy = 0; dy < h; ++dy)
+            ids.push_back(CoordToId(acc, x0 + dx, y0 + dy));
+        std::sort(ids.begin(), ids.end());
+        uniq.insert(std::move(ids));
+      }
+    }
+  }
+  out.assign(uniq.begin(), uniq.end());
+  return out;
+}
+
+std::optional<std::vector<int>> PreferredAllocation(
+    const AcceleratorType& acc, const std::vector<int>& available,
+    const std::vector<int>& must_include, int size) {
+  std::set<int> avail(available.begin(), available.end());
+  std::set<int> must(must_include.begin(), must_include.end());
+  if (static_cast<int>(must.size()) > size) return std::nullopt;
+  for (int m : must)
+    if (!avail.count(m)) return std::nullopt;
+  for (const auto& subset : AlignedSubsets(acc, size)) {
+    std::set<int> s(subset.begin(), subset.end());
+    bool covers_must = std::includes(s.begin(), s.end(), must.begin(),
+                                     must.end());
+    bool within_avail =
+        std::includes(avail.begin(), avail.end(), s.begin(), s.end());
+    if (covers_must && within_avail) return subset;
+  }
+  return std::nullopt;
+}
+
+bool ValidateAllocation(const AcceleratorType& acc,
+                        const std::vector<int>& device_ids,
+                        std::string* reason) {
+  std::vector<int> ids(device_ids);
+  std::sort(ids.begin(), ids.end());
+  int n = static_cast<int>(ids.size());
+  auto join = [](const std::vector<int>& v) {
+    std::ostringstream os;
+    for (size_t i = 0; i < v.size(); ++i) os << (i ? "," : "") << v[i];
+    return os.str();
+  };
+  if (std::find(acc.aligned_sizes.begin(), acc.aligned_sizes.end(), n) ==
+      acc.aligned_sizes.end()) {
+    std::ostringstream os;
+    os << "request size " << n << " is not aligned for " << acc.name
+       << "; allowed sizes: ";
+    for (size_t i = 0; i < acc.aligned_sizes.size(); ++i)
+      os << (i ? "," : "") << acc.aligned_sizes[i];
+    *reason = os.str();
+    return false;
+  }
+  for (int id : ids) {
+    if (id < 0 || id >= acc.chips_per_host) {
+      *reason = "device ids out of range for " + acc.name;
+      return false;
+    }
+  }
+  if (std::set<int>(ids.begin(), ids.end()).size() != ids.size()) {
+    *reason = "duplicate device ids in " + join(ids);
+    return false;
+  }
+  auto subsets = AlignedSubsets(acc, n);
+  if (std::find(subsets.begin(), subsets.end(), ids) != subsets.end()) {
+    *reason = "aligned sub-mesh";
+    return true;
+  }
+  *reason = "device set " + join(ids) +
+            " is not an ICI-contiguous sub-mesh of " + acc.name + " (" +
+            acc.LabelTopology() + ")";
+  return false;
+}
+
+std::string GoldenJson() {
+  std::ostringstream os;
+  os << "{\"accelerators\": [";
+  bool first_acc = true;
+  for (const auto& acc : Catalogue()) {
+    if (!first_acc) os << ", ";
+    first_acc = false;
+    os << "{\"name\": \"" << acc.name << "\", \"chips_per_host\": "
+       << acc.chips_per_host << ", \"topology\": [" << acc.topo_x << ", "
+       << acc.topo_y << "], \"aligned_sizes\": [";
+    for (size_t i = 0; i < acc.aligned_sizes.size(); ++i)
+      os << (i ? ", " : "") << acc.aligned_sizes[i];
+    os << "], \"aligned_subsets\": {";
+    for (size_t i = 0; i < acc.aligned_sizes.size(); ++i) {
+      int sz = acc.aligned_sizes[i];
+      os << (i ? ", " : "") << "\"" << sz << "\": [";
+      auto subsets = AlignedSubsets(acc, sz);
+      for (size_t j = 0; j < subsets.size(); ++j) {
+        os << (j ? ", " : "") << "[";
+        for (size_t k = 0; k < subsets[j].size(); ++k)
+          os << (k ? ", " : "") << subsets[j][k];
+        os << "]";
+      }
+      os << "]";
+    }
+    os << "}, \"validate_cases\": [";
+    // Exhaustive combinations, same order as Python itertools.combinations.
+    bool first_case = true;
+    for (int n = 1; n <= acc.chips_per_host; ++n) {
+      std::vector<int> combo(n);
+      // Generate combinations in lexicographic order.
+      for (int i = 0; i < n; ++i) combo[i] = i;
+      while (true) {
+        std::string reason;
+        bool ok = ValidateAllocation(acc, combo, &reason);
+        if (!first_case) os << ", ";
+        first_case = false;
+        os << "{\"ids\": [";
+        for (int i = 0; i < n; ++i) os << (i ? ", " : "") << combo[i];
+        os << "], \"ok\": " << (ok ? "true" : "false") << "}";
+        // next combination
+        int i = n - 1;
+        while (i >= 0 && combo[i] == acc.chips_per_host - n + i) --i;
+        if (i < 0) break;
+        ++combo[i];
+        for (int j = i + 1; j < n; ++j) combo[j] = combo[j - 1] + 1;
+      }
+    }
+    os << "]}";
+  }
+  os << "]}";
+  return os.str();
+}
+
+}  // namespace tpud

@@ -1,0 +1,56 @@
+// TPU per-host topology model + aligned-allocation policy (C++).
+//
+// Mirror of tpu_cluster/topology.py — the two implementations are pinned to
+// the same golden vectors (tests/data/topology_golden.json via
+// tests/test_native.py). Policy rationale lives in the Python docstrings.
+#pragma once
+
+#include <cstdint>
+#include <optional>
+#include <string>
+#include <vector>
+
+namespace tpud {
+
+struct AcceleratorType {
+  std::string name;        // "v5e-8"
+  std::string generation;  // "v5e"
+  int chips_per_host;
+  int topo_x, topo_y;      // per-host chip grid
+  int hbm_gib_per_chip;
+  std::vector<int> aligned_sizes;
+  // size -> sub-mesh rectangle (w, h)
+  std::vector<std::pair<int, std::pair<int, int>>> sub_mesh_shapes;
+
+  std::string LabelTopology() const {
+    return std::to_string(topo_x) + "x" + std::to_string(topo_y);
+  }
+};
+
+// nullptr when unknown.
+const AcceleratorType* FindAccelerator(const std::string& name);
+std::vector<std::string> KnownAccelerators();
+
+// All chip-id subsets of `size` forming a valid ICI sub-mesh; sorted, each
+// subset sorted (deterministic; matches Python aligned_subsets()).
+std::vector<std::vector<int>> AlignedSubsets(const AcceleratorType& acc,
+                                             int size);
+
+// GetPreferredAllocation policy: aligned sub-mesh covering must_include from
+// available, lowest chip ids first. nullopt when impossible.
+std::optional<std::vector<int>> PreferredAllocation(
+    const AcceleratorType& acc, const std::vector<int>& available,
+    const std::vector<int>& must_include, int size);
+
+// Allocate() admission check. Returns true when device_ids is an aligned
+// sub-mesh; fills *reason either way.
+bool ValidateAllocation(const AcceleratorType& acc,
+                        const std::vector<int>& device_ids,
+                        std::string* reason);
+
+// Emits the same JSON structure as tests/data/topology_golden.json so the
+// Python test can diff the two implementations byte-for-byte (modulo
+// formatting).
+std::string GoldenJson();
+
+}  // namespace tpud

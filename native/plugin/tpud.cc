@@ -1,0 +1,482 @@
+// tpud — TPU device plugin daemon (the stack's centerpiece).
+//
+// Native replacement for the reference's nvidia-device-plugin-daemonset
+// (reference README.md:106,211; SURVEY.md §2.2): registers with the kubelet
+// over the DevicePlugin v1beta1 gRPC API, ListAndWatches chips discovered
+// from /dev/accel* (or synthesised in --fake-devices mode, the clusterless
+// test story of SURVEY.md §4), advertises the `google.com/tpu` extended
+// resource, answers topology-aligned GetPreferredAllocation, and returns
+// device nodes + env + libtpu mount from Allocate — which on TPU also covers
+// the capability the GPU stack needs nvidia-container-toolkit for
+// (reference README.md:105,210; docs/DELTAS.md).
+//
+// Design: single-threaded poll loop (grpcmin::Server::RunOnce) + periodic
+// device rescans and kubelet (re-)registration. Kubelet restarts are detected
+// by watching the registration socket inode; the plugin re-registers, which
+// is the subtle lifecycle requirement SURVEY.md §7 ranks hard-part #1.
+
+#include <glob.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <map>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "deviceplugin.pb.h"
+#include "../grpcmin/grpc.h"
+#include "topology.h"
+
+namespace {
+
+volatile sig_atomic_t g_stop = 0;
+void HandleSignal(int) { g_stop = 1; }
+
+struct Options {
+  std::string resource = "google.com/tpu";
+  std::string accelerator = "v5e-8";
+  std::string device_glob = "/dev/accel*";
+  std::string libtpu_path = "/var/lib/tpu/libtpu.so";
+  std::string kubelet_dir = "/var/lib/kubelet/device-plugins";
+  std::string endpoint = "tpud.sock";
+  std::string devfs_root;          // re-roots device_glob (tests)
+  int fake_devices = -1;           // >=0: synthesise N chips, no device files
+  bool do_register = true;
+  bool print_topology_golden = false;
+  int rescan_interval_s = 3;
+};
+
+struct ChipDevice {
+  int index;
+  std::string path;
+  bool healthy;
+  int numa_node = -1;
+};
+
+std::string DeviceId(int index) { return "tpu-" + std::to_string(index); }
+
+int ParseIndexFromId(const std::string& id) {
+  if (id.rfind("tpu-", 0) != 0) return -1;
+  return atoi(id.c_str() + 4);
+}
+
+int ReadNumaNode(const std::string& dev_path) {
+  // /dev/accelN -> /sys/class/accel/accelN/device/numa_node
+  const char* base = strrchr(dev_path.c_str(), '/');
+  if (!base) return -1;
+  std::string sysfs = "/sys/class/accel/" + std::string(base + 1) +
+                      "/device/numa_node";
+  FILE* f = fopen(sysfs.c_str(), "r");
+  if (!f) return -1;
+  int node = -1;
+  if (fscanf(f, "%d", &node) != 1) node = -1;
+  fclose(f);
+  return node;
+}
+
+std::vector<ChipDevice> DiscoverDevices(const Options& opt) {
+  std::vector<ChipDevice> out;
+  if (opt.fake_devices >= 0) {
+    for (int i = 0; i < opt.fake_devices; ++i)
+      out.push_back({i, "/dev/accel" + std::to_string(i), true, -1});
+    return out;
+  }
+  std::string pattern = opt.device_glob;
+  if (!opt.devfs_root.empty()) {
+    std::string rel = pattern;
+    if (!rel.empty() && rel[0] == '/') rel = rel.substr(1);
+    pattern = opt.devfs_root + "/" + rel;
+  }
+  glob_t g;
+  memset(&g, 0, sizeof(g));
+  if (glob(pattern.c_str(), 0, nullptr, &g) == 0) {
+    for (size_t i = 0; i < g.gl_pathc; ++i) {
+      std::string path = g.gl_pathv[i];
+      const char* base = strrchr(path.c_str(), '/');
+      base = base ? base + 1 : path.c_str();
+      // accept accelN / accel_N
+      const char* digits = base;
+      while (*digits && (*digits < '0' || *digits > '9')) ++digits;
+      if (!*digits) continue;
+      int idx = atoi(digits);
+      out.push_back({idx, path, access(path.c_str(), F_OK) == 0,
+                     ReadNumaNode(path)});
+    }
+  }
+  globfree(&g);
+  // sort by index for deterministic ids
+  std::sort(out.begin(), out.end(),
+            [](const ChipDevice& a, const ChipDevice& b) {
+              return a.index < b.index;
+            });
+  return out;
+}
+
+class Plugin {
+ public:
+  Plugin(const Options& opt, const tpud::AcceleratorType& acc)
+      : opt_(opt), acc_(acc) {}
+
+  bool Init() {
+    socket_path_ = opt_.kubelet_dir + "/" + opt_.endpoint;
+    if (!server_.Listen(socket_path_)) {
+      fprintf(stderr, "tpud: cannot listen on %s: %s\n", socket_path_.c_str(),
+              strerror(errno));
+      return false;
+    }
+    devices_ = DiscoverDevices(opt_);
+    fprintf(stderr, "tpud: serving %s on %s (%zu chips, accelerator=%s)\n",
+            opt_.resource.c_str(), socket_path_.c_str(), devices_.size(),
+            acc_.name.c_str());
+    RegisterMethods();
+    return true;
+  }
+
+  void Run() {
+    time_t last_rescan = 0, last_reg_check = 0;
+    while (!g_stop) {
+      server_.RunOnce(200);
+      time_t now = time(nullptr);
+      if (now - last_rescan >= opt_.rescan_interval_s) {
+        last_rescan = now;
+        Rescan();
+      }
+      if (now - last_reg_check >= 2) {
+        last_reg_check = now;
+        CheckOwnSocket();
+        if (opt_.do_register) MaybeRegister();
+      }
+    }
+    fprintf(stderr, "tpud: shutting down\n");
+    server_.Shutdown();
+  }
+
+ private:
+  // ---------------------------------------------------------- services
+
+  void RegisterMethods() {
+    using grpcmin::Status;
+    using grpcmin::StatusCode;
+
+    server_.AddUnary(
+        "/v1beta1.DevicePlugin/GetDevicePluginOptions",
+        [](const std::string&, std::string* resp) {
+          v1beta1::DevicePluginOptions opts;
+          opts.set_get_preferred_allocation_available(true);
+          opts.SerializeToString(resp);
+          return Status::Ok();
+        });
+
+    server_.AddServerStreaming(
+        "/v1beta1.DevicePlugin/ListAndWatch",
+        [this](const std::string&, grpcmin::ServerStream* stream) {
+          stream->on_closed = [this, stream]() { watchers_.erase(stream); };
+          watchers_.insert(stream);
+          stream->Send(SerializeDeviceList());
+        });
+
+    server_.AddUnary(
+        "/v1beta1.DevicePlugin/GetPreferredAllocation",
+        [this](const std::string& req_bytes, std::string* resp) {
+          v1beta1::PreferredAllocationRequest req;
+          if (!req.ParseFromString(req_bytes))
+            return Status{StatusCode::kInvalidArgument, "bad request proto"};
+          v1beta1::PreferredAllocationResponse resp_pb;
+          for (const auto& creq : req.container_requests()) {
+            auto* cresp = resp_pb.add_container_responses();
+            std::vector<int> avail, must;
+            for (const auto& id : creq.available_deviceids())
+              avail.push_back(ParseIndexFromId(id));
+            for (const auto& id : creq.must_include_deviceids())
+              must.push_back(ParseIndexFromId(id));
+            auto pick = tpud::PreferredAllocation(acc_, avail, must,
+                                                  creq.allocation_size());
+            if (pick) {
+              for (int idx : *pick) cresp->add_deviceids(DeviceId(idx));
+            }
+            // Empty response lets kubelet fall back to its own pick, which
+            // Allocate() will then admission-check.
+          }
+          resp_pb.SerializeToString(resp);
+          return Status::Ok();
+        });
+
+    server_.AddUnary(
+        "/v1beta1.DevicePlugin/Allocate",
+        [this](const std::string& req_bytes, std::string* resp) {
+          v1beta1::AllocateRequest req;
+          if (!req.ParseFromString(req_bytes))
+            return Status{StatusCode::kInvalidArgument, "bad request proto"};
+          v1beta1::AllocateResponse resp_pb;
+          for (const auto& creq : req.container_requests()) {
+            std::vector<int> ids;
+            for (const auto& id : creq.devicesids())
+              ids.push_back(ParseIndexFromId(id));
+            std::string reason;
+            if (!tpud::ValidateAllocation(acc_, ids, &reason)) {
+              // Surfaces in the pod event — the admission story for
+              // unaligned requests (SURVEY.md §7 hard-part #2).
+              return Status{StatusCode::kInvalidArgument, reason};
+            }
+            FillContainerResponse(ids, resp_pb.add_container_responses());
+          }
+          resp_pb.SerializeToString(resp);
+          return Status::Ok();
+        });
+
+    server_.AddUnary("/v1beta1.DevicePlugin/PreStartContainer",
+                     [](const std::string&, std::string* resp) {
+                       v1beta1::PreStartContainerResponse r;
+                       r.SerializeToString(resp);
+                       return Status::Ok();
+                     });
+  }
+
+  void FillContainerResponse(const std::vector<int>& ids,
+                             v1beta1::ContainerAllocateResponse* cresp) {
+    std::vector<int> sorted_ids(ids);
+    std::sort(sorted_ids.begin(), sorted_ids.end());
+    std::string visible;
+    for (size_t i = 0; i < sorted_ids.size(); ++i)
+      visible += (i ? "," : "") + std::to_string(sorted_ids[i]);
+
+    // Device nodes. Container path mirrors the canonical /dev/accelN layout
+    // regardless of host devfs rerooting.
+    for (int idx : sorted_ids) {
+      const ChipDevice* dev = FindDevice(idx);
+      auto* spec = cresp->add_devices();
+      spec->set_container_path("/dev/accel" + std::to_string(idx));
+      spec->set_host_path(dev ? dev->path
+                              : "/dev/accel" + std::to_string(idx));
+      spec->set_permissions("rw");
+    }
+
+    // Sub-mesh bounds of the allocated chip set (bounding box of coords).
+    int min_x = acc_.topo_x, max_x = -1, min_y = acc_.topo_y, max_y = -1;
+    for (int idx : sorted_ids) {
+      int x = idx % acc_.topo_x, y = idx / acc_.topo_x;
+      min_x = std::min(min_x, x); max_x = std::max(max_x, x);
+      min_y = std::min(min_y, y); max_y = std::max(max_y, y);
+    }
+    int w = max_x - min_x + 1, h = max_y - min_y + 1;
+
+    // The env contract consumed by libtpu/JAX in the workload container —
+    // the TPU delta replacing the container-toolkit hook (docs/DELTAS.md).
+    auto& envs = *cresp->mutable_envs();
+    envs["TPU_VISIBLE_DEVICES"] = visible;
+    envs["TPU_CHIPS_PER_HOST_BOUNDS"] =
+        std::to_string(w) + "," + std::to_string(h) + ",1";
+    envs["TPU_HOST_BOUNDS"] = "1,1,1";
+    envs["TPU_SKIP_MDS_QUERY"] = "true";
+    envs["TPU_ACCELERATOR_TYPE"] = acc_.name;
+    envs["TPU_DEVICE_COUNT"] = std::to_string(sorted_ids.size());
+
+    if (!opt_.libtpu_path.empty()) {
+      std::string dir = opt_.libtpu_path.substr(
+          0, opt_.libtpu_path.find_last_of('/'));
+      auto* m = cresp->add_mounts();
+      m->set_container_path(dir);
+      m->set_host_path(dir);
+      m->set_read_only(true);
+      envs["TPU_LIBRARY_PATH"] = opt_.libtpu_path;
+    }
+    (*cresp->mutable_annotations())["tpu.native/allocation"] = visible;
+  }
+
+  // ---------------------------------------------------------- devices
+
+  const ChipDevice* FindDevice(int index) const {
+    for (const auto& d : devices_)
+      if (d.index == index) return &d;
+    return nullptr;
+  }
+
+  std::string SerializeDeviceList() const {
+    v1beta1::ListAndWatchResponse resp;
+    for (const auto& d : devices_) {
+      auto* dev = resp.add_devices();
+      dev->set_id(DeviceId(d.index));
+      dev->set_health(d.healthy ? "Healthy" : "Unhealthy");
+      if (d.numa_node >= 0)
+        dev->mutable_topology()->add_nodes()->set_id(d.numa_node);
+    }
+    std::string out;
+    resp.SerializeToString(&out);
+    return out;
+  }
+
+  void Rescan() {
+    auto found = DiscoverDevices(opt_);
+    bool changed = found.size() != devices_.size();
+    if (!changed) {
+      for (size_t i = 0; i < found.size(); ++i) {
+        if (found[i].index != devices_[i].index ||
+            found[i].healthy != devices_[i].healthy) {
+          changed = true;
+          break;
+        }
+      }
+    }
+    if (changed) {
+      fprintf(stderr, "tpud: device set changed (%zu -> %zu chips)\n",
+              devices_.size(), found.size());
+      devices_ = std::move(found);
+      std::string update = SerializeDeviceList();
+      for (auto* w : std::set<grpcmin::ServerStream*>(watchers_))
+        w->Send(update);
+    }
+  }
+
+  // ---------------------------------------------------------- registration
+
+  // A restarting kubelet wipes the device-plugins dir, deleting our endpoint
+  // socket — the canonical re-register signal. (We cannot rely on the
+  // kubelet.sock inode alone: tmpfs reuses inode numbers, so a fast restart
+  // can leave it unchanged.)
+  void CheckOwnSocket() {
+    struct stat st;
+    if (stat(socket_path_.c_str(), &st) == 0 && S_ISSOCK(st.st_mode)) return;
+    fprintf(stderr,
+            "tpud: endpoint socket %s disappeared (kubelet restart?); "
+            "re-listening\n",
+            socket_path_.c_str());
+    server_.Shutdown();
+    watchers_.clear();  // streams died with their connections
+    if (!server_.Listen(socket_path_)) {
+      fprintf(stderr, "tpud: re-listen failed: %s\n", strerror(errno));
+    }
+    registered_ = false;
+  }
+
+  void MaybeRegister() {
+    std::string kubelet_sock = opt_.kubelet_dir + "/kubelet.sock";
+    struct stat st;
+    if (stat(kubelet_sock.c_str(), &st) != 0) {
+      registered_ = false;  // kubelet gone; re-register when it returns
+      return;
+    }
+    bool same_socket =
+        st.st_ino == kubelet_ino_ &&
+        st.st_mtim.tv_sec == kubelet_mtim_.tv_sec &&
+        st.st_mtim.tv_nsec == kubelet_mtim_.tv_nsec;
+    if (registered_ && same_socket) return;
+
+    v1beta1::RegisterRequest req;
+    req.set_version("v1beta1");
+    req.set_endpoint(opt_.endpoint);
+    req.set_resource_name(opt_.resource);
+    req.mutable_options()->set_get_preferred_allocation_available(true);
+    std::string req_bytes;
+    req.SerializeToString(&req_bytes);
+
+    std::string resp_bytes;
+    grpcmin::Status status;
+    bool ok = grpcmin::Client::UnaryCall(
+        kubelet_sock, "/v1beta1.Registration/Register", req_bytes,
+        &resp_bytes, &status, 3000);
+    if (ok && status.code == grpcmin::StatusCode::kOk) {
+      registered_ = true;
+      kubelet_ino_ = st.st_ino;
+      kubelet_mtim_ = st.st_mtim;
+      fprintf(stderr, "tpud: registered %s with kubelet (endpoint %s)\n",
+              opt_.resource.c_str(), opt_.endpoint.c_str());
+    } else if (!registered_) {
+      fprintf(stderr, "tpud: kubelet registration failed (%s); will retry\n",
+              status.message.empty() ? "transport error"
+                                     : status.message.c_str());
+    }
+  }
+
+  Options opt_;
+  const tpud::AcceleratorType& acc_;
+  grpcmin::Server server_;
+  std::string socket_path_;
+  std::vector<ChipDevice> devices_;
+  std::set<grpcmin::ServerStream*> watchers_;
+  bool registered_ = false;
+  ino_t kubelet_ino_ = 0;
+  struct timespec kubelet_mtim_ = {0, 0};
+};
+
+bool ParseFlag(const char* arg, const char* name, std::string* out) {
+  size_t n = strlen(name);
+  if (strncmp(arg, name, n) == 0 && arg[n] == '=') {
+    *out = arg + n + 1;
+    return true;
+  }
+  return false;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  Options opt;
+  std::string sval;
+  for (int i = 1; i < argc; ++i) {
+    const char* a = argv[i];
+    if (ParseFlag(a, "--resource", &opt.resource)) continue;
+    if (ParseFlag(a, "--accelerator", &opt.accelerator)) continue;
+    if (ParseFlag(a, "--device-glob", &opt.device_glob)) continue;
+    if (ParseFlag(a, "--libtpu-path", &opt.libtpu_path)) continue;
+    if (ParseFlag(a, "--kubelet-dir", &opt.kubelet_dir)) continue;
+    if (ParseFlag(a, "--endpoint", &opt.endpoint)) continue;
+    if (ParseFlag(a, "--devfs-root", &opt.devfs_root)) continue;
+    if (ParseFlag(a, "--fake-devices", &sval)) {
+      opt.fake_devices = atoi(sval.c_str());
+      continue;
+    }
+    if (ParseFlag(a, "--rescan-interval", &sval)) {
+      opt.rescan_interval_s = atoi(sval.c_str());
+      continue;
+    }
+    if (strcmp(a, "--no-register") == 0) {
+      opt.do_register = false;
+      continue;
+    }
+    if (strcmp(a, "--print-topology-golden") == 0) {
+      opt.print_topology_golden = true;
+      continue;
+    }
+    fprintf(stderr,
+            "tpud: unknown flag %s\n"
+            "usage: tpud [--resource=google.com/tpu] [--accelerator=v5e-8]\n"
+            "            [--device-glob=/dev/accel*] [--devfs-root=DIR]\n"
+            "            [--fake-devices=N] [--libtpu-path=PATH]\n"
+            "            [--kubelet-dir=DIR] [--endpoint=tpud.sock]\n"
+            "            [--rescan-interval=SECS] [--no-register]\n"
+            "            [--print-topology-golden]\n",
+            a);
+    return 2;
+  }
+
+  if (opt.print_topology_golden) {
+    printf("%s\n", tpud::GoldenJson().c_str());
+    return 0;
+  }
+
+  const tpud::AcceleratorType* acc = tpud::FindAccelerator(opt.accelerator);
+  if (!acc) {
+    fprintf(stderr, "tpud: unknown accelerator type '%s'; known:",
+            opt.accelerator.c_str());
+    for (const auto& n : tpud::KnownAccelerators())
+      fprintf(stderr, " %s", n.c_str());
+    fprintf(stderr, "\n");
+    return 2;
+  }
+
+  signal(SIGINT, HandleSignal);
+  signal(SIGTERM, HandleSignal);
+  signal(SIGPIPE, SIG_IGN);
+
+  Plugin plugin(opt, *acc);
+  if (!plugin.Init()) return 1;
+  plugin.Run();
+  return 0;
+}

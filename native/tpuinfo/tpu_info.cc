@@ -1,0 +1,192 @@
+// tpu-info — host-level TPU probe, the nvidia-smi analog.
+//
+// The reference's acceptance check execs nvidia-smi in the driver pod and
+// compares a pasted table (reference README.md:152-168). tpu-info is the TPU
+// stack's equivalent native probe: it enumerates the TPU device nodes, reads
+// what the host exposes (sysfs NUMA node, optional runtime-metrics textfile
+// written by the workload/libtpu side), and prints a table, one line
+// (--oneline, used by the libtpu-prep readiness probe), or JSON (--json).
+//
+// Runtime metrics interface: a Prometheus-style textfile (default
+// /run/tpu/metrics.prom) with lines like
+//   tpu_duty_cycle_percent{chip="0"} 37.5
+//   tpu_hbm_used_bytes{chip="0"} 1073741824
+// The same file feeds tpu-metrics-exporter; see docs/DELTAS.md.
+
+#include <glob.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <unistd.h>
+
+#include <map>
+#include <string>
+#include <vector>
+
+#include "../plugin/topology.h"
+
+namespace {
+
+struct Chip {
+  int index;
+  std::string path;
+  bool present;
+  int numa = -1;
+  double duty_cycle = -1;   // percent; -1 = unknown
+  double hbm_used = -1;     // bytes
+};
+
+int ReadNuma(const std::string& dev_path) {
+  const char* base = strrchr(dev_path.c_str(), '/');
+  if (!base) return -1;
+  std::string sysfs =
+      "/sys/class/accel/" + std::string(base + 1) + "/device/numa_node";
+  FILE* f = fopen(sysfs.c_str(), "r");
+  if (!f) return -1;
+  int node = -1;
+  if (fscanf(f, "%d", &node) != 1) node = -1;
+  fclose(f);
+  return node;
+}
+
+std::vector<Chip> Discover(const std::string& device_glob,
+                           const std::string& devfs_root, int fake) {
+  std::vector<Chip> chips;
+  if (fake >= 0) {
+    for (int i = 0; i < fake; ++i)
+      chips.push_back({i, "/dev/accel" + std::to_string(i), true});
+    return chips;
+  }
+  std::string pattern = device_glob;
+  if (!devfs_root.empty()) {
+    std::string rel = pattern[0] == '/' ? pattern.substr(1) : pattern;
+    pattern = devfs_root + "/" + rel;
+  }
+  glob_t g;
+  memset(&g, 0, sizeof(g));
+  if (glob(pattern.c_str(), 0, nullptr, &g) == 0) {
+    for (size_t i = 0; i < g.gl_pathc; ++i) {
+      std::string path = g.gl_pathv[i];
+      const char* base = strrchr(path.c_str(), '/');
+      base = base ? base + 1 : path.c_str();
+      const char* digits = base;
+      while (*digits && (*digits < '0' || *digits > '9')) ++digits;
+      if (!*digits) continue;
+      chips.push_back({atoi(digits), path, access(path.c_str(), F_OK) == 0,
+                       ReadNuma(path)});
+    }
+  }
+  globfree(&g);
+  return chips;
+}
+
+// Parses `name{chip="N"} value` lines for the two metrics we display.
+void MergeRuntimeMetrics(const std::string& file, std::vector<Chip>* chips) {
+  FILE* f = fopen(file.c_str(), "r");
+  if (!f) return;
+  char line[512];
+  while (fgets(line, sizeof(line), f)) {
+    if (line[0] == '#') continue;
+    char name[128], labels[256];
+    double value;
+    if (sscanf(line, "%127[a-zA-Z0-9_]{%255[^}]} %lf", name, labels, &value) !=
+        3)
+      continue;
+    int chip = -1;
+    const char* c = strstr(labels, "chip=\"");
+    if (c) chip = atoi(c + 6);
+    for (auto& ch : *chips) {
+      if (ch.index != chip) continue;
+      if (strcmp(name, "tpu_duty_cycle_percent") == 0) ch.duty_cycle = value;
+      if (strcmp(name, "tpu_hbm_used_bytes") == 0) ch.hbm_used = value;
+    }
+  }
+  fclose(f);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string device_glob = "/dev/accel*";
+  std::string devfs_root;
+  std::string accelerator = "v5e-8";
+  std::string metrics_file = "/run/tpu/metrics.prom";
+  int fake = -1;
+  bool json = false, oneline = false;
+
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    auto val = [&](const char* k) -> const char* {
+      size_t n = strlen(k);
+      if (a.compare(0, n, k) == 0 && a[n] == '=') return a.c_str() + n + 1;
+      return nullptr;
+    };
+    const char* v;
+    if ((v = val("--device-glob"))) device_glob = v;
+    else if ((v = val("--devfs-root"))) devfs_root = v;
+    else if ((v = val("--accelerator"))) accelerator = v;
+    else if ((v = val("--metrics-file"))) metrics_file = v;
+    else if ((v = val("--fake-devices"))) fake = atoi(v);
+    else if (a == "--json") json = true;
+    else if (a == "--oneline") oneline = true;
+    else {
+      fprintf(stderr,
+              "usage: tpu-info [--device-glob=G] [--devfs-root=D] "
+              "[--accelerator=T] [--metrics-file=F] [--fake-devices=N] "
+              "[--json|--oneline]\n");
+      return 2;
+    }
+  }
+
+  const tpud::AcceleratorType* acc = tpud::FindAccelerator(accelerator);
+  auto chips = Discover(device_glob, devfs_root, fake);
+  MergeRuntimeMetrics(metrics_file, &chips);
+
+  if (oneline) {
+    printf("tpu-info: %zu chip(s) [%s %s]\n", chips.size(),
+           acc ? acc->name.c_str() : accelerator.c_str(),
+           acc ? acc->LabelTopology().c_str() : "?");
+    return chips.empty() ? 1 : 0;
+  }
+
+  if (json) {
+    printf("{\"accelerator\": \"%s\", \"topology\": \"%s\", \"chips\": [",
+           acc ? acc->name.c_str() : accelerator.c_str(),
+           acc ? acc->LabelTopology().c_str() : "");
+    for (size_t i = 0; i < chips.size(); ++i) {
+      const Chip& c = chips[i];
+      printf("%s{\"index\": %d, \"path\": \"%s\", \"present\": %s, "
+             "\"numa\": %d",
+             i ? ", " : "", c.index, c.path.c_str(),
+             c.present ? "true" : "false", c.numa);
+      if (c.duty_cycle >= 0) printf(", \"duty_cycle_percent\": %g",
+                                    c.duty_cycle);
+      if (c.hbm_used >= 0) printf(", \"hbm_used_bytes\": %.0f", c.hbm_used);
+      printf("}");
+    }
+    printf("], \"chip_count\": %zu}\n", chips.size());
+    return chips.empty() ? 1 : 0;
+  }
+
+  // Table mode — the human-facing nvidia-smi analog.
+  printf("+---------------------------------------------------------------+\n");
+  printf("| tpu-info          accelerator: %-8s  topology: %-6s      |\n",
+         acc ? acc->name.c_str() : accelerator.c_str(),
+         acc ? acc->LabelTopology().c_str() : "?");
+  printf("|---------------------------------------------------------------|\n");
+  printf("| chip | device        | present | numa | duty%% | HBM used      |\n");
+  printf("|------+---------------+---------+------+-------+---------------|\n");
+  for (const Chip& c : chips) {
+    char duty[16] = "   - ", hbm[24] = "      -      ";
+    if (c.duty_cycle >= 0) snprintf(duty, sizeof(duty), "%5.1f", c.duty_cycle);
+    if (c.hbm_used >= 0)
+      snprintf(hbm, sizeof(hbm), "%10.0f MiB", c.hbm_used / (1024.0 * 1024));
+    printf("| %4d | %-13s | %-7s | %4d | %s | %s |\n", c.index,
+           c.path.c_str(), c.present ? "yes" : "no", c.numa, duty, hbm);
+  }
+  if (chips.empty())
+    printf("|      no TPU device nodes found (%-28s) |\n",
+           device_glob.c_str());
+  printf("+---------------------------------------------------------------+\n");
+  return chips.empty() ? 1 : 0;
+}

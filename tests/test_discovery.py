@@ -49,6 +49,23 @@ def test_labels_absent_deletes_stale_keys():
     assert b'"google.com/tpu.count": null' in patch
 
 
+def test_labeler_fatal_config_errors(tmp_path, capsys):
+    # unknown accelerator -> exit 2, not an eternal retry loop
+    rc = labeler.main(["--accelerator=v99", "--oneshot", "--print"])
+    assert rc == 2
+    assert "fatal" in capsys.readouterr().err
+    # missing NODE_NAME in patch mode -> exit 2
+    import os
+    old = os.environ.pop("NODE_NAME", None)
+    try:
+        rc = labeler.main(["--accelerator=v5e-8", "--oneshot"])
+        assert rc == 2
+        assert "NODE_NAME" in capsys.readouterr().err
+    finally:
+        if old is not None:
+            os.environ["NODE_NAME"] = old
+
+
 def test_labeler_oneshot_outfile(tmp_path):
     devices.make_fake_tree(str(tmp_path), 8)
     out = tmp_path / "labels.jsonl"

@@ -1,0 +1,390 @@
+"""Native component tests: build, selftests, and — the load-bearing part —
+gRPC interop between the C++ plugin (grpcmin) and real grpcio peers.
+
+The C++ and Python topology policies are pinned to the same golden file, and
+tpud is driven through a real grpcio client exactly as the kubelet's grpc-go
+client would drive it (ListAndWatch long-poll, Allocate, preferred
+allocation), per the test strategy in SURVEY.md §4.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import time
+import urllib.request
+
+import grpc
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "native")
+BUILD = os.path.join(NATIVE, "build")
+GOLDEN = os.path.join(REPO, "tests", "data", "topology_golden.json")
+
+
+@pytest.fixture(scope="session")
+def native_build():
+    """Configure+build the native tree once per test session (cached)."""
+    if not os.path.exists(os.path.join(BUILD, "build.ninja")):
+        subprocess.run(["cmake", "-S", NATIVE, "-B", BUILD, "-G", "Ninja"],
+                       check=True, capture_output=True)
+    subprocess.run(["ninja", "-C", BUILD], check=True, capture_output=True,
+                   timeout=600)
+    return BUILD
+
+
+def binpath(build, name):
+    return os.path.join(build, name)
+
+
+def start_tpud(build, tmp_path, *extra_args):
+    args = [
+        binpath(build, "tpud"),
+        f"--kubelet-dir={tmp_path}",
+        "--endpoint=tpud.sock",
+        "--accelerator=v5e-8",
+        *extra_args,
+    ]
+    proc = subprocess.Popen(args, stderr=subprocess.PIPE)
+    sock = os.path.join(str(tmp_path), "tpud.sock")
+    for _ in range(100):
+        if os.path.exists(sock):
+            break
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"tpud exited rc={proc.returncode}: {proc.stderr.read()}")
+        time.sleep(0.05)
+    else:
+        proc.kill()
+        raise RuntimeError("tpud socket never appeared")
+    return proc, sock
+
+
+@pytest.fixture
+def tpud_fake8(native_build, tmp_path):
+    proc, sock = start_tpud(native_build, tmp_path, "--fake-devices=8",
+                            "--no-register")
+    yield sock
+    proc.terminate()
+    proc.wait(timeout=5)
+
+
+def test_grpcmin_selftest(native_build):
+    subprocess.run([binpath(native_build, "grpcmin_selftest")], check=True)
+
+
+def test_topology_golden_cpp_matches_python(native_build):
+    """C++ and Python allocation policies pinned to the same golden file."""
+    out = subprocess.run([binpath(native_build, "tpud"),
+                          "--print-topology-golden"],
+                         check=True, capture_output=True, text=True)
+    cpp = json.loads(out.stdout)
+    with open(GOLDEN, encoding="utf-8") as f:
+        golden = json.load(f)
+    cpp_by_name = {a["name"]: a for a in cpp["accelerators"]}
+    for entry in golden["accelerators"]:
+        got = cpp_by_name[entry["name"]]
+        assert got["aligned_sizes"] == entry["aligned_sizes"], entry["name"]
+        assert got["aligned_subsets"] == entry["aligned_subsets"], entry["name"]
+        assert got["validate_cases"] == entry["validate_cases"], entry["name"]
+
+
+# ---------------------------------------------------------------- interop
+
+
+def test_options_and_listandwatch(tpud_fake8):
+    from tpu_cluster.plugin_api.client import DevicePluginClient
+    c = DevicePluginClient(tpud_fake8)
+    try:
+        opts = c.get_options()
+        assert opts.get_preferred_allocation_available
+        stream = c.list_and_watch()
+        first = next(stream)
+        assert len(first.devices) == 8
+        ids = sorted(d.ID for d in first.devices)
+        assert ids == [f"tpu-{i}" for i in range(8)]
+        assert all(d.health == "Healthy" for d in first.devices)
+        stream.cancel()
+    finally:
+        c.close()
+
+
+def test_preferred_allocation_interop(tpud_fake8):
+    from tpu_cluster.plugin_api.client import DevicePluginClient
+    c = DevicePluginClient(tpud_fake8)
+    try:
+        resp = c.get_preferred_allocation(
+            [f"tpu-{i}" for i in range(8)], [], 4)
+        got = list(resp.container_responses[0].deviceIDs)
+        assert got == ["tpu-0", "tpu-1", "tpu-2", "tpu-3"]
+        # must_include forces the containing quad
+        resp = c.get_preferred_allocation(
+            [f"tpu-{i}" for i in range(8)], ["tpu-5"], 4)
+        got = list(resp.container_responses[0].deviceIDs)
+        assert "tpu-5" in got and len(got) == 4
+        # fragmented availability -> empty (kubelet falls back)
+        resp = c.get_preferred_allocation(
+            ["tpu-0", "tpu-3", "tpu-5", "tpu-6"], [], 4)
+        assert list(resp.container_responses[0].deviceIDs) == []
+    finally:
+        c.close()
+
+
+def test_allocate_aligned(tpud_fake8):
+    from tpu_cluster.plugin_api.client import DevicePluginClient
+    c = DevicePluginClient(tpud_fake8)
+    try:
+        resp = c.allocate(["tpu-0", "tpu-1", "tpu-2", "tpu-3"])
+        cr = resp.container_responses[0]
+        assert [d.container_path for d in cr.devices] == [
+            f"/dev/accel{i}" for i in range(4)]
+        assert all(d.permissions == "rw" for d in cr.devices)
+        assert cr.envs["TPU_VISIBLE_DEVICES"] == "0,1,2,3"
+        assert cr.envs["TPU_CHIPS_PER_HOST_BOUNDS"] == "2,2,1"
+        assert cr.envs["TPU_HOST_BOUNDS"] == "1,1,1"
+        assert cr.envs["TPU_SKIP_MDS_QUERY"] == "true"
+        assert cr.envs["TPU_ACCELERATOR_TYPE"] == "v5e-8"
+        assert cr.envs["TPU_LIBRARY_PATH"] == "/var/lib/tpu/libtpu.so"
+        assert cr.mounts[0].host_path == "/var/lib/tpu"
+        assert cr.annotations["tpu.native/allocation"] == "0,1,2,3"
+        # full host
+        resp = c.allocate([f"tpu-{i}" for i in range(8)])
+        envs = resp.container_responses[0].envs
+        assert envs["TPU_CHIPS_PER_HOST_BOUNDS"] == "2,4,1"
+        assert envs["TPU_VISIBLE_DEVICES"] == "0,1,2,3,4,5,6,7"
+    finally:
+        c.close()
+
+
+def test_allocate_unaligned_rejected(tpud_fake8):
+    from tpu_cluster.plugin_api.client import DevicePluginClient
+    c = DevicePluginClient(tpud_fake8)
+    try:
+        with pytest.raises(grpc.RpcError) as ei:
+            c.allocate(["tpu-0", "tpu-1"])  # size 2 unaligned on v5e-8
+        assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        assert "not aligned" in ei.value.details()
+        with pytest.raises(grpc.RpcError) as ei:
+            c.allocate(["tpu-0", "tpu-1", "tpu-2", "tpu-4"])  # not a sub-mesh
+        assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        assert "sub-mesh" in ei.value.details()
+    finally:
+        c.close()
+
+
+def test_prestart_and_unknown_method(tpud_fake8):
+    from tpu_cluster.plugin_api import deviceplugin_pb2 as pb
+    from tpu_cluster.plugin_api.client import DevicePluginClient
+    c = DevicePluginClient(tpud_fake8)
+    try:
+        c.pre_start_container(["tpu-0"])  # must not raise
+        bogus = c.channel.unary_unary(
+            "/v1beta1.DevicePlugin/DoesNotExist",
+            request_serializer=pb.Empty.SerializeToString,
+            response_deserializer=pb.Empty.FromString,
+        )
+        with pytest.raises(grpc.RpcError) as ei:
+            bogus(pb.Empty(), timeout=5)
+        assert ei.value.code() == grpc.StatusCode.UNIMPLEMENTED
+    finally:
+        c.close()
+
+
+def test_registration_against_fake_kubelet(native_build, tmp_path):
+    """tpud's C++ gRPC client registers with a real grpcio server."""
+    from tpu_cluster.plugin_api.fake_kubelet import FakeKubelet
+    kubelet = FakeKubelet(os.path.join(str(tmp_path), "kubelet.sock"))
+    kubelet.start()
+    try:
+        proc, _ = start_tpud(native_build, tmp_path, "--fake-devices=8")
+        try:
+            assert kubelet.wait_for_register(timeout=15)
+            req = kubelet.requests[0]
+            assert req.version == "v1beta1"
+            assert req.endpoint == "tpud.sock"
+            assert req.resource_name == "google.com/tpu"
+            assert req.options.get_preferred_allocation_available
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)
+    finally:
+        kubelet.stop()
+
+
+def test_device_loss_pushes_listandwatch_update(native_build, tmp_path):
+    """Remove a device node -> plugin pushes an updated device list on the
+    open ListAndWatch stream (kubelet sees 7 chips)."""
+    from tpu_cluster.discovery import devices as pydev
+    from tpu_cluster.plugin_api.client import DevicePluginClient
+    devfs = tmp_path / "devfs"
+    paths = pydev.make_fake_tree(str(devfs), 8)
+    proc, sock = start_tpud(
+        native_build, tmp_path, f"--devfs-root={devfs}",
+        "--rescan-interval=1", "--no-register")
+    try:
+        c = DevicePluginClient(sock)
+        stream = c.list_and_watch()
+        first = next(stream)
+        assert len(first.devices) == 8
+        os.unlink(paths[7])
+        second = next(stream)  # pushed within ~1s rescan
+        assert len(second.devices) == 7
+        assert all(d.ID != "tpu-7" for d in second.devices)
+        stream.cancel()
+        c.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
+def test_reregistration_on_kubelet_restart(native_build, tmp_path):
+    """Kubelet restart (socket recreated fast, inode may be reused) and
+    plugin-socket wipe must both trigger re-registration — SURVEY.md §7
+    hard-part #1 (lifecycle)."""
+    from tpu_cluster.plugin_api.fake_kubelet import FakeKubelet
+    kubelet = FakeKubelet(os.path.join(str(tmp_path), "kubelet.sock"))
+    kubelet.start()
+    proc, sock = start_tpud(native_build, tmp_path, "--fake-devices=8")
+    try:
+        assert kubelet.wait_for_register(timeout=15)
+        kubelet.stop()
+        k2 = FakeKubelet(os.path.join(str(tmp_path), "kubelet.sock"))
+        k2.start()
+        try:
+            assert k2.wait_for_register(timeout=15), \
+                "no re-register after kubelet restart"
+            # kubelet wipes the device-plugins dir on restart
+            os.unlink(sock)
+            k2.event.clear()
+            assert k2.wait_for_register(timeout=15), \
+                "no re-register after plugin socket wipe"
+            assert os.path.exists(sock), "plugin did not re-listen"
+        finally:
+            k2.stop()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
+# ---------------------------------------------------------------- tpu-info
+
+
+def test_tpu_info_json_and_oneline(native_build, tmp_path):
+    from tpu_cluster.discovery import devices as pydev
+    pydev.make_fake_tree(str(tmp_path), 8)
+    out = subprocess.run(
+        [binpath(native_build, "tpu-info"), f"--devfs-root={tmp_path}",
+         "--json"],
+        check=True, capture_output=True, text=True)
+    doc = json.loads(out.stdout)
+    assert doc["chip_count"] == 8
+    assert doc["accelerator"] == "v5e-8" and doc["topology"] == "2x4"
+    one = subprocess.run(
+        [binpath(native_build, "tpu-info"), f"--devfs-root={tmp_path}",
+         "--oneline"],
+        check=True, capture_output=True, text=True)
+    assert "8 chip(s)" in one.stdout
+    # empty tree -> rc 1 (used as the libtpu-prep readiness signal)
+    rc = subprocess.run(
+        [binpath(native_build, "tpu-info"),
+         f"--devfs-root={tmp_path}/nothing", "--oneline"],
+        capture_output=True)
+    assert rc.returncode == 1
+
+
+def test_tpu_info_runtime_metrics(native_build, tmp_path):
+    from tpu_cluster.discovery import devices as pydev
+    pydev.make_fake_tree(str(tmp_path), 2)
+    mf = tmp_path / "metrics.prom"
+    mf.write_text('tpu_duty_cycle_percent{chip="0"} 37.5\n'
+                  'tpu_hbm_used_bytes{chip="1"} 1073741824\n')
+    out = subprocess.run(
+        [binpath(native_build, "tpu-info"), f"--devfs-root={tmp_path}",
+         f"--metrics-file={mf}", "--json"],
+        check=True, capture_output=True, text=True)
+    doc = json.loads(out.stdout)
+    assert doc["chips"][0]["duty_cycle_percent"] == 37.5
+    assert doc["chips"][1]["hbm_used_bytes"] == 1073741824
+
+
+# ---------------------------------------------------------------- exporter
+
+
+def _free_port():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_exporter_scrape(native_build, tmp_path):
+    """BASELINE config 4: metrics scrape returns per-chip HBM/duty-cycle."""
+    from tpu_cluster.discovery import devices as pydev
+    pydev.make_fake_tree(str(tmp_path), 8)
+    mf = tmp_path / "metrics.prom"
+    mf.write_text('tpu_duty_cycle_percent{chip="0"} 12.5\n'
+                  'not_a_tpu_metric 1\n')
+    port = _free_port()
+    proc = subprocess.Popen(
+        [binpath(native_build, "tpu-metrics-exporter"), f"--port={port}",
+         f"--devfs-root={tmp_path}", f"--metrics-file={mf}"],
+        stderr=subprocess.PIPE)
+    try:
+        body = None
+        for _ in range(50):
+            try:
+                body = urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=1).read().decode()
+                break
+            except Exception:
+                time.sleep(0.1)
+        assert body is not None, "exporter never came up"
+        assert "tpu_chips_total 8" in body
+        assert "tpu_chips_expected 8" in body
+        assert 'tpu_chip_present{chip="7"' in body
+        assert 'tpu_hbm_capacity_bytes{chip="0"} 17179869184' in body
+        assert 'tpu_duty_cycle_percent{chip="0"} 12.5' in body
+        assert "not_a_tpu_metric" not in body  # relay filter
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
+def test_exporter_status_mode(native_build, tmp_path):
+    from tpu_cluster.discovery import devices as pydev
+    pydev.make_fake_tree(str(tmp_path), 8)
+    libdir = tmp_path / "var" / "lib" / "tpu"
+    libdir.mkdir(parents=True)
+    (libdir / "libtpu.so").write_bytes(b"\x7fELF-fake")
+    port = _free_port()
+    proc = subprocess.Popen(
+        [binpath(native_build, "tpu-metrics-exporter"), f"--port={port}",
+         "--status-mode", f"--devfs-root={tmp_path}",
+         "--libtpu-path=/var/lib/tpu/libtpu.so",
+         "--plugin-socket=/var/lib/kubelet/device-plugins/tpud.sock",
+         "--expect-chips=8"],
+        stderr=subprocess.PIPE)
+    try:
+        doc = None
+        for _ in range(50):
+            try:
+                doc = json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/status", timeout=1).read())
+                break
+            except Exception:
+                time.sleep(0.1)
+        assert doc is not None
+        assert doc["chips"] == 8 and doc["checks"]["chip_count"]
+        assert doc["checks"]["libtpu_staged"]
+        assert not doc["checks"]["plugin_socket"]  # no socket in fake root
+        assert not doc["healthy"]
+        # healthz reflects status
+        with pytest.raises(Exception):
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz",
+                                   timeout=1)
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)

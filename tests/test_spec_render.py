@@ -53,8 +53,24 @@ def test_load_rejects_unknowns():
         specmod.load("tpu:\n  operands:\n    warpDrive: {enabled: true}")
     with pytest.raises(specmod.SpecError):
         specmod.load("cluster: {podCidr: not-a-cidr}")
+    with pytest.raises(specmod.SpecError):
+        specmod.load("cluster: {podCidr: garbage/999}")
     with pytest.raises(KeyError):
         specmod.load("tpu: {accelerator: v99-1}")
+    # nested sections are set programmatically; naming them directly is an
+    # error, not a silent overwrite
+    with pytest.raises(specmod.SpecError):
+        specmod.load("cluster: {controlPlane: {source: static}}")
+    with pytest.raises(specmod.SpecError):
+        specmod.load("cluster: {tpu: {accelerator: v5e-8}}")
+    with pytest.raises(specmod.SpecError):
+        specmod.load("tpu: {operands: {devicePlugin: 3}}")
+
+
+def test_operand_bool_shorthand():
+    s = specmod.load("tpu: {operands: {devicePlugin: false, libtpuPrep: true}}")
+    assert not s.tpu.operand("devicePlugin").enabled
+    assert s.tpu.operand("libtpuPrep").enabled
 
 
 def test_node_prep_renders_reference_phase1():

@@ -73,6 +73,18 @@ def main(argv=None) -> int:
     p.add_argument("--print", dest="print_only", action="store_true")
     p.add_argument("--out-file", default="")
     args = p.parse_args(argv)
+    # Permanent configuration errors must crash the pod (CrashLoopBackOff is
+    # the operator-visible signal), not retry forever looking healthy.
+    from .. import topology
+    try:
+        topology.get(args.accelerator)
+    except KeyError as exc:
+        print(f"fatal: {exc}", file=sys.stderr)
+        return 2
+    if not (args.print_only or args.out_file) and not os.environ.get("NODE_NAME"):
+        print("fatal: NODE_NAME env not set (downward-API fieldRef missing "
+              "from the DaemonSet manifest?)", file=sys.stderr)
+        return 2
     while True:
         try:
             run_once(args)

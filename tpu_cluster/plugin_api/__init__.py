@@ -1,0 +1,10 @@
+"""DevicePlugin v1beta1 API bindings for tests and tooling.
+
+``deviceplugin_pb2`` is generated from native/proto/deviceplugin.proto
+(protoc --python_out; committed). ``client`` wraps grpcio channels with
+hand-rolled method stubs (no grpc_tools in this environment), and
+``fake_kubelet`` is the in-process Registration server the plugin's
+registration path is tested against (SURVEY.md §4 point 2).
+"""
+
+from . import deviceplugin_pb2  # noqa: F401

@@ -1,0 +1,80 @@
+"""grpcio client stubs for the DevicePlugin v1beta1 API.
+
+Used by the test harness as the kubelet-side counterparty to the native
+plugin: a *real* gRPC implementation (grpcio) talking to tpud's minimal
+C++ gRPC server is the interop proof that a real kubelet (grpc-go) will
+interoperate too — both are spec-complete HTTP/2+HPACK peers, which is
+exactly what grpcmin must withstand (Huffman coding, dynamic-table
+indexing, flow control).
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from . import deviceplugin_pb2 as pb
+
+SERVICE = "v1beta1.DevicePlugin"
+REGISTRATION_SERVICE = "v1beta1.Registration"
+
+
+class DevicePluginClient:
+    def __init__(self, socket_path: str, timeout: float = 5.0):
+        self.channel = grpc.insecure_channel(f"unix:{socket_path}")
+        self.timeout = timeout
+        self._options = self.channel.unary_unary(
+            f"/{SERVICE}/GetDevicePluginOptions",
+            request_serializer=pb.Empty.SerializeToString,
+            response_deserializer=pb.DevicePluginOptions.FromString,
+        )
+        self._list_and_watch = self.channel.unary_stream(
+            f"/{SERVICE}/ListAndWatch",
+            request_serializer=pb.Empty.SerializeToString,
+            response_deserializer=pb.ListAndWatchResponse.FromString,
+        )
+        self._preferred = self.channel.unary_unary(
+            f"/{SERVICE}/GetPreferredAllocation",
+            request_serializer=pb.PreferredAllocationRequest.SerializeToString,
+            response_deserializer=pb.PreferredAllocationResponse.FromString,
+        )
+        self._allocate = self.channel.unary_unary(
+            f"/{SERVICE}/Allocate",
+            request_serializer=pb.AllocateRequest.SerializeToString,
+            response_deserializer=pb.AllocateResponse.FromString,
+        )
+        self._prestart = self.channel.unary_unary(
+            f"/{SERVICE}/PreStartContainer",
+            request_serializer=pb.PreStartContainerRequest.SerializeToString,
+            response_deserializer=pb.PreStartContainerResponse.FromString,
+        )
+
+    def close(self):
+        self.channel.close()
+
+    def get_options(self) -> pb.DevicePluginOptions:
+        return self._options(pb.Empty(), timeout=self.timeout)
+
+    def list_and_watch(self):
+        """Returns the response iterator (long-lived stream)."""
+        return self._list_and_watch(pb.Empty())
+
+    def get_preferred_allocation(self, available, must_include, size
+                                 ) -> pb.PreferredAllocationResponse:
+        req = pb.PreferredAllocationRequest(container_requests=[
+            pb.ContainerPreferredAllocationRequest(
+                available_deviceIDs=list(available),
+                must_include_deviceIDs=list(must_include),
+                allocation_size=size,
+            )
+        ])
+        return self._preferred(req, timeout=self.timeout)
+
+    def allocate(self, device_ids) -> pb.AllocateResponse:
+        req = pb.AllocateRequest(container_requests=[
+            pb.ContainerAllocateRequest(devicesIDs=list(device_ids))
+        ])
+        return self._allocate(req, timeout=self.timeout)
+
+    def pre_start_container(self, device_ids) -> pb.PreStartContainerResponse:
+        req = pb.PreStartContainerRequest(devicesIDs=list(device_ids))
+        return self._prestart(req, timeout=self.timeout)

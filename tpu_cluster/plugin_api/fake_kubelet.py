@@ -1,0 +1,53 @@
+"""In-process fake kubelet: a grpcio Registration server on a unix socket.
+
+The multi-chip-without-hardware test story (SURVEY.md §4 point 2): tpud's
+C++ gRPC *client* dials this real-gRPC server exactly like it would dial the
+real kubelet's /var/lib/kubelet/device-plugins/kubelet.sock, proving the
+registration path without a cluster. Records every RegisterRequest received.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent import futures
+from typing import List
+
+import grpc
+
+from . import deviceplugin_pb2 as pb
+
+
+class FakeKubelet:
+    def __init__(self, socket_path: str):
+        self.socket_path = socket_path
+        self.requests: List[pb.RegisterRequest] = []
+        self.event = threading.Event()
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+
+        def register(request_bytes, context):
+            req = pb.RegisterRequest.FromString(request_bytes)
+            self.requests.append(req)
+            self.event.set()
+            return pb.Empty()
+
+        handler = grpc.method_handlers_generic_handler(
+            "v1beta1.Registration",
+            {
+                "Register": grpc.unary_unary_rpc_method_handler(
+                    register,
+                    request_deserializer=lambda b: b,  # raw; parsed above
+                    response_serializer=pb.Empty.SerializeToString,
+                )
+            },
+        )
+        self._server.add_generic_rpc_handlers((handler,))
+        self._server.add_insecure_port(f"unix:{socket_path}")
+
+    def start(self):
+        self._server.start()
+
+    def stop(self):
+        self._server.stop(grace=0.2)
+
+    def wait_for_register(self, timeout: float = 10.0) -> bool:
+        return self.event.wait(timeout)

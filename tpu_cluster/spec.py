@@ -16,8 +16,18 @@ document that renders to:
 from __future__ import annotations
 
 import dataclasses
+import ipaddress
+import re
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
+
+# camelCase and acronym spellings both normalise: podCidr and the
+# Kubernetes-canonical podCIDR -> pod_cidr.
+_CAMEL_RE = re.compile(r"(?<=[a-z0-9])(?=[A-Z])|(?<=[A-Z])(?=[A-Z][a-z])")
+
+
+def _snake(key: str) -> str:
+    return _CAMEL_RE.sub("_", key).lower()
 
 import yaml
 
@@ -117,29 +127,29 @@ class ClusterSpec:
     def validate(self) -> "ClusterSpec":
         if not self.name:
             raise SpecError("cluster name must be non-empty")
-        parts = self.pod_cidr.split("/")
-        if len(parts) != 2 or not parts[1].isdigit():
-            raise SpecError(f"podCIDR {self.pod_cidr!r} is not a CIDR")
+        try:
+            ipaddress.ip_network(self.pod_cidr)
+        except ValueError as exc:
+            raise SpecError(f"podCIDR {self.pod_cidr!r} is not a CIDR: {exc}") from None
         self.control_plane.validate()
         self.tpu.validate()
         return self
 
 
-def _build(cls, data: Dict[str, Any], path: str):
-    """Construct dataclass ``cls`` from a camelCase-keyed mapping."""
+def _build(cls, data: Dict[str, Any], path: str, forbidden=()):
+    """Construct dataclass ``cls`` from a camelCase-keyed mapping.
+
+    ``forbidden`` names dataclass fields that load() fills programmatically
+    (nested sections) — naming them in the YAML is an error, not a silent
+    overwrite.
+    """
     if not isinstance(data, dict):
         raise SpecError(f"{path}: expected mapping, got {type(data).__name__}")
     fields = {f.name: f for f in dataclasses.fields(cls)}
-    def snake(k: str) -> str:
-        # camelCase and acronym spellings both normalise: podCidr and the
-        # Kubernetes-canonical podCIDR -> pod_cidr.
-        import re
-        s = re.sub(r"(?<=[a-z0-9])(?=[A-Z])|(?<=[A-Z])(?=[A-Z][a-z])", "_", k)
-        return s.lower()
     kwargs = {}
     for key, value in data.items():
-        name = snake(key)
-        if name not in fields:
+        name = _snake(key)
+        if name not in fields or name in forbidden:
             raise SpecError(f"{path}: unknown field {key!r}")
         kwargs[name] = value
     return cls(**kwargs)
@@ -152,15 +162,25 @@ def load(text: str) -> ClusterSpec:
     cluster = dict(doc.get("cluster") or {})
     cp = _build(ControlPlaneEndpoint, cluster.pop("controlPlaneEndpoint", None) or {},
                 "cluster.controlPlaneEndpoint")
-    spec = _build(ClusterSpec, cluster, "cluster")
+    spec = _build(ClusterSpec, cluster, "cluster",
+                  forbidden=("control_plane", "tpu"))
     spec.control_plane = cp
 
     tpu_doc = dict(doc.get("tpu") or {})
     operands_doc = tpu_doc.pop("operands", {})
-    tpu = _build(TpuSpec, tpu_doc, "tpu")
+    tpu = _build(TpuSpec, tpu_doc, "tpu", forbidden=("operands",))
     operands = {}
     for name, od in (operands_doc or {}).items():
-        od = dict(od or {})
+        if isinstance(od, bool):
+            od = {"enabled": od}  # `devicePlugin: false` shorthand
+        elif od is None:
+            od = {}
+        elif not isinstance(od, dict):
+            raise SpecError(
+                f"tpu.operands.{name}: expected mapping or bool, "
+                f"got {type(od).__name__}")
+        else:
+            od = dict(od)
         operands[name] = OperandSpec(
             enabled=bool(od.pop("enabled", True)),
             image=str(od.pop("image", "")),
