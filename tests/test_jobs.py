@@ -1,0 +1,95 @@
+"""Validation-Job renderer + runner tests (SURVEY.md §2.3, §7 steps 4/8)."""
+
+import json
+
+import pytest
+
+from tpu_cluster import spec as specmod
+from tpu_cluster.render import jobs
+from tpu_cluster.workloads import multihost, validate
+
+
+@pytest.fixture()
+def spec():
+    return specmod.default_spec()
+
+
+def _container(job):
+    return job["spec"]["template"]["spec"]["containers"][0]
+
+
+def test_job_set_covers_baseline_configs(spec):
+    objs = jobs.render_validation_jobs(spec)
+    names = [o["metadata"]["name"] for o in objs]
+    assert names == ["tpu-device-query", "tpu-vector-add", "tpu-matmul",
+                     "tpu-psum"]
+    for o in objs:
+        assert o["kind"] == "Job"
+        assert o["metadata"]["namespace"] == spec.tpu.namespace
+        c = _container(o)
+        assert c["command"] == ["python", "-m",
+                                "tpu_cluster.workloads.validate"]
+        # every Job pins to labeled TPU nodes (reference README.md:119 analog)
+        sel = o["spec"]["template"]["spec"]["nodeSelector"]
+        assert sel == {"google.com/tpu.present": "true"}
+
+
+def test_chip_counts_are_topology_aligned(spec):
+    by_name = {o["metadata"]["name"]: o
+               for o in jobs.render_validation_jobs(spec)}
+    res = lambda n: _container(by_name[n])["resources"]["limits"]
+    assert res("tpu-device-query") == {"google.com/tpu": "8"}
+    assert res("tpu-vector-add") == {"google.com/tpu": "1"}
+    assert res("tpu-psum") == {"google.com/tpu": "8"}
+
+
+def test_multihost_pair_renders_bootstrap_contract(spec):
+    svc, job = jobs.multihost_psum_job(spec, num_hosts=2)
+    assert svc["kind"] == "Service" and svc["spec"]["clusterIP"] == "None"
+    assert svc["spec"]["selector"] == {"job-name": "tpu-psum-multihost"}
+    assert job["spec"]["completionMode"] == "Indexed"
+    assert job["spec"]["completions"] == 2 == job["spec"]["parallelism"]
+    tmpl = job["spec"]["template"]["spec"]
+    assert tmpl["subdomain"] == svc["metadata"]["name"]
+
+    env = {e["name"]: e["value"] for e in _container(job)["env"]}
+    hosts = env["TPU_WORKER_HOSTNAMES"].split(",")
+    assert len(hosts) == 2
+    assert hosts[0].startswith("tpu-psum-multihost-0.tpu-psum-multihost.")
+
+    # The rendered env + Indexed completion index resolve to a valid
+    # jax.distributed plan for every worker (workloads/multihost contract).
+    for idx in range(2):
+        plan = multihost.plan({**env, "JOB_COMPLETION_INDEX": str(idx)})
+        assert plan["multihost"] and plan["num_processes"] == 2
+        assert plan["process_id"] == idx
+        assert plan["coordinator_address"] == f"{hosts[0]}:8476"
+
+
+def test_validate_runner_modes(capsys):
+    # device-query / vector-add / psum on the virtual 8-device mesh
+    for mode, check in [("device-query", lambda r: r["device_count"] == 8),
+                        ("vector-add", lambda r: r["check"] == "vector_add"),
+                        ("psum", lambda r: r["devices"] == 8)]:
+        rc = validate.main([f"--mode={mode}", "--matmul-dim=128",
+                            "--expect-devices=8"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0, out
+        assert out["ok"] and check(out)
+        # single-host pod: bootstrap must be the no-op plan
+        assert out["bootstrap"] == {"multihost": False, "num_processes": 1,
+                                    "process_id": 0}
+
+
+def test_validate_runner_rejects_unknown_mode():
+    with pytest.raises(SystemExit):
+        validate.main(["--mode=warp"])
+
+
+def test_device_query_fails_on_partial_chip_set(capsys):
+    """A degraded node (fewer devices than allocated) must fail the
+    nvidia-smi-analog check, not pass with device_count >= 1."""
+    rc = validate.main(["--mode=device-query", "--expect-devices=16"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1 and not out["ok"]
+    assert out["expected_devices"] == 16 and out["device_count"] == 8

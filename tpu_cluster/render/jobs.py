@@ -1,0 +1,150 @@
+"""Render the validation Jobs — the reference's verification workloads.
+
+The reference proves its stack with `nvidia-smi` exec'd in the driver pod
+(reference README.md:152-168) and a cuda-vector-add sample (BASELINE.json
+config 3); its implied multi-node check is a 2-node NCCL all-reduce
+(BASELINE config 5). The TPU equivalents are Kubernetes Jobs that request
+``google.com/tpu`` and run ``tpu_cluster.workloads.validate`` (SURVEY.md
+§2.3):
+
+  tpu-device-query   8 chips  jax.devices() enumeration
+  tpu-vector-add     1 chip   jnp.add (+ element-wise verification)
+  tpu-matmul         1 chip   bf16 matmul throughput
+  tpu-psum           8 chips  collective matrix over ICI
+  tpu-psum-multihost N hosts  same, over DCN: an Indexed Job + headless
+                              Service give each pod a stable DNS name and
+                              TPU_WORKER_* env for jax.distributed.initialize
+                              (workloads/multihost.py consumes exactly this)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from ..spec import ClusterSpec
+from ..workloads.multihost import DEFAULT_COORDINATOR_PORT
+from .manifests import DEFAULT_IMAGE, TPU_PRESENT_LABEL, _meta
+
+
+def _job(spec: ClusterSpec, name: str, args: List[str], chips: int,
+         backoff_limit: int = 0) -> Dict[str, Any]:
+    """A batch/v1 Job running the validate entry point with ``chips`` TPUs."""
+    resource = spec.tpu.resource_name
+    pod_spec: Dict[str, Any] = {
+        "restartPolicy": "Never",
+        "nodeSelector": {TPU_PRESENT_LABEL: "true"},
+        "containers": [{
+            "name": "validate",
+            "image": DEFAULT_IMAGE,
+            "command": ["python", "-m", "tpu_cluster.workloads.validate"],
+            "args": args,
+            "resources": {
+                "limits": {resource: str(chips)},
+                "requests": {resource: str(chips)},
+            },
+        }],
+    }
+    return {
+        "apiVersion": "batch/v1",
+        "kind": "Job",
+        "metadata": _meta(name, spec, "validation"),
+        "spec": {
+            "backoffLimit": backoff_limit,
+            "template": {
+                "metadata": {"labels": {"app.kubernetes.io/name": name}},
+                "spec": pod_spec,
+            },
+        },
+    }
+
+
+def device_query_job(spec: ClusterSpec) -> Dict[str, Any]:
+    """nvidia-smi analog (reference README.md:152): enumerate every chip the
+    plugin allocated; golden output is device_count == chips_per_host."""
+    chips = spec.tpu.accelerator_type.chips_per_host
+    return _job(spec, "tpu-device-query",
+                ["--mode=device-query", f"--expect-devices={chips}"], chips)
+
+
+def vector_add_job(spec: ClusterSpec) -> Dict[str, Any]:
+    """cuda-vector-add analog (BASELINE config 3): one chip."""
+    return _job(spec, "tpu-vector-add", ["--mode=vector-add"], 1)
+
+
+def matmul_job(spec: ClusterSpec) -> Dict[str, Any]:
+    return _job(spec, "tpu-matmul", ["--mode=matmul"], 1)
+
+
+def psum_job(spec: ClusterSpec) -> Dict[str, Any]:
+    """NCCL all-reduce analog over ICI (BASELINE config 5, single host)."""
+    chips = spec.tpu.accelerator_type.chips_per_host
+    return _job(spec, "tpu-psum", ["--mode=psum"], chips)
+
+
+def multihost_psum_job(spec: ClusterSpec,
+                       num_hosts: int = 2) -> List[Dict[str, Any]]:
+    """The DCN half of BASELINE config 5: an Indexed Job spanning
+    ``num_hosts`` TPU hosts plus the headless Service that gives each pod the
+    stable DNS name the coordinator address needs (SURVEY.md §2.4(b), §7
+    hard-part #4).
+
+    Env contract per pod (consumed by workloads/multihost.plan):
+      JOB_COMPLETION_INDEX  set automatically by Indexed completion mode
+      TPU_WORKER_HOSTNAMES  all pods' stable FQDNs, index order
+      TPU_COORDINATOR_PORT  worker 0's jax.distributed port
+    """
+    name = "tpu-psum-multihost"
+    svc_name = name
+    ns = spec.tpu.namespace
+    chips = spec.tpu.accelerator_type.chips_per_host
+    hostnames = [
+        f"{name}-{i}.{svc_name}.{ns}.svc.cluster.local"
+        for i in range(num_hosts)
+    ]
+    job = _job(spec, name, ["--mode=psum"], chips)
+    job["spec"].update({
+        "completionMode": "Indexed",
+        "completions": num_hosts,
+        "parallelism": num_hosts,
+    })
+    tmpl = job["spec"]["template"]
+    tmpl["spec"]["subdomain"] = svc_name
+    container = tmpl["spec"]["containers"][0]
+    container["env"] = [
+        {"name": "TPU_WORKER_HOSTNAMES", "value": ",".join(hostnames)},
+        {"name": "TPU_COORDINATOR_PORT",
+         "value": str(DEFAULT_COORDINATOR_PORT)},
+    ]
+    container["ports"] = [{"name": "coordinator",
+                           "containerPort": DEFAULT_COORDINATOR_PORT}]
+    svc = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": _meta(svc_name, spec, "validation"),
+        "spec": {
+            "clusterIP": "None",
+            # Workers start in any order; publish DNS for not-yet-ready pods
+            # or worker N races resolving worker 0's coordinator address.
+            "publishNotReadyAddresses": True,
+            # batch/v1 adds the job-name label to every pod of the Job
+            "selector": {"job-name": name},
+            "ports": [{"name": "coordinator",
+                       "port": DEFAULT_COORDINATOR_PORT}],
+        },
+    }
+    return [svc, job]
+
+
+def render_validation_jobs(spec: ClusterSpec,
+                           multihost_hosts: int = 0) -> List[Dict[str, Any]]:
+    """All validation Jobs in runbook order (docs/GUIDE.md Phase 4); the
+    multi-host pair is included when ``multihost_hosts`` >= 2."""
+    objs = [
+        device_query_job(spec),
+        vector_add_job(spec),
+        matmul_job(spec),
+        psum_job(spec),
+    ]
+    if multihost_hosts >= 2:
+        objs.extend(multihost_psum_job(spec, multihost_hosts))
+    return objs

@@ -51,7 +51,9 @@ def plan(env: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
     if "TPU_WORKER_ID" not in env and "JOB_COMPLETION_INDEX" in env:
         env["TPU_WORKER_ID"] = env["JOB_COMPLETION_INDEX"]
     hosts = [h for h in env.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
-    if not hosts:
+    if len(hosts) <= 1:
+        # TPU VM images set TPU_WORKER_HOSTNAMES=localhost on single-host
+        # slices; one host means no DCN and no jax.distributed bootstrap.
         return {"multihost": False, "num_processes": 1, "process_id": 0}
     if "TPU_WORKER_ID" not in env:
         raise RuntimeError(

@@ -4,7 +4,7 @@ The reference proves the accelerator path works by exec'ing ``nvidia-smi`` in
 the driver pod (reference README.md:152-168) and running a cuda-vector-add
 sample (BASELINE.json config 3). The TPU equivalents below run inside a
 validation Job that requested ``google.com/tpu``; on success their output is
-the golden output the runbook compares against (docs/RUNBOOK.md).
+the golden output the runbook compares against (docs/GUIDE.md Phase 4).
 """
 
 from __future__ import annotations

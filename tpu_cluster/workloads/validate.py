@@ -1,0 +1,91 @@
+"""Validation-Job entry point — what the rendered Jobs actually run.
+
+One runner for every BASELINE.json acceptance config so the Job manifests
+(`tpu_cluster/render/jobs.py`) stay declarative: they invoke
+
+    python -m tpu_cluster.workloads.validate --mode=<mode>
+
+inside a pod that was granted ``google.com/tpu`` chips by the device plugin.
+Modes map to the reference's validation workloads (SURVEY.md §2.3):
+
+  device-query  jax.devices() enumeration         (nvidia-smi analog)
+  vector-add    jnp.add on one chip               (cuda-vector-add analog)
+  matmul        bf16 matmul throughput            (compute smoke)
+  psum          collective matrix over the mesh   (NCCL all-reduce analog)
+  suite         all of the above
+
+Multi-host Jobs run the same modes: ``multihost.initialize()`` is called
+first and is a no-op unless the Indexed-Job env (TPU_WORKER_HOSTNAMES …) is
+present, so one entry point serves the single-host ICI and 2-node DCN cases
+(BASELINE config 5).
+
+Output: one JSON document on stdout (the golden output `tpuctl verify`
+asserts on); exit code 0 iff every check passed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _expected_devices(override: int) -> int:
+    """Chip count the Job was allocated: --expect-devices flag, else the
+    TPU_DEVICE_COUNT env the device plugin's Allocate response injects
+    (native/plugin/tpud.cc FillContainerResponse), else 1."""
+    if override > 0:
+        return override
+    import os
+    return int(os.environ.get("TPU_DEVICE_COUNT", "1") or "1")
+
+
+def run(mode: str, matmul_dim: int = 2048, psum_devices: int = 0,
+        expect_devices: int = 0) -> dict:
+    from . import collectives, multihost, smoke
+
+    bootstrap = multihost.initialize()
+    result: dict = {"mode": mode, "bootstrap": bootstrap}
+    if mode == "device-query":
+        rep = smoke.device_report()
+        result.update(rep)
+        expected = _expected_devices(expect_devices)
+        result["expected_devices"] = expected
+        # A partially-initialized node (degraded ICI, dead chip) must FAIL
+        # the nvidia-smi-analog check, not pass with fewer devices.
+        result["ok"] = rep["local_device_count"] == expected
+    elif mode == "vector-add":
+        result.update(smoke.vector_add())
+    elif mode == "matmul":
+        result.update(smoke.matmul(matmul_dim, matmul_dim, matmul_dim))
+    elif mode == "psum":
+        result.update(collectives.collective_matrix(psum_devices))
+    elif mode == "suite":
+        result.update(smoke.run_suite(matmul_dim=matmul_dim))
+        result["psum"] = collectives.collective_matrix(psum_devices)
+        result["ok"] = result["ok"] and result["psum"]["ok"]
+    else:
+        raise SystemExit(f"unknown --mode={mode}")
+    return result
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tpu_cluster.workloads.validate")
+    ap.add_argument("--mode", default="suite",
+                    choices=["device-query", "vector-add", "matmul", "psum",
+                             "suite"])
+    ap.add_argument("--matmul-dim", type=int, default=2048)
+    ap.add_argument("--psum-devices", type=int, default=0,
+                    help="0 = all local devices")
+    ap.add_argument("--expect-devices", type=int, default=0,
+                    help="device-query: required jax.local_device_count() "
+                         "(0 = TPU_DEVICE_COUNT env from Allocate, else 1)")
+    args = ap.parse_args(argv)
+    result = run(args.mode, args.matmul_dim, args.psum_devices,
+                 args.expect_devices)
+    print(json.dumps(result, indent=2))
+    return 0 if result.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
