@@ -1,0 +1,95 @@
+#include "kubeapi.h"
+
+#include <map>
+
+namespace kubeapi {
+
+namespace {
+
+// kind -> plural for every kind the operator bundle can contain. A lookup
+// table beats naive pluralisation: it turns an unsupported kind into a loud
+// error instead of a 404 against a misspelled path.
+const std::map<std::string, std::string>& Plurals() {
+  static const auto* m = new std::map<std::string, std::string>{
+      {"Namespace", "namespaces"},
+      {"ConfigMap", "configmaps"},
+      {"Secret", "secrets"},
+      {"Service", "services"},
+      {"ServiceAccount", "serviceaccounts"},
+      {"Pod", "pods"},
+      {"DaemonSet", "daemonsets"},
+      {"Deployment", "deployments"},
+      {"StatefulSet", "statefulsets"},
+      {"Job", "jobs"},
+      {"ClusterRole", "clusterroles"},
+      {"ClusterRoleBinding", "clusterrolebindings"},
+      {"Role", "roles"},
+      {"RoleBinding", "rolebindings"},
+  };
+  return *m;
+}
+
+}  // namespace
+
+bool IsClusterScoped(const std::string& kind) {
+  return kind == "Namespace" || kind == "ClusterRole" ||
+         kind == "ClusterRoleBinding" || kind == "Node" ||
+         kind == "PersistentVolume";
+}
+
+std::string CollectionPath(const minijson::Value& obj, std::string* err) {
+  std::string api_version = obj.PathString("apiVersion");
+  std::string kind = obj.PathString("kind");
+  auto it = Plurals().find(kind);
+  if (api_version.empty() || it == Plurals().end()) {
+    *err = "unsupported object: apiVersion='" + api_version + "' kind='" +
+           kind + "'";
+    return "";
+  }
+  // core group ("v1") lives under /api, named groups under /apis
+  std::string prefix = api_version.find('/') == std::string::npos
+                           ? "/api/" + api_version
+                           : "/apis/" + api_version;
+  if (IsClusterScoped(kind)) return prefix + "/" + it->second;
+  std::string ns = obj.PathString("metadata.namespace", "default");
+  return prefix + "/namespaces/" + ns + "/" + it->second;
+}
+
+std::string ObjectPath(const minijson::Value& obj, std::string* err) {
+  std::string coll = CollectionPath(obj, err);
+  if (coll.empty()) return "";
+  std::string name = obj.PathString("metadata.name");
+  if (name.empty()) {
+    *err = "object has no metadata.name";
+    return "";
+  }
+  return coll + "/" + name;
+}
+
+bool IsReady(const minijson::Value& obj) {
+  std::string kind = obj.PathString("kind");
+  if (kind == "DaemonSet") {
+    double desired = obj.PathNumber("status.desiredNumberScheduled", -1);
+    double ready = obj.PathNumber("status.numberReady", -2);
+    // A DaemonSet with nothing scheduled yet (desired 0 or missing status)
+    // is NOT ready: on a real cluster desired becomes >0 once nodes match;
+    // treating 0==0 as ready would open the gate before pods even exist.
+    // Exception: clusters genuinely without matching nodes would wedge the
+    // rollout; operators handle that case with --allow-empty-daemonsets.
+    return desired >= 0 && desired == ready && desired > 0;
+  }
+  if (kind == "Deployment") {
+    double want = obj.PathNumber("spec.replicas", 1);
+    // Missing readyReplicas means zero ready pods — which satisfies a
+    // deliberately scaled-to-zero Deployment (replicas: 0) immediately.
+    double ready = obj.PathNumber("status.readyReplicas", 0);
+    return ready >= want;
+  }
+  if (kind == "Job") {
+    double want = obj.PathNumber("spec.completions", 1);
+    return obj.PathNumber("status.succeeded", 0) >= want;
+  }
+  return true;  // config-ish kinds are ready by existing
+}
+
+}  // namespace kubeapi

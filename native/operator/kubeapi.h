@@ -1,0 +1,34 @@
+// kubeapi — Kubernetes REST path construction + readiness evaluation for the
+// object kinds the TPU stack manages. Kept apart from the daemon loop so the
+// selftest binary can pin this logic without a server.
+
+#ifndef TPU_NATIVE_OPERATOR_KUBEAPI_H_
+#define TPU_NATIVE_OPERATOR_KUBEAPI_H_
+
+#include <string>
+
+#include "minijson.h"
+
+namespace kubeapi {
+
+// "/api/v1/namespaces/tpu-system/daemonsets" style collection path for the
+// object's (apiVersion, kind, metadata.namespace). Returns "" (and sets
+// *err) for kinds outside the supported set.
+std::string CollectionPath(const minijson::Value& obj, std::string* err);
+
+// CollectionPath + "/<metadata.name>".
+std::string ObjectPath(const minijson::Value& obj, std::string* err);
+
+// Workload readiness from an object's status:
+//   DaemonSet:  desiredNumberScheduled == numberReady (and observed spec)
+//   Deployment: spec.replicas == status.readyReplicas
+//   Job:        status.succeeded >= spec.completions (default 1)
+//   other kinds: ready on creation
+bool IsReady(const minijson::Value& obj);
+
+// True for kinds with no namespace segment (Namespace, ClusterRole, ...).
+bool IsClusterScoped(const std::string& kind);
+
+}  // namespace kubeapi
+
+#endif  // TPU_NATIVE_OPERATOR_KUBEAPI_H_

@@ -1,0 +1,322 @@
+#include "kubeclient.h"
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <vector>
+
+namespace kubeclient {
+
+bool ReadFileTrim(const std::string& path, std::string* out) {
+  FILE* f = fopen(path.c_str(), "r");
+  if (!f) return false;
+  char buf[8192];
+  out->clear();
+  size_t n;
+  while ((n = fread(buf, 1, sizeof(buf), f)) > 0) out->append(buf, n);
+  fclose(f);
+  while (!out->empty() && (out->back() == '\n' || out->back() == '\r'))
+    out->pop_back();
+  return true;
+}
+
+namespace {
+
+struct Url {
+  bool https = false;
+  std::string host;
+  int port = 80;
+};
+
+bool ParseUrl(const std::string& url, Url* out, std::string* err) {
+  std::string rest;
+  if (url.rfind("http://", 0) == 0) {
+    out->https = false;
+    out->port = 80;
+    rest = url.substr(7);
+  } else if (url.rfind("https://", 0) == 0) {
+    out->https = true;
+    out->port = 443;
+    rest = url.substr(8);
+  } else {
+    *err = "base_url must start with http:// or https://";
+    return false;
+  }
+  size_t slash = rest.find('/');
+  if (slash != std::string::npos) rest = rest.substr(0, slash);
+  if (!rest.empty() && rest[0] == '[') {
+    // bracketed IPv6 literal: [::1] or [::1]:8001
+    size_t close = rest.find(']');
+    if (close == std::string::npos) {
+      *err = "unterminated '[' in base_url host";
+      return false;
+    }
+    out->host = rest.substr(1, close - 1);
+    if (close + 1 < rest.size() && rest[close + 1] == ':')
+      out->port = atoi(rest.c_str() + close + 2);
+  } else {
+    size_t colon = rest.rfind(':');
+    if (colon != std::string::npos) {
+      out->port = atoi(rest.c_str() + colon + 1);
+      rest = rest.substr(0, colon);
+    }
+    out->host = rest;
+  }
+  if (out->host.empty()) {
+    *err = "empty host in base_url";
+    return false;
+  }
+  return true;
+}
+
+// ------------------------------------------------------------------ plain http
+
+int ConnectTcp(const std::string& host, int port, int timeout_ms,
+               std::string* err) {
+  struct addrinfo hints;
+  memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  char portstr[16];
+  snprintf(portstr, sizeof(portstr), "%d", port);
+  int rc = getaddrinfo(host.c_str(), portstr, &hints, &res);
+  if (rc != 0) {
+    *err = std::string("resolve ") + host + ": " + gai_strerror(rc);
+    return -1;
+  }
+  int fd = -1;
+  for (struct addrinfo* ai = res; ai; ai = ai->ai_next) {
+    fd = socket(ai->ai_family, SOCK_STREAM, 0);
+    if (fd < 0) continue;
+    // non-blocking connect with timeout
+    int flags = fcntl(fd, F_GETFL, 0);
+    fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+    rc = connect(fd, ai->ai_addr, ai->ai_addrlen);
+    if (rc != 0 && errno == EINPROGRESS) {
+      struct pollfd pfd = {fd, POLLOUT, 0};
+      rc = poll(&pfd, 1, timeout_ms) == 1 ? 0 : -1;
+      if (rc == 0) {
+        int soerr = 0;
+        socklen_t len = sizeof(soerr);
+        getsockopt(fd, SOL_SOCKET, SO_ERROR, &soerr, &len);
+        if (soerr != 0) rc = -1;
+      }
+    }
+    if (rc == 0) {
+      fcntl(fd, F_SETFL, flags);
+      break;
+    }
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd < 0 && err->empty()) *err = "connect failed: " + host;
+  return fd;
+}
+
+Response PlainHttp(const Config& cfg, const Url& url,
+                   const std::string& method, const std::string& path,
+                   const std::string& body,
+                   const std::string& content_type) {
+  Response resp;
+  std::string err;
+  int fd = ConnectTcp(url.host, url.port, cfg.timeout_ms, &err);
+  if (fd < 0) {
+    resp.error = err;
+    return resp;
+  }
+  std::string req = method + " " + path + " HTTP/1.1\r\n" +
+                    "Host: " + url.host + "\r\n" +
+                    "Connection: close\r\nAccept: application/json\r\n";
+  if (!cfg.token.empty()) req += "Authorization: Bearer " + cfg.token + "\r\n";
+  if (!body.empty()) {
+    req += "Content-Type: " + content_type + "\r\n";
+    req += "Content-Length: " + std::to_string(body.size()) + "\r\n";
+  }
+  req += "\r\n" + body;
+
+  size_t off = 0;
+  while (off < req.size()) {
+    ssize_t n = write(fd, req.data() + off, req.size() - off);
+    if (n <= 0) {
+      resp.error = "write failed";
+      close(fd);
+      return resp;
+    }
+    off += n;
+  }
+  std::string raw;
+  char buf[8192];
+  while (true) {
+    struct pollfd pfd = {fd, POLLIN, 0};
+    if (poll(&pfd, 1, cfg.timeout_ms) != 1) {
+      resp.error = "read timeout";
+      close(fd);
+      return resp;
+    }
+    ssize_t n = read(fd, buf, sizeof(buf));
+    if (n < 0) {
+      resp.error = "read failed";
+      close(fd);
+      return resp;
+    }
+    if (n == 0) break;
+    raw.append(buf, n);
+  }
+  close(fd);
+
+  size_t hdr_end = raw.find("\r\n\r\n");
+  if (raw.compare(0, 5, "HTTP/") != 0 || hdr_end == std::string::npos) {
+    resp.error = "malformed HTTP response";
+    return resp;
+  }
+  resp.status = atoi(raw.c_str() + raw.find(' ') + 1);
+  std::string headers = raw.substr(0, hdr_end);
+  resp.body = raw.substr(hdr_end + 4);
+  // Connection: close => body runs to EOF, but honor chunked encoding from
+  // picky servers.
+  for (char& c : headers) c = tolower(c);
+  if (headers.find("transfer-encoding: chunked") != std::string::npos) {
+    std::string decoded;
+    size_t pos = 0;
+    while (pos < resp.body.size()) {
+      size_t nl = resp.body.find("\r\n", pos);
+      if (nl == std::string::npos) break;
+      long chunk = strtol(resp.body.c_str() + pos, nullptr, 16);
+      if (chunk <= 0) break;
+      decoded += resp.body.substr(nl + 2, chunk);
+      pos = nl + 2 + chunk + 2;
+    }
+    resp.body = decoded;
+  }
+  return resp;
+}
+
+// ------------------------------------------------------------------ curl https
+
+Response CurlHttps(const Config& cfg, const std::string& method,
+                   const std::string& url, const std::string& body,
+                   const std::string& content_type) {
+  Response resp;
+  char body_path[] = "/tmp/tpuop-body-XXXXXX";
+  int body_fd = -1;
+  if (!body.empty()) {
+    body_fd = mkstemp(body_path);
+    if (body_fd < 0 || write(body_fd, body.data(), body.size()) !=
+                           static_cast<ssize_t>(body.size())) {
+      resp.error = "cannot stage request body";
+      if (body_fd >= 0) close(body_fd);
+      return resp;
+    }
+  }
+
+  std::vector<std::string> args = {
+      "curl", "-sS", "-X", method, "--max-time",
+      std::to_string((cfg.timeout_ms + 999) / 1000),
+      // status on the last line of stdout, separated for parsing
+      "-w", "\n%{http_code}",
+      "-H", "Accept: application/json",
+  };
+  if (!cfg.token.empty())
+    args.insert(args.end(), {"-H", "Authorization: Bearer " + cfg.token});
+  if (!cfg.ca_file.empty())
+    args.insert(args.end(), {"--cacert", cfg.ca_file});
+  else
+    args.push_back("-k");
+  if (!body.empty()) {
+    args.insert(args.end(), {"-H", "Content-Type: " + content_type,
+                             "--data-binary", std::string("@") + body_path});
+  }
+  args.push_back(url);
+
+  int pipefd[2];
+  if (pipe(pipefd) != 0) {
+    resp.error = "pipe failed";
+    if (body_fd >= 0) close(body_fd);
+    return resp;
+  }
+  pid_t pid = fork();
+  if (pid < 0) {
+    resp.error = "fork failed";
+    close(pipefd[0]);
+    close(pipefd[1]);
+    if (body_fd >= 0) close(body_fd);
+    return resp;
+  }
+  if (pid == 0) {
+    dup2(pipefd[1], 1);
+    close(pipefd[0]);
+    close(pipefd[1]);
+    std::vector<char*> argv;
+    for (auto& a : args) argv.push_back(const_cast<char*>(a.c_str()));
+    argv.push_back(nullptr);
+    execvp("curl", argv.data());
+    _exit(127);
+  }
+  close(pipefd[1]);
+  std::string out;
+  char buf[8192];
+  ssize_t n;
+  while ((n = read(pipefd[0], buf, sizeof(buf))) > 0) out.append(buf, n);
+  close(pipefd[0]);
+  int wstatus = 0;
+  waitpid(pid, &wstatus, 0);
+  if (body_fd >= 0) {
+    close(body_fd);
+    unlink(body_path);
+  }
+  if (!WIFEXITED(wstatus) || WEXITSTATUS(wstatus) != 0) {
+    resp.error = "curl exited " + std::to_string(WEXITSTATUS(wstatus)) +
+                 ": " + out.substr(0, 200);
+    return resp;
+  }
+  size_t nl = out.rfind('\n');
+  if (nl == std::string::npos) {
+    resp.error = "curl produced no status line";
+    return resp;
+  }
+  resp.status = atoi(out.c_str() + nl + 1);
+  resp.body = out.substr(0, nl);
+  return resp;
+}
+
+}  // namespace
+
+bool Config::InCluster(Config* out) {
+  const char* host = getenv("KUBERNETES_SERVICE_HOST");
+  const char* port = getenv("KUBERNETES_SERVICE_PORT");
+  if (!host || !*host) return false;
+  std::string h = host;
+  if (h.find(':') != std::string::npos && h[0] != '[')
+    h = "[" + h + "]";  // IPv6 single-stack clusters export a bare literal
+  out->base_url = "https://" + h + ":" + (port ? port : "443");
+  const char* sa = "/var/run/secrets/kubernetes.io/serviceaccount";
+  ReadFileTrim(std::string(sa) + "/token", &out->token);
+  std::string ca = std::string(sa) + "/ca.crt";
+  if (access(ca.c_str(), R_OK) == 0) out->ca_file = ca;
+  return true;
+}
+
+Response Call(const Config& cfg, const std::string& method,
+              const std::string& path, const std::string& body,
+              const std::string& content_type) {
+  Url url;
+  Response resp;
+  if (!ParseUrl(cfg.base_url, &url, &resp.error)) return resp;
+  if (url.https)
+    return CurlHttps(cfg, method, cfg.base_url + path, body, content_type);
+  return PlainHttp(cfg, url, method, path, body, content_type);
+}
+
+}  // namespace kubeclient

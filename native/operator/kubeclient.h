@@ -1,0 +1,48 @@
+// kubeclient — minimal kube-apiserver REST client for the tpu-operator.
+//
+// Two transports behind one interface:
+//  - plain HTTP/1.1 over TCP for http:// base URLs (the in-process fake
+//    apiserver in tests, or a `kubectl proxy` endpoint), implemented with
+//    raw sockets — no third-party HTTP library in the image;
+//  - HTTPS via exec of the system `curl` binary for in-cluster https://
+//    apiserver access with the ServiceAccount token + cluster CA (the image
+//    ships no TLS headers, and shipping our own TLS would be malpractice —
+//    curl is present in every node image this stack targets).
+
+#ifndef TPU_NATIVE_OPERATOR_KUBECLIENT_H_
+#define TPU_NATIVE_OPERATOR_KUBECLIENT_H_
+
+#include <string>
+
+namespace kubeclient {
+
+struct Response {
+  int status = 0;          // HTTP status; 0 = transport failure
+  std::string body;
+  std::string error;       // transport-level error when status == 0
+  bool ok() const { return status >= 200 && status < 300; }
+};
+
+struct Config {
+  std::string base_url;     // e.g. https://10.96.0.1:443 or http://127.0.0.1:8001
+  std::string token;        // bearer token ("" = none)
+  std::string ca_file;      // CA bundle for https ("" = curl -k)
+  int timeout_ms = 10000;
+
+  // In-cluster defaults: KUBERNETES_SERVICE_HOST/PORT env + the mounted
+  // ServiceAccount token/CA. Returns false when not running in a cluster.
+  static bool InCluster(Config* out);
+};
+
+// method: GET | POST | PUT | PATCH | DELETE. content_type applies when body
+// is non-empty (Kubernetes needs application/merge-patch+json for PATCH).
+Response Call(const Config& cfg, const std::string& method,
+              const std::string& path, const std::string& body = "",
+              const std::string& content_type = "application/json");
+
+// Read a whole file, stripping trailing newlines (token files etc.).
+bool ReadFileTrim(const std::string& path, std::string* out);
+
+}  // namespace kubeclient
+
+#endif  // TPU_NATIVE_OPERATOR_KUBECLIENT_H_

@@ -1,0 +1,362 @@
+#include "minijson.h"
+
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+
+namespace minijson {
+
+ValuePtr Value::MakeObject() {
+  auto v = std::make_shared<Value>();
+  v->type_ = Type::kObject;
+  return v;
+}
+
+ValuePtr Value::MakeArray() {
+  auto v = std::make_shared<Value>();
+  v->type_ = Type::kArray;
+  return v;
+}
+
+ValuePtr Value::Get(const std::string& key) const {
+  if (type_ != Type::kObject) return nullptr;
+  for (const auto& [k, v] : obj_)
+    if (k == key) return v;
+  return nullptr;
+}
+
+void Value::Set(const std::string& key, ValuePtr v) {
+  for (auto& [k, existing] : obj_) {
+    if (k == key) {
+      existing = std::move(v);
+      return;
+    }
+  }
+  obj_.emplace_back(key, std::move(v));
+}
+
+ValuePtr Value::Path(const std::string& dotted) const {
+  size_t start = 0;
+  const Value* cur = this;
+  ValuePtr held;
+  while (start <= dotted.size()) {
+    size_t dot = dotted.find('.', start);
+    std::string key = dotted.substr(
+        start, dot == std::string::npos ? std::string::npos : dot - start);
+    held = cur->Get(key);
+    if (!held) return nullptr;
+    cur = held.get();
+    if (dot == std::string::npos) break;
+    start = dot + 1;
+  }
+  return held;
+}
+
+std::string Value::PathString(const std::string& dotted,
+                              const std::string& fallback) const {
+  ValuePtr v = Path(dotted);
+  return v && v->is_string() ? v->as_string() : fallback;
+}
+
+double Value::PathNumber(const std::string& dotted, double fallback) const {
+  ValuePtr v = Path(dotted);
+  return v && v->is_number() ? v->as_number() : fallback;
+}
+
+namespace {
+
+void EscapeTo(const std::string& s, std::string* out) {
+  out->push_back('"');
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': *out += "\\\""; break;
+      case '\\': *out += "\\\\"; break;
+      case '\n': *out += "\\n"; break;
+      case '\r': *out += "\\r"; break;
+      case '\t': *out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", c);
+          *out += buf;
+        } else {
+          out->push_back(static_cast<char>(c));
+        }
+    }
+  }
+  out->push_back('"');
+}
+
+struct Parser {
+  const char* p;
+  const char* end;
+  std::string err;
+
+  void Skip() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+      ++p;
+  }
+
+  bool Fail(const char* msg) {
+    char buf[96];
+    snprintf(buf, sizeof(buf), "%s at byte %zd", msg,
+             static_cast<ssize_t>(p - start));
+    err = buf;
+    return false;
+  }
+
+  bool Literal(const char* lit) {
+    size_t n = strlen(lit);
+    if (static_cast<size_t>(end - p) < n || strncmp(p, lit, n) != 0)
+      return Fail("bad literal");
+    p += n;
+    return true;
+  }
+
+  bool ParseString(std::string* out) {
+    if (p >= end || *p != '"') return Fail("expected string");
+    ++p;
+    while (p < end && *p != '"') {
+      if (*p == '\\') {
+        ++p;
+        if (p >= end) return Fail("truncated escape");
+        switch (*p) {
+          case '"': out->push_back('"'); break;
+          case '\\': out->push_back('\\'); break;
+          case '/': out->push_back('/'); break;
+          case 'b': out->push_back('\b'); break;
+          case 'f': out->push_back('\f'); break;
+          case 'n': out->push_back('\n'); break;
+          case 'r': out->push_back('\r'); break;
+          case 't': out->push_back('\t'); break;
+          case 'u': {
+            if (end - p < 5) return Fail("truncated \\u");
+            unsigned code = 0;
+            for (int i = 1; i <= 4; ++i) {
+              char c = p[i];
+              code <<= 4;
+              if (c >= '0' && c <= '9') code |= c - '0';
+              else if (c >= 'a' && c <= 'f') code |= c - 'a' + 10;
+              else if (c >= 'A' && c <= 'F') code |= c - 'A' + 10;
+              else return Fail("bad \\u digit");
+            }
+            p += 4;
+            // UTF-8 encode (surrogate pairs folded to U+FFFD — manifest
+            // content is ASCII/BMP in practice)
+            if (code >= 0xD800 && code <= 0xDFFF) code = 0xFFFD;
+            if (code < 0x80) {
+              out->push_back(static_cast<char>(code));
+            } else if (code < 0x800) {
+              out->push_back(static_cast<char>(0xC0 | (code >> 6)));
+              out->push_back(static_cast<char>(0x80 | (code & 0x3F)));
+            } else {
+              out->push_back(static_cast<char>(0xE0 | (code >> 12)));
+              out->push_back(static_cast<char>(0x80 | ((code >> 6) & 0x3F)));
+              out->push_back(static_cast<char>(0x80 | (code & 0x3F)));
+            }
+            break;
+          }
+          default:
+            return Fail("bad escape");
+        }
+        ++p;
+      } else {
+        out->push_back(*p++);
+      }
+    }
+    if (p >= end) return Fail("unterminated string");
+    ++p;  // closing quote
+    return true;
+  }
+
+  ValuePtr ParseValue(int depth) {
+    if (depth > 64) {
+      Fail("nesting too deep");
+      return nullptr;
+    }
+    Skip();
+    if (p >= end) {
+      Fail("unexpected end");
+      return nullptr;
+    }
+    switch (*p) {
+      case '{': {
+        ++p;
+        auto obj = Value::MakeObject();
+        Skip();
+        if (p < end && *p == '}') {
+          ++p;
+          return obj;
+        }
+        while (true) {
+          Skip();
+          std::string key;
+          if (!ParseString(&key)) return nullptr;
+          Skip();
+          if (p >= end || *p != ':') {
+            Fail("expected ':'");
+            return nullptr;
+          }
+          ++p;
+          ValuePtr v = ParseValue(depth + 1);
+          if (!v) return nullptr;
+          obj->Set(key, v);
+          Skip();
+          if (p < end && *p == ',') {
+            ++p;
+            continue;
+          }
+          if (p < end && *p == '}') {
+            ++p;
+            return obj;
+          }
+          Fail("expected ',' or '}'");
+          return nullptr;
+        }
+      }
+      case '[': {
+        ++p;
+        auto arr = Value::MakeArray();
+        Skip();
+        if (p < end && *p == ']') {
+          ++p;
+          return arr;
+        }
+        while (true) {
+          ValuePtr v = ParseValue(depth + 1);
+          if (!v) return nullptr;
+          arr->Append(v);
+          Skip();
+          if (p < end && *p == ',') {
+            ++p;
+            continue;
+          }
+          if (p < end && *p == ']') {
+            ++p;
+            return arr;
+          }
+          Fail("expected ',' or ']'");
+          return nullptr;
+        }
+      }
+      case '"': {
+        std::string s;
+        if (!ParseString(&s)) return nullptr;
+        return std::make_shared<Value>(s);
+      }
+      case 't':
+        if (!Literal("true")) return nullptr;
+        return std::make_shared<Value>(true);
+      case 'f':
+        if (!Literal("false")) return nullptr;
+        return std::make_shared<Value>(false);
+      case 'n':
+        if (!Literal("null")) return nullptr;
+        return std::make_shared<Value>();
+      default: {
+        // Scan per the JSON number grammar before strtod — bare strtod
+        // also accepts inf/nan/hex, which must stay malformed here.
+        const char* q = p;
+        if (q < end && *q == '-') ++q;
+        const char* int_start = q;
+        while (q < end && *q >= '0' && *q <= '9') ++q;
+        if (q == int_start ||
+            (*int_start == '0' && q - int_start > 1)) {
+          Fail("bad number");
+          return nullptr;
+        }
+        if (q < end && *q == '.') {
+          ++q;
+          const char* frac_start = q;
+          while (q < end && *q >= '0' && *q <= '9') ++q;
+          if (q == frac_start) {
+            Fail("bad number");
+            return nullptr;
+          }
+        }
+        if (q < end && (*q == 'e' || *q == 'E')) {
+          ++q;
+          if (q < end && (*q == '+' || *q == '-')) ++q;
+          const char* exp_start = q;
+          while (q < end && *q >= '0' && *q <= '9') ++q;
+          if (q == exp_start) {
+            Fail("bad number");
+            return nullptr;
+          }
+        }
+        double d = strtod(std::string(p, q).c_str(), nullptr);
+        p = q;
+        return std::make_shared<Value>(d);
+      }
+    }
+  }
+
+  const char* start;
+};
+
+}  // namespace
+
+void Value::DumpTo(std::string* out) const {
+  switch (type_) {
+    case Type::kNull: *out += "null"; break;
+    case Type::kBool: *out += bool_ ? "true" : "false"; break;
+    case Type::kNumber: {
+      char buf[32];
+      if (num_ == std::floor(num_) && std::fabs(num_) < 1e15) {
+        snprintf(buf, sizeof(buf), "%lld", static_cast<long long>(num_));
+      } else {
+        snprintf(buf, sizeof(buf), "%.17g", num_);
+      }
+      *out += buf;
+      break;
+    }
+    case Type::kString: EscapeTo(str_, out); break;
+    case Type::kArray: {
+      out->push_back('[');
+      for (size_t i = 0; i < arr_.size(); ++i) {
+        if (i) out->push_back(',');
+        arr_[i]->DumpTo(out);
+      }
+      out->push_back(']');
+      break;
+    }
+    case Type::kObject: {
+      out->push_back('{');
+      bool first = true;
+      for (const auto& [k, v] : obj_) {
+        if (!first) out->push_back(',');
+        first = false;
+        EscapeTo(k, out);
+        out->push_back(':');
+        v->DumpTo(out);
+      }
+      out->push_back('}');
+      break;
+    }
+  }
+}
+
+std::string Value::Dump() const {
+  std::string out;
+  DumpTo(&out);
+  return out;
+}
+
+ValuePtr Parse(const std::string& text, std::string* err) {
+  Parser parser;
+  parser.p = text.data();
+  parser.start = text.data();
+  parser.end = text.data() + text.size();
+  ValuePtr v = parser.ParseValue(0);
+  if (v) {
+    parser.Skip();
+    if (parser.p != parser.end) {
+      parser.Fail("trailing garbage");
+      v = nullptr;
+    }
+  }
+  if (!v && err) *err = parser.err;
+  return v;
+}
+
+}  // namespace minijson

@@ -1,0 +1,85 @@
+// minijson — small self-contained JSON parser/serializer for the operator.
+//
+// The tpu-operator (gpu-operator analog, reference README.md:101-110) talks
+// to the kube-apiserver in JSON: it POSTs manifest documents it read from the
+// bundle dir and extracts a handful of status fields (DaemonSet
+// desired/ready counts etc.) from responses. Full DOM, no streaming; inputs
+// are trusted-size (manifests, single-object API responses).
+
+#ifndef TPU_NATIVE_OPERATOR_MINIJSON_H_
+#define TPU_NATIVE_OPERATOR_MINIJSON_H_
+
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace minijson {
+
+class Value;
+using ValuePtr = std::shared_ptr<Value>;
+
+class Value {
+ public:
+  enum class Type { kNull, kBool, kNumber, kString, kArray, kObject };
+
+  Value() : type_(Type::kNull) {}
+  explicit Value(bool b) : type_(Type::kBool), bool_(b) {}
+  explicit Value(double d) : type_(Type::kNumber), num_(d) {}
+  explicit Value(const std::string& s) : type_(Type::kString), str_(s) {}
+
+  static ValuePtr MakeObject();
+  static ValuePtr MakeArray();
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::kNull; }
+  bool is_object() const { return type_ == Type::kObject; }
+  bool is_array() const { return type_ == Type::kArray; }
+  bool is_string() const { return type_ == Type::kString; }
+  bool is_number() const { return type_ == Type::kNumber; }
+  bool is_bool() const { return type_ == Type::kBool; }
+
+  bool as_bool(bool fallback = false) const {
+    return type_ == Type::kBool ? bool_ : fallback;
+  }
+  double as_number(double fallback = 0) const {
+    return type_ == Type::kNumber ? num_ : fallback;
+  }
+  const std::string& as_string() const { return str_; }
+
+  // Object access. Get returns nullptr when absent or not an object.
+  ValuePtr Get(const std::string& key) const;
+  void Set(const std::string& key, ValuePtr v);
+  const std::vector<std::pair<std::string, ValuePtr>>& items() const {
+    return obj_;
+  }
+
+  // Array access.
+  const std::vector<ValuePtr>& elements() const { return arr_; }
+  void Append(ValuePtr v) { arr_.push_back(std::move(v)); }
+
+  // Dotted-path convenience: Path("status.numberReady").
+  ValuePtr Path(const std::string& dotted) const;
+  std::string PathString(const std::string& dotted,
+                         const std::string& fallback = "") const;
+  double PathNumber(const std::string& dotted, double fallback = 0) const;
+
+  std::string Dump() const;
+
+ private:
+  void DumpTo(std::string* out) const;
+
+  Type type_;
+  bool bool_ = false;
+  double num_ = 0;
+  std::string str_;
+  std::vector<ValuePtr> arr_;
+  std::vector<std::pair<std::string, ValuePtr>> obj_;  // insertion order
+};
+
+// Returns nullptr on malformed input; *err gets a position-tagged message.
+ValuePtr Parse(const std::string& text, std::string* err = nullptr);
+
+}  // namespace minijson
+
+#endif  // TPU_NATIVE_OPERATOR_MINIJSON_H_

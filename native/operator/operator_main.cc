@@ -1,0 +1,469 @@
+// tpu-operator — the stack's controller (gpu-operator analog).
+//
+// The reference's `helm install --wait gpu-operator` creates a Go
+// controller that rolls five operand DaemonSets onto accelerator nodes in
+// dependency order, each step gated on the previous one's readiness
+// (reference README.md:101-110; trace in SURVEY.md §3.3). This daemon
+// reproduces that core behavior for the TPU operands:
+//
+//  - reads a manifest bundle from --bundle-dir (a mounted ConfigMap rendered
+//    by `tpu_cluster.render.operator_bundle`): flat files named
+//    "NN-stage--object.json"; lexicographic order = rollout order, the
+//    "NN-stage" prefix is the readiness gate boundary;
+//  - applies each stage against the apiserver (POST when absent,
+//    merge-PATCH when present — drift in our own operands is reverted);
+//  - waits for every workload object in the stage to be Ready before
+//    touching the next stage (helm --wait / operator ordering analog);
+//  - loops forever re-reconciling (DaemonSet deleted by hand -> recreated
+//    next pass), or runs one pass with --once (the `tpuctl apply --wait`
+//    backend);
+//  - serves /status /healthz /metrics on --status-port while reconciling
+//    (single-threaded: the status socket is pumped during readiness waits).
+
+#include <dirent.h>
+#include <netinet/in.h>
+#include <poll.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <string>
+#include <vector>
+
+#include "kubeapi.h"
+#include "kubeclient.h"
+#include "minijson.h"
+
+namespace {
+
+volatile sig_atomic_t g_stop = 0;
+void HandleSignal(int) { g_stop = 1; }
+
+struct Options {
+  std::string apiserver;     // "" = in-cluster config
+  std::string token_file;
+  std::string ca_file;
+  std::string bundle_dir = "/etc/tpu-operator/bundle";
+  int interval_s = 15;
+  int stage_timeout_s = 600;
+  int poll_ms = 1000;
+  int status_port = 9402;    // 0 = disabled
+  bool once = false;
+  bool allow_empty_daemonsets = false;
+};
+
+struct BundleObject {
+  std::string file;
+  std::string stage;
+  minijson::ValuePtr obj;
+  // reconcile state (refreshed every pass)
+  bool applied = false;
+  bool ready = false;
+  std::string error;
+};
+
+struct ReadFile {
+  static bool Whole(const std::string& path, std::string* out) {
+    FILE* f = fopen(path.c_str(), "r");
+    if (!f) return false;
+    char buf[16384];
+    size_t n;
+    out->clear();
+    while ((n = fread(buf, 1, sizeof(buf), f)) > 0) out->append(buf, n);
+    fclose(f);
+    return true;
+  }
+};
+
+bool LoadBundle(const std::string& dir, std::vector<BundleObject>* out,
+                std::string* err) {
+  DIR* d = opendir(dir.c_str());
+  if (!d) {
+    *err = "cannot open bundle dir " + dir;
+    return false;
+  }
+  std::vector<std::string> names;
+  while (struct dirent* e = readdir(d)) {
+    std::string name = e->d_name;
+    if (name.size() > 5 && name.substr(name.size() - 5) == ".json" &&
+        name[0] != '.')
+      names.push_back(name);
+  }
+  closedir(d);
+  std::sort(names.begin(), names.end());
+  if (names.empty()) {
+    *err = "bundle dir " + dir + " contains no .json manifests";
+    return false;
+  }
+  out->clear();
+  for (const auto& name : names) {
+    std::string text;
+    if (!ReadFile::Whole(dir + "/" + name, &text)) {
+      *err = "cannot read " + name;
+      return false;
+    }
+    std::string perr;
+    minijson::ValuePtr obj = minijson::Parse(text, &perr);
+    if (!obj || !obj->is_object()) {
+      *err = name + ": " + (perr.empty() ? "not a JSON object" : perr);
+      return false;
+    }
+    BundleObject bo;
+    bo.file = name;
+    size_t sep = name.find("--");
+    bo.stage = sep == std::string::npos ? name.substr(0, name.size() - 5)
+                                        : name.substr(0, sep);
+    bo.obj = obj;
+    out->push_back(std::move(bo));
+  }
+  return true;
+}
+
+class StatusServer {
+ public:
+  bool Listen(int port) {
+    if (port <= 0) return true;
+    fd_ = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd_ < 0) return false;
+    int one = 1;
+    setsockopt(fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    struct sockaddr_in addr;
+    memset(&addr, 0, sizeof(addr));
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (bind(fd_, reinterpret_cast<struct sockaddr*>(&addr), sizeof(addr)) !=
+            0 ||
+        listen(fd_, 8) != 0) {
+      close(fd_);
+      fd_ = -1;
+      return false;
+    }
+    return true;
+  }
+
+  // Serve pending requests for up to wait_ms — doubles as the loop's sleep.
+  void Pump(int wait_ms, const std::string& status_json,
+            const std::string& metrics, bool healthy) {
+    if (fd_ < 0) {
+      if (wait_ms > 0) usleep(wait_ms * 1000);
+      return;
+    }
+    struct timespec t0;
+    clock_gettime(CLOCK_MONOTONIC, &t0);
+    int left = wait_ms;
+    do {
+      struct pollfd pfd = {fd_, POLLIN, 0};
+      int rc = poll(&pfd, 1, left < 0 ? 0 : left);
+      if (rc > 0) {
+        int cfd = accept(fd_, nullptr, nullptr);
+        if (cfd >= 0) {
+          char buf[1024];
+          ssize_t n = read(cfd, buf, sizeof(buf) - 1);
+          std::string body = status_json, ctype = "application/json";
+          int code = 200;
+          if (n > 0) {
+            buf[n] = 0;
+            char method[8], path[128];
+            if (sscanf(buf, "%7s %127s", method, path) == 2) {
+              if (strcmp(path, "/metrics") == 0) {
+                body = metrics;
+                ctype = "text/plain; version=0.0.4";
+              } else if (strcmp(path, "/healthz") == 0) {
+                body = healthy ? "ok\n" : "reconcile failing\n";
+                ctype = "text/plain";
+                code = healthy ? 200 : 503;
+              }
+            }
+          }
+          char hdr[256];
+          snprintf(hdr, sizeof(hdr),
+                   "HTTP/1.1 %d %s\r\nContent-Type: %s\r\n"
+                   "Content-Length: %zu\r\nConnection: close\r\n\r\n",
+                   code, code == 200 ? "OK" : "Service Unavailable",
+                   ctype.c_str(), body.size());
+          (void)!write(cfd, hdr, strlen(hdr));
+          (void)!write(cfd, body.data(), body.size());
+          close(cfd);
+        }
+      }
+      struct timespec now;
+      clock_gettime(CLOCK_MONOTONIC, &now);
+      left = wait_ms - static_cast<int>((now.tv_sec - t0.tv_sec) * 1000 +
+                                        (now.tv_nsec - t0.tv_nsec) / 1000000);
+    } while (left > 0 && !g_stop);
+  }
+
+ private:
+  int fd_ = -1;
+};
+
+class Operator {
+ public:
+  Operator(const Options& opt, kubeclient::Config cfg)
+      : opt_(opt), cfg_(std::move(cfg)) {}
+
+  bool LoadOrReloadBundle() {
+    std::string err;
+    if (!LoadBundle(opt_.bundle_dir, &bundle_, &err)) {
+      fprintf(stderr, "tpu-operator: %s\n", err.c_str());
+      return false;
+    }
+    return true;
+  }
+
+  bool Listen() { return status_.Listen(opt_.status_port); }
+
+  // One full reconcile pass: apply + gate stage by stage. Returns true when
+  // every object applied and became ready.
+  bool ReconcilePass() {
+    ++passes_;
+    for (auto& bo : bundle_) {
+      bo.applied = false;
+      bo.ready = false;
+      bo.error.clear();
+    }
+    size_t i = 0;
+    while (i < bundle_.size() && !g_stop) {
+      const std::string stage = bundle_[i].stage;
+      size_t stage_end = i;
+      while (stage_end < bundle_.size() && bundle_[stage_end].stage == stage)
+        ++stage_end;
+      // apply every object of the stage
+      for (size_t j = i; j < stage_end; ++j) {
+        if (!ApplyObject(&bundle_[j])) {
+          fprintf(stderr, "tpu-operator: stage %s: apply %s failed: %s\n",
+                  stage.c_str(), bundle_[j].file.c_str(),
+                  bundle_[j].error.c_str());
+          return false;
+        }
+      }
+      // gate on readiness of the stage's workload objects (helm --wait
+      // analog, reference README.md:101)
+      time_t deadline = time(nullptr) + opt_.stage_timeout_s;
+      while (!g_stop) {
+        bool all_ready = true;
+        for (size_t j = i; j < stage_end; ++j) {
+          if (!bundle_[j].ready && !CheckReady(&bundle_[j]))
+            all_ready = false;
+        }
+        if (all_ready) break;
+        if (time(nullptr) >= deadline) {
+          for (size_t j = i; j < stage_end; ++j) {
+            if (!bundle_[j].ready)
+              fprintf(stderr,
+                      "tpu-operator: stage %s: %s not ready after %ds\n",
+                      stage.c_str(), bundle_[j].file.c_str(),
+                      opt_.stage_timeout_s);
+          }
+          return false;
+        }
+        Sleep(opt_.poll_ms);
+      }
+      i = stage_end;
+    }
+    return !g_stop;
+  }
+
+  void RunForever() {
+    while (!g_stop) {
+      bool ok = ReconcilePass();
+      healthy_ = ok;
+      if (ok) fprintf(stderr, "tpu-operator: pass %d converged\n", passes_);
+      Sleep(opt_.interval_s * 1000);
+    }
+  }
+
+  std::string StatusJson() const {
+    minijson::ValuePtr root = minijson::Value::MakeObject();
+    root->Set("passes", std::make_shared<minijson::Value>(double(passes_)));
+    root->Set("healthy", std::make_shared<minijson::Value>(healthy_));
+    auto arr = minijson::Value::MakeArray();
+    for (const auto& bo : bundle_) {
+      auto o = minijson::Value::MakeObject();
+      o->Set("file", std::make_shared<minijson::Value>(bo.file));
+      o->Set("stage", std::make_shared<minijson::Value>(bo.stage));
+      o->Set("applied", std::make_shared<minijson::Value>(bo.applied));
+      o->Set("ready", std::make_shared<minijson::Value>(bo.ready));
+      if (!bo.error.empty())
+        o->Set("error", std::make_shared<minijson::Value>(bo.error));
+      arr->Append(o);
+    }
+    root->Set("objects", arr);
+    return root->Dump() + "\n";
+  }
+
+  std::string Metrics() const {
+    int applied = 0, ready = 0;
+    for (const auto& bo : bundle_) {
+      applied += bo.applied;
+      ready += bo.ready;
+    }
+    char buf[512];
+    snprintf(buf, sizeof(buf),
+             "# TYPE tpu_operator_objects gauge\n"
+             "tpu_operator_objects{state=\"desired\"} %zu\n"
+             "tpu_operator_objects{state=\"applied\"} %d\n"
+             "tpu_operator_objects{state=\"ready\"} %d\n"
+             "# TYPE tpu_operator_passes_total counter\n"
+             "tpu_operator_passes_total %d\n"
+             "# TYPE tpu_operator_healthy gauge\n"
+             "tpu_operator_healthy %d\n",
+             bundle_.size(), applied, ready, passes_, healthy_ ? 1 : 0);
+    return buf;
+  }
+
+  bool healthy() const { return healthy_; }
+  void set_healthy(bool h) { healthy_ = h; }
+
+ private:
+  void Sleep(int ms) { status_.Pump(ms, StatusJson(), Metrics(), healthy_); }
+
+  bool ApplyObject(BundleObject* bo) {
+    std::string err;
+    std::string obj_path = kubeapi::ObjectPath(*bo->obj, &err);
+    if (obj_path.empty()) {
+      bo->error = err;
+      return false;
+    }
+    kubeclient::Response get = kubeclient::Call(cfg_, "GET", obj_path);
+    if (get.status == 404) {
+      std::string coll = kubeapi::CollectionPath(*bo->obj, &err);
+      kubeclient::Response post =
+          kubeclient::Call(cfg_, "POST", coll, bo->obj->Dump());
+      if (!post.ok()) {
+        bo->error = "POST " + coll + " -> " + std::to_string(post.status) +
+                    " " + (post.status ? post.body.substr(0, 160) : post.error);
+        return false;
+      }
+    } else if (get.ok()) {
+      // merge-patch the desired state over whatever is there — reverts
+      // manual drift in our operands without clobbering server-set fields
+      kubeclient::Response patch =
+          kubeclient::Call(cfg_, "PATCH", obj_path, bo->obj->Dump(),
+                           "application/merge-patch+json");
+      if (!patch.ok()) {
+        bo->error = "PATCH " + obj_path + " -> " +
+                    std::to_string(patch.status) + " " +
+                    (patch.status ? patch.body.substr(0, 160) : patch.error);
+        return false;
+      }
+    } else {
+      bo->error = "GET " + obj_path + " -> " + std::to_string(get.status) +
+                  " " + (get.status ? get.body.substr(0, 160) : get.error);
+      return false;
+    }
+    bo->applied = true;
+    return true;
+  }
+
+  bool CheckReady(BundleObject* bo) {
+    std::string kind = bo->obj->PathString("kind");
+    if (kind != "DaemonSet" && kind != "Deployment" && kind != "Job") {
+      bo->ready = true;
+      return true;
+    }
+    std::string err;
+    std::string obj_path = kubeapi::ObjectPath(*bo->obj, &err);
+    kubeclient::Response get = kubeclient::Call(cfg_, "GET", obj_path);
+    if (!get.ok()) return false;
+    minijson::ValuePtr live = minijson::Parse(get.body);
+    if (!live) return false;
+    bool ready = kubeapi::IsReady(*live);
+    if (!ready && opt_.allow_empty_daemonsets && kind == "DaemonSet" &&
+        live->PathNumber("status.desiredNumberScheduled", -1) == 0)
+      ready = true;  // cluster has no matching nodes yet; don't wedge
+    bo->ready = ready;
+    return ready;
+  }
+
+  Options opt_;
+  kubeclient::Config cfg_;
+  std::vector<BundleObject> bundle_;
+  StatusServer status_;
+  int passes_ = 0;
+  bool healthy_ = false;
+};
+
+bool FlagVal(const char* arg, const char* name, std::string* out) {
+  size_t n = strlen(name);
+  if (strncmp(arg, name, n) == 0 && arg[n] == '=') {
+    *out = arg + n + 1;
+    return true;
+  }
+  return false;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  Options opt;
+  std::string sval;
+  for (int i = 1; i < argc; ++i) {
+    const char* a = argv[i];
+    if (FlagVal(a, "--apiserver", &opt.apiserver)) continue;
+    if (FlagVal(a, "--token-file", &opt.token_file)) continue;
+    if (FlagVal(a, "--ca-file", &opt.ca_file)) continue;
+    if (FlagVal(a, "--bundle-dir", &opt.bundle_dir)) continue;
+    if (FlagVal(a, "--interval", &sval)) { opt.interval_s = atoi(sval.c_str()); continue; }
+    if (FlagVal(a, "--stage-timeout", &sval)) { opt.stage_timeout_s = atoi(sval.c_str()); continue; }
+    if (FlagVal(a, "--poll-ms", &sval)) { opt.poll_ms = atoi(sval.c_str()); continue; }
+    if (FlagVal(a, "--status-port", &sval)) { opt.status_port = atoi(sval.c_str()); continue; }
+    if (strcmp(a, "--once") == 0) { opt.once = true; continue; }
+    if (strcmp(a, "--allow-empty-daemonsets") == 0) {
+      opt.allow_empty_daemonsets = true;
+      continue;
+    }
+    fprintf(stderr,
+            "tpu-operator: unknown flag %s\n"
+            "usage: tpu-operator [--apiserver=URL] [--token-file=F] "
+            "[--ca-file=F]\n"
+            "  [--bundle-dir=DIR] [--interval=SECS] [--stage-timeout=SECS]\n"
+            "  [--poll-ms=MS] [--status-port=PORT] [--once]\n"
+            "  [--allow-empty-daemonsets]\n",
+            a);
+    return 2;
+  }
+
+  kubeclient::Config cfg;
+  if (!opt.apiserver.empty()) {
+    cfg.base_url = opt.apiserver;
+    if (!opt.token_file.empty())
+      kubeclient::ReadFileTrim(opt.token_file, &cfg.token);
+    cfg.ca_file = opt.ca_file;
+  } else if (!kubeclient::Config::InCluster(&cfg)) {
+    fprintf(stderr,
+            "tpu-operator: not in-cluster and no --apiserver given\n");
+    return 2;
+  }
+
+  signal(SIGINT, HandleSignal);
+  signal(SIGTERM, HandleSignal);
+  signal(SIGPIPE, SIG_IGN);
+
+  Operator op(opt, cfg);
+  if (!op.LoadOrReloadBundle()) return 1;
+  if (!op.Listen()) {
+    fprintf(stderr, "tpu-operator: cannot listen on status port %d\n",
+            opt.status_port);
+    return 1;
+  }
+  fprintf(stderr,
+          "tpu-operator: %s, bundle=%s, status port %d\n",
+          opt.once ? "single pass" : "reconciling",
+          opt.bundle_dir.c_str(), opt.status_port);
+
+  if (opt.once) {
+    bool ok = op.ReconcilePass();
+    op.set_healthy(ok);
+    printf("%s", op.StatusJson().c_str());
+    return ok ? 0 : 1;
+  }
+  op.RunForever();
+  return 0;
+}

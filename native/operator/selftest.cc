@@ -1,0 +1,134 @@
+// operator_selftest — unit checks for minijson + kubeapi (no server needed).
+
+#include <stdio.h>
+#include <string.h>
+
+#include "kubeapi.h"
+#include "minijson.h"
+
+static int g_failures = 0;
+
+#define CHECK(cond)                                                    \
+  do {                                                                 \
+    if (!(cond)) {                                                     \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+      ++g_failures;                                                    \
+    }                                                                  \
+  } while (0)
+
+static void TestJsonRoundtrip() {
+  const char* doc =
+      "{\"a\": [1, 2.5, -3], \"b\": {\"c\": \"x\\ny\", \"d\": true},"
+      " \"e\": null, \"uni\": \"\\u00e9\\u0041\"}";
+  std::string err;
+  auto v = minijson::Parse(doc, &err);
+  CHECK(v && err.empty());
+  CHECK(v->Path("b.c")->as_string() == "x\ny");
+  CHECK(v->Path("b.d")->as_bool());
+  CHECK(v->Path("e")->is_null());
+  CHECK(v->Get("a")->elements().size() == 3);
+  CHECK(v->Get("a")->elements()[1]->as_number() == 2.5);
+  CHECK(v->Get("uni")->as_string() == "\xc3\xa9" "A");
+  // dump -> reparse -> identical dump (canonical form fixpoint)
+  std::string d1 = v->Dump();
+  auto v2 = minijson::Parse(d1, &err);
+  CHECK(v2 && v2->Dump() == d1);
+  // integers stay integers through the double representation
+  auto n = minijson::Parse("{\"x\": 123456789012}");
+  CHECK(n->Dump() == "{\"x\":123456789012}");
+}
+
+static void TestJsonErrors() {
+  std::string err;
+  CHECK(!minijson::Parse("{", &err) && !err.empty());
+  CHECK(!minijson::Parse("{\"a\": }", &err));
+  CHECK(!minijson::Parse("[1, 2] trailing", &err));
+  CHECK(!minijson::Parse("\"unterminated", &err));
+  CHECK(!minijson::Parse("01x", &err));
+  // strict number grammar: strtod-isms are malformed JSON
+  CHECK(!minijson::Parse("inf", &err));
+  CHECK(!minijson::Parse("{\"x\": nan}", &err));
+  CHECK(!minijson::Parse("0x10", &err));
+  CHECK(!minijson::Parse("01", &err));
+  CHECK(!minijson::Parse("1.", &err));
+  CHECK(!minijson::Parse("1e", &err));
+  CHECK(!minijson::Parse("-", &err));
+  CHECK(minijson::Parse("-0.5e-3", &err) != nullptr);
+}
+
+static minijson::ValuePtr Obj(const char* text) {
+  std::string err;
+  auto v = minijson::Parse(text, &err);
+  if (!v) fprintf(stderr, "bad test object: %s\n", err.c_str());
+  return v;
+}
+
+static void TestPaths() {
+  std::string err;
+  auto ds = Obj(
+      "{\"apiVersion\": \"apps/v1\", \"kind\": \"DaemonSet\","
+      " \"metadata\": {\"name\": \"tpud\", \"namespace\": \"tpu-system\"}}");
+  CHECK(kubeapi::CollectionPath(*ds, &err) ==
+        "/apis/apps/v1/namespaces/tpu-system/daemonsets");
+  CHECK(kubeapi::ObjectPath(*ds, &err) ==
+        "/apis/apps/v1/namespaces/tpu-system/daemonsets/tpud");
+
+  auto ns = Obj(
+      "{\"apiVersion\": \"v1\", \"kind\": \"Namespace\","
+      " \"metadata\": {\"name\": \"tpu-system\"}}");
+  CHECK(kubeapi::ObjectPath(*ns, &err) == "/api/v1/namespaces/tpu-system");
+
+  auto svc = Obj(
+      "{\"apiVersion\": \"v1\", \"kind\": \"Service\","
+      " \"metadata\": {\"name\": \"m\", \"namespace\": \"x\"}}");
+  CHECK(kubeapi::CollectionPath(*svc, &err) ==
+        "/api/v1/namespaces/x/services");
+
+  auto crb = Obj(
+      "{\"apiVersion\": \"rbac.authorization.k8s.io/v1\","
+      " \"kind\": \"ClusterRoleBinding\", \"metadata\": {\"name\": \"b\"}}");
+  CHECK(kubeapi::ObjectPath(*crb, &err) ==
+        "/apis/rbac.authorization.k8s.io/v1/clusterrolebindings/b");
+
+  auto bogus = Obj("{\"apiVersion\": \"v1\", \"kind\": \"Wombat\","
+                   " \"metadata\": {\"name\": \"w\"}}");
+  CHECK(kubeapi::CollectionPath(*bogus, &err).empty() && !err.empty());
+}
+
+static void TestReadiness() {
+  CHECK(!kubeapi::IsReady(*Obj(
+      "{\"kind\": \"DaemonSet\", \"status\": {}}")));
+  CHECK(!kubeapi::IsReady(*Obj(
+      "{\"kind\": \"DaemonSet\","
+      " \"status\": {\"desiredNumberScheduled\": 2, \"numberReady\": 1}}")));
+  CHECK(kubeapi::IsReady(*Obj(
+      "{\"kind\": \"DaemonSet\","
+      " \"status\": {\"desiredNumberScheduled\": 2, \"numberReady\": 2}}")));
+  // desired==0: not ready by default (no nodes matched yet)
+  CHECK(!kubeapi::IsReady(*Obj(
+      "{\"kind\": \"DaemonSet\","
+      " \"status\": {\"desiredNumberScheduled\": 0, \"numberReady\": 0}}")));
+  CHECK(!kubeapi::IsReady(*Obj(
+      "{\"kind\": \"Deployment\", \"spec\": {\"replicas\": 2},"
+      " \"status\": {\"readyReplicas\": 1}}")));
+  CHECK(kubeapi::IsReady(*Obj(
+      "{\"kind\": \"Deployment\", \"spec\": {\"replicas\": 2},"
+      " \"status\": {\"readyReplicas\": 2}}")));
+  CHECK(kubeapi::IsReady(*Obj(
+      "{\"kind\": \"Job\", \"status\": {\"succeeded\": 1}}")));
+  CHECK(!kubeapi::IsReady(*Obj("{\"kind\": \"Job\", \"status\": {}}")));
+  CHECK(kubeapi::IsReady(*Obj("{\"kind\": \"ConfigMap\"}")));
+}
+
+int main() {
+  TestJsonRoundtrip();
+  TestJsonErrors();
+  TestPaths();
+  TestReadiness();
+  if (g_failures) {
+    fprintf(stderr, "operator_selftest: %d FAILURES\n", g_failures);
+    return 1;
+  }
+  printf("operator_selftest: all checks passed\n");
+  return 0;
+}

@@ -1,0 +1,204 @@
+"""In-process fake kube-apiserver (SURVEY.md §4: the fake layer the
+reference lacks).
+
+Speaks the small REST subset the tpu-operator and `tpuctl apply` use:
+
+  GET    <collection>/<name>   -> 200 stored object | 404
+  POST   <collection>          -> 201, stores body at collection/<name>
+  PUT    <collection>/<name>   -> 200, replaces
+  PATCH  <collection>/<name>   -> 200, merge-patch (RFC 7386: null deletes)
+  DELETE <collection>/<name>   -> 200 | 404
+
+The store is a flat {path: object} dict — the path grammar
+(/api/v1/... vs /apis/<group>/...) is produced by the client side, the fake
+only needs prefix bookkeeping. ``auto_ready`` fills workload status at create
+time (DaemonSet desired==ready etc.) so convergence tests don't need a node
+simulator; gating tests leave it off and flip readiness by hand via
+``set_ready``. Every request is appended to ``log`` for ordering assertions.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def merge_patch(target: Any, patch: Any) -> Any:
+    """RFC 7386 JSON merge patch."""
+    if not isinstance(patch, dict):
+        return patch
+    if not isinstance(target, dict):
+        target = {}
+    out = dict(target)
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        else:
+            out[k] = merge_patch(out.get(k), v)
+    return out
+
+
+def ready_status(obj: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    kind = obj.get("kind")
+    if kind == "DaemonSet":
+        return {"desiredNumberScheduled": 2, "numberReady": 2}
+    if kind == "Deployment":
+        want = obj.get("spec", {}).get("replicas", 1)
+        return {"readyReplicas": want, "availableReplicas": want}
+    if kind == "Job":
+        return {"succeeded": obj.get("spec", {}).get("completions", 1)}
+    return None
+
+
+class FakeApiServer:
+    def __init__(self, auto_ready: bool = True):
+        self.auto_ready = auto_ready
+        self.store: Dict[str, Dict[str, Any]] = {}
+        self.log: List[Tuple[str, str]] = []  # (method, path)
+        self.created: List[str] = []          # stored object paths, in order
+        self.headers_seen: List[Dict[str, str]] = []
+        self._lock = threading.Lock()
+
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _body(self) -> Any:
+                n = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(n) if n else b""
+                return json.loads(raw) if raw else None
+
+            def _reply(self, code: int, obj: Any = None):
+                body = json.dumps(obj if obj is not None else {}).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _record(self):
+                with fake._lock:
+                    fake.log.append((self.command, self.path))
+                    fake.headers_seen.append(dict(self.headers))
+
+            def do_GET(self):
+                self._record()
+                with fake._lock:
+                    obj = fake.store.get(self.path)
+                if obj is None:
+                    self._reply(404, {"kind": "Status", "code": 404})
+                else:
+                    self._reply(200, obj)
+
+            def do_POST(self):
+                self._record()
+                obj = self._body()
+                name = (obj or {}).get("metadata", {}).get("name")
+                if not name:
+                    self._reply(422, {"message": "metadata.name required"})
+                    return
+                path = f"{self.path}/{name}"
+                with fake._lock:
+                    if path in fake.store:
+                        self._reply(409, {"kind": "Status", "code": 409,
+                                          "reason": "AlreadyExists"})
+                        return
+                    if fake.auto_ready:
+                        st = ready_status(obj)
+                        if st:
+                            obj = dict(obj)
+                            obj["status"] = st
+                    fake.store[path] = obj
+                    fake.created.append(path)
+                self._reply(201, obj)
+
+            def do_PUT(self):
+                self._record()
+                obj = self._body()
+                with fake._lock:
+                    existed = self.path in fake.store
+                    fake.store[self.path] = obj
+                self._reply(200 if existed else 201, obj)
+
+            def do_PATCH(self):
+                self._record()
+                patch = self._body()
+                with fake._lock:
+                    cur = fake.store.get(self.path)
+                    if cur is None:
+                        self._reply(404, {"kind": "Status", "code": 404})
+                        return
+                    merged = merge_patch(cur, patch)
+                    if fake.auto_ready and "status" not in merged:
+                        st = ready_status(merged)
+                        if st:
+                            merged["status"] = st
+                    fake.store[self.path] = merged
+                self._reply(200, merged)
+
+            def do_DELETE(self):
+                self._record()
+                with fake._lock:
+                    gone = fake.store.pop(self.path, None)
+                self._reply(200 if gone is not None else 404, {})
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> "FakeApiServer":
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # ------------------------------------------------------------- test hooks
+
+    def paths(self, kind_suffix: str = "") -> List[str]:
+        with self._lock:
+            return [p for p in self.store if kind_suffix in p]
+
+    def get(self, path: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            obj = self.store.get(path)
+            return json.loads(json.dumps(obj)) if obj else None
+
+    def set_ready(self, path: str, ready: bool = True):
+        """Flip a workload object's readiness (the node-simulator stand-in)."""
+        with self._lock:
+            obj = self.store[path]
+            st = ready_status(obj) or {}
+            if not ready:
+                st = {k: 0 for k in st}
+                if obj.get("kind") == "DaemonSet":
+                    st["desiredNumberScheduled"] = 2
+            obj["status"] = st
+
+    def delete(self, path: str):
+        with self._lock:
+            self.store.pop(path, None)
+
+    def creation_order(self) -> List[str]:
+        with self._lock:
+            return list(self.created)

@@ -1,0 +1,221 @@
+"""tpu-operator controller tests: the C++ daemon driven against the fake
+apiserver — ordered rollout, readiness gating, drift repair, status surface
+(SURVEY.md §3.3 / §7 step 7)."""
+
+import json
+import os
+import signal
+import subprocess
+import time
+import urllib.request
+
+import pytest
+
+from fake_apiserver import FakeApiServer
+from tpu_cluster import spec as specmod
+from tpu_cluster.render import operator_bundle
+
+from test_native import native_build, binpath  # noqa: F401  (fixture reuse)
+
+NS = "tpu-system"
+DS = f"/apis/apps/v1/namespaces/{NS}/daemonsets"
+
+
+@pytest.fixture()
+def bundle_dir(tmp_path):
+    spec = specmod.default_spec()
+    d = tmp_path / "bundle"
+    d.mkdir()
+    for name, obj in operator_bundle.bundle_files(spec).items():
+        (d / name).write_text(json.dumps(obj))
+    return str(d)
+
+
+def run_operator(native_build, *args, timeout=60):
+    proc = subprocess.run(
+        [binpath(native_build, "tpu-operator"), *args],
+        capture_output=True, text=True, timeout=timeout)
+    return proc
+
+
+def start_operator(native_build, *args):
+    return subprocess.Popen(
+        [binpath(native_build, "tpu-operator"), *args],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+
+def wait_until(pred, timeout=15, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_operator_selftest(native_build):
+    subprocess.run([binpath(native_build, "operator_selftest")], check=True)
+
+
+def test_once_converges_and_orders_stages(native_build, bundle_dir):
+    with FakeApiServer(auto_ready=True) as api:
+        proc = run_operator(
+            native_build, f"--apiserver={api.url}",
+            f"--bundle-dir={bundle_dir}", "--once", "--poll-ms=20",
+            "--stage-timeout=10", "--status-port=0")
+        assert proc.returncode == 0, proc.stderr
+        status = json.loads(proc.stdout)
+        assert status["healthy"] and status["passes"] == 1
+        assert all(o["applied"] and o["ready"] for o in status["objects"])
+
+        # every operand landed
+        assert api.get(f"/api/v1/namespaces/{NS}") is not None
+        for name in ["tpu-libtpu-prep", "tpu-device-plugin",
+                     "tpu-metrics-exporter", "tpu-node-status-exporter"]:
+            assert api.get(f"{DS}/{name}") is not None, name
+
+        # rollout order: namespace < libtpu < device-plugin < observability
+        order = api.creation_order()
+        def pos(frag):
+            return next(i for i, p in enumerate(order) if frag in p)
+        assert pos("/namespaces") < pos("tpu-libtpu-prep")
+        assert pos("tpu-libtpu-prep") < pos("tpu-device-plugin")
+        assert pos("tpu-device-plugin") < pos("tpu-metrics-exporter")
+
+
+def test_stage_gating_blocks_on_unready_daemonset(native_build, bundle_dir):
+    """The helm-install --wait analog (reference README.md:101): stage N+1
+    must not be touched until stage N's DaemonSet reports ready."""
+    with FakeApiServer(auto_ready=False) as api:
+        op = start_operator(
+            native_build, f"--apiserver={api.url}",
+            f"--bundle-dir={bundle_dir}", "--interval=1", "--poll-ms=30",
+            "--stage-timeout=30", "--status-port=0")
+        try:
+            # libtpu-prep (stage 10) gets created...
+            assert wait_until(
+                lambda: api.get(f"{DS}/tpu-libtpu-prep") is not None)
+            # ...but with its DS unready, stage 20 must stay untouched
+            time.sleep(0.5)
+            assert api.get(f"{DS}/tpu-device-plugin") is None
+
+            api.set_ready(f"{DS}/tpu-libtpu-prep")
+            assert wait_until(
+                lambda: api.get(f"{DS}/tpu-device-plugin") is not None)
+            # still gated: feature-discovery waits on the plugin DS
+            time.sleep(0.5)
+            assert api.get(
+                f"{DS}/tpu-feature-discovery") is None
+
+            api.set_ready(f"{DS}/tpu-device-plugin")
+            assert wait_until(
+                lambda: api.get(f"{DS}/tpu-feature-discovery") is not None)
+        finally:
+            op.send_signal(signal.SIGTERM)
+            op.wait(timeout=10)
+
+
+def test_drift_recreated_and_status_served(native_build, bundle_dir):
+    with FakeApiServer(auto_ready=True) as api:
+        op = start_operator(
+            native_build, f"--apiserver={api.url}",
+            f"--bundle-dir={bundle_dir}", "--interval=1", "--poll-ms=20",
+            "--stage-timeout=10", "--status-port=19402")
+        try:
+            assert wait_until(
+                lambda: api.get(f"{DS}/tpu-node-status-exporter") is not None)
+
+            # kill an operand behind the operator's back -> recreated on the
+            # next reconcile pass (DaemonSet-restart resilience, SURVEY.md §5)
+            api.delete(f"{DS}/tpu-device-plugin")
+            assert wait_until(
+                lambda: api.get(f"{DS}/tpu-device-plugin") is not None,
+                timeout=20)
+
+            # status endpoint serves while reconciling
+            def fetch(path):
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:19402{path}", timeout=5) as r:
+                    return r.status, r.read().decode()
+            assert wait_until(
+                lambda: json.loads(fetch("/status")[1])["healthy"],
+                timeout=20)
+            code, metrics = fetch("/metrics")
+            assert code == 200 and "tpu_operator_healthy 1" in metrics
+            code, _ = fetch("/healthz")
+            assert code == 200
+        finally:
+            op.send_signal(signal.SIGTERM)
+            op.wait(timeout=10)
+
+
+def test_operator_sends_bearer_token(native_build, bundle_dir, tmp_path):
+    tok = tmp_path / "token"
+    tok.write_text("sekrit-token\n")
+    with FakeApiServer(auto_ready=True) as api:
+        proc = run_operator(
+            native_build, f"--apiserver={api.url}",
+            f"--bundle-dir={bundle_dir}", f"--token-file={tok}", "--once",
+            "--poll-ms=20", "--stage-timeout=10", "--status-port=0")
+        assert proc.returncode == 0, proc.stderr
+        auths = {h.get("Authorization") for h in api.headers_seen}
+        assert auths == {"Bearer sekrit-token"}
+
+
+def test_operator_bundle_render_shape():
+    spec = specmod.default_spec()
+    files = operator_bundle.bundle_files(spec)
+    stages = [n.split("--")[0] for n in sorted(files)]
+    assert stages[0] == "00-namespace"
+    assert stages == sorted(stages)
+    # disabling an operand drops its stage (reference --set flag analog)
+    s2 = specmod.load("tpu: {operands: {metricsExporter: false, "
+                      "nodeStatusExporter: false}}")
+    assert not any("40-observability" in n
+                   for n in operator_bundle.bundle_files(s2))
+
+    install = operator_bundle.operator_install(spec)
+    kinds = [o["kind"] for o in install]
+    assert kinds == ["Namespace", "ServiceAccount", "ClusterRole",
+                     "ClusterRoleBinding", "ConfigMap", "Deployment"]
+    cm = install[4]
+    assert set(cm["data"]) == set(files)
+    # bundle documents round-trip through the ConfigMap encoding
+    for name, text in cm["data"].items():
+        assert json.loads(text) == files[name]
+
+
+def test_operator_rbac_covers_bundle_grants():
+    """Kubernetes RBAC escalation prevention: the operator can only create a
+    ClusterRole whose permissions it itself holds. Every (group, resource,
+    verb) granted by any role INSIDE the bundle must be covered by the
+    operator's own ClusterRole, and the operator must be allowed to manage
+    every kind the bundle contains."""
+    spec = specmod.default_spec()
+    op_role = operator_bundle.rbac(spec)[1]
+
+    def covered(group, resource, verb):
+        return any(group in r["apiGroups"] and resource in r["resources"]
+                   and verb in r["verbs"] for r in op_role["rules"])
+
+    kind_to_gr = {
+        "Namespace": ("", "namespaces"),
+        "ConfigMap": ("", "configmaps"),
+        "Service": ("", "services"),
+        "ServiceAccount": ("", "serviceaccounts"),
+        "DaemonSet": ("apps", "daemonsets"),
+        "Deployment": ("apps", "deployments"),
+        "ClusterRole": ("rbac.authorization.k8s.io", "clusterroles"),
+        "ClusterRoleBinding":
+            ("rbac.authorization.k8s.io", "clusterrolebindings"),
+    }
+    for name, obj in operator_bundle.bundle_files(spec).items():
+        group, resource = kind_to_gr[obj["kind"]]
+        for verb in ("get", "create", "patch"):
+            assert covered(group, resource, verb), (name, obj["kind"], verb)
+        if obj["kind"] == "ClusterRole":
+            for rule in obj["rules"]:
+                for g in rule["apiGroups"]:
+                    for res in rule["resources"]:
+                        for v in rule["verbs"]:
+                            assert covered(g, res, v), (name, g, res, v)

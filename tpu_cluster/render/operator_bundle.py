@@ -1,0 +1,163 @@
+"""Render the tpu-operator's inputs: manifest bundle + its own install.
+
+The reference's controller (gpu-operator, reference README.md:101-110) reads
+a ClusterPolicy CR and reconciles operands in dependency order. Our
+controller (native/operator/operator_main.cc) reads a **manifest bundle**: a
+flat ConfigMap of ``NN-stage--object.json`` files where lexicographic order
+is rollout order and the ``NN-stage`` prefix is the readiness-gate boundary
+(SURVEY.md §3.3 — driver → device-plugin → GFD → exporters, each gated).
+
+This module renders:
+- :func:`bundle_files` — the staged operand manifests as JSON documents,
+- :func:`operator_install` — Namespace + RBAC + bundle ConfigMap + the
+  operator Deployment itself (what ``tpuctl apply --operator`` applies).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List
+
+from ..spec import ClusterSpec
+from . import manifests
+
+OPERATOR_NAME = "tpu-operator"
+BUNDLE_CONFIGMAP = "tpu-operator-bundle"
+BUNDLE_MOUNT = "/etc/tpu-operator/bundle"
+STATUS_PORT = 9402
+
+
+def _fname(stage: str, obj: Dict[str, Any]) -> str:
+    return f"{stage}--{obj['kind'].lower()}-{obj['metadata']['name']}.json"
+
+
+def bundle_files(spec: ClusterSpec) -> Dict[str, Dict[str, Any]]:
+    """filename -> manifest, in rollout order. Stage prefixes mirror the
+    reference's operand dependency chain (reference README.md:201-213)."""
+    t = spec.tpu
+    stages: List[tuple] = [("00-namespace", [manifests.namespace(spec)])]
+    if t.operand("libtpuPrep").enabled:
+        stages.append(("10-libtpu-prep", [manifests.libtpu_prep(spec)]))
+    if t.operand("devicePlugin").enabled:
+        stages.append(("20-device-plugin", [manifests.device_plugin(spec)]))
+    if t.operand("featureDiscovery").enabled:
+        stages.append(("30-feature-discovery",
+                       manifests.feature_discovery(spec)))
+    tail: List[Dict[str, Any]] = []
+    if t.operand("metricsExporter").enabled:
+        tail.extend(manifests.metrics_exporter(spec))
+    if t.operand("nodeStatusExporter").enabled:
+        tail.append(manifests.node_status_exporter(spec))
+    if tail:
+        stages.append(("40-observability", tail))
+
+    out: Dict[str, Dict[str, Any]] = {}
+    for stage, objs in stages:
+        for obj in objs:
+            out[_fname(stage, obj)] = obj
+    return out
+
+
+def rbac(spec: ClusterSpec) -> List[Dict[str, Any]]:
+    """ServiceAccount + ClusterRole + binding for the operator. Verbs are the
+    reconcile set (get/create/patch, plus delete for operand replacement);
+    cluster-scoped because the bundle contains the Namespace itself."""
+    meta = manifests._meta(OPERATOR_NAME, spec, "operator")
+    sa = {"apiVersion": "v1", "kind": "ServiceAccount", "metadata": meta}
+    role = {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRole",
+        "metadata": {"name": OPERATOR_NAME,
+                     "labels": dict(meta["labels"])},
+        "rules": [
+            {"apiGroups": ["", "apps", "batch"],
+             "resources": ["namespaces", "configmaps", "services",
+                           "serviceaccounts", "daemonsets", "deployments",
+                           "jobs", "pods"],
+             "verbs": ["get", "list", "watch", "create", "patch", "delete"]},
+            # The bundle's feature-discovery stage contains its own
+            # ClusterRole/Binding, so the operator must manage RBAC objects...
+            {"apiGroups": ["rbac.authorization.k8s.io"],
+             "resources": ["clusterroles", "clusterrolebindings",
+                           "roles", "rolebindings"],
+             "verbs": ["get", "list", "watch", "create", "patch", "delete"]},
+            # ...and — per Kubernetes RBAC escalation prevention — must itself
+            # hold every permission those roles grant (node labeling).
+            {"apiGroups": [""],
+             "resources": ["nodes", "nodes/status"],
+             "verbs": ["get", "list", "watch", "patch"]},
+        ],
+    }
+    binding = {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRoleBinding",
+        "metadata": {"name": OPERATOR_NAME,
+                     "labels": dict(meta["labels"])},
+        "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                    "kind": "ClusterRole", "name": OPERATOR_NAME},
+        "subjects": [{"kind": "ServiceAccount", "name": OPERATOR_NAME,
+                      "namespace": spec.tpu.namespace}],
+    }
+    return [sa, role, binding]
+
+
+def bundle_configmap(spec: ClusterSpec) -> Dict[str, Any]:
+    return {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": manifests._meta(BUNDLE_CONFIGMAP, spec, "operator"),
+        "data": {name: json.dumps(obj, indent=2)
+                 for name, obj in bundle_files(spec).items()},
+    }
+
+
+def deployment(spec: ClusterSpec) -> Dict[str, Any]:
+    labels = {"app.kubernetes.io/name": OPERATOR_NAME}
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": manifests._meta(OPERATOR_NAME, spec, "operator"),
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": labels},
+            "template": {
+                "metadata": {"labels": dict(labels)},
+                "spec": {
+                    "serviceAccountName": OPERATOR_NAME,
+                    "containers": [{
+                        "name": "operator",
+                        "image": manifests._image(spec, "devicePlugin"),
+                        "command": ["tpu-operator"],
+                        "args": [f"--bundle-dir={BUNDLE_MOUNT}",
+                                 f"--status-port={STATUS_PORT}",
+                                 "--allow-empty-daemonsets"],
+                        "ports": [{"name": "status",
+                                   "containerPort": STATUS_PORT}],
+                        "readinessProbe": {
+                            "httpGet": {"path": "/healthz",
+                                        "port": STATUS_PORT},
+                            "initialDelaySeconds": 5,
+                            "periodSeconds": 10,
+                        },
+                        "volumeMounts": [{
+                            "name": "bundle",
+                            "mountPath": BUNDLE_MOUNT,
+                            "readOnly": True,
+                        }],
+                    }],
+                    "volumes": [{
+                        "name": "bundle",
+                        "configMap": {"name": BUNDLE_CONFIGMAP},
+                    }],
+                },
+            },
+        },
+    }
+
+
+def operator_install(spec: ClusterSpec) -> List[Dict[str, Any]]:
+    """Everything ``tpuctl apply --operator`` needs, in apply order: the
+    namespace first (the SA/ConfigMap/Deployment live in it), then RBAC,
+    bundle, controller."""
+    return ([manifests.namespace(spec)] + rbac(spec)
+            + [bundle_configmap(spec), deployment(spec)])
