@@ -11,6 +11,7 @@
 #include <string.h>
 #include <sys/socket.h>
 #include <sys/wait.h>
+#include <time.h>
 #include <unistd.h>
 
 #include <vector>
@@ -36,6 +37,7 @@ struct Url {
   bool https = false;
   std::string host;
   int port = 80;
+  std::string base_path;  // mount prefix, e.g. "/k8s" behind a proxy
 };
 
 bool ParseUrl(const std::string& url, Url* out, std::string* err) {
@@ -53,7 +55,12 @@ bool ParseUrl(const std::string& url, Url* out, std::string* err) {
     return false;
   }
   size_t slash = rest.find('/');
-  if (slash != std::string::npos) rest = rest.substr(0, slash);
+  if (slash != std::string::npos) {
+    out->base_path = rest.substr(slash);
+    while (!out->base_path.empty() && out->base_path.back() == '/')
+      out->base_path.pop_back();
+    rest = rest.substr(0, slash);
+  }
   if (!rest.empty() && rest[0] == '[') {
     // bracketed IPv6 literal: [::1] or [::1]:8001
     size_t close = rest.find(']');
@@ -136,7 +143,7 @@ Response PlainHttp(const Config& cfg, const Url& url,
     resp.error = err;
     return resp;
   }
-  std::string req = method + " " + path + " HTTP/1.1\r\n" +
+  std::string req = method + " " + url.base_path + path + " HTTP/1.1\r\n" +
                     "Host: " + url.host + "\r\n" +
                     "Connection: close\r\nAccept: application/json\r\n";
   if (!cfg.token.empty()) req += "Authorization: Bearer " + cfg.token + "\r\n";
@@ -158,9 +165,18 @@ Response PlainHttp(const Config& cfg, const Url& url,
   }
   std::string raw;
   char buf[8192];
+  // timeout_ms bounds the WHOLE response, not each poll — a server
+  // trickling bytes must not stall the single-threaded caller forever.
+  struct timespec t0;
+  clock_gettime(CLOCK_MONOTONIC, &t0);
   while (true) {
+    struct timespec now;
+    clock_gettime(CLOCK_MONOTONIC, &now);
+    int left = cfg.timeout_ms -
+               static_cast<int>((now.tv_sec - t0.tv_sec) * 1000 +
+                                (now.tv_nsec - t0.tv_nsec) / 1000000);
     struct pollfd pfd = {fd, POLLIN, 0};
-    if (poll(&pfd, 1, cfg.timeout_ms) != 1) {
+    if (left <= 0 || poll(&pfd, 1, left) != 1) {
       resp.error = "read timeout";
       close(fd);
       return resp;
@@ -220,6 +236,21 @@ Response CurlHttps(const Config& cfg, const std::string& method,
       return resp;
     }
   }
+  // The bearer token must never appear on the argv (readable by any
+  // process via /proc/<pid>/cmdline); pass it via a 0600 header file.
+  char hdr_path[] = "/tmp/tpuop-hdr-XXXXXX";
+  int hdr_fd = -1;
+  if (!cfg.token.empty()) {
+    hdr_fd = mkstemp(hdr_path);
+    std::string hdr = "Authorization: Bearer " + cfg.token + "\n";
+    if (hdr_fd < 0 || write(hdr_fd, hdr.data(), hdr.size()) !=
+                          static_cast<ssize_t>(hdr.size())) {
+      resp.error = "cannot stage auth header";
+      if (hdr_fd >= 0) close(hdr_fd);
+      if (body_fd >= 0) { close(body_fd); unlink(body_path); }
+      return resp;
+    }
+  }
 
   std::vector<std::string> args = {
       "curl", "-sS", "-X", method, "--max-time",
@@ -228,8 +259,8 @@ Response CurlHttps(const Config& cfg, const std::string& method,
       "-w", "\n%{http_code}",
       "-H", "Accept: application/json",
   };
-  if (!cfg.token.empty())
-    args.insert(args.end(), {"-H", "Authorization: Bearer " + cfg.token});
+  if (hdr_fd >= 0)
+    args.insert(args.end(), {"-H", std::string("@") + hdr_path});
   if (!cfg.ca_file.empty())
     args.insert(args.end(), {"--cacert", cfg.ca_file});
   else
@@ -240,10 +271,15 @@ Response CurlHttps(const Config& cfg, const std::string& method,
   }
   args.push_back(url);
 
+  auto cleanup_temps = [&]() {
+    if (body_fd >= 0) { close(body_fd); unlink(body_path); }
+    if (hdr_fd >= 0) { close(hdr_fd); unlink(hdr_path); }
+  };
+
   int pipefd[2];
   if (pipe(pipefd) != 0) {
     resp.error = "pipe failed";
-    if (body_fd >= 0) close(body_fd);
+    cleanup_temps();
     return resp;
   }
   pid_t pid = fork();
@@ -251,7 +287,7 @@ Response CurlHttps(const Config& cfg, const std::string& method,
     resp.error = "fork failed";
     close(pipefd[0]);
     close(pipefd[1]);
-    if (body_fd >= 0) close(body_fd);
+    cleanup_temps();
     return resp;
   }
   if (pid == 0) {
@@ -272,10 +308,7 @@ Response CurlHttps(const Config& cfg, const std::string& method,
   close(pipefd[0]);
   int wstatus = 0;
   waitpid(pid, &wstatus, 0);
-  if (body_fd >= 0) {
-    close(body_fd);
-    unlink(body_path);
-  }
+  cleanup_temps();
   if (!WIFEXITED(wstatus) || WEXITSTATUS(wstatus) != 0) {
     resp.error = "curl exited " + std::to_string(WEXITSTATUS(wstatus)) +
                  ": " + out.substr(0, 200);
@@ -304,7 +337,15 @@ bool Config::InCluster(Config* out) {
   const char* sa = "/var/run/secrets/kubernetes.io/serviceaccount";
   ReadFileTrim(std::string(sa) + "/token", &out->token);
   std::string ca = std::string(sa) + "/ca.crt";
-  if (access(ca.c_str(), R_OK) == 0) out->ca_file = ca;
+  if (access(ca.c_str(), R_OK) == 0) {
+    out->ca_file = ca;
+  } else {
+    // Never downgrade to unverified TLS silently — a missing projected CA
+    // is a misconfiguration worth shouting about.
+    fprintf(stderr,
+            "kubeclient: WARNING: %s unreadable; apiserver TLS will NOT be "
+            "verified (curl -k)\n", ca.c_str());
+  }
   return true;
 }
 

@@ -67,19 +67,6 @@ struct BundleObject {
   std::string error;
 };
 
-struct ReadFile {
-  static bool Whole(const std::string& path, std::string* out) {
-    FILE* f = fopen(path.c_str(), "r");
-    if (!f) return false;
-    char buf[16384];
-    size_t n;
-    out->clear();
-    while ((n = fread(buf, 1, sizeof(buf), f)) > 0) out->append(buf, n);
-    fclose(f);
-    return true;
-  }
-};
-
 bool LoadBundle(const std::string& dir, std::vector<BundleObject>* out,
                 std::string* err) {
   DIR* d = opendir(dir.c_str());
@@ -103,7 +90,8 @@ bool LoadBundle(const std::string& dir, std::vector<BundleObject>* out,
   out->clear();
   for (const auto& name : names) {
     std::string text;
-    if (!ReadFile::Whole(dir + "/" + name, &text)) {
+    // trailing-newline trim is harmless for JSON documents
+    if (!kubeclient::ReadFileTrim(dir + "/" + name, &text)) {
       *err = "cannot read " + name;
       return false;
     }
@@ -126,6 +114,8 @@ bool LoadBundle(const std::string& dir, std::vector<BundleObject>* out,
 
 class StatusServer {
  public:
+  bool enabled() const { return fd_ >= 0; }
+
   bool Listen(int port) {
     if (port <= 0) return true;
     fd_ = socket(AF_INET, SOCK_STREAM, 0);
@@ -163,6 +153,11 @@ class StatusServer {
       if (rc > 0) {
         int cfd = accept(fd_, nullptr, nullptr);
         if (cfd >= 0) {
+          // A silent client must not wedge the single-threaded daemon:
+          // bound both directions of the exchange.
+          struct timeval tv = {0, 500 * 1000};
+          setsockopt(cfd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+          setsockopt(cfd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
           char buf[1024];
           ssize_t n = read(cfd, buf, sizeof(buf) - 1);
           std::string body = status_json, ctype = "application/json";
@@ -272,6 +267,17 @@ class Operator {
 
   void RunForever() {
     while (!g_stop) {
+      // The bundle is a mounted ConfigMap that kubelet live-updates; reload
+      // each pass so a re-rendered bundle rolls out without a pod restart
+      // (a stale snapshot would merge-PATCH the upgrade away as "drift").
+      std::vector<BundleObject> fresh;
+      std::string err;
+      if (LoadBundle(opt_.bundle_dir, &fresh, &err)) {
+        bundle_ = std::move(fresh);
+      } else {
+        fprintf(stderr, "tpu-operator: bundle reload failed (%s); "
+                "keeping previous bundle\n", err.c_str());
+      }
       bool ok = ReconcilePass();
       healthy_ = ok;
       if (ok) fprintf(stderr, "tpu-operator: pass %d converged\n", passes_);
@@ -322,7 +328,15 @@ class Operator {
   void set_healthy(bool h) { healthy_ = h; }
 
  private:
-  void Sleep(int ms) { status_.Pump(ms, StatusJson(), Metrics(), healthy_); }
+  void Sleep(int ms) {
+    if (!status_.enabled()) {
+      // no status listener: plain sleep, skip serializing state every poll
+      for (int left = ms; left > 0 && !g_stop; left -= 50)
+        usleep(std::min(left, 50) * 1000);
+      return;
+    }
+    status_.Pump(ms, StatusJson(), Metrics(), healthy_);
+  }
 
   bool ApplyObject(BundleObject* bo) {
     std::string err;
