@@ -1,0 +1,170 @@
+"""kubeapply + CLI tests: the one-shot rollout path against the fake
+apiserver, pinned to the same readiness semantics as the C++ operator."""
+
+import json
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+import yaml
+
+from fake_apiserver import FakeApiServer
+from tpu_cluster import kubeapply
+from tpu_cluster import spec as specmod
+from tpu_cluster.render import manifests, operator_bundle
+
+NS = "tpu-system"
+DS = f"/apis/apps/v1/namespaces/{NS}/daemonsets"
+
+
+@pytest.fixture()
+def spec():
+    return specmod.default_spec()
+
+
+def test_paths_match_cpp_selftest_goldens(spec):
+    """The Python path builder and the C++ kubeapi must agree — these are the
+    same goldens native/operator/selftest.cc pins."""
+    ds = {"apiVersion": "apps/v1", "kind": "DaemonSet",
+          "metadata": {"name": "tpud", "namespace": "tpu-system"}}
+    assert kubeapply.object_path(ds) == \
+        "/apis/apps/v1/namespaces/tpu-system/daemonsets/tpud"
+    ns = {"apiVersion": "v1", "kind": "Namespace",
+          "metadata": {"name": "tpu-system"}}
+    assert kubeapply.object_path(ns) == "/api/v1/namespaces/tpu-system"
+    crb = {"apiVersion": "rbac.authorization.k8s.io/v1",
+           "kind": "ClusterRoleBinding", "metadata": {"name": "b"}}
+    assert kubeapply.object_path(crb) == \
+        "/apis/rbac.authorization.k8s.io/v1/clusterrolebindings/b"
+    with pytest.raises(kubeapply.ApplyError):
+        kubeapply.collection_path({"apiVersion": "v1", "kind": "Wombat"})
+
+
+def test_readiness_rules_match_cpp(spec):
+    assert not kubeapply.is_ready(
+        {"kind": "DaemonSet", "status": {"desiredNumberScheduled": 0,
+                                         "numberReady": 0}})
+    assert kubeapply.is_ready(
+        {"kind": "DaemonSet", "status": {"desiredNumberScheduled": 0,
+                                         "numberReady": 0}},
+        allow_empty_daemonsets=True)
+    assert kubeapply.is_ready(
+        {"kind": "DaemonSet", "status": {"desiredNumberScheduled": 2,
+                                         "numberReady": 2}})
+    assert kubeapply.is_ready({"kind": "Deployment", "spec": {"replicas": 0},
+                               "status": {}})
+    assert not kubeapply.is_ready({"kind": "Job", "status": {}})
+    assert kubeapply.is_ready({"kind": "ConfigMap"})
+
+
+def test_apply_groups_waits_and_orders(spec):
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        kubeapply.apply_groups(client, manifests.rollout_groups(spec),
+                               wait=True, stage_timeout=10, poll=0.02)
+        order = api.creation_order()
+        def pos(frag):
+            return next(i for i, p in enumerate(order) if frag in p)
+        assert pos("/namespaces/tpu-system") < pos("tpu-libtpu-prep") \
+            < pos("tpu-device-plugin") < pos("tpu-metrics-exporter")
+        # idempotent: second apply patches instead of POSTing
+        result = kubeapply.apply_groups(
+            client, manifests.rollout_groups(spec), wait=True,
+            stage_timeout=10, poll=0.02)
+        assert all(a.startswith("patched") for a in result.actions)
+
+
+def test_apply_gates_on_readiness(spec):
+    with FakeApiServer(auto_ready=False) as api:
+        client = kubeapply.Client(api.url)
+        groups = manifests.rollout_groups(spec)
+        done = []
+
+        def run():
+            kubeapply.apply_groups(client, groups, wait=True,
+                                   stage_timeout=30, poll=0.02)
+            done.append(True)
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        deadline = time.time() + 5
+        while api.get(f"{DS}/tpu-libtpu-prep") is None:
+            assert time.time() < deadline
+            time.sleep(0.02)
+        time.sleep(0.3)
+        assert api.get(f"{DS}/tpu-device-plugin") is None  # gated
+        api.set_ready(f"{DS}/tpu-libtpu-prep")
+        deadline = time.time() + 5
+        while api.get(f"{DS}/tpu-device-plugin") is None:
+            assert time.time() < deadline
+            time.sleep(0.02)
+        # later groups appear as earlier gates open — keep marking new
+        # DaemonSets ready until the rollout converges
+        deadline = time.time() + 15
+        while not done and time.time() < deadline:
+            for path in api.paths("daemonsets/"):
+                api.set_ready(path)
+            time.sleep(0.05)
+        t.join(timeout=5)
+        assert done
+
+
+def test_apply_timeout_raises(spec):
+    with FakeApiServer(auto_ready=False) as api:
+        client = kubeapply.Client(api.url)
+        with pytest.raises(kubeapply.ApplyError, match="timed out"):
+            kubeapply.apply_groups(client, manifests.rollout_groups(spec),
+                                   wait=True, stage_timeout=0.3, poll=0.02)
+
+
+def run_cli(*argv):
+    proc = subprocess.run([sys.executable, "-m", "tpu_cluster", *argv],
+                          capture_output=True, text=True, timeout=120)
+    return proc
+
+
+def test_cli_render_all_artifacts(tmp_path):
+    proc = run_cli("render", "--out", str(tmp_path / "r"))
+    assert proc.returncode == 0, proc.stderr
+    written = {p.name for p in (tmp_path / "r").iterdir()}
+    assert written == {"nodeprep.sh", "kubeadm-packages.sh",
+                       "kubeadm-init.sh", "kubeadm-join.sh",
+                       "smoke-check.sh", "manifests.yaml", "jobs.yaml",
+                       "operator.yaml", "bundle.json"}
+    docs = list(yaml.safe_load_all((tmp_path / "r" / "manifests.yaml")
+                                   .read_text()))
+    assert any(d["kind"] == "DaemonSet" for d in docs)
+    bundle = json.loads((tmp_path / "r" / "bundle.json").read_text())
+    assert any(name.startswith("20-device-plugin") for name in bundle)
+
+
+def test_cli_render_only_and_spec(tmp_path):
+    spec_file = tmp_path / "c.yaml"
+    spec_file.write_text(
+        "cluster: {name: prod}\ntpu: {namespace: tpu-prod}\n")
+    proc = run_cli("render", "--spec", str(spec_file), "--only", "manifests")
+    assert proc.returncode == 0, proc.stderr
+    assert "tpu-prod" in proc.stdout
+    proc = run_cli("render", "--spec", str(spec_file), "--only", "nodeprep")
+    assert proc.stdout.startswith("#!/usr/bin/env bash")
+    # bad spec -> clean error, not a traceback
+    spec_file.write_text("cluster: {bogus: 1}\n")
+    proc = run_cli("render", "--spec", str(spec_file), "--only", "manifests")
+    assert proc.returncode == 2
+    assert "spec error" in proc.stderr and "Traceback" not in proc.stderr
+
+
+def test_cli_apply_operator_install(spec):
+    with FakeApiServer(auto_ready=True) as api:
+        proc = run_cli("apply", "--apiserver", api.url, "--operator",
+                       "--poll", "0.05", "--stage-timeout", "20")
+        assert proc.returncode == 0, proc.stderr
+        assert "apply: converged" in proc.stdout
+        dep = api.get(f"/apis/apps/v1/namespaces/{NS}/deployments/"
+                      f"{operator_bundle.OPERATOR_NAME}")
+        assert dep is not None
+        cm = api.get(f"/api/v1/namespaces/{NS}/configmaps/"
+                     f"{operator_bundle.BUNDLE_CONFIGMAP}")
+        assert cm is not None and cm["data"]

@@ -1,0 +1,141 @@
+"""Acceptance-runbook (verify) + triage tests with a canned kubectl runner
+(SURVEY.md §4: kubectl JSON-path assertions instead of grep)."""
+
+import json
+
+import pytest
+
+from tpu_cluster import spec as specmod, triage, verify
+
+
+def node(name, ready=True, tpu=8, labeled=True):
+    labels = {"google.com/tpu.present": "true"} if labeled else {}
+    return {
+        "metadata": {"name": name, "labels": labels},
+        "status": {
+            "conditions": [{"type": "Ready",
+                            "status": "True" if ready else "False"}],
+            "allocatable": ({"google.com/tpu": str(tpu)} if tpu else {}),
+        },
+    }
+
+
+def pod(name, phase="Running"):
+    return {"metadata": {"name": name}, "status": {"phase": phase}}
+
+
+def job(name, completions=1, succeeded=1, failed=0):
+    return {"metadata": {"name": name},
+            "spec": {"completions": completions},
+            "status": {"succeeded": succeeded, "failed": failed}}
+
+
+class CannedRunner:
+    """Maps a recognizable slice of the kubectl argv onto canned payloads,
+    recording every call."""
+
+    def __init__(self, healthy=True):
+        self.calls = []
+        ns_pods = [pod(f"{n}-x7k2f") for n in verify.OPERAND_PODS]
+        self.responses = {
+            "get nodes": {"items": [node("tpu-node-0"),
+                                    node("cp-node", tpu=0, labeled=False)]},
+            "get pods -n kube-system": {"items": [pod("coredns"),
+                                                  pod("kube-apiserver")]},
+            f"get pods -n tpu-system": {"items": ns_pods},
+            "get nodes -l google.com/tpu.present=true":
+                {"items": [node("tpu-node-0")]},
+            **{f"get job -n tpu-system {j}": job(j)
+               for j in verify.VALIDATION_JOBS},
+        }
+        self.raw = {"proxy/metrics": "tpu_chips_total 8\ntpu_chip_present 1\n",
+                    "proxy/status": '{"healthy": true}'}
+        if not healthy:
+            self.responses["get nodes"] = {
+                "items": [node("tpu-node-0", ready=False, tpu=4)]}
+            self.responses["get pods -n tpu-system"] = {
+                "items": [pod("tpu-device-plugin-abc", "CrashLoopBackOff"),
+                          pod("tpu-libtpu-prep-def")]}
+            self.responses["get nodes -l google.com/tpu.present=true"] = \
+                {"items": []}
+            self.responses["get job -n tpu-system tpu-psum"] = \
+                job("tpu-psum", succeeded=0, failed=2)
+            self.raw = {}
+
+    def __call__(self, argv):
+        assert argv[0] == "kubectl"
+        self.calls.append(argv)
+        rest = [a for a in argv[1:] if a not in ("-o", "json")]
+        key = " ".join(rest)
+        if rest[:2] == ["get", "--raw"]:
+            for frag, payload in self.raw.items():
+                if frag in rest[2]:
+                    return 0, payload
+            return 1, ""
+        if key in self.responses:
+            return 0, json.dumps(self.responses[key])
+        # describe/logs for triage
+        if rest[0] in ("describe", "logs"):
+            return 0, f"(canned {rest[0]} output for {rest[-1]})"
+        return 1, ""
+
+
+@pytest.fixture()
+def spec():
+    return specmod.default_spec()
+
+
+def test_all_checks_pass_on_healthy_cluster(spec):
+    runner = CannedRunner(healthy=True)
+    results = verify.run_checks(list(verify.CHECKS), spec, runner)
+    assert [r.name for r in results] == list(verify.CHECKS)
+    assert all(r.ok for r in results), [r.line() for r in results]
+
+
+def test_checks_fail_loudly_on_broken_cluster(spec):
+    runner = CannedRunner(healthy=False)
+    results = {r.name: r for r in
+               verify.run_checks(list(verify.CHECKS), spec, runner)}
+    assert not results["smoke"].ok and "not Ready" in results["smoke"].detail
+    assert not results["operands"].ok
+    assert "tpu-device-plugin" in results["operands"].detail
+    assert not results["labels"].ok
+    assert not results["allocatable"].ok and "4" in results["allocatable"].detail
+    assert not results["metrics"].ok
+    assert not results["psum"].ok and "failed 2" in results["psum"].detail
+
+
+def test_disabled_operand_not_required(spec):
+    s = specmod.load("tpu: {operands: {nodeStatusExporter: false}}")
+    runner = CannedRunner(healthy=True)
+    runner.responses["get pods -n tpu-system"]["items"] = [
+        pod(f"{n}-x") for n in verify.OPERAND_PODS
+        if n != "tpu-node-status-exporter"]
+    res = verify.check_operands(runner, s)
+    assert res.ok
+
+
+def test_unknown_check_rejected(spec):
+    with pytest.raises(KeyError):
+        verify.run_checks(["warp-drive"], spec)
+
+
+def test_triage_healthy_report(spec):
+    report = triage.run_triage(spec, CannedRunner(healthy=True))
+    text = report.text()
+    assert "pods in tpu-system" in text
+    assert "allocatable per node" in text
+    assert "google.com/tpu=8" in text
+    assert "describe" not in text.split("hints")[0].replace(
+        "=== ", "")  # no problem pods -> no describe sections
+
+
+def test_triage_collects_describe_and_logs_for_problem_pods(spec):
+    runner = CannedRunner(healthy=False)
+    text = triage.run_triage(spec, runner).text()
+    assert "describe tpu-device-plugin-abc" in text
+    assert "logs tpu-device-plugin-abc" in text
+    assert "canned describe output" in text
+    # healthy pod not described (runbook discipline: triage what's broken)
+    assert "describe tpu-libtpu-prep-def" not in text
+    assert "hints" in text

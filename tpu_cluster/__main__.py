@@ -1,0 +1,182 @@
+"""tpuctl — the framework CLI (``python -m tpu_cluster``).
+
+One command per phase of docs/GUIDE.md, replacing the reference guide's
+copy-paste heredocs and ``helm install --wait`` (reference README.md:101)
+with rendered artifacts and an ordered, readiness-gated apply:
+
+  render   cluster-spec -> node-prep / kubeadm scripts, operand manifests,
+           validation Jobs, operator install, operator bundle
+  apply    rollout against the apiserver, gating each group on readiness
+           (--operator deploys the in-cluster controller instead)
+  verify   the executable acceptance runbook (BASELINE configs)
+  triage   the executable troubleshooting runbook
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict
+
+import yaml
+
+from . import kubeapply, spec as specmod, triage, verify
+from .render import jobs, kubeadm, manifests, nodeprep, operator_bundle
+
+
+def _load_spec(path: str) -> specmod.ClusterSpec:
+    return specmod.load_file(path) if path else specmod.default_spec()
+
+
+def _render_artifacts(spec: specmod.ClusterSpec,
+                      multihost: int) -> Dict[str, str]:
+    """name -> rendered text for every artifact the spec produces."""
+    return {
+        "nodeprep": nodeprep.render_node_prep(spec),
+        "kubeadm-packages": nodeprep.render_kubeadm_packages(spec),
+        "kubeadm-init": kubeadm.render_init_script(spec),
+        "kubeadm-join": kubeadm.render_join_script(spec),
+        "smoke-check": kubeadm.render_smoke_check(spec),
+        "manifests": manifests.render_all(spec),
+        "jobs": yaml.dump_all(
+            jobs.render_validation_jobs(spec, multihost), sort_keys=False),
+        "operator": yaml.dump_all(
+            operator_bundle.operator_install(spec), sort_keys=False),
+        "bundle": json.dumps(operator_bundle.bundle_files(spec), indent=2),
+    }
+
+
+_EXT = {"nodeprep": "sh", "kubeadm-packages": "sh", "kubeadm-init": "sh",
+        "kubeadm-join": "sh", "smoke-check": "sh", "manifests": "yaml",
+        "jobs": "yaml", "operator": "yaml", "bundle": "json"}
+
+
+def cmd_render(args) -> int:
+    spec = _load_spec(args.spec)
+    artifacts = _render_artifacts(spec, args.multihost)
+    if args.only:
+        print(artifacts[args.only], end="")
+        return 0
+    if not args.out:
+        print("render: pass --only <name> to print one artifact or "
+              f"--out DIR for all; names: {', '.join(artifacts)}",
+              file=sys.stderr)
+        return 2
+    import os
+    os.makedirs(args.out, exist_ok=True)
+    for name, text in artifacts.items():
+        path = os.path.join(args.out, f"{name}.{_EXT[name]}")
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(text)
+        print(path)
+    return 0
+
+
+def cmd_apply(args) -> int:
+    spec = _load_spec(args.spec)
+    token = ""
+    if args.token_file:
+        with open(args.token_file, encoding="utf-8") as f:
+            token = f.read().strip()
+    client = kubeapply.Client(args.apiserver, token=token,
+                              ca_file=args.ca_file)
+    if args.operator:
+        groups = [operator_bundle.operator_install(spec)]
+    else:
+        groups = manifests.rollout_groups(spec)
+    try:
+        kubeapply.apply_groups(
+            client, groups, wait=args.wait,
+            stage_timeout=args.stage_timeout, poll=args.poll,
+            allow_empty_daemonsets=args.allow_empty_daemonsets,
+            log=lambda msg: print(msg))
+    except kubeapply.ApplyError as exc:
+        print(f"apply failed: {exc}", file=sys.stderr)
+        return 1
+    print("apply: converged" if args.wait else "apply: submitted")
+    return 0
+
+
+def cmd_verify(args) -> int:
+    spec = _load_spec(args.spec)
+    names = (list(verify.CHECKS) if args.config == "all"
+             else [args.config])
+    try:
+        results = verify.run_checks(names, spec)
+    except KeyError as exc:
+        print(exc, file=sys.stderr)
+        return 2
+    for res in results:
+        print(res.line())
+    return 0 if all(r.ok for r in results) else 1
+
+
+def cmd_triage(args) -> int:
+    spec = _load_spec(args.spec)
+    print(triage.run_triage(spec).text())
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="tpuctl", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("render", help="render artifacts from a cluster-spec")
+    p.add_argument("--spec", default="", help="cluster-spec YAML path "
+                                              "(default: built-in defaults)")
+    p.add_argument("--only", choices=sorted(_EXT),
+                   help="print one artifact to stdout")
+    p.add_argument("--out", help="write every artifact into DIR")
+    p.add_argument("--multihost", type=int, default=0,
+                   help="include the N-host DCN psum Job pair in 'jobs'")
+    p.set_defaults(fn=cmd_render)
+
+    p = sub.add_parser(
+        "apply", help="ordered, readiness-gated rollout "
+                      "(helm install --wait analog)")
+    p.add_argument("--spec", default="")
+    p.add_argument("--apiserver", required=True,
+                   help="base URL (kubectl proxy: http://127.0.0.1:8001, "
+                        "or https://<apiserver>:6443)")
+    p.add_argument("--token-file", default="")
+    p.add_argument("--ca-file", default=None)
+    p.add_argument("--operator", action="store_true",
+                   help="install the in-cluster tpu-operator instead of "
+                        "applying operands directly")
+    p.add_argument("--wait", action=argparse.BooleanOptionalAction,
+                   default=True)
+    p.add_argument("--stage-timeout", type=float, default=600)
+    p.add_argument("--poll", type=float, default=1.0)
+    p.add_argument("--allow-empty-daemonsets", action="store_true",
+                   help="treat DaemonSets with no matching nodes as ready")
+    p.set_defaults(fn=cmd_apply)
+
+    p = sub.add_parser("verify", help="run the acceptance runbook")
+    p.add_argument("--spec", default="")
+    p.add_argument("--config", default="all",
+                   help=f"all | {' | '.join(verify.CHECKS)}")
+    p.set_defaults(fn=cmd_verify)
+
+    p = sub.add_parser("triage", help="run the troubleshooting runbook")
+    p.add_argument("--spec", default="")
+    p.set_defaults(fn=cmd_triage)
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except specmod.SpecError as exc:
+        print(f"spec error: {exc}", file=sys.stderr)
+        return 2
+    except FileNotFoundError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

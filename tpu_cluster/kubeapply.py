@@ -1,0 +1,191 @@
+"""Apply manifests against the kube-apiserver and wait for readiness.
+
+The Python half of the rollout machinery: `tpuctl apply --wait` uses this for
+one-shot installs (reference README.md:101 ``helm install --wait`` analog)
+and the tests drive it against the in-process fake apiserver. The in-cluster
+continuous reconciler is the native C++ tpu-operator
+(native/operator/operator_main.cc) — same REST subset, same readiness rules;
+the two are pinned to each other by tests/test_apply.py.
+
+Transports: a base URL (``http://127.0.0.1:8001`` from ``kubectl proxy``, or
+the fake apiserver) via urllib, with optional bearer token / CA file for
+direct https apiserver access.
+"""
+
+from __future__ import annotations
+
+import json
+import ssl
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+# kind -> (api prefix builder, plural, cluster-scoped). Mirrors
+# native/operator/kubeapi.cc Plurals() — a lookup table so unsupported kinds
+# fail loudly instead of 404ing a guessed path.
+_KINDS: Dict[str, tuple] = {
+    "Namespace": ("namespaces", True),
+    "ConfigMap": ("configmaps", False),
+    "Secret": ("secrets", False),
+    "Service": ("services", False),
+    "ServiceAccount": ("serviceaccounts", False),
+    "Pod": ("pods", False),
+    "DaemonSet": ("daemonsets", False),
+    "Deployment": ("deployments", False),
+    "StatefulSet": ("statefulsets", False),
+    "Job": ("jobs", False),
+    "ClusterRole": ("clusterroles", True),
+    "ClusterRoleBinding": ("clusterrolebindings", True),
+    "Role": ("roles", False),
+    "RoleBinding": ("rolebindings", False),
+}
+
+WORKLOAD_KINDS = ("DaemonSet", "Deployment", "Job")
+
+
+class ApplyError(RuntimeError):
+    pass
+
+
+def collection_path(obj: Dict[str, Any]) -> str:
+    api_version = obj.get("apiVersion", "")
+    kind = obj.get("kind", "")
+    if kind not in _KINDS:
+        raise ApplyError(f"unsupported kind {kind!r}")
+    plural, cluster_scoped = _KINDS[kind]
+    prefix = (f"/api/{api_version}" if "/" not in api_version
+              else f"/apis/{api_version}")
+    if cluster_scoped:
+        return f"{prefix}/{plural}"
+    ns = obj.get("metadata", {}).get("namespace", "default")
+    return f"{prefix}/namespaces/{ns}/{plural}"
+
+
+def object_path(obj: Dict[str, Any]) -> str:
+    name = obj.get("metadata", {}).get("name")
+    if not name:
+        raise ApplyError("object has no metadata.name")
+    return f"{collection_path(obj)}/{name}"
+
+
+def is_ready(obj: Dict[str, Any],
+             allow_empty_daemonsets: bool = False) -> bool:
+    """Same readiness rules as kubeapi::IsReady (pinned by test_apply.py)."""
+    kind = obj.get("kind")
+    status = obj.get("status") or {}
+    if kind == "DaemonSet":
+        desired = status.get("desiredNumberScheduled", -1)
+        ready = status.get("numberReady", -2)
+        if desired == 0 and allow_empty_daemonsets:
+            return True
+        return desired > 0 and desired == ready
+    if kind == "Deployment":
+        want = (obj.get("spec") or {}).get("replicas", 1)
+        return status.get("readyReplicas", 0) >= want
+    if kind == "Job":
+        want = (obj.get("spec") or {}).get("completions", 1)
+        return status.get("succeeded", 0) >= want
+    return True
+
+
+@dataclass
+class Client:
+    base_url: str
+    token: str = ""
+    ca_file: Optional[str] = None
+    timeout: float = 10.0
+
+    def _request(self, method: str, path: str,
+                 body: Optional[Dict[str, Any]] = None,
+                 content_type: str = "application/json"):
+        req = urllib.request.Request(self.base_url + path, method=method)
+        req.add_header("Accept", "application/json")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        data = None
+        if body is not None:
+            data = json.dumps(body).encode()
+            req.add_header("Content-Type", content_type)
+        ctx = None
+        if self.base_url.startswith("https"):
+            ctx = ssl.create_default_context(cafile=self.ca_file)
+            if not self.ca_file:
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+        try:
+            with urllib.request.urlopen(req, data=data, timeout=self.timeout,
+                                        context=ctx) as resp:
+                return resp.status, json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as exc:
+            payload = exc.read()
+            try:
+                parsed = json.loads(payload or b"{}")
+            except ValueError:
+                parsed = {"message": payload.decode(errors="replace")[:200]}
+            return exc.code, parsed
+
+    def get(self, path: str):
+        return self._request("GET", path)
+
+    def apply(self, obj: Dict[str, Any]) -> str:
+        """Create-or-patch one object; returns 'created' | 'patched'."""
+        path = object_path(obj)
+        code, _ = self.get(path)
+        if code == 404:
+            code, resp = self._request("POST", collection_path(obj), obj)
+            if code not in (200, 201, 202):
+                raise ApplyError(f"POST {path}: {code} {resp}")
+            return "created"
+        if code != 200:
+            raise ApplyError(f"GET {path}: {code}")
+        code, resp = self._request("PATCH", path, obj,
+                                   "application/merge-patch+json")
+        if code != 200:
+            raise ApplyError(f"PATCH {path}: {code} {resp}")
+        return "patched"
+
+    def wait_ready(self, objs: Sequence[Dict[str, Any]], timeout: float,
+                   poll: float = 1.0,
+                   allow_empty_daemonsets: bool = False) -> None:
+        deadline = time.monotonic() + timeout
+        pending = [o for o in objs if o.get("kind") in WORKLOAD_KINDS]
+        while pending:
+            still = []
+            for obj in pending:
+                code, live = self.get(object_path(obj))
+                if code != 200 or not is_ready(live, allow_empty_daemonsets):
+                    still.append(obj)
+            pending = still
+            if not pending:
+                return
+            if time.monotonic() >= deadline:
+                names = [o["metadata"]["name"] for o in pending]
+                raise ApplyError(f"timed out waiting for readiness: {names}")
+            time.sleep(poll)
+
+
+@dataclass
+class GroupResult:
+    actions: List[str] = field(default_factory=list)
+
+
+def apply_groups(client: Client, groups: Sequence[Sequence[Dict[str, Any]]],
+                 wait: bool = True, stage_timeout: float = 600,
+                 poll: float = 1.0, allow_empty_daemonsets: bool = False,
+                 log=lambda msg: None) -> GroupResult:
+    """Ordered, readiness-gated rollout of manifest groups — the reference's
+    operator behavior (SURVEY.md §3.3) as a one-shot procedure."""
+    result = GroupResult()
+    for i, group in enumerate(groups):
+        for obj in group:
+            action = client.apply(obj)
+            name = f"{obj['kind']}/{obj['metadata']['name']}"
+            result.actions.append(f"{action} {name}")
+            log(f"{action} {name}")
+        if wait:
+            client.wait_ready(group, stage_timeout, poll,
+                              allow_empty_daemonsets)
+            log(f"group {i + 1}/{len(groups)} ready")
+    return result

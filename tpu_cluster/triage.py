@@ -1,0 +1,93 @@
+"""Failure-triage runbook, executable — reference README.md:176-187 analog.
+
+The reference's troubleshooting section is three manual steps: describe the
+failing pod, read the driver container's logs, and confirm the instance
+really has a GPU. ``tpuctl triage`` executes the TPU edition of that runbook
+against every operand and folds in the node-status surface the GPU stack
+lacks (SURVEY.md §5 failure-detection plan), producing one report.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import List
+
+from .spec import ClusterSpec
+from .verify import OPERAND_PODS, Runner, subprocess_runner
+
+
+@dataclass
+class TriageReport:
+    sections: List[str] = field(default_factory=list)
+
+    def add(self, title: str, body: str):
+        self.sections.append(f"=== {title} ===\n{body.rstrip()}\n")
+
+    def text(self) -> str:
+        return "\n".join(self.sections)
+
+
+def run_triage(spec: ClusterSpec,
+               runner: Runner = subprocess_runner) -> TriageReport:
+    ns = spec.tpu.namespace
+    report = TriageReport()
+
+    # 1. pod inventory with phases (the "kubectl get pods" first look)
+    rc, out = runner(["kubectl", "get", "pods", "-n", ns, "-o", "json"])
+    problem_pods: List[str] = []
+    if rc != 0:
+        report.add(f"pods in {ns}", "ERROR: cannot list pods — is the stack "
+                                    "installed? (tpuctl apply)")
+    else:
+        lines = []
+        for pod in json.loads(out).get("items", []):
+            name = pod["metadata"]["name"]
+            phase = pod["status"].get("phase", "?")
+            lines.append(f"{name}  {phase}")
+            if phase not in ("Running", "Succeeded"):
+                problem_pods.append(name)
+        report.add(f"pods in {ns}", "\n".join(lines) or "(none)")
+
+    # 2. describe + logs for every problem pod (reference README.md:179-184)
+    for pod in problem_pods:
+        rc, out = runner(["kubectl", "describe", "pod", "-n", ns, pod])
+        report.add(f"describe {pod}", out if rc == 0 else "describe failed")
+        rc, out = runner(["kubectl", "logs", "-n", ns, pod, "--tail=50"])
+        report.add(f"logs {pod}", out if rc == 0 else "logs unavailable")
+
+    # 3. per-node health from the node-status-exporter (the automated
+    # version of "confirm the instance really has a GPU", README.md:187)
+    if spec.tpu.operand("nodeStatusExporter").enabled:
+        rc, out = runner([
+            "kubectl", "get", "--raw",
+            f"/api/v1/namespaces/{ns}/services/"
+            f"tpu-node-status-exporter:9401/proxy/status",
+        ])
+        report.add("node TPU-stack status",
+                   out if rc == 0 else
+                   "status endpoint unreachable; on the node run: "
+                   f"ls {spec.tpu.device_glob}  (device nodes present?)")
+
+    # 4. device-plugin registration state
+    rc, out = runner(["kubectl", "get", "nodes", "-o", "json"])
+    if rc == 0:
+        resource = spec.tpu.resource_name
+        rows = []
+        for node in json.loads(out).get("items", []):
+            alloc = node["status"].get("allocatable", {}).get(resource, "0")
+            rows.append(f"{node['metadata']['name']}  {resource}={alloc}")
+        report.add("allocatable per node (device-plugin registration)",
+                   "\n".join(rows) or "(no nodes)")
+
+    hints = [
+        "Unaligned-allocation pod events (InvalidArgument: ... not an "
+        "aligned sub-mesh): request 1/2/4/8 chips on v5e-8.",
+        f"Resource missing from Allocatable: check the plugin pod and "
+        f"/var/lib/kubelet/device-plugins/tpud.sock on the node; tpud "
+        f"re-registers after kubelet restarts (look for 're-listening').",
+        f"No chips found: ls {spec.tpu.device_glob} on the node "
+        "(control-plane nodes legitimately have none).",
+    ]
+    report.add("hints", "\n".join(f"- {h}" for h in hints))
+    return report

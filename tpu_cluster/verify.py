@@ -1,0 +1,213 @@
+"""Executable acceptance runbook — the reference's golden-output checks.
+
+The reference's test strategy is a human runbook: run a kubectl command,
+compare with a pasted expected output (SURVEY.md §4). This module turns each
+check into an executable assertion over ``kubectl -o json`` (JSON paths
+instead of grep), one per BASELINE.json config plus the operand/label checks
+in between. ``tpuctl verify`` runs them; tests inject a canned runner.
+
+A *runner* is ``callable(argv: List[str]) -> (returncode, stdout_text)`` —
+the only seam between these checks and a live cluster.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .spec import ClusterSpec
+
+Runner = Callable[[List[str]], Tuple[int, str]]
+
+OPERAND_PODS = ("tpu-libtpu-prep", "tpu-device-plugin",
+                "tpu-feature-discovery", "tpu-metrics-exporter",
+                "tpu-node-status-exporter")
+VALIDATION_JOBS = ("tpu-device-query", "tpu-vector-add", "tpu-matmul",
+                   "tpu-psum")
+
+
+def subprocess_runner(argv: List[str]) -> Tuple[int, str]:
+    try:
+        proc = subprocess.run(argv, capture_output=True, text=True,
+                              timeout=120)
+    except FileNotFoundError:
+        return 127, ""  # no kubectl on PATH -> each check FAILs, not a crash
+    except subprocess.TimeoutExpired:
+        return 124, ""
+    return proc.returncode, proc.stdout
+
+
+@dataclass
+class CheckResult:
+    name: str
+    ok: bool
+    detail: str
+
+    def line(self) -> str:
+        return f"[{'PASS' if self.ok else 'FAIL'}] {self.name}: {self.detail}"
+
+
+def _kubectl_json(runner: Runner, args: List[str]) -> Optional[dict]:
+    rc, out = runner(["kubectl", *args, "-o", "json"])
+    if rc != 0:
+        return None
+    try:
+        return json.loads(out)
+    except ValueError:
+        return None
+
+
+def check_smoke(runner: Runner, spec: ClusterSpec) -> CheckResult:
+    """BASELINE config 1 (reference README.md:77-82): nodes Ready,
+    kube-system pods healthy."""
+    nodes = _kubectl_json(runner, ["get", "nodes"])
+    if not nodes or not nodes.get("items"):
+        return CheckResult("smoke", False, "kubectl get nodes failed or empty")
+    not_ready = []
+    for node in nodes["items"]:
+        conds = {c["type"]: c["status"]
+                 for c in node["status"].get("conditions", [])}
+        if conds.get("Ready") != "True":
+            not_ready.append(node["metadata"]["name"])
+    if not_ready:
+        return CheckResult("smoke", False, f"nodes not Ready: {not_ready}")
+    pods = _kubectl_json(runner, ["get", "pods", "-n", "kube-system"])
+    if pods is None:
+        return CheckResult("smoke", False, "cannot list kube-system pods")
+    bad = [p["metadata"]["name"] for p in pods["items"]
+           if p["status"].get("phase") not in ("Running", "Succeeded")]
+    if bad:
+        return CheckResult("smoke", False, f"kube-system pods not up: {bad}")
+    return CheckResult(
+        "smoke", True,
+        f"{len(nodes['items'])} nodes Ready, kube-system healthy")
+
+
+def check_operands(runner: Runner, spec: ClusterSpec) -> CheckResult:
+    """Operand pod health (reference README.md:116, 195-207 analog)."""
+    enabled = {
+        "tpu-libtpu-prep": spec.tpu.operand("libtpuPrep").enabled,
+        "tpu-device-plugin": spec.tpu.operand("devicePlugin").enabled,
+        "tpu-feature-discovery": spec.tpu.operand("featureDiscovery").enabled,
+        "tpu-metrics-exporter": spec.tpu.operand("metricsExporter").enabled,
+        "tpu-node-status-exporter":
+            spec.tpu.operand("nodeStatusExporter").enabled,
+    }
+    pods = _kubectl_json(runner, ["get", "pods", "-n", spec.tpu.namespace])
+    if pods is None:
+        return CheckResult("operands", False,
+                           f"cannot list pods in {spec.tpu.namespace}")
+    running = [p["metadata"]["name"] for p in pods["items"]
+               if p["status"].get("phase") == "Running"]
+    missing = [name for name, on in enabled.items()
+               if on and not any(r.startswith(name) for r in running)]
+    if missing:
+        return CheckResult("operands", False,
+                           f"operand pods not Running: {missing}")
+    return CheckResult("operands", True,
+                       f"{len(running)} operand pods Running")
+
+
+def check_labels(runner: Runner, spec: ClusterSpec) -> CheckResult:
+    """Node labels (reference README.md:119 analog)."""
+    nodes = _kubectl_json(runner, ["get", "nodes", "-l",
+                                   "google.com/tpu.present=true"])
+    if not nodes or not nodes.get("items"):
+        return CheckResult("labels", False,
+                           "no nodes labeled google.com/tpu.present=true")
+    names = [n["metadata"]["name"] for n in nodes["items"]]
+    return CheckResult("labels", True, f"TPU nodes: {names}")
+
+
+def check_allocatable(runner: Runner, spec: ClusterSpec) -> CheckResult:
+    """Extended resource in Allocatable (reference README.md:122 analog) —
+    the BASELINE.json headline metric."""
+    want = spec.tpu.accelerator_type.chips_per_host
+    resource = spec.tpu.resource_name
+    nodes = _kubectl_json(runner, ["get", "nodes"])
+    if not nodes:
+        return CheckResult("allocatable", False, "kubectl get nodes failed")
+    per_node = {
+        n["metadata"]["name"]:
+            int(n["status"].get("allocatable", {}).get(resource, 0))
+        for n in nodes["items"]
+    }
+    good = {k: v for k, v in per_node.items() if v == want}
+    if not good:
+        return CheckResult(
+            "allocatable", False,
+            f"no node advertises {resource}={want} (saw {per_node})")
+    return CheckResult("allocatable", True,
+                       f"{resource}={want} on {sorted(good)}")
+
+
+def _check_job(runner: Runner, spec: ClusterSpec, check: str,
+               job: str) -> CheckResult:
+    doc = _kubectl_json(runner,
+                        ["get", "job", "-n", spec.tpu.namespace, job])
+    if doc is None:
+        return CheckResult(check, False, f"job {job} not found (render+apply "
+                                         "it: tpuctl render --only jobs)")
+    want = (doc.get("spec") or {}).get("completions", 1)
+    got = (doc.get("status") or {}).get("succeeded", 0)
+    if got >= want:
+        return CheckResult(check, True, f"{job} succeeded {got}/{want}")
+    failed = (doc.get("status") or {}).get("failed", 0)
+    return CheckResult(check, False,
+                       f"{job} succeeded {got}/{want}, failed {failed}")
+
+
+def check_device_query(runner: Runner, spec: ClusterSpec) -> CheckResult:
+    """BASELINE config 2: the nvidia-smi analog Job."""
+    return _check_job(runner, spec, "device-query", "tpu-device-query")
+
+
+def check_vector_add(runner: Runner, spec: ClusterSpec) -> CheckResult:
+    """BASELINE config 3: the cuda-vector-add analog Job."""
+    return _check_job(runner, spec, "vector-add", "tpu-vector-add")
+
+
+def check_psum(runner: Runner, spec: ClusterSpec) -> CheckResult:
+    """BASELINE config 5: all-reduce over ICI."""
+    return _check_job(runner, spec, "psum", "tpu-psum")
+
+
+def check_metrics(runner: Runner, spec: ClusterSpec) -> CheckResult:
+    """BASELINE config 4: the exporter scrape, through the apiserver service
+    proxy so it works from anywhere kubectl works."""
+    port = spec.tpu.operand("metricsExporter").extra.get("port", 9400)
+    rc, out = runner([
+        "kubectl", "get", "--raw",
+        f"/api/v1/namespaces/{spec.tpu.namespace}/services/"
+        f"tpu-metrics-exporter:{port}/proxy/metrics",
+    ])
+    if rc != 0:
+        return CheckResult("metrics", False, "service proxy scrape failed")
+    if "tpu_chips_total" not in out:
+        return CheckResult("metrics", False,
+                           "scrape lacks tpu_chips_total gauge")
+    line = next((ln for ln in out.splitlines()
+                 if ln.startswith("tpu_chips_total")), "")
+    return CheckResult("metrics", True, line or "tpu_chips_total present")
+
+
+CHECKS: Dict[str, Callable[[Runner, ClusterSpec], CheckResult]] = {
+    "smoke": check_smoke,
+    "operands": check_operands,
+    "labels": check_labels,
+    "allocatable": check_allocatable,
+    "device-query": check_device_query,
+    "vector-add": check_vector_add,
+    "metrics": check_metrics,
+    "psum": check_psum,
+}
+
+
+def run_checks(names: List[str], spec: ClusterSpec,
+               runner: Runner = subprocess_runner) -> List[CheckResult]:
+    unknown = [n for n in names if n not in CHECKS]
+    if unknown:
+        raise KeyError(f"unknown checks {unknown}; known: {list(CHECKS)}")
+    return [CHECKS[n](runner, spec) for n in names]
