@@ -1,0 +1,83 @@
+#!/usr/bin/env bash
+# kind integration: the clusterless multi-node story (SURVEY.md §4 point 3).
+#
+# Brings up a kind cluster, deploys the TPU stack with the device plugin in
+# --fake-devices=8 mode, and asserts the §3.4 trace end-to-end on a cluster
+# with zero TPUs:
+#   - node Allocatable reports google.com/tpu: 8
+#   - a Job requesting 8 chips schedules and sees the Allocate env
+#
+# Skips (exit 0 with a notice) when docker/kind/kubectl are unavailable so
+# CI environments without container tooling stay green.
+set -euo pipefail
+
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+CLUSTER=tpu-stack-it
+IMG=tpu-stack:it
+
+for tool in docker kind kubectl; do
+  if ! command -v "$tool" >/dev/null 2>&1; then
+    echo "SKIP: $tool not available - kind integration needs docker+kind+kubectl"
+    exit 0
+  fi
+done
+
+cleanup() { kind delete cluster --name "$CLUSTER" >/dev/null 2>&1 || true; }
+trap cleanup EXIT
+
+echo "--- building image"
+docker build -q -f "$REPO/deploy/Dockerfile" -t "$IMG" "$REPO"
+
+echo "--- creating kind cluster"
+kind create cluster --name "$CLUSTER" --wait 120s
+kind load docker-image "$IMG" --name "$CLUSTER"
+
+echo "--- rendering manifests (fake-device mode)"
+SPEC=$(mktemp)
+cat >"$SPEC" <<EOF
+tpu:
+  accelerator: v5e-8
+  operands:
+    libtpuPrep: {enabled: false}     # no device nodes on kind workers
+    devicePlugin:
+      image: $IMG
+      extraArgs: ["--fake-devices=8"]
+    featureDiscovery: {image: $IMG}
+    metricsExporter: {image: $IMG, extraArgs: ["--fake-devices=8"]}
+    nodeStatusExporter: {enabled: false}  # expects real chips
+EOF
+PYTHONPATH="$REPO" python3 -m tpu_cluster render --spec "$SPEC" --only manifests \
+  | kubectl apply -f -
+
+echo "--- waiting for the device plugin"
+kubectl -n tpu-system rollout status ds/tpu-device-plugin --timeout=180s
+
+echo "--- asserting allocatable google.com/tpu=8"
+for i in $(seq 1 30); do
+  GOT=$(kubectl get nodes -o jsonpath='{.items[*].status.allocatable.google\.com/tpu}')
+  [ "${GOT:-}" = "8" ] && break
+  sleep 2
+done
+[ "${GOT:-}" = "8" ] || { echo "FAIL: allocatable google.com/tpu='$GOT'"; exit 1; }
+echo "allocatable OK: google.com/tpu=8"
+
+echo "--- running a pod that consumes the resource"
+kubectl apply -f - <<'EOF'
+apiVersion: batch/v1
+kind: Job
+metadata: {name: tpu-consume, namespace: tpu-system}
+spec:
+  backoffLimit: 0
+  template:
+    spec:
+      restartPolicy: Never
+      containers:
+      - name: consume
+        image: busybox
+        command: ["sh", "-c", "echo TPU_VISIBLE_DEVICES=$TPU_VISIBLE_DEVICES; test -n \"$TPU_VISIBLE_DEVICES\""]
+        resources:
+          limits: {google.com/tpu: "8"}
+EOF
+kubectl -n tpu-system wait --for=condition=complete job/tpu-consume --timeout=120s
+kubectl -n tpu-system logs job/tpu-consume
+echo "PASS: kind integration complete"

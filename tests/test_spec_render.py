@@ -170,3 +170,28 @@ def test_rollout_groups_ordered():
     order = [g[0]["metadata"]["name"] for g in groups]
     assert order == ["tpu-system", "tpu-libtpu-prep", "tpu-device-plugin",
                      "tpu-feature-discovery", "tpu-metrics-exporter"]
+
+
+def test_extra_args_validated_and_rendered():
+    """extraArgs: validated in spec.load, splatted into container args."""
+    s = specmod.load(
+        "tpu:\n  operands:\n"
+        "    devicePlugin: {extraArgs: ['--fake-devices=8']}\n"
+        "    metricsExporter: {extraArgs: [--fake-devices=8, 42]}\n")
+    # items coerced to str at load time
+    assert s.tpu.operand("metricsExporter").extra["extraArgs"] == \
+        ["--fake-devices=8", "42"]
+    dp = manifests.device_plugin(s)
+    assert "--fake-devices=8" in \
+        dp["spec"]["template"]["spec"]["containers"][0]["args"]
+    me_ds = manifests.metrics_exporter(s)[0]
+    args = me_ds["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert args[-2:] == ["--fake-devices=8", "42"]
+
+    # scalar (the natural one-flag mistake) is rejected, not char-splatted
+    with pytest.raises(specmod.SpecError, match="expected a list"):
+        specmod.load("tpu: {operands: {devicePlugin: "
+                     "{extraArgs: --fake-devices=8}}}")
+    # libtpuPrep runs an inline script; extraArgs there is an error
+    with pytest.raises(specmod.SpecError, match="not supported"):
+        specmod.load("tpu: {operands: {libtpuPrep: {extraArgs: [-v]}}}")

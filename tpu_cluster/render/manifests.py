@@ -39,6 +39,12 @@ def _image(spec: ClusterSpec, operand: str) -> str:
     return spec.tpu.operand(operand).image or DEFAULT_IMAGE
 
 
+def _extra_args(spec: ClusterSpec, operand: str) -> List[str]:
+    """User-supplied container args (validated in spec.py), e.g.
+    --fake-devices=8 for clusterless integration (SURVEY.md §4)."""
+    return spec.tpu.operand(operand).extra.get("extraArgs", [])
+
+
 def _meta(name: str, spec: ClusterSpec, component: str) -> Dict[str, Any]:
     return {
         "name": name,
@@ -173,6 +179,7 @@ def device_plugin(spec: ClusterSpec) -> Dict[str, Any]:
                 f"--device-glob={spec.tpu.device_glob}",
                 f"--libtpu-path={spec.tpu.libtpu_host_path}",
                 f"--kubelet-dir={KUBELET_DP_DIR}",
+                *_extra_args(spec, "devicePlugin"),
             ],
             "securityContext": {"privileged": True},
             "volumeMounts": [
@@ -226,7 +233,8 @@ def feature_discovery(spec: ClusterSpec) -> List[Dict[str, Any]]:
             "command": ["python3", "-m", "tpu_cluster.discovery.labeler"],
             "args": [f"--accelerator={spec.tpu.accelerator}",
                      f"--device-glob={spec.tpu.device_glob}",
-                     "--interval=60"],
+                     "--interval=60",
+                     *_extra_args(spec, "featureDiscovery")],
             "env": [{"name": "NODE_NAME",
                      "valueFrom": {"fieldRef": {"fieldPath": "spec.nodeName"}}}],
             "volumeMounts": [{"name": "dev", "mountPath": "/dev",
@@ -242,7 +250,8 @@ def feature_discovery(spec: ClusterSpec) -> List[Dict[str, Any]]:
 def metrics_exporter(spec: ClusterSpec) -> List[Dict[str, Any]]:
     """tpu-metrics-exporter DaemonSet + Service — dcgm-exporter analog
     (reference README.md:204,213). Native C++ collector (native/exporter)."""
-    port = int(spec.tpu.operand("metricsExporter").extra.get("port", METRICS_PORT))
+    op = spec.tpu.operand("metricsExporter")
+    port = int(op.extra.get("port", METRICS_PORT))
     pod: Dict[str, Any] = {
         "nodeSelector": _tpu_node_selector(),
         "containers": [{
@@ -251,7 +260,8 @@ def metrics_exporter(spec: ClusterSpec) -> List[Dict[str, Any]]:
             "command": ["tpu-metrics-exporter"],
             "args": [f"--port={port}",
                      f"--device-glob={spec.tpu.device_glob}",
-                     f"--accelerator={spec.tpu.accelerator}"],
+                     f"--accelerator={spec.tpu.accelerator}",
+                     *_extra_args(spec, "metricsExporter")],
             "ports": [{"name": "metrics", "containerPort": port}],
             "volumeMounts": [
                 {"name": "dev", "mountPath": "/dev", "readOnly": True},
@@ -302,7 +312,8 @@ def node_status_exporter(spec: ClusterSpec) -> Dict[str, Any]:
                      f"--accelerator={acc.name}",
                      f"--expect-chips={acc.chips_per_host}",
                      f"--libtpu-path={spec.tpu.libtpu_host_path}",
-                     f"--plugin-socket={KUBELET_DP_DIR}/tpud.sock"],
+                     f"--plugin-socket={KUBELET_DP_DIR}/tpud.sock",
+                     *_extra_args(spec, "nodeStatusExporter")],
             "ports": [{"name": "status", "containerPort": STATUS_PORT}],
             "volumeMounts": [
                 {"name": "dev", "mountPath": "/dev", "readOnly": True},

@@ -96,13 +96,29 @@ class TpuSpec:
         "nodeStatusExporter",  # ~ node-status-exporter
     )
 
+    # Operands whose container takes CLI args; libtpuPrep runs an inline
+    # shell script, so extraArgs cannot apply there.
+    EXTRA_ARGS_OPERANDS = ("devicePlugin", "featureDiscovery",
+                           "metricsExporter", "nodeStatusExporter")
+
     def validate(self) -> None:
         topology.get(self.accelerator)  # raises on unknown
-        for name in self.operands:
+        for name, op in self.operands.items():
             if name not in self.OPERAND_NAMES:
                 raise SpecError(
                     f"unknown operand {name!r}; known: {list(self.OPERAND_NAMES)}"
                 )
+            if "extraArgs" in op.extra:
+                ea = op.extra["extraArgs"]
+                if name not in self.EXTRA_ARGS_OPERANDS:
+                    raise SpecError(
+                        f"tpu.operands.{name}: extraArgs not supported "
+                        f"(allowed on: {list(self.EXTRA_ARGS_OPERANDS)})")
+                if not isinstance(ea, list):
+                    raise SpecError(
+                        f"tpu.operands.{name}.extraArgs: expected a list, "
+                        f"got {type(ea).__name__}")
+                op.extra["extraArgs"] = [str(a) for a in ea]
 
     def operand(self, name: str) -> OperandSpec:
         if name not in self.OPERAND_NAMES:
