@@ -32,9 +32,23 @@ def main() -> int:
     if platform == "cpu":
         # Clusterless fallback: tiny shapes so CI stays fast.
         mm = smoke.matmul(512, 512, 512, iters=3)
+        value = round(mm["tflops"], 2)
     else:
-        mm = smoke.matmul(4096, 4096, 4096, iters=20)
-    value = round(mm["tflops"], 2)
+        # Two-point measurement: the per-dispatch constant cancels in the
+        # difference, leaving the sustained MXU rate (nccl-tests busbw
+        # methodology). The constant is NOT negligible here: through the
+        # remote-chip tunnel a single dispatch+sync costs ~85ms, an order
+        # of magnitude above the 100-iter compute time.
+        lo = smoke.matmul(4096, 4096, 4096, iters=100)
+        hi = smoke.matmul(4096, 4096, 4096, iters=500)
+        flops_per_iter = 2.0 * 4096 ** 3
+        dt = hi["seconds"] - lo["seconds"]
+        if dt > 1e-3:
+            value = round(flops_per_iter * (500 - 100) / dt / 1e12, 2)
+        else:
+            # Timing noise swamped the delta; report the raw long-run rate
+            # rather than emitting garbage.
+            value = round(hi["tflops"], 2)
     print(json.dumps({
         "metric": "bf16_matmul_tflops_1chip",
         "value": value,

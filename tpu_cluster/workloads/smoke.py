@@ -63,17 +63,30 @@ def matmul(m: int = 4096, k: int = 4096, n: int = 4096,
            dtype=jnp.bfloat16, iters: int = 10) -> Dict[str, Any]:
     """bf16 matmul smoke + throughput: keeps the MXU busy with one large
     static-shape contraction (SURVEY's idiomatic-TPU rule: big, batched,
-    bfloat16). Returns sustained TFLOP/s over ``iters`` timed steps."""
+    bfloat16). The ``iters`` timed steps run INSIDE one compiled computation
+    (lax.scan with a data-dependent carry, so XLA cannot CSE them away) —
+    per-step Python dispatch would dominate the sub-millisecond matmul and
+    measure the host/tunnel, not the MXU. Requires k == n (the carry is fed
+    back through the same rhs each step)."""
+    if k != n:
+        raise ValueError(f"chained-carry benchmark needs k == n, got "
+                         f"k={k} n={n}")
     key = jax.random.PRNGKey(0)
     ka, kb = jax.random.split(key)
     a = jax.random.normal(ka, (m, k), dtype=dtype)
     b = jax.random.normal(kb, (k, n), dtype=dtype)
-    f = jax.jit(lambda x, y: x @ y)
-    f(a, b).block_until_ready()  # compile
+    scale = dtype(1.0 / np.sqrt(k))  # keep the carried product bounded
+
+    @jax.jit
+    def chain(x, y):
+        def step(carry, _):
+            return (carry @ y) * scale, None
+        out, _ = jax.lax.scan(step, x, None, length=iters)
+        return out
+
+    chain(a, b).block_until_ready()  # compile
     t0 = time.perf_counter()
-    out = None
-    for _ in range(iters):
-        out = f(a, b)
+    out = chain(a, b)
     out.block_until_ready()
     dt = time.perf_counter() - t0
     flops = 2.0 * m * k * n * iters
