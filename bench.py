@@ -39,12 +39,14 @@ def main() -> int:
         # methodology). The constant is NOT negligible here: through the
         # remote-chip tunnel a single dispatch+sync costs ~85ms, an order
         # of magnitude above the 100-iter compute time.
-        lo = smoke.matmul(4096, 4096, 4096, iters=100)
-        hi = smoke.matmul(4096, 4096, 4096, iters=500)
-        flops_per_iter = 2.0 * 4096 ** 3
+        dim, lo_iters, hi_iters = 4096, 100, 500
+        lo = smoke.matmul(dim, dim, dim, iters=lo_iters)
+        hi = smoke.matmul(dim, dim, dim, iters=hi_iters)
+        flops_per_iter = 2.0 * hi["m"] * hi["k"] * hi["n"]
         dt = hi["seconds"] - lo["seconds"]
         if dt > 1e-3:
-            value = round(flops_per_iter * (500 - 100) / dt / 1e12, 2)
+            value = round(
+                flops_per_iter * (hi["iters"] - lo["iters"]) / dt / 1e12, 2)
         else:
             # Timing noise swamped the delta; report the raw long-run rate
             # rather than emitting garbage.

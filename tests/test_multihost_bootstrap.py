@@ -1,0 +1,64 @@
+"""Two real processes through the multi-host bootstrap (SURVEY.md §7
+hard-part #4): the exact env the Indexed Job + headless Service render is fed
+to two subprocesses; each must come up as one JAX process of a 2-process
+cluster via workloads.multihost.initialize()."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+WORKER = r"""
+import json, sys
+from tpu_cluster.workloads import multihost
+plan = multihost.initialize()
+import jax
+print(json.dumps({
+    "plan": plan,
+    "process_index": jax.process_index(),
+    "process_count": jax.process_count(),
+}))
+"""
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_jax_distributed_bootstrap(tmp_path):
+    port = free_port()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    base_env = {
+        **os.environ,
+        "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        "PALLAS_AXON_POOL_IPS": "",       # force local CPU backend
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        # what the rendered Indexed Job injects (render/jobs.py): the
+        # headless-Service DNS names become localhost in this harness
+        "TPU_WORKER_HOSTNAMES": "127.0.0.1,127.0.0.1",
+        "TPU_COORDINATOR_PORT": str(port),
+    }
+    procs = []
+    for idx in range(2):
+        env = {**base_env, "JOB_COMPLETION_INDEX": str(idx)}
+        env.pop("TPU_WORKER_ID", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", WORKER], env=env, cwd=repo,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    results = []
+    for idx, proc in enumerate(procs):
+        out, err = proc.communicate(timeout=120)
+        assert proc.returncode == 0, f"worker {idx} failed:\n{err[-2000:]}"
+        results.append(json.loads(out.splitlines()[-1]))
+
+    assert {r["process_index"] for r in results} == {0, 1}
+    for idx, r in enumerate(results):
+        assert r["process_count"] == 2
+        assert r["plan"]["multihost"] is True
+        assert r["plan"]["num_processes"] == 2
+        assert r["plan"]["process_id"] == idx
+        assert r["plan"]["coordinator_address"] == f"127.0.0.1:{port}"

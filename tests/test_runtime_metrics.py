@@ -1,0 +1,55 @@
+"""Runtime-metrics loop: workload writer -> textfile -> C++ exporter relay
+(the dcgm-exporter scrape path, BASELINE config 4)."""
+
+import json
+import os
+import subprocess
+
+from tpu_cluster.workloads import runtime_metrics, validate
+
+from test_native import native_build, binpath  # noqa: F401
+
+
+def test_writer_atomic_and_prefixed(tmp_path):
+    path = str(tmp_path / "metrics.prom")
+    out = runtime_metrics.write(path, now=1234567890)
+    assert out == path
+    text = open(path).read()
+    assert "tpu_process_devices 8" in text  # virtual mesh
+    assert "tpu_runtime_metrics_timestamp_seconds 1234567890" in text
+    # every non-comment line is tpu_-prefixed (the exporter's relay filter)
+    for line in text.splitlines():
+        assert line.startswith("#") or line.startswith("tpu_"), line
+    assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+
+
+def test_writer_noop_without_directory(tmp_path):
+    assert runtime_metrics.write(str(tmp_path / "nodir" / "m.prom")) is None
+
+
+def test_validate_runner_publishes_metrics(tmp_path, capsys, monkeypatch):
+    path = tmp_path / "m.prom"
+    monkeypatch.setenv("TPU_METRICS_FILE", str(path))
+    rc = validate.main(["--mode=vector-add"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["metrics_file"] == str(path)
+    assert "tpu_process_devices" in path.read_text()
+
+
+def test_exporter_relays_only_tpu_lines(native_build, tmp_path):
+    """End-to-end: writer output flows through the C++ exporter; hostile
+    series in the textfile are filtered."""
+    path = str(tmp_path / "metrics.prom")
+    runtime_metrics.write(path, now=42)
+    with open(path, "a") as f:
+        f.write('evil_metric{x="1"} 666\n'
+                "tpu_custom_gauge 7\n")
+    proc = subprocess.run(
+        [binpath(native_build, "tpu-metrics-exporter"), "--once",
+         f"--metrics-file={path}", "--fake-devices=8",
+         "--accelerator=v5e-8"],
+        capture_output=True, text=True, check=True)
+    assert "tpu_chips_total 8" in proc.stdout          # exporter's own census
+    assert "tpu_process_devices 8" in proc.stdout      # relayed from writer
+    assert "tpu_custom_gauge 7" in proc.stdout
+    assert "evil_metric" not in proc.stdout            # filtered

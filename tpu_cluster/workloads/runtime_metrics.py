@@ -1,0 +1,84 @@
+"""Runtime-metrics textfile writer — the workload side of the scrape path.
+
+The dcgm-exporter gets its numbers from DCGM's privileged daemon; libtpu has
+no such system daemon, so this stack inverts the flow (SURVEY.md §7
+hard-part #5): the process that owns the chips (the JAX workload) writes
+``tpu_``-prefixed Prometheus lines to a hostPath textfile
+(``/run/tpu/metrics.prom``), and the tpu-metrics-exporter DaemonSet relays
+validated lines into its ``/metrics`` endpoint
+(native/exporter/exporter.cc RelayRuntimeMetrics).
+
+Metrics published per local device:
+  tpu_hbm_bytes_in_use{chip=...}   from device.memory_stats()
+  tpu_hbm_bytes_limit{chip=...}
+  tpu_process_devices              local device count of the writer
+  tpu_runtime_metrics_timestamp_seconds  staleness marker for scrapers
+
+The write is atomic (tmp + rename) so the exporter never relays a torn file.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional
+
+DEFAULT_PATH = "/run/tpu/metrics.prom"
+
+
+def collect_lines(now: Optional[float] = None) -> List[str]:
+    import jax
+
+    lines = [
+        "# HELP tpu_hbm_bytes_in_use HBM bytes in use (per chip, from the "
+        "owning JAX process)",
+        "# TYPE tpu_hbm_bytes_in_use gauge",
+    ]
+    from .smoke import hbm_stats
+
+    devices = jax.local_devices()
+    in_use, limits = {}, {}
+    for d in devices:
+        stats = hbm_stats(d)
+        if "bytes_in_use" in stats:
+            in_use[d.id] = stats["bytes_in_use"]
+        if "bytes_limit" in stats:
+            limits[d.id] = stats["bytes_limit"]
+    for chip, val in sorted(in_use.items()):
+        lines.append(f'tpu_hbm_bytes_in_use{{chip="{chip}"}} {val}')
+    lines += ["# HELP tpu_hbm_bytes_limit HBM capacity visible to the runtime",
+              "# TYPE tpu_hbm_bytes_limit gauge"]
+    for chip, val in sorted(limits.items()):
+        lines.append(f'tpu_hbm_bytes_limit{{chip="{chip}"}} {val}')
+    lines += [
+        "# HELP tpu_process_devices local devices owned by the writer",
+        "# TYPE tpu_process_devices gauge",
+        f"tpu_process_devices {len(devices)}",
+        "# TYPE tpu_runtime_metrics_timestamp_seconds gauge",
+        f"tpu_runtime_metrics_timestamp_seconds "
+        f"{int(now if now is not None else time.time())}",
+    ]
+    return lines
+
+
+def write(path: str = DEFAULT_PATH, now: Optional[float] = None) -> Optional[str]:
+    """Atomically publish current metrics; returns the path written, or None
+    when the directory doesn't exist (node without the exporter hostPath —
+    a no-op by design so workloads never fail on metrics plumbing)."""
+    directory = os.path.dirname(path) or "."
+    if not os.path.isdir(directory):
+        return None
+    tmp = f"{path}.{os.getpid()}.tmp"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write("\n".join(collect_lines(now)) + "\n")
+        os.replace(tmp, path)
+    except Exception:
+        # Metrics plumbing must never fail the workload — that includes
+        # runtime errors out of device enumeration, not just I/O.
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+    return path

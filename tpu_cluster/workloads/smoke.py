@@ -36,16 +36,27 @@ def device_report() -> Dict[str, Any]:
     for d in devices:
         entry: Dict[str, Any] = {"id": d.id, "kind": d.device_kind,
                                  "process": d.process_index}
-        try:
-            stats = d.memory_stats() or {}
-            if "bytes_limit" in stats:
-                entry["hbm_bytes_limit"] = int(stats["bytes_limit"])
-            if "bytes_in_use" in stats:
-                entry["hbm_bytes_in_use"] = int(stats["bytes_in_use"])
-        except Exception:
-            pass  # CPU backend has no memory_stats
+        stats = hbm_stats(d)
+        if "bytes_limit" in stats:
+            entry["hbm_bytes_limit"] = stats["bytes_limit"]
+        if "bytes_in_use" in stats:
+            entry["hbm_bytes_in_use"] = stats["bytes_in_use"]
         report["devices"].append(entry)
     return report
+
+
+def hbm_stats(device) -> Dict[str, int]:
+    """Normalized per-device HBM stats; {} on backends without memory_stats
+    (CPU, some tunneled TPU runtimes return None)."""
+    try:
+        stats = device.memory_stats() or {}
+    except Exception:
+        return {}
+    out: Dict[str, int] = {}
+    for key in ("bytes_in_use", "bytes_limit"):
+        if key in stats:
+            out[key] = int(stats[key])
+    return out
 
 
 def vector_add(n: int = 1 << 20) -> Dict[str, Any]:

@@ -83,6 +83,14 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     result = run(args.mode, args.matmul_dim, args.psum_devices,
                  args.expect_devices)
+    # Publish HBM gauges for the metrics-exporter relay (no-op when the
+    # /run/tpu hostPath isn't mounted) — BASELINE config 4's data source.
+    from . import runtime_metrics
+    import os
+    written = runtime_metrics.write(
+        os.environ.get("TPU_METRICS_FILE", runtime_metrics.DEFAULT_PATH))
+    if written:
+        result["metrics_file"] = written
     print(json.dumps(result, indent=2))
     return 0 if result.get("ok") else 1
 
