@@ -168,3 +168,64 @@ def test_cli_apply_operator_install(spec):
         cm = api.get(f"/api/v1/namespaces/{NS}/configmaps/"
                      f"{operator_bundle.BUNDLE_CONFIGMAP}")
         assert cm is not None and cm["data"]
+
+
+def test_apply_groups_kubectl_backend(spec):
+    """The kubectl-CLI twin: same groups, gating via rollout status/wait."""
+    calls = []
+
+    def fake_kubectl(argv, input_text=None):
+        calls.append((list(argv), input_text))
+        if argv[1] == "get":  # post-gate empty-DS re-check
+            return 0, json.dumps({"kind": "DaemonSet", "status": {
+                "desiredNumberScheduled": 2, "numberReady": 2}})
+        return 0, "ok"
+
+    groups = manifests.rollout_groups(spec)
+    result = kubeapply.apply_groups_kubectl(groups, wait=True,
+                                            stage_timeout=30,
+                                            runner=fake_kubectl)
+    applies = [c for c in calls if c[0][:3] == ["kubectl", "apply", "-f"]]
+    assert len(applies) == len(groups)
+    # every apply got real YAML on stdin
+    for _, text in applies:
+        assert text and "apiVersion" in text
+    # readiness gate per workload object, interleaved between applies:
+    # the rollout-status for group N precedes the apply of group N+1
+    flat = ["apply" if c[0][1] == "apply" else "gate" for c in calls]
+    first_gate = flat.index("gate")
+    assert "apply" in flat[first_gate:]  # later groups applied after a gate
+    gates = [c[0] for c in calls if c[0][1] in ("rollout", "wait")]
+    assert any("daemonset/tpu-device-plugin" in " ".join(g) for g in gates)
+    assert len(result.actions) == sum(len(g) for g in groups)
+
+
+def test_apply_kubectl_backend_fails_on_unready(spec):
+    def failing_rollout(argv, input_text=None):
+        if argv[1] in ("rollout", "wait"):
+            return 1, "error: timed out waiting for the condition"
+        return 0, "ok"
+
+    with pytest.raises(kubeapply.ApplyError, match="timed out"):
+        kubeapply.apply_groups_kubectl(manifests.rollout_groups(spec),
+                                       wait=True, runner=failing_rollout)
+
+
+def test_apply_kubectl_backend_empty_daemonset_guard(spec):
+    """rollout status exits 0 for a 0-desired DaemonSet; the backend must
+    re-check and fail like the REST path does (mislabeled cluster)."""
+    def kubectl_zero_desired(argv, input_text=None):
+        if argv[1] == "get":
+            return 0, json.dumps({"kind": "DaemonSet", "status": {
+                "desiredNumberScheduled": 0, "numberReady": 0}})
+        return 0, "ok"
+
+    groups = manifests.rollout_groups(spec)
+    with pytest.raises(kubeapply.ApplyError, match="no node matches"):
+        kubeapply.apply_groups_kubectl(groups, wait=True,
+                                       runner=kubectl_zero_desired)
+    # escape hatch mirrors the REST path's flag
+    result = kubeapply.apply_groups_kubectl(
+        groups, wait=True, runner=kubectl_zero_desired,
+        allow_empty_daemonsets=True)
+    assert result.actions

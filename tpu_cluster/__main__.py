@@ -75,22 +75,35 @@ def cmd_render(args) -> int:
 
 def cmd_apply(args) -> int:
     spec = _load_spec(args.spec)
-    token = ""
-    if args.token_file:
-        with open(args.token_file, encoding="utf-8") as f:
-            token = f.read().strip()
-    client = kubeapply.Client(args.apiserver, token=token,
-                              ca_file=args.ca_file)
     if args.operator:
         groups = [operator_bundle.operator_install(spec)]
     else:
         groups = manifests.rollout_groups(spec)
     try:
-        kubeapply.apply_groups(
-            client, groups, wait=args.wait,
-            stage_timeout=args.stage_timeout, poll=args.poll,
-            allow_empty_daemonsets=args.allow_empty_daemonsets,
-            log=lambda msg: print(msg))
+        if args.apiserver:
+            token = ""
+            if args.token_file:
+                with open(args.token_file, encoding="utf-8") as f:
+                    token = f.read().strip()
+            client = kubeapply.Client(args.apiserver, token=token,
+                                      ca_file=args.ca_file)
+            kubeapply.apply_groups(
+                client, groups, wait=args.wait,
+                stage_timeout=args.stage_timeout, poll=args.poll,
+                allow_empty_daemonsets=args.allow_empty_daemonsets,
+                log=lambda msg: print(msg))
+        else:
+            if args.token_file or args.ca_file:
+                print("apply: --token-file/--ca-file need --apiserver "
+                      "(the kubectl backend authenticates via kubeconfig)",
+                      file=sys.stderr)
+                return 2
+            # no URL given: use kubectl from PATH (the reference guide's
+            # control-plane-node workflow)
+            kubeapply.apply_groups_kubectl(
+                groups, wait=args.wait, stage_timeout=args.stage_timeout,
+                allow_empty_daemonsets=args.allow_empty_daemonsets,
+                log=lambda msg: print(msg))
     except kubeapply.ApplyError as exc:
         print(f"apply failed: {exc}", file=sys.stderr)
         return 1
@@ -138,9 +151,10 @@ def build_parser() -> argparse.ArgumentParser:
         "apply", help="ordered, readiness-gated rollout "
                       "(helm install --wait analog)")
     p.add_argument("--spec", default="")
-    p.add_argument("--apiserver", required=True,
-                   help="base URL (kubectl proxy: http://127.0.0.1:8001, "
-                        "or https://<apiserver>:6443)")
+    p.add_argument("--apiserver", default="",
+                   help="apiserver base URL (kubectl proxy: "
+                        "http://127.0.0.1:8001, or https://<host>:6443); "
+                        "omit to use kubectl from PATH")
     p.add_argument("--token-file", default="")
     p.add_argument("--ca-file", default=None)
     p.add_argument("--operator", action="store_true",

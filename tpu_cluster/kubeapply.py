@@ -171,6 +171,90 @@ class GroupResult:
     actions: List[str] = field(default_factory=list)
 
 
+def kubectl_runner(argv: Sequence[str], input_text: Optional[str] = None,
+                   timeout: float = 900):
+    import subprocess
+    try:
+        # Always provide stdin (empty when there's no payload): inheriting
+        # the caller's tty would hang any kubectl invocation that reads it.
+        proc = subprocess.run(list(argv), input=input_text or "",
+                              capture_output=True, text=True,
+                              timeout=timeout)
+    except FileNotFoundError:
+        return 127, "kubectl not found on PATH"
+    except subprocess.TimeoutExpired:
+        return 124, f"kubectl killed after {timeout:.0f}s"
+    return proc.returncode, proc.stdout + proc.stderr
+
+
+def apply_groups_kubectl(groups: Sequence[Sequence[Dict[str, Any]]],
+                         wait: bool = True, stage_timeout: float = 600,
+                         runner=None, allow_empty_daemonsets: bool = False,
+                         log=lambda msg: None) -> GroupResult:
+    """The kubectl-CLI twin of :func:`apply_groups` for hosts where only
+    kubectl (not a proxied apiserver URL) is available — the common case on
+    the reference guide's control-plane node. Readiness gating uses
+    ``kubectl rollout status`` / ``kubectl wait``, then re-checks
+    :func:`is_ready` on the live object so the empty-DaemonSet guard (no
+    node matched the selector) holds on this path too."""
+    import json as jsonmod
+
+    import yaml
+
+    if runner is None:
+        def runner(argv, input_text=None,
+                   _t=stage_timeout + 120):  # outlive kubectl's own timeout
+            return kubectl_runner(argv, input_text, timeout=_t)
+
+    result = GroupResult()
+    timeout_arg = f"--timeout={int(stage_timeout)}s"
+    for i, group in enumerate(groups):
+        text = yaml.dump_all(group, sort_keys=False)
+        rc, out = runner(["kubectl", "apply", "-f", "-"], text)
+        if rc != 0:
+            raise ApplyError(f"kubectl apply (group {i + 1}): {out[-400:]}")
+        for obj in group:
+            result.actions.append(
+                f"applied {obj['kind']}/{obj['metadata']['name']}")
+        if not wait:
+            continue
+        for obj in group:
+            kind = obj.get("kind")
+            if kind not in WORKLOAD_KINDS:
+                continue
+            name = obj["metadata"]["name"]
+            ns = obj["metadata"].get("namespace", "default")
+            if kind == "Job":
+                cmd = ["kubectl", "wait", "--for=condition=complete",
+                       f"job/{name}", "-n", ns, timeout_arg]
+            else:
+                cmd = ["kubectl", "rollout", "status",
+                       f"{kind.lower()}/{name}", "-n", ns, timeout_arg]
+            rc, out = runner(cmd)
+            if rc != 0:
+                reason = ("timed out waiting for readiness"
+                          if rc == 124 or "timed out" in out
+                          else "readiness gate failed")
+                raise ApplyError(f"{reason}: {kind}/{name}: {out[-400:]}")
+            if kind == "DaemonSet" and not allow_empty_daemonsets:
+                # rollout status exits 0 for a DaemonSet with 0 desired
+                # pods; re-check with the REST path's rule so a mislabeled
+                # cluster can't report silent success.
+                rc, out = runner(["kubectl", "get", "daemonset", name,
+                                  "-n", ns, "-o", "json"])
+                try:
+                    live = jsonmod.loads(out) if rc == 0 else None
+                except ValueError:
+                    live = None
+                if live is not None and not is_ready(live):
+                    raise ApplyError(
+                        f"readiness gate failed: DaemonSet/{name} has no "
+                        "scheduled pods (no node matches its selector?); "
+                        "pass --allow-empty-daemonsets to accept this")
+        log(f"group {i + 1}/{len(groups)} ready")
+    return result
+
+
 def apply_groups(client: Client, groups: Sequence[Sequence[Dict[str, Any]]],
                  wait: bool = True, stage_timeout: float = 600,
                  poll: float = 1.0, allow_empty_daemonsets: bool = False,

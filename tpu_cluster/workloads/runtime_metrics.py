@@ -8,9 +8,10 @@ hard-part #5): the process that owns the chips (the JAX workload) writes
 validated lines into its ``/metrics`` endpoint
 (native/exporter/exporter.cc RelayRuntimeMetrics).
 
-Metrics published per local device:
-  tpu_hbm_bytes_in_use{chip=...}   from device.memory_stats()
-  tpu_hbm_bytes_limit{chip=...}
+Metrics published per local device (names shared with the tpu-info probe,
+which renders tpu_hbm_used_bytes in its table — native/tpuinfo):
+  tpu_hbm_used_bytes{chip=...}     from device.memory_stats()
+  tpu_hbm_limit_bytes{chip=...}
   tpu_process_devices              local device count of the writer
   tpu_runtime_metrics_timestamp_seconds  staleness marker for scrapers
 
@@ -30,9 +31,9 @@ def collect_lines(now: Optional[float] = None) -> List[str]:
     import jax
 
     lines = [
-        "# HELP tpu_hbm_bytes_in_use HBM bytes in use (per chip, from the "
+        "# HELP tpu_hbm_used_bytes HBM bytes in use (per chip, from the "
         "owning JAX process)",
-        "# TYPE tpu_hbm_bytes_in_use gauge",
+        "# TYPE tpu_hbm_used_bytes gauge",
     ]
     from .smoke import hbm_stats
 
@@ -45,11 +46,11 @@ def collect_lines(now: Optional[float] = None) -> List[str]:
         if "bytes_limit" in stats:
             limits[d.id] = stats["bytes_limit"]
     for chip, val in sorted(in_use.items()):
-        lines.append(f'tpu_hbm_bytes_in_use{{chip="{chip}"}} {val}')
-    lines += ["# HELP tpu_hbm_bytes_limit HBM capacity visible to the runtime",
-              "# TYPE tpu_hbm_bytes_limit gauge"]
+        lines.append(f'tpu_hbm_used_bytes{{chip="{chip}"}} {val}')
+    lines += ["# HELP tpu_hbm_limit_bytes HBM capacity visible to the runtime",
+              "# TYPE tpu_hbm_limit_bytes gauge"]
     for chip, val in sorted(limits.items()):
-        lines.append(f'tpu_hbm_bytes_limit{{chip="{chip}"}} {val}')
+        lines.append(f'tpu_hbm_limit_bytes{{chip="{chip}"}} {val}')
     lines += [
         "# HELP tpu_process_devices local devices owned by the writer",
         "# TYPE tpu_process_devices gauge",
