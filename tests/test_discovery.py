@@ -75,5 +75,46 @@ def test_labeler_oneshot_outfile(tmp_path):
     ])
     assert rc == 0
     rec = json.loads(out.read_text().strip())
-    assert rec["google.com/tpu.present"] == "true"
-    assert rec["google.com/tpu.count"] == "8"
+    assert rec["labels"]["google.com/tpu.present"] == "true"
+    assert rec["labels"]["google.com/tpu.count"] == "8"
+    assert "condition" not in rec  # --conditions off
+
+
+def test_tpu_ready_condition_states():
+    """TpuReady condition (node-problem-detector analog, SURVEY.md §5)."""
+    ok = labeler.tpu_ready_condition("v5e-8", 8)
+    assert ok["type"] == "TpuReady" and ok["status"] == "True"
+    assert ok["reason"] == "AllChipsPresent"
+    degraded = labeler.tpu_ready_condition("v5e-8", 5)
+    assert degraded["status"] == "False"
+    assert degraded["reason"] == "DegradedChipSet"
+    assert "5/8" in degraded["message"]
+    none = labeler.tpu_ready_condition("v5e-8", 0)
+    assert none["status"] == "False" and none["reason"] == "NoTpuDevices"
+    # status patch body merges by condition type
+    body = json.loads(labeler.status_patch(ok))
+    assert body == {"status": {"conditions": [ok]}}
+    # transition time is preserved across same-status heartbeats and reset
+    # on a status flip
+    first = labeler.tpu_ready_condition("v5e-8", 8, now="T1")
+    assert first["lastTransitionTime"] == "T1"
+    second = labeler.tpu_ready_condition("v5e-8", 8, now="T2",
+                                         previous=first)
+    assert second["lastTransitionTime"] == "T1"
+    assert second["lastHeartbeatTime"] == "T2"
+    flipped = labeler.tpu_ready_condition("v5e-8", 5, now="T3",
+                                          previous=second)
+    assert flipped["lastTransitionTime"] == "T3"
+
+
+def test_labeler_conditions_flag(tmp_path, capsys):
+    devices.make_fake_tree(str(tmp_path), 8)
+    rc = labeler.main([
+        "--accelerator=v5e-8", f"--devfs-root={tmp_path}",
+        "--oneshot", "--print", "--conditions",
+    ])
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["labels"]["google.com/tpu.present"] == "true"
+    assert rec["condition"]["status"] == "True"
+    assert rec["condition"]["lastHeartbeatTime"].endswith("Z")

@@ -195,3 +195,14 @@ def test_extra_args_validated_and_rendered():
     # libtpuPrep runs an inline script; extraArgs there is an error
     with pytest.raises(specmod.SpecError, match="not supported"):
         specmod.load("tpu: {operands: {libtpuPrep: {extraArgs: [-v]}}}")
+
+
+def test_example_specs_load_and_render():
+    import glob, os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    examples = sorted(glob.glob(os.path.join(repo, "examples", "*.yaml")))
+    assert len(examples) >= 2
+    for path in examples:
+        s = specmod.load_file(path)
+        text = manifests.render_all(s)
+        assert "DaemonSet" in text, path

@@ -50,6 +50,9 @@ class CannedRunner:
         }
         self.raw = {"proxy/metrics": "tpu_chips_total 8\ntpu_chip_present 1\n",
                     "proxy/status": '{"healthy": true}'}
+        # golden output of the device-query Job (nvidia-smi table analog)
+        self.device_query_logs = json.dumps(
+            {"device_count": 8 if healthy else 4, "platform": "tpu"})
         if not healthy:
             self.responses["get nodes"] = {
                 "items": [node("tpu-node-0", ready=False, tpu=4)]}
@@ -74,7 +77,9 @@ class CannedRunner:
             return 1, ""
         if key in self.responses:
             return 0, json.dumps(self.responses[key])
-        # describe/logs for triage
+        # describe/logs for triage + the device-query golden output
+        if rest[0] == "logs" and rest[-1] == "job/tpu-device-query":
+            return 0, self.device_query_logs
         if rest[0] in ("describe", "logs"):
             return 0, f"(canned {rest[0]} output for {rest[-1]})"
         return 1, ""
@@ -103,6 +108,9 @@ def test_checks_fail_loudly_on_broken_cluster(spec):
     assert not results["allocatable"].ok and "4" in results["allocatable"].detail
     assert not results["metrics"].ok
     assert not results["psum"].ok and "failed 2" in results["psum"].detail
+    # job succeeded but golden output shows a partial chip set -> FAIL
+    assert not results["device-query"].ok
+    assert "saw 4 devices" in results["device-query"].detail
 
 
 def test_disabled_operand_not_required(spec):

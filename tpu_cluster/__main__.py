@@ -98,6 +98,10 @@ def cmd_apply(args) -> int:
                       "(the kubectl backend authenticates via kubeconfig)",
                       file=sys.stderr)
                 return 2
+            if args.poll != 1.0:
+                print("apply: note: --poll has no effect on the kubectl "
+                      "backend (kubectl rollout status does its own "
+                      "polling)", file=sys.stderr)
             # no URL given: use kubectl from PATH (the reference guide's
             # control-plane-node workflow)
             kubeapply.apply_groups_kubectl(

@@ -2,8 +2,14 @@
 
 Periodically discovers TPU device nodes and patches the labels from
 ``labels.compute_labels`` onto this Node via the Kubernetes API (in-cluster
-ServiceAccount). Clusterless modes for tests: ``--print`` emits the labels as
-JSON; ``--out-file`` appends the would-be patch (the fake-apiserver story).
+ServiceAccount). With ``--conditions`` it additionally publishes a
+``TpuReady`` Node condition (node-problem-detector style) from the chip
+census — the "surface health via node status" half of SURVEY.md §5's
+failure-detection plan; schedulers and humans see degraded TPU nodes in
+``kubectl describe node`` without scraping anything.
+
+Clusterless modes for tests: ``--print`` emits the labels as JSON;
+``--out-file`` appends the would-be patches (the fake-apiserver story).
 """
 
 from __future__ import annotations
@@ -14,6 +20,7 @@ import os
 import sys
 import time
 import urllib.request
+from typing import Optional
 
 from . import devices as devs
 from . import labels as lbl
@@ -23,8 +30,47 @@ def node_patch(labels: dict) -> bytes:
     return json.dumps({"metadata": {"labels": labels}}).encode()
 
 
-def patch_node_incluster(node_name: str, labels: dict) -> int:
-    """Strategic-merge-patch the Node using the in-cluster SA token."""
+def tpu_ready_condition(accelerator: str, found_count: int, now: str = "",
+                        previous: Optional[dict] = None) -> dict:
+    """The TpuReady Node condition body. True iff the chip census matches
+    the accelerator type's expectation; nodes without chips report False
+    with a distinct reason (legitimately non-TPU nodes also carry
+    present=false labels, so consumers can tell the cases apart).
+
+    ``previous`` (the condition from the last cycle) preserves
+    lastTransitionTime across heartbeats so "how long has this node been
+    degraded" is answerable, like kubelet-managed conditions. A daemon
+    restart starts a fresh transition time — documented limitation.
+    """
+    from .. import topology
+
+    expected = topology.get(accelerator).chips_per_host
+    if found_count == expected:
+        status, reason = "True", "AllChipsPresent"
+        message = f"{found_count}/{expected} TPU chips present"
+    elif found_count == 0:
+        status, reason = "False", "NoTpuDevices"
+        message = f"no TPU device nodes (expected {expected})"
+    else:
+        status, reason = "False", "DegradedChipSet"
+        message = f"{found_count}/{expected} TPU chips present"
+    cond = {"type": "TpuReady", "status": status, "reason": reason,
+            "message": message}
+    if now:
+        cond["lastHeartbeatTime"] = now
+        if previous and previous.get("status") == status:
+            cond["lastTransitionTime"] = previous.get(
+                "lastTransitionTime", now)
+        else:
+            cond["lastTransitionTime"] = now
+    return cond
+
+
+def status_patch(condition: dict) -> bytes:
+    return json.dumps({"status": {"conditions": [condition]}}).encode()
+
+
+def _incluster_request(path: str, data: bytes) -> int:
     host = os.environ["KUBERNETES_SERVICE_HOST"]
     port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
     sa = "/var/run/secrets/kubernetes.io/serviceaccount"
@@ -33,8 +79,8 @@ def patch_node_incluster(node_name: str, labels: dict) -> int:
     import ssl
     ctx = ssl.create_default_context(cafile=f"{sa}/ca.crt")
     req = urllib.request.Request(
-        f"https://{host}:{port}/api/v1/nodes/{node_name}",
-        data=node_patch(labels),
+        f"https://{host}:{port}{path}",
+        data=data,
         method="PATCH",
         headers={
             "Authorization": f"Bearer {token}",
@@ -45,22 +91,50 @@ def patch_node_incluster(node_name: str, labels: dict) -> int:
         return resp.status
 
 
-def run_once(args: argparse.Namespace) -> dict:
+def patch_node_incluster(node_name: str, labels: dict) -> int:
+    """Strategic-merge-patch the Node using the in-cluster SA token."""
+    return _incluster_request(f"/api/v1/nodes/{node_name}",
+                              node_patch(labels))
+
+
+def patch_node_condition_incluster(node_name: str, condition: dict) -> int:
+    """Patch the Node's status subresource with the TpuReady condition.
+    Strategic merge on conditions merges by `type`, so only ours moves."""
+    return _incluster_request(f"/api/v1/nodes/{node_name}/status",
+                              status_patch(condition))
+
+
+def run_once(args: argparse.Namespace,
+             previous_condition: Optional[dict] = None) -> dict:
+    """One discovery+publish cycle. Returns ``{"labels": ..}`` plus
+    ``"condition"`` when --conditions is on — the same record shape in every
+    output mode (print / out-file / in-cluster patch)."""
     found = devs.discover(args.device_glob, args.devfs_root)
     if not found:
         found = devs.discover_vfio(args.devfs_root)
     labels = lbl.compute_labels(args.accelerator, found,
                                 os.environ.get("NODE_NAME", ""))
+    record: dict = {"labels": labels}
+    if args.conditions:
+        record["condition"] = tpu_ready_condition(
+            args.accelerator, len(found),
+            now=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            previous=previous_condition)
+    condition = record.get("condition")
     if args.print_only:
-        print(json.dumps(labels, sort_keys=True))
+        print(json.dumps(record, sort_keys=True))
     elif args.out_file:
         with open(args.out_file, "a", encoding="utf-8") as f:
-            f.write(json.dumps(labels, sort_keys=True) + "\n")
+            f.write(json.dumps(record, sort_keys=True) + "\n")
     else:
-        status = patch_node_incluster(os.environ["NODE_NAME"], labels)
-        print(f"patched node {os.environ['NODE_NAME']}: HTTP {status}",
-              file=sys.stderr)
-    return labels
+        node = os.environ["NODE_NAME"]
+        status = patch_node_incluster(node, labels)
+        print(f"patched node {node}: HTTP {status}", file=sys.stderr)
+        if condition:
+            status = patch_node_condition_incluster(node, condition)
+            print(f"patched node {node} condition TpuReady="
+                  f"{condition['status']}: HTTP {status}", file=sys.stderr)
+    return record
 
 
 def main(argv=None) -> int:
@@ -69,6 +143,8 @@ def main(argv=None) -> int:
     p.add_argument("--device-glob", default="/dev/accel*")
     p.add_argument("--devfs-root", default="")
     p.add_argument("--interval", type=float, default=60)
+    p.add_argument("--conditions", action="store_true",
+                   help="also publish the TpuReady Node condition")
     p.add_argument("--oneshot", action="store_true")
     p.add_argument("--print", dest="print_only", action="store_true")
     p.add_argument("--out-file", default="")
@@ -85,9 +161,11 @@ def main(argv=None) -> int:
         print("fatal: NODE_NAME env not set (downward-API fieldRef missing "
               "from the DaemonSet manifest?)", file=sys.stderr)
         return 2
+    previous_condition: Optional[dict] = None
     while True:
         try:
-            run_once(args)
+            record = run_once(args, previous_condition)
+            previous_condition = record.get("condition")
         except Exception as exc:  # keep the daemon alive across apiserver blips
             if args.oneshot:
                 raise

@@ -207,7 +207,6 @@ def apply_groups_kubectl(groups: Sequence[Sequence[Dict[str, Any]]],
             return kubectl_runner(argv, input_text, timeout=_t)
 
     result = GroupResult()
-    timeout_arg = f"--timeout={int(stage_timeout)}s"
     for i, group in enumerate(groups):
         text = yaml.dump_all(group, sort_keys=False)
         rc, out = runner(["kubectl", "apply", "-f", "-"], text)
@@ -218,12 +217,17 @@ def apply_groups_kubectl(groups: Sequence[Sequence[Dict[str, Any]]],
                 f"applied {obj['kind']}/{obj['metadata']['name']}")
         if not wait:
             continue
+        # stage_timeout bounds the WHOLE group (matching the REST path):
+        # each sequential gate gets only the remaining budget.
+        group_deadline = time.monotonic() + stage_timeout
         for obj in group:
             kind = obj.get("kind")
             if kind not in WORKLOAD_KINDS:
                 continue
             name = obj["metadata"]["name"]
             ns = obj["metadata"].get("namespace", "default")
+            remaining = max(1, int(group_deadline - time.monotonic()))
+            timeout_arg = f"--timeout={remaining}s"
             if kind == "Job":
                 cmd = ["kubectl", "wait", "--for=condition=complete",
                        f"job/{name}", "-n", ns, timeout_arg]
@@ -246,11 +250,25 @@ def apply_groups_kubectl(groups: Sequence[Sequence[Dict[str, Any]]],
                     live = jsonmod.loads(out) if rc == 0 else None
                 except ValueError:
                     live = None
-                if live is not None and not is_ready(live):
+                if live is None:
+                    # Cannot confirm — failing open here would defeat the
+                    # guard in exactly the case it exists for.
                     raise ApplyError(
-                        f"readiness gate failed: DaemonSet/{name} has no "
-                        "scheduled pods (no node matches its selector?); "
-                        "pass --allow-empty-daemonsets to accept this")
+                        f"readiness gate failed: could not re-check "
+                        f"DaemonSet/{name}: {out[-200:]}")
+                if not is_ready(live):
+                    desired = (live.get("status") or {}).get(
+                        "desiredNumberScheduled", 0)
+                    if desired == 0:
+                        raise ApplyError(
+                            f"readiness gate failed: DaemonSet/{name} has "
+                            "no scheduled pods (no node matches its "
+                            "selector?); pass --allow-empty-daemonsets to "
+                            "accept this")
+                    ready = (live.get("status") or {}).get("numberReady", 0)
+                    raise ApplyError(
+                        f"readiness gate failed: DaemonSet/{name} pods "
+                        f"regressed after rollout ({ready}/{desired} ready)")
         log(f"group {i + 1}/{len(groups)} ready")
     return result
 

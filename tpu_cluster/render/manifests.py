@@ -214,7 +214,10 @@ def feature_discovery(spec: ClusterSpec) -> List[Dict[str, Any]]:
         "kind": "ClusterRole",
         "metadata": {"name": "tpu-feature-discovery"},
         "rules": [{"apiGroups": [""], "resources": ["nodes"],
-                   "verbs": ["get", "patch", "list"]}],
+                   "verbs": ["get", "patch", "list"]},
+                  # TpuReady condition lives on the status subresource
+                  {"apiGroups": [""], "resources": ["nodes/status"],
+                   "verbs": ["get", "patch"]}],
     }
     binding = {
         "apiVersion": "rbac.authorization.k8s.io/v1",
@@ -234,6 +237,7 @@ def feature_discovery(spec: ClusterSpec) -> List[Dict[str, Any]]:
             "args": [f"--accelerator={spec.tpu.accelerator}",
                      f"--device-glob={spec.tpu.device_glob}",
                      "--interval=60",
+                     "--conditions",
                      *_extra_args(spec, "featureDiscovery")],
             "env": [{"name": "NODE_NAME",
                      "valueFrom": {"fieldRef": {"fieldPath": "spec.nodeName"}}}],

@@ -69,16 +69,26 @@ def run_triage(spec: ClusterSpec,
                    "status endpoint unreachable; on the node run: "
                    f"ls {spec.tpu.device_glob}  (device nodes present?)")
 
-    # 4. device-plugin registration state
+    # 4. device-plugin registration state + TpuReady conditions
     rc, out = runner(["kubectl", "get", "nodes", "-o", "json"])
     if rc == 0:
         resource = spec.tpu.resource_name
-        rows = []
+        rows, cond_rows = [], []
         for node in json.loads(out).get("items", []):
+            name = node["metadata"]["name"]
             alloc = node["status"].get("allocatable", {}).get(resource, "0")
-            rows.append(f"{node['metadata']['name']}  {resource}={alloc}")
+            rows.append(f"{name}  {resource}={alloc}")
+            for cond in node["status"].get("conditions", []):
+                if cond.get("type") == "TpuReady":
+                    cond_rows.append(
+                        f"{name}  TpuReady={cond['status']} "
+                        f"({cond.get('reason', '')}: "
+                        f"{cond.get('message', '')})")
         report.add("allocatable per node (device-plugin registration)",
                    "\n".join(rows) or "(no nodes)")
+        if cond_rows:
+            report.add("TpuReady node conditions (feature discovery)",
+                       "\n".join(cond_rows))
 
     hints = [
         "Unaligned-allocation pod events (InvalidArgument: ... not an "

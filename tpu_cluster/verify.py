@@ -160,8 +160,31 @@ def _check_job(runner: Runner, spec: ClusterSpec, check: str,
 
 
 def check_device_query(runner: Runner, spec: ClusterSpec) -> CheckResult:
-    """BASELINE config 2: the nvidia-smi analog Job."""
-    return _check_job(runner, spec, "device-query", "tpu-device-query")
+    """BASELINE config 2: the nvidia-smi analog Job — status AND golden
+    output (the runbook pastes the expected table; we assert the parsed
+    device count, reference README.md:157-168 analog)."""
+    res = _check_job(runner, spec, "device-query", "tpu-device-query")
+    if not res.ok:
+        return res
+    rc, out = runner(["kubectl", "logs", "-n", spec.tpu.namespace,
+                      "job/tpu-device-query"])
+    if rc != 0:
+        return CheckResult("device-query", True,
+                           f"{res.detail} (logs unavailable)")
+    try:
+        doc = json.loads(out)
+    except ValueError:
+        doc = None
+    if not isinstance(doc, dict):
+        return CheckResult("device-query", False,
+                           "job logs are not the expected JSON report")
+    want = spec.tpu.accelerator_type.chips_per_host
+    got = doc.get("device_count")
+    if got != want:
+        return CheckResult("device-query", False,
+                           f"job saw {got} devices, expected {want}")
+    return CheckResult("device-query", True,
+                       f"{res.detail}; {got}/{want} devices enumerated")
 
 
 def check_vector_add(runner: Runner, spec: ClusterSpec) -> CheckResult:
