@@ -149,6 +149,42 @@ def test_drift_recreated_and_status_served(native_build, bundle_dir):
             op.wait(timeout=10)
 
 
+def test_bundle_reload_rolls_out_updates(native_build, bundle_dir):
+    """The bundle is a live-updating mounted ConfigMap: a re-rendered
+    manifest (e.g. new operand image) must roll out on the next pass, not
+    be merge-patched back to the stale startup snapshot."""
+    with FakeApiServer(auto_ready=True) as api:
+        op = start_operator(
+            native_build, f"--apiserver={api.url}",
+            f"--bundle-dir={bundle_dir}", "--interval=1", "--poll-ms=20",
+            "--stage-timeout=10", "--status-port=0")
+        try:
+            assert wait_until(
+                lambda: api.get(f"{DS}/tpu-device-plugin") is not None)
+            # simulate `tpuctl render` shipping a new image via the ConfigMap
+            path = os.path.join(bundle_dir,
+                                [f for f in os.listdir(bundle_dir)
+                                 if "device-plugin" in f][0])
+            doc = json.loads(open(path).read())
+            doc["spec"]["template"]["spec"]["containers"][0]["image"] = \
+                "tpu-stack:v2"
+            # atomic replace — a kubelet ConfigMap update is a symlink
+            # swap, never a truncate-then-write the reloader could race
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(json.dumps(doc))
+            os.replace(tmp, path)
+
+            def image():
+                live = api.get(f"{DS}/tpu-device-plugin")
+                return (live or {}).get("spec", {}).get("template", {}) \
+                    .get("spec", {}).get("containers", [{}])[0].get("image")
+            assert wait_until(lambda: image() == "tpu-stack:v2", timeout=20)
+        finally:
+            op.send_signal(signal.SIGTERM)
+            op.wait(timeout=10)
+
+
 def test_operator_sends_bearer_token(native_build, bundle_dir, tmp_path):
     tok = tmp_path / "token"
     tok.write_text("sekrit-token\n")

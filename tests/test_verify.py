@@ -50,9 +50,12 @@ class CannedRunner:
         }
         self.raw = {"proxy/metrics": "tpu_chips_total 8\ntpu_chip_present 1\n",
                     "proxy/status": '{"healthy": true}'}
-        # golden output of the device-query Job (nvidia-smi table analog)
-        self.device_query_logs = json.dumps(
-            {"device_count": 8 if healthy else 4, "platform": "tpu"})
+        # golden output of the device-query Job (nvidia-smi table analog);
+        # kubectl logs interleaves stderr warnings with the JSON report
+        self.device_query_logs = (
+            "WARNING: All log messages before absl::InitializeLog()...\n"
+            + json.dumps({"device_count": 8 if healthy else 4,
+                          "platform": "tpu"}, indent=2))
         if not healthy:
             self.responses["get nodes"] = {
                 "items": [node("tpu-node-0", ready=False, tpu=4)]}
@@ -111,6 +114,29 @@ def test_checks_fail_loudly_on_broken_cluster(spec):
     # job succeeded but golden output shows a partial chip set -> FAIL
     assert not results["device-query"].ok
     assert "saw 4 devices" in results["device-query"].detail
+
+
+def test_device_query_fails_closed_without_logs(spec):
+    """GC'd Job pods prove nothing about the current chip set."""
+    runner = CannedRunner(healthy=True)
+    orig = runner.__call__
+
+    def no_logs(argv):
+        rest = [a for a in argv[1:] if a not in ("-o", "json")]
+        if rest[0] == "logs":
+            return 1, ""
+        return orig(argv)
+
+    res = verify.check_device_query(no_logs, spec)
+    assert not res.ok and "logs unavailable" in res.detail
+
+
+def test_trailing_json_parser():
+    assert verify._trailing_json_object("noise\n{\"a\": 1}") == {"a": 1}
+    assert verify._trailing_json_object(
+        "{broken\nWARN x\n{\n  \"b\": 2\n}") == {"b": 2}
+    assert verify._trailing_json_object("no json here") is None
+    assert verify._trailing_json_object("[1, 2]") is None
 
 
 def test_disabled_operand_not_required(spec):

@@ -143,6 +143,23 @@ def check_allocatable(runner: Runner, spec: ClusterSpec) -> CheckResult:
                        f"{resource}={want} on {sorted(good)}")
 
 
+def _trailing_json_object(text: str) -> Optional[dict]:
+    """Parse the JSON object at the tail of mixed pod logs: kubectl merges
+    stdout with stderr warnings (JAX/absl), so scan column-0 '{' lines from
+    the last one backwards until a parse succeeds."""
+    lines = text.splitlines()
+    for i in range(len(lines) - 1, -1, -1):
+        if not lines[i].startswith("{"):
+            continue
+        try:
+            doc = json.loads("\n".join(lines[i:]))
+        except ValueError:
+            continue
+        if isinstance(doc, dict):
+            return doc
+    return None
+
+
 def _check_job(runner: Runner, spec: ClusterSpec, check: str,
                job: str) -> CheckResult:
     doc = _kubectl_json(runner,
@@ -169,13 +186,13 @@ def check_device_query(runner: Runner, spec: ClusterSpec) -> CheckResult:
     rc, out = runner(["kubectl", "logs", "-n", spec.tpu.namespace,
                       "job/tpu-device-query"])
     if rc != 0:
-        return CheckResult("device-query", True,
-                           f"{res.detail} (logs unavailable)")
-    try:
-        doc = json.loads(out)
-    except ValueError:
-        doc = None
-    if not isinstance(doc, dict):
+        # Fail closed (like the apply gates): a Job whose pods were GC'd
+        # proves nothing about the current chip set.
+        return CheckResult("device-query", False,
+                           f"{res.detail}, but logs unavailable — re-run "
+                           "the job to confirm the device count")
+    doc = _trailing_json_object(out)
+    if doc is None:
         return CheckResult("device-query", False,
                            "job logs are not the expected JSON report")
     want = spec.tpu.accelerator_type.chips_per_host
