@@ -92,7 +92,7 @@ def run_triage(spec: ClusterSpec,
 
     hints = [
         "Unaligned-allocation pod events (InvalidArgument: ... not an "
-        "aligned sub-mesh): request 1/2/4/8 chips on v5e-8.",
+        "aligned sub-mesh): request 1, 4, or 8 chips on v5e-8.",
         f"Resource missing from Allocatable: check the plugin pod and "
         f"/var/lib/kubelet/device-plugins/tpud.sock on the node; tpud "
         f"re-registers after kubelet restarts (look for 're-listening').",
