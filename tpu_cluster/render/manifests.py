@@ -35,6 +35,11 @@ METRICS_PORT = 9400
 STATUS_PORT = 9401
 
 
+def OPERAND_RESOURCES() -> Dict[str, Any]:
+    """Fresh per-container default resources (Burstable, no memory limit)."""
+    return {"requests": {"cpu": "50m", "memory": "64Mi"}}
+
+
 def _image(spec: ClusterSpec, operand: str) -> str:
     return spec.tpu.operand(operand).image or DEFAULT_IMAGE
 
@@ -59,6 +64,13 @@ def _meta(name: str, spec: ClusterSpec, component: str) -> Dict[str, Any]:
 
 def _daemonset(spec: ClusterSpec, name: str, component: str,
                pod_spec: Dict[str, Any]) -> Dict[str, Any]:
+    # Infrastructure operands get small requests (Burstable QoS) so a
+    # saturated node can't starve/evict the very daemons that report its
+    # health. Deliberately no memory limit: an arbitrary cap would trade
+    # the starvation risk for an OOM-kill crash-loop.
+    for container in (pod_spec.get("containers", [])
+                      + pod_spec.get("initContainers", [])):
+        container.setdefault("resources", OPERAND_RESOURCES())
     labels = {"app.kubernetes.io/name": name}
     return {
         "apiVersion": "apps/v1",

@@ -127,6 +127,9 @@ def deployment(spec: ClusterSpec) -> Dict[str, Any]:
                     "containers": [{
                         "name": "operator",
                         "image": manifests._image(spec, "devicePlugin"),
+                        # same QoS as the operands it manages — a BestEffort
+                        # controller would be evicted before them
+                        "resources": manifests.OPERAND_RESOURCES(),
                         "command": ["tpu-operator"],
                         "args": [f"--bundle-dir={BUNDLE_MOUNT}",
                                  f"--status-port={STATUS_PORT}",
