@@ -111,11 +111,20 @@ std::vector<ChipDevice> DiscoverDevices(const Options& opt) {
     }
   }
   globfree(&g);
-  // sort by index for deterministic ids
+  // sort by parsed number for deterministic ids
   std::sort(out.begin(), out.end(),
             [](const ChipDevice& a, const ChipDevice& b) {
               return a.index < b.index;
             });
+  // VFIO group nodes carry host-global IOMMU group numbers (e.g.
+  // /dev/vfio/45..48), which are NOT chip topology coordinates. Re-rank
+  // them densely 0..N-1 (sorted group order) so device ids, sub-mesh math,
+  // and TPU_VISIBLE_DEVICES stay chip-indexed; the host path keeps the
+  // group identity for the container mount.
+  for (size_t i = 0; i < out.size(); ++i) {
+    if (out[i].path.find("/vfio/") != std::string::npos)
+      out[i].index = static_cast<int>(i);
+  }
   return out;
 }
 
@@ -247,14 +256,36 @@ class Plugin {
     for (size_t i = 0; i < sorted_ids.size(); ++i)
       visible += (i ? "," : "") + std::to_string(sorted_ids[i]);
 
-    // Device nodes. Container path mirrors the canonical /dev/accelN layout
-    // regardless of host devfs rerooting.
+    // Device nodes. accel devices keep the canonical /dev/accelN container
+    // layout regardless of host devfs rerooting; VFIO-passthrough devices
+    // must keep their /dev/vfio/N identity (libtpu opens them by that
+    // name) plus the /dev/vfio/vfio container node, added once.
+    bool vfio_ctl_added = false;
     for (int idx : sorted_ids) {
       const ChipDevice* dev = FindDevice(idx);
       auto* spec = cresp->add_devices();
-      spec->set_container_path("/dev/accel" + std::to_string(idx));
-      spec->set_host_path(dev ? dev->path
-                              : "/dev/accel" + std::to_string(idx));
+      bool vfio = dev && dev->path.find("/vfio/") != std::string::npos;
+      if (vfio) {
+        // keep the IOMMU group identity (basename), not the chip index —
+        // libtpu opens the group node by its real name
+        std::string group = dev->path.substr(dev->path.rfind('/') + 1);
+        spec->set_container_path("/dev/vfio/" + group);
+        spec->set_host_path(dev->path);
+        if (!vfio_ctl_added) {
+          vfio_ctl_added = true;
+          auto* ctl = cresp->add_devices();
+          ctl->set_container_path("/dev/vfio/vfio");
+          // honour devfs rerooting (tests): the control node sits beside
+          // the group nodes on the host
+          std::string dir = dev->path.substr(0, dev->path.rfind('/'));
+          ctl->set_host_path(dir + "/vfio");
+          ctl->set_permissions("rw");
+        }
+      } else {
+        spec->set_container_path("/dev/accel" + std::to_string(idx));
+        spec->set_host_path(dev ? dev->path
+                                : "/dev/accel" + std::to_string(idx));
+      }
       spec->set_permissions("rw");
     }
 

@@ -388,3 +388,49 @@ def test_exporter_status_mode(native_build, tmp_path):
     finally:
         proc.terminate()
         proc.wait(timeout=5)
+
+
+def test_allocate_vfio_devices(native_build, tmp_path):
+    """VFIO passthrough: host-global IOMMU group numbers (45..48, NOT dense
+    chip indices) are re-ranked to chip ids 0..3, group nodes keep their
+    /dev/vfio/<group> identity in the container, and the /dev/vfio/vfio
+    control node rides along exactly once."""
+    from tpu_cluster.plugin_api.client import DevicePluginClient
+
+    vfio_dir = tmp_path / "devfs" / "dev" / "vfio"
+    vfio_dir.mkdir(parents=True)
+    groups = [45, 46, 47, 48]
+    for g in groups:
+        (vfio_dir / str(g)).write_text("")
+    (vfio_dir / "vfio").write_text("")  # control node
+    devfs = tmp_path / "devfs"
+    proc, sock = start_tpud(
+        native_build, tmp_path, "--accelerator=v5e-4",
+        "--device-glob=/dev/vfio/*", f"--devfs-root={devfs}",
+        "--no-register")
+    c = DevicePluginClient(sock)
+    try:
+        stream = c.list_and_watch()
+        first = next(stream)
+        # dense chip ids, not group numbers; control node not advertised
+        assert sorted(d.ID for d in first.devices) == [
+            f"tpu-{i}" for i in range(4)]
+        stream.cancel()
+
+        resp = c.allocate([f"tpu-{i}" for i in range(4)])
+        cr = resp.container_responses[0]
+        paths = [(d.container_path, d.host_path) for d in cr.devices]
+        ctl = [p for p in paths if p[0] == "/dev/vfio/vfio"]
+        assert len(ctl) == 1
+        assert ctl[0][1] == str(vfio_dir / "vfio")
+        grp = [p for p in paths if p[0] != "/dev/vfio/vfio"]
+        assert [p[0] for p in grp] == [f"/dev/vfio/{g}" for g in groups]
+        assert [p[1] for p in grp] == [str(vfio_dir / str(g))
+                                       for g in groups]
+        # env stays chip-indexed (the sub-mesh math contract)
+        assert cr.envs["TPU_VISIBLE_DEVICES"] == "0,1,2,3"
+        assert cr.envs["TPU_CHIPS_PER_HOST_BOUNDS"] == "2,2,1"
+    finally:
+        c.close()
+        proc.terminate()
+        proc.wait(timeout=5)
