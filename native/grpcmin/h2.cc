@@ -100,7 +100,32 @@ H2Stream* H2Conn::GetStream(uint32_t id) {
   return it == streams_.end() ? nullptr : it->second.get();
 }
 
-void H2Conn::ForgetStream(uint32_t id) { streams_.erase(id); }
+void H2Conn::ForgetStream(uint32_t id) {
+  // Deferred destruction: callbacks (on_data/on_headers) run while the
+  // frame-processing path still holds a raw H2Stream*, and they may call
+  // ForgetStream (a unary handler finishing). Unlink the stream now so
+  // GetStream stops returning it, but free it only at ReapDoomed(), a
+  // point where no raw pointer is live.
+  auto it = streams_.find(id);
+  if (it == streams_.end()) return;
+  doomed_.push_back(std::move(it->second));
+  streams_.erase(it);
+}
+
+void H2Conn::ReapDoomed() { doomed_.clear(); }
+
+void H2Conn::PumpAllPending() {
+  // Snapshot ids first: PumpPending can close a stream, whose
+  // on_stream_closed may ForgetStream — erasing from streams_ mid-iteration
+  // would invalidate a range-for.
+  std::vector<uint32_t> ids;
+  ids.reserve(streams_.size());
+  for (auto& [sid, s] : streams_) ids.push_back(sid);
+  for (uint32_t sid : ids) {
+    H2Stream* s = GetStream(sid);
+    if (s) PumpPending(s);
+  }
+}
 
 bool H2Conn::SendHeaders(uint32_t stream_id, const std::vector<Header>& headers,
                          bool end_stream) {
@@ -183,6 +208,9 @@ void H2Conn::CloseStreamIfDone(H2Stream* s) {
 }
 
 bool H2Conn::OnReadable() {
+  // Free streams doomed during the previous cycle: no raw H2Stream*
+  // survives across OnReadable calls.
+  ReapDoomed();
   char buf[16384];
   while (alive_) {
     ssize_t n = read(fd_, buf, sizeof(buf));
@@ -387,7 +415,7 @@ bool H2Conn::HandleSettings(uint8_t flags, const uint8_t* payload, size_t len) {
   got_peer_settings_ = true;
   if (!WriteFrame(FrameType::kSettings, kFlagAck, 0, nullptr, 0)) return false;
   // New window may unblock pending sends.
-  for (auto& [sid, s] : streams_) PumpPending(s.get());
+  PumpAllPending();
   return true;
 }
 
@@ -398,7 +426,7 @@ bool H2Conn::HandleWindowUpdate(uint32_t stream_id, const uint8_t* p,
   if (inc == 0) return stream_id != 0;  // conn-level zero increment is fatal
   if (stream_id == 0) {
     conn_send_window_ += inc;
-    for (auto& [sid, s] : streams_) PumpPending(s.get());
+    PumpAllPending();
   } else {
     H2Stream* s = GetStream(stream_id);
     if (s) {

@@ -93,7 +93,11 @@ class H2Conn {
   uint32_t NextStreamId();
 
   H2Stream* GetStream(uint32_t id);
+  // Unlinks the stream (GetStream -> nullptr) but defers the free until
+  // ReapDoomed() — safe to call from inside on_data/on_headers callbacks.
   void ForgetStream(uint32_t id);
+  void ReapDoomed();
+  void PumpAllPending();
 
   int fd() const { return fd_; }
   bool alive() const { return alive_; }
@@ -140,6 +144,7 @@ class H2Conn {
   std::string hdr_block_;
   bool hdr_end_stream_ = false;
   std::map<uint32_t, std::unique_ptr<H2Stream>> streams_;
+  std::vector<std::unique_ptr<H2Stream>> doomed_;  // see ForgetStream
 };
 
 }  // namespace grpcmin

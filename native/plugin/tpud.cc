@@ -58,6 +58,7 @@ struct ChipDevice {
   std::string path;
   bool healthy;
   int numa_node = -1;
+  bool vfio = false;  // classified once at discovery
 };
 
 std::string DeviceId(int index) { return "tpu-" + std::to_string(index); }
@@ -122,8 +123,10 @@ std::vector<ChipDevice> DiscoverDevices(const Options& opt) {
   // and TPU_VISIBLE_DEVICES stay chip-indexed; the host path keeps the
   // group identity for the container mount.
   for (size_t i = 0; i < out.size(); ++i) {
-    if (out[i].path.find("/vfio/") != std::string::npos)
+    if (out[i].path.find("/vfio/") != std::string::npos) {
+      out[i].vfio = true;
       out[i].index = static_cast<int>(i);
+    }
   }
   return out;
 }
@@ -264,8 +267,7 @@ class Plugin {
     for (int idx : sorted_ids) {
       const ChipDevice* dev = FindDevice(idx);
       auto* spec = cresp->add_devices();
-      bool vfio = dev && dev->path.find("/vfio/") != std::string::npos;
-      if (vfio) {
+      if (dev && dev->vfio) {
         // keep the IOMMU group identity (basename), not the chip index —
         // libtpu opens the group node by its real name
         std::string group = dev->path.substr(dev->path.rfind('/') + 1);
@@ -348,8 +350,11 @@ class Plugin {
     bool changed = found.size() != devices_.size();
     if (!changed) {
       for (size_t i = 0; i < found.size(); ++i) {
+        // Path matters: VFIO re-ranking keeps indices dense 0..N-1, so an
+        // IOMMU-group renumbering is visible only through the host path.
         if (found[i].index != devices_[i].index ||
-            found[i].healthy != devices_[i].healthy) {
+            found[i].healthy != devices_[i].healthy ||
+            found[i].path != devices_[i].path) {
           changed = true;
           break;
         }
