@@ -13,7 +13,6 @@ Exit 0 = clean; nonzero = crash or sanitizer report.
 
 from __future__ import annotations
 
-import json
 import os
 import subprocess
 import sys
@@ -52,7 +51,7 @@ def hammer_tpud(build: str, rounds: int = 20) -> None:
                 time.sleep(0.05)
             c = DevicePluginClient(sock)
             for _ in range(rounds):
-                stream = c.list_and_watch()
+                stream = c.list_and_watch(timeout=15)
                 next(stream)
                 stream.cancel()
                 c.get_preferred_allocation(
@@ -88,15 +87,11 @@ def hammer_tpud(build: str, rounds: int = 20) -> None:
 
 
 def converge_operator(build: str) -> None:
-    from fake_apiserver import FakeApiServer
+    from fake_apiserver import FakeApiServer, write_bundle
     from tpu_cluster import spec as specmod
-    from tpu_cluster.render import operator_bundle
 
-    spec = specmod.default_spec()
     bundle = tempfile.mkdtemp()
-    for name, obj in operator_bundle.bundle_files(spec).items():
-        with open(os.path.join(bundle, name), "w", encoding="utf-8") as f:
-            f.write(json.dumps(obj))
+    write_bundle(specmod.default_spec(), bundle)
     with FakeApiServer(auto_ready=True) as api:
         proc = subprocess.run(
             [os.path.join(build, "tpu-operator"),

@@ -20,9 +20,20 @@ simulator; gating tests leave it off and flip readiness by hand via
 from __future__ import annotations
 
 import json
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
+
+
+def write_bundle(spec, directory: str) -> None:
+    """Materialize the operator bundle as on-disk JSON files — shared by the
+    operator tests and the sanitizer interop harness."""
+    from tpu_cluster.render import operator_bundle
+
+    for name, obj in operator_bundle.bundle_files(spec).items():
+        with open(os.path.join(directory, name), "w", encoding="utf-8") as f:
+            f.write(json.dumps(obj))
 
 
 def merge_patch(target: Any, patch: Any) -> Any:
@@ -53,8 +64,13 @@ def ready_status(obj: Dict[str, Any]) -> Optional[Dict[str, Any]]:
 
 
 class FakeApiServer:
-    def __init__(self, auto_ready: bool = True):
+    """``tls`` = (certfile, keyfile) serves HTTPS — used to exercise the
+    operator's in-cluster transport (exec-of-curl with --cacert + bearer
+    token) without a real apiserver."""
+
+    def __init__(self, auto_ready: bool = True, tls=None):
         self.auto_ready = auto_ready
+        self._tls = tls
         self.store: Dict[str, Dict[str, Any]] = {}
         self.log: List[Tuple[str, str]] = []  # (method, path)
         self.created: List[str] = []          # stored object paths, in order
@@ -149,6 +165,12 @@ class FakeApiServer:
                 self._reply(200 if gone is not None else 404, {})
 
         self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        if tls is not None:
+            import ssl
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(certfile=tls[0], keyfile=tls[1])
+            self._server.socket = ctx.wrap_socket(self._server.socket,
+                                                  server_side=True)
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True)
 
@@ -165,7 +187,8 @@ class FakeApiServer:
     @property
     def url(self) -> str:
         host, port = self._server.server_address[:2]
-        return f"http://{host}:{port}"
+        scheme = "https" if self._tls else "http"
+        return f"{scheme}://{host}:{port}"
 
     def __enter__(self):
         return self.start()

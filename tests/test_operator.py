@@ -23,11 +23,10 @@ DS = f"/apis/apps/v1/namespaces/{NS}/daemonsets"
 
 @pytest.fixture()
 def bundle_dir(tmp_path):
-    spec = specmod.default_spec()
+    from fake_apiserver import write_bundle
     d = tmp_path / "bundle"
     d.mkdir()
-    for name, obj in operator_bundle.bundle_files(spec).items():
-        (d / name).write_text(json.dumps(obj))
+    write_bundle(specmod.default_spec(), str(d))
     return str(d)
 
 
@@ -196,6 +195,35 @@ def test_operator_sends_bearer_token(native_build, bundle_dir, tmp_path):
         assert proc.returncode == 0, proc.stderr
         auths = {h.get("Authorization") for h in api.headers_seen}
         assert auths == {"Bearer sekrit-token"}
+
+
+def test_operator_https_curl_transport(native_build, bundle_dir, tmp_path):
+    """The in-cluster transport for real: HTTPS apiserver, CA verification,
+    bearer token via curl header file (never argv) — the full CurlHttps
+    path in native/operator/kubeclient.cc."""
+    cert = tmp_path / "tls.crt"
+    key = tmp_path / "tls.key"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=127.0.0.1",
+         "-addext", "subjectAltName=IP:127.0.0.1"],
+        check=True, capture_output=True)
+    tok = tmp_path / "token"
+    tok.write_text("https-sekrit\n")
+    with FakeApiServer(auto_ready=True, tls=(str(cert), str(key))) as api:
+        assert api.url.startswith("https://")
+        proc = run_operator(
+            native_build, f"--apiserver={api.url}",
+            f"--bundle-dir={bundle_dir}", f"--token-file={tok}",
+            f"--ca-file={cert}", "--once", "--poll-ms=20",
+            "--stage-timeout=20", "--status-port=0", timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        status = json.loads(proc.stdout)
+        assert status["healthy"]
+        auths = {h.get("Authorization") for h in api.headers_seen}
+        assert auths == {"Bearer https-sekrit"}
+        assert api.get(f"{DS}/tpu-device-plugin") is not None
 
 
 def test_operator_bundle_render_shape():

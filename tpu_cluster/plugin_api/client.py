@@ -54,9 +54,11 @@ class DevicePluginClient:
     def get_options(self) -> pb.DevicePluginOptions:
         return self._options(pb.Empty(), timeout=self.timeout)
 
-    def list_and_watch(self):
-        """Returns the response iterator (long-lived stream)."""
-        return self._list_and_watch(pb.Empty())
+    def list_and_watch(self, timeout=None):
+        """Returns the response iterator (long-lived stream). ``timeout``
+        bounds the whole stream — harnesses pass one so a wedged server
+        fails the run instead of hanging it."""
+        return self._list_and_watch(pb.Empty(), timeout=timeout)
 
     def get_preferred_allocation(self, available, must_include, size
                                  ) -> pb.PreferredAllocationResponse:
