@@ -87,11 +87,12 @@ def hammer_tpud(build: str, rounds: int = 20) -> None:
 
 
 def converge_operator(build: str) -> None:
-    from fake_apiserver import FakeApiServer, write_bundle
+    from fake_apiserver import FakeApiServer
     from tpu_cluster import spec as specmod
+    from tpu_cluster.render import operator_bundle
 
     bundle = tempfile.mkdtemp()
-    write_bundle(specmod.default_spec(), bundle)
+    operator_bundle.write_bundle(specmod.default_spec(), bundle)
     with FakeApiServer(auto_ready=True) as api:
         proc = subprocess.run(
             [os.path.join(build, "tpu-operator"),

@@ -20,20 +20,9 @@ simulator; gating tests leave it off and flip readiness by hand via
 from __future__ import annotations
 
 import json
-import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
-
-
-def write_bundle(spec, directory: str) -> None:
-    """Materialize the operator bundle as on-disk JSON files — shared by the
-    operator tests and the sanitizer interop harness."""
-    from tpu_cluster.render import operator_bundle
-
-    for name, obj in operator_bundle.bundle_files(spec).items():
-        with open(os.path.join(directory, name), "w", encoding="utf-8") as f:
-            f.write(json.dumps(obj))
 
 
 def merge_patch(target: Any, patch: Any) -> Any:

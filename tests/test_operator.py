@@ -7,6 +7,7 @@ import os
 import signal
 import subprocess
 import time
+import urllib.error
 import urllib.request
 
 import pytest
@@ -23,10 +24,9 @@ DS = f"/apis/apps/v1/namespaces/{NS}/daemonsets"
 
 @pytest.fixture()
 def bundle_dir(tmp_path):
-    from fake_apiserver import write_bundle
     d = tmp_path / "bundle"
     d.mkdir()
-    write_bundle(specmod.default_spec(), str(d))
+    operator_bundle.write_bundle(specmod.default_spec(), str(d))
     return str(d)
 
 
@@ -195,6 +195,40 @@ def test_operator_sends_bearer_token(native_build, bundle_dir, tmp_path):
         assert proc.returncode == 0, proc.stderr
         auths = {h.get("Authorization") for h in api.headers_seen}
         assert auths == {"Bearer sekrit-token"}
+
+
+def test_healthz_gates_on_first_convergence(native_build, bundle_dir):
+    """The operator Deployment's readinessProbe hits /healthz; it must be
+    503 until a pass converges — this is what makes `tpuctl apply
+    --operator --wait` equivalent to waiting for the whole stack."""
+    with FakeApiServer(auto_ready=False) as api:
+        op = start_operator(
+            native_build, f"--apiserver={api.url}",
+            f"--bundle-dir={bundle_dir}", "--interval=1", "--poll-ms=30",
+            "--stage-timeout=2", "--status-port=19403")
+        try:
+            def healthz():
+                try:
+                    with urllib.request.urlopen(
+                            "http://127.0.0.1:19403/healthz",
+                            timeout=5) as r:
+                        return r.status
+                except urllib.error.HTTPError as exc:
+                    return exc.code
+                except OSError:
+                    return None
+
+            assert wait_until(lambda: healthz() == 503)
+            # unblock readiness everywhere; next pass converges -> 200
+            deadline = time.time() + 30
+            while healthz() != 200 and time.time() < deadline:
+                for path in api.paths("daemonsets/"):
+                    api.set_ready(path)
+                time.sleep(0.1)
+            assert healthz() == 200
+        finally:
+            op.send_signal(signal.SIGTERM)
+            op.wait(timeout=10)
 
 
 def test_operator_https_curl_transport(native_build, bundle_dir, tmp_path):

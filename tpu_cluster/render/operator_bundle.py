@@ -58,6 +58,21 @@ def bundle_files(spec: ClusterSpec) -> Dict[str, Dict[str, Any]]:
     return out
 
 
+def write_bundle(spec: ClusterSpec, directory: str) -> List[str]:
+    """Materialize :func:`bundle_files` as on-disk JSON files — what the
+    mounted ConfigMap looks like to the operator (tests, harnesses, and
+    local operator runs share this encoding)."""
+    import os
+
+    written = []
+    for name, obj in bundle_files(spec).items():
+        path = os.path.join(directory, name)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(json.dumps(obj))
+        written.append(path)
+    return written
+
+
 def rbac(spec: ClusterSpec) -> List[Dict[str, Any]]:
     """ServiceAccount + ClusterRole + binding for the operator. Verbs are the
     reconcile set (get/create/patch, plus delete for operand replacement);
