@@ -434,3 +434,46 @@ def test_allocate_vfio_devices(native_build, tmp_path):
         c.close()
         proc.terminate()
         proc.wait(timeout=5)
+
+
+def test_tpud_survives_malformed_input(native_build, tmp_path):
+    """A device plugin parses whatever connects to its socket; garbage
+    (wrong preface, truncated/oversized frames, junk HPACK) must neither
+    crash it nor wedge service for well-formed peers."""
+    import socket
+
+    from tpu_cluster.plugin_api.client import DevicePluginClient
+
+    proc, sock_path = start_tpud(native_build, tmp_path, "--fake-devices=8",
+                                 "--no-register")
+    garbage = [
+        b"GET / HTTP/1.1\r\nHost: x\r\n\r\n",          # not HTTP/2 at all
+        b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n" + b"\xff" * 64,  # preface + junk
+        b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+        + b"\x00\x00\x04\x06\x00\x00\x00\x00\x00",      # truncated PING
+        b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+        + b"\xff\xff\xff\x00\x00\x00\x00\x00\x01",      # absurd frame length
+    ]
+    try:
+        for payload in garbage:
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.settimeout(2)
+            s.connect(sock_path)
+            s.sendall(payload)
+            try:
+                s.recv(4096)
+            except OSError:
+                pass
+            s.close()
+            assert proc.poll() is None, "tpud died on malformed input"
+        # well-formed clients still get service afterwards
+        c = DevicePluginClient(sock_path)
+        try:
+            resp = c.allocate(["tpu-0", "tpu-1", "tpu-2", "tpu-3"])
+            assert resp.container_responses[0].envs[
+                "TPU_VISIBLE_DEVICES"] == "0,1,2,3"
+        finally:
+            c.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
