@@ -9,6 +9,8 @@ import socket
 import subprocess
 import sys
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 WORKER = r"""
 import json, sys
 from tpu_cluster.workloads import multihost
@@ -28,37 +30,88 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_jax_distributed_bootstrap(tmp_path):
-    port = free_port()
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    base_env = {
-        **os.environ,
-        "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
-        "PALLAS_AXON_POOL_IPS": "",       # force local CPU backend
-        "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
-        # what the rendered Indexed Job injects (render/jobs.py): the
-        # headless-Service DNS names become localhost in this harness
-        "TPU_WORKER_HOSTNAMES": "127.0.0.1,127.0.0.1",
-        "TPU_COORDINATOR_PORT": str(port),
-    }
-    procs = []
-    for idx in range(2):
-        env = {**base_env, "JOB_COMPLETION_INDEX": str(idx)}
-        env.pop("TPU_WORKER_ID", None)
-        procs.append(subprocess.Popen(
-            [sys.executable, "-c", WORKER], env=env, cwd=repo,
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
-    results = []
-    for idx, proc in enumerate(procs):
-        out, err = proc.communicate(timeout=120)
-        assert proc.returncode == 0, f"worker {idx} failed:\n{err[-2000:]}"
-        results.append(json.loads(out.splitlines()[-1]))
+def run_two_workers(argv, attempts=2, timeout=180):
+    """Launch two workers with the Indexed-Job env contract; returns
+    [(rc, stdout, stderr), ...]. The coordinator port comes from free_port(),
+    which can race the rest of the suite — retry with a fresh port when the
+    failure smells like a bind conflict."""
+    last = None
+    for _ in range(attempts):
+        port = free_port()
+        base_env = {
+            **os.environ,
+            "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+            "PALLAS_AXON_POOL_IPS": "",       # force local CPU backend
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            # what the rendered Indexed Job injects (render/jobs.py): the
+            # headless-Service DNS names become localhost in this harness
+            "TPU_WORKER_HOSTNAMES": "127.0.0.1,127.0.0.1",
+            "TPU_COORDINATOR_PORT": str(port),
+        }
+        procs = []
+        for idx in range(2):
+            env = {**base_env, "JOB_COMPLETION_INDEX": str(idx)}
+            env.pop("TPU_WORKER_ID", None)
+            procs.append(subprocess.Popen(
+                argv, env=env, cwd=REPO, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True))
+        results = []
+        try:
+            for proc in procs:
+                out, err = proc.communicate(timeout=timeout)
+                results.append((proc.returncode, out, err, port))
+        finally:
+            # a hung handshake must not leak live workers (and the bound
+            # coordinator port) into the rest of the suite
+            for proc in procs:
+                if proc.poll() is None:
+                    proc.kill()
+                    proc.wait(timeout=10)
+        if all(r[0] == 0 for r in results):
+            return results
+        last = results
+        bind_race = any("address already in use" in r[2].lower()
+                        or "eaddrinuse" in r[2].lower() for r in results)
+        if not bind_race:
+            break  # a real failure, not a port race
+    return last
 
-    assert {r["process_index"] for r in results} == {0, 1}
-    for idx, r in enumerate(results):
+
+def test_two_process_jax_distributed_bootstrap():
+    results = run_two_workers([sys.executable, "-c", WORKER])
+    parsed = []
+    for idx, (rc, out, err, port) in enumerate(results):
+        assert rc == 0, f"worker {idx} failed:\n{err[-2000:]}"
+        parsed.append((json.loads(out.splitlines()[-1]), port))
+
+    assert {r["process_index"] for r, _ in parsed} == {0, 1}
+    for idx, (r, port) in enumerate(parsed):
         assert r["process_count"] == 2
         assert r["plan"]["multihost"] is True
         assert r["plan"]["num_processes"] == 2
         assert r["plan"]["process_id"] == idx
         assert r["plan"]["coordinator_address"] == f"127.0.0.1:{port}"
+
+
+def test_two_process_global_psum_via_validate_job():
+    """BASELINE config 5, 2-node case, end to end: both workers run the
+    SAME entry point the rendered Job uses (validate --mode=psum) and the
+    all-reduce spans every device of both processes."""
+    results = run_two_workers(
+        [sys.executable, "-m", "tpu_cluster.workloads.validate",
+         "--mode=psum"])
+    for idx, (rc, out, err, _) in enumerate(results):
+        assert rc == 0, f"worker {idx} failed:\n{err[-2000:]}"
+        doc = json.loads(out[out.index("{"):])
+        assert doc["ok"], doc
+        # the full collective matrix runs across both processes...
+        assert doc["devices"] == 8
+        for key in ("psum_ok", "all_gather_ok", "reduce_scatter_ok",
+                    "ppermute_ok"):
+            assert doc[key] is True, (key, doc)
+        # ...plus the dedicated global all-reduce acceptance check
+        gp = doc["global_psum"]
+        assert gp["ok"] and gp["processes"] == 2
+        assert gp["total"] == 28.0  # sum(0..7) across both processes
+        assert doc["bootstrap"]["process_id"] == idx

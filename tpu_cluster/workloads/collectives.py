@@ -54,6 +54,41 @@ def psum_check(n_devices: int = 0, elems_per_device: int = 1 << 16) -> Dict[str,
     return {"check": "psum", "devices": n, "expected": expect, "ok": ok}
 
 
+def global_psum_check(elems: int = 0) -> Dict[str, Any]:
+    """Multi-controller all-reduce across EVERY process's devices — the DCN
+    half of BASELINE config 5 (2-node NCCL all-reduce analog).
+
+    Unlike :func:`psum_check`, no host array is device_put onto a global
+    sharding (illegal across processes); the sharded operand is created
+    inside jit via with_sharding_constraint, and the full reduction forces
+    XLA to emit the cross-process collective (ICI within a host, DCN/gloo
+    across hosts). Every process must see the same total.
+    """
+    devs = jax.devices()  # global device list in multi-controller JAX
+    n = len(devs)
+    size = elems or n
+    mesh = Mesh(np.array(devs), ("chips",))
+
+    @jax.jit
+    def reduce_all():
+        x = jax.lax.with_sharding_constraint(
+            jnp.arange(size, dtype=jnp.float32),
+            NamedSharding(mesh, P("chips")))
+        return jnp.sum(x)
+
+    total = float(reduce_all())
+    expect = float(size * (size - 1) / 2)
+    return {
+        "check": "global_psum",
+        "devices": n,
+        "processes": jax.process_count(),
+        "process_index": jax.process_index(),
+        "expected": expect,
+        "total": total,
+        "ok": total == expect,
+    }
+
+
 def allreduce_bandwidth(n_devices: int = 0, mib: int = 64,
                         iters: int = 10) -> Dict[str, Any]:
     """Measured all-reduce bus bandwidth per device (NCCL-tests busbw analog):

