@@ -197,6 +197,42 @@ def test_operator_sends_bearer_token(native_build, bundle_dir, tmp_path):
         assert auths == {"Bearer sekrit-token"}
 
 
+def test_corrupt_bundle_reload_keeps_last_good(native_build, bundle_dir):
+    """A bad ConfigMap render (truncated/garbage JSON) must not take the
+    operator down or wipe the running stack: the reload fails loudly and
+    the previous bundle keeps reconciling."""
+    with FakeApiServer(auto_ready=True) as api:
+        op = start_operator(
+            native_build, f"--apiserver={api.url}",
+            f"--bundle-dir={bundle_dir}", "--interval=1", "--poll-ms=20",
+            "--stage-timeout=10", "--status-port=0")
+        try:
+            assert wait_until(
+                lambda: api.get(f"{DS}/tpu-device-plugin") is not None)
+            # corrupt one manifest atomically (same shape as a bad render)
+            path = os.path.join(bundle_dir,
+                                [f for f in os.listdir(bundle_dir)
+                                 if "device-plugin" in f][0])
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write("{definitely not json")
+            os.replace(tmp, path)
+            # drift repair still works off the last good bundle
+            api.delete(f"{DS}/tpu-device-plugin")
+            assert wait_until(
+                lambda: api.get(f"{DS}/tpu-device-plugin") is not None,
+                timeout=20)
+            assert op.poll() is None  # daemon alive
+        finally:
+            op.send_signal(signal.SIGTERM)
+            try:
+                op.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                op.kill()
+            stderr = op.stderr.read()
+            assert "bundle reload failed" in stderr, stderr[-1000:]
+
+
 def test_healthz_gates_on_first_convergence(native_build, bundle_dir):
     """The operator Deployment's readinessProbe hits /healthz; it must be
     503 until a pass converges — this is what makes `tpuctl apply
