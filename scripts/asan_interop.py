@@ -107,11 +107,78 @@ def converge_operator(build: str) -> None:
     print("tpu-operator --once: clean, converged")
 
 
+def hammer_exporter(build: str) -> None:
+    """Exporter HTTP surface: metrics/status/healthz plus garbage requests."""
+    import socket
+    import urllib.error
+    import urllib.request
+
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    metrics = os.path.join(tempfile.mkdtemp(), "metrics.prom")
+    with open(metrics, "w", encoding="utf-8") as f:
+        f.write("tpu_custom_gauge 7\nevil 666\n")
+    proc = subprocess.Popen(
+        [os.path.join(build, "tpu-metrics-exporter"), f"--port={port}",
+         "--fake-devices=8", "--status-mode", f"--metrics-file={metrics}",
+         "--libtpu-path=/nonexistent", "--expect-chips=8"],
+        stderr=subprocess.PIPE, text=True)
+    try:
+        body = ""
+        for _ in range(100):
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics", timeout=2) as r:
+                    body = r.read().decode()
+                break
+            except OSError:
+                time.sleep(0.1)
+        assert "tpu_chips_total 8" in body and "evil" not in body, body[:400]
+        for path in ("/status", "/healthz", "/bogus"):
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=2).read()
+            except urllib.error.HTTPError:
+                pass  # 503 from unhealthy status-mode is expected
+        s = socket.create_connection(("127.0.0.1", port), timeout=2)
+        s.sendall(b"\x00\xff garbage not http\r\n\r\n")
+        s.close()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=2) as r:
+            assert b"tpu_chips_total" in r.read()
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+    check_clean("tpu-metrics-exporter", proc.stderr.read())
+    print("exporter hammer: clean")
+
+
+def probe_tpu_info(build: str) -> None:
+    for flag in ("", "--json", "--oneline"):
+        argv = [os.path.join(build, "tpu-info"), "--fake-devices=8"]
+        if flag:
+            argv.append(flag)
+        proc = subprocess.run(argv, capture_output=True, text=True,
+                              timeout=30)
+        check_clean("tpu-info", proc.stderr)
+        if proc.returncode != 0:
+            print(f"tpu-info {flag} rc={proc.returncode}", file=sys.stderr)
+            raise SystemExit(1)
+    print("tpu-info probes: clean")
+
+
 def main() -> int:
     build = sys.argv[1] if len(sys.argv) > 1 else \
         os.path.join(REPO, "native", "build-asan")
     hammer_tpud(build)
     converge_operator(build)
+    hammer_exporter(build)
+    probe_tpu_info(build)
     return 0
 
 
