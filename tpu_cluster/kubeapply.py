@@ -125,6 +125,11 @@ class Client:
             except ValueError:
                 parsed = {"message": payload.decode(errors="replace")[:200]}
             return exc.code, parsed
+        except (urllib.error.URLError, OSError, TimeoutError) as exc:
+            # Transport failure (refused/reset/DNS/TLS/timeout): status 0,
+            # like the C++ twin's Response.error — wait_ready retries it,
+            # apply() turns it into a clean ApplyError.
+            return 0, {"message": f"transport error: {exc}"}
 
     def get(self, path: str):
         return self._request("GET", path)
@@ -132,7 +137,10 @@ class Client:
     def apply(self, obj: Dict[str, Any]) -> str:
         """Create-or-patch one object; returns 'created' | 'patched'."""
         path = object_path(obj)
-        code, _ = self.get(path)
+        code, resp = self.get(path)
+        if code == 0:
+            raise ApplyError(f"GET {path}: {resp.get('message', 'transport '
+                                                      'failure')}")
         if code == 404:
             code, resp = self._request("POST", collection_path(obj), obj)
             if code not in (200, 201, 202):
