@@ -1,28 +1,15 @@
 """Test bootstrap: force an 8-device virtual CPU mesh.
 
-SURVEY.md §4 point 5: JAX supports clusterless multi-chip simulation via
---xla_force_host_platform_device_count; every workload/collective test runs on
-this virtual v5e-8-shaped mesh and the identical code path runs on real chips.
-
-Note: on this machine a sitecustomize may import JAX at interpreter start (to
-register a TPU plugin), so setting JAX_PLATFORMS in os.environ here is too
-late — jax.config.update is the reliable override. XLA_FLAGS is still read
-lazily at CPU-client creation, so setting it here works as long as no test ran
-a computation first.
+The forcing recipe (sitecustomize-safe platform override + host-platform
+device count) lives in tpu_cluster.virtualmesh — shared with the driver's
+``__graft_entry__.dryrun_multichip`` so the two cannot drift.
 """
 
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpu_cluster.virtualmesh import force_virtual_cpu_mesh  # noqa: E402
+
+force_virtual_cpu_mesh(8)

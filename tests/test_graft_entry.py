@@ -1,0 +1,76 @@
+"""Driver-contract regression tests for __graft_entry__.
+
+Round 1's only red driver artifact was ``MULTICHIP_r01.json``:
+``dryrun_multichip(8)`` queried ``jax.devices()`` without forcing the virtual
+CPU mesh and died with "need 8 devices, have 1" when the driver ran it with no
+env prefix. These tests run the entry point in a bare subprocess (no
+JAX_PLATFORMS / XLA_FLAGS / tunneled-TPU registration) to pin the fix.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _bare_env():
+    """Driver-like env: no mesh forcing, no tunneled-TPU registration."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    return env
+
+
+def _run(code, timeout=300):
+    return subprocess.run(
+        [sys.executable, "-c", code], cwd=REPO, env=_bare_env(),
+        capture_output=True, text=True, timeout=timeout,
+    )
+
+
+def test_dryrun_multichip_bare_subprocess():
+    proc = _run(
+        "import __graft_entry__ as g\n"
+        "g.dryrun_multichip(8)\n"
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "mesh(data=2, model=4)" in proc.stdout
+    assert "OK" in proc.stdout
+
+
+def test_dryrun_restores_process_state():
+    # dryrun forces the virtual CPU mesh; afterwards the process must be able
+    # to do unrelated JAX work on the default platform at the default size.
+    proc = _run(
+        "import os, jax, jax.numpy as jnp\n"
+        "import __graft_entry__ as g\n"
+        "g.dryrun_multichip(8)\n"
+        "assert os.environ.get('JAX_PLATFORMS') is None, os.environ\n"
+        "assert 'xla_force_host_platform' not in"
+        " os.environ.get('XLA_FLAGS', ''), os.environ\n"
+        "assert jax.config.jax_num_cpu_devices == -1\n"
+        # NB: len(jax.devices('cpu')) may stay 8 — XLA parses XLA_FLAGS once
+        # per process (C++ layer), so the client size itself cannot shrink
+        # back; the restored env/config only govern future processes.
+
+        "fn, args = g.entry()\n"
+        "out = jax.jit(fn)(*args)\n"
+        "jax.block_until_ready(out)\n"
+        "print('post-dryrun platform:',"
+        " list(out.devices())[0].platform)\n"
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "post-dryrun platform: cpu" in proc.stdout  # bare env ⇒ cpu default
+
+
+def test_dryrun_repeat_and_growth():
+    proc = _run(
+        "import __graft_entry__ as g\n"
+        "g.dryrun_multichip(4)\n"
+        "g.dryrun_multichip(8)\n"
+        "g.dryrun_multichip(8)\n"
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stdout.count("OK") == 3

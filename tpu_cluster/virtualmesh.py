@@ -1,0 +1,128 @@
+"""Force a virtual N-device CPU mesh for clusterless multi-chip testing.
+
+SURVEY.md §4 point 5: JAX supports clusterless multi-chip simulation via
+``--xla_force_host_platform_device_count``; the test suite and the driver's
+``dryrun_multichip`` entry point both run sharded code on this virtual
+v5e-8-shaped mesh, and the identical code path runs on real chips.
+
+Single source of truth for the forcing recipe — tests/conftest.py and
+__graft_entry__.py both use this module so the subtle sitecustomize
+workaround cannot drift between them. Callers that need the process usable
+for real-device work afterwards should use the :func:`virtual_cpu_mesh`
+context manager; :func:`force_virtual_cpu_mesh` is the permanent,
+process-wide variant (what conftest wants).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import re
+
+# Env vars force_virtual_cpu_mesh mutates; virtual_cpu_mesh restores exactly
+# this set. Keep the two in sync by keeping both in this module.
+_ENV_KEYS = ("JAX_PLATFORMS", "XLA_FLAGS", "PALLAS_AXON_POOL_IPS")
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def force_virtual_cpu_mesh(n_devices: int) -> list:
+    """Return ``n_devices`` virtual CPU devices, forcing the platform to CPU.
+
+    Environment quirk this handles: a machine-level sitecustomize may import
+    JAX at interpreter start and register a tunneled TPU platform (gated on
+    ``PALLAS_AXON_POOL_IPS``), so env vars alone are too late —
+    ``jax.config.update("jax_platforms", "cpu")`` is the reliable override.
+    The env vars are still written so *subprocesses* spawned under the forced
+    environment (e.g. the two-process jax.distributed tests) inherit the
+    virtual mesh. The CPU device count is pinned when the CPU client is first
+    created; if a backend already exists (e.g. a TPU computation ran first in
+    this process) the cached backends are discarded so the client is rebuilt
+    at the new size. The count only ever grows — a smaller request reuses the
+    larger existing mesh.
+    """
+    import jax
+
+    from jax._src import xla_bridge
+
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    # One target count feeds both mechanisms (the config wins in-process on
+    # this JAX version; the flag is what subprocesses inherit): the max of
+    # the request, any count already in XLA_FLAGS, and the current config.
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(_COUNT_FLAG + r"=(\d+)", flags)
+    target = max(n_devices, int(m.group(1)) if m else 0,
+                 jax.config.jax_num_cpu_devices)
+    want = f"{_COUNT_FLAG}={target}"
+    if m:
+        flags = re.sub(_COUNT_FLAG + r"=\d+", want, flags)
+    else:
+        flags = (flags + " " + want).strip()
+    os.environ["XLA_FLAGS"] = flags
+
+    if xla_bridge.backends_are_initialized():
+        # jax_num_cpu_devices rejects updates after init; clear first.
+        clear_backend_caches()
+    if jax.config.jax_num_cpu_devices < target:
+        jax.config.update("jax_num_cpu_devices", target)
+    jax.config.update("jax_platforms", "cpu")
+    devices = jax.devices("cpu")
+    if len(devices) < n_devices:
+        raise RuntimeError(
+            f"need {n_devices} CPU devices, have {len(devices)}: the CPU "
+            f"client pre-dates this call and could not be rebuilt at the new "
+            f"size"
+        )
+    return devices
+
+
+@contextlib.contextmanager
+def virtual_cpu_mesh(n_devices: int):
+    """Context manager: forced virtual CPU mesh inside, state restored after.
+
+    Snapshots every process-global force_virtual_cpu_mesh mutates (env vars,
+    ``jax_platforms``, ``jax_num_cpu_devices``) and restores them on exit —
+    including on a failed force — then discards cached backends so the next
+    JAX op re-resolves the default platform (e.g. back to a real TPU).
+
+    Residual: a rebuilt CPU client may keep the forced device count — XLA
+    parses XLA_FLAGS once per process in the C++ layer — so only the default
+    *platform* is fully restored in-process; the env restore governs
+    subprocesses.
+    """
+    import jax
+
+    saved_env = {k: os.environ.get(k) for k in _ENV_KEYS}
+    saved_platforms = jax.config.jax_platforms
+    saved_num_cpu = jax.config.jax_num_cpu_devices
+    try:
+        yield force_virtual_cpu_mesh(n_devices)
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        clear_backend_caches()
+        jax.config.update("jax_platforms", saved_platforms)
+        jax.config.update("jax_num_cpu_devices", saved_num_cpu)
+
+
+def clear_backend_caches() -> None:
+    """Discard every cached JAX backend so the next op re-resolves platforms.
+
+    ``xla_bridge._clear_backends()`` alone is insufficient: ``get_backend``,
+    ``local_devices`` and friends are memoized separately (``util.cache``),
+    and a stale entry keeps serving the old client — observed on jax 0.9.0 as
+    arrays landing on CPU even after ``jax.devices()`` re-resolves to TPU.
+    ``jax.clear_caches()`` flushes every util.cache (including those), at the
+    cost of retracing.
+    """
+    import jax
+
+    from jax._src import xla_bridge
+
+    xla_bridge._clear_backends()
+    jax.clear_caches()

@@ -7,9 +7,10 @@ NOT implement collectives — XLA does — its job is to lay the computation out
 a Mesh so the collective rides ICI, and to verify correctness + measure
 bandwidth.
 
-Clusterless testing: run under ``XLA_FLAGS=--xla_force_host_platform_device_count=8
-JAX_PLATFORMS=cpu`` (SURVEY.md §4 point 5); the same code path runs on real
-chips unchanged.
+Clusterless testing: call ``tpu_cluster.virtualmesh.force_virtual_cpu_mesh(8)``
+before any computation (SURVEY.md §4 point 5) — raw env vars are too late on
+machines whose sitecustomize imports JAX at interpreter start. The same code
+path runs on real chips unchanged.
 """
 
 from __future__ import annotations
