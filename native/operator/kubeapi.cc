@@ -68,9 +68,26 @@ std::string ObjectPath(const minijson::Value& obj, std::string* err) {
 
 bool IsReady(const minijson::Value& obj) {
   std::string kind = obj.PathString("kind");
+  // Upgrade semantics (kubectl `rollout status` parity, mirrored in
+  // kubeapply.is_ready): when the object carries metadata.generation, a
+  // status from an older generation must not satisfy the gate — on a
+  // re-reconcile that PATCHes an existing DaemonSet/Deployment the old pods
+  // are still Ready, so without the observedGeneration and updated-count
+  // checks the stage gate would pass before the new pods roll. Objects
+  // without generation tracking keep the plain count rules.
+  double generation = obj.PathNumber("metadata.generation", -1);
+  bool tracked = generation >= 0;
+  if (tracked && (kind == "DaemonSet" || kind == "Deployment") &&
+      obj.PathNumber("status.observedGeneration", 0) < generation) {
+    return false;
+  }
   if (kind == "DaemonSet") {
     double desired = obj.PathNumber("status.desiredNumberScheduled", -1);
     double ready = obj.PathNumber("status.numberReady", -2);
+    if (tracked &&
+        obj.PathNumber("status.updatedNumberScheduled", 0) < desired) {
+      return false;
+    }
     // A DaemonSet with nothing scheduled yet (desired 0 or missing status)
     // is NOT ready: on a real cluster desired becomes >0 once nodes match;
     // treating 0==0 as ready would open the gate before pods even exist.
@@ -80,6 +97,9 @@ bool IsReady(const minijson::Value& obj) {
   }
   if (kind == "Deployment") {
     double want = obj.PathNumber("spec.replicas", 1);
+    if (tracked && obj.PathNumber("status.updatedReplicas", 0) < want) {
+      return false;
+    }
     // Missing readyReplicas means zero ready pods — which satisfies a
     // deliberately scaled-to-zero Deployment (replicas: 0) immediately.
     double ready = obj.PathNumber("status.readyReplicas", 0);

@@ -197,7 +197,16 @@ Response PlainHttp(const Config& cfg, const Url& url,
     resp.error = "malformed HTTP response";
     return resp;
   }
-  resp.status = atoi(raw.c_str() + raw.find(' ') + 1);
+  // The status code sits after the first space WITHIN the status line; a
+  // truncated/malformed reply without one must be a loud parse error, not
+  // atoi("HTTP/...") (find() past the line would wrap npos+1 to 0).
+  size_t line_end = raw.find("\r\n");
+  size_t sp = raw.find(' ');
+  if (sp == std::string::npos || sp > line_end) {
+    resp.error = "malformed HTTP status line";
+    return resp;
+  }
+  resp.status = atoi(raw.c_str() + sp + 1);
   std::string headers = raw.substr(0, hdr_end);
   resp.body = raw.substr(hdr_end + 4);
   // Connection: close => body runs to EOF, but honor chunked encoding from
@@ -206,13 +215,27 @@ Response PlainHttp(const Config& cfg, const Url& url,
   if (headers.find("transfer-encoding: chunked") != std::string::npos) {
     std::string decoded;
     size_t pos = 0;
+    bool terminated = false;
     while (pos < resp.body.size()) {
       size_t nl = resp.body.find("\r\n", pos);
       if (nl == std::string::npos) break;
       long chunk = strtol(resp.body.c_str() + pos, nullptr, 16);
-      if (chunk <= 0) break;
+      if (chunk <= 0) {
+        terminated = chunk == 0;
+        break;
+      }
+      if (nl + 2 + chunk > resp.body.size()) break;  // truncated data
       decoded += resp.body.substr(nl + 2, chunk);
       pos = nl + 2 + chunk + 2;
+    }
+    if (!terminated) {
+      // A chunked body that ends without the 0-length chunk was cut off
+      // mid-stream; silently returning the prefix would hand truncated JSON
+      // to the reconciler.
+      resp.status = 0;
+      resp.body.clear();
+      resp.error = "truncated chunked HTTP body";
+      return resp;
     }
     resp.body = decoded;
   }
@@ -225,6 +248,12 @@ Response CurlHttps(const Config& cfg, const std::string& method,
                    const std::string& url, const std::string& body,
                    const std::string& content_type) {
   Response resp;
+  if (cfg.ca_file.empty() && !cfg.insecure_skip_tls_verify) {
+    resp.error =
+        "refusing unverified https to " + cfg.base_url +
+        ": no CA file; pass --ca-file or --insecure-skip-tls-verify";
+    return resp;
+  }
   char body_path[] = "/tmp/tpuop-body-XXXXXX";
   int body_fd = -1;
   if (!body.empty()) {
@@ -261,10 +290,19 @@ Response CurlHttps(const Config& cfg, const std::string& method,
   };
   if (hdr_fd >= 0)
     args.insert(args.end(), {"-H", std::string("@") + hdr_path});
-  if (!cfg.ca_file.empty())
+  if (!cfg.ca_file.empty()) {
     args.insert(args.end(), {"--cacert", cfg.ca_file});
-  else
+  } else {
+    // Reachable only with insecure_skip_tls_verify (gated at entry above).
+    static bool warned = false;
+    if (!warned) {
+      warned = true;
+      fprintf(stderr,
+              "kubeclient: WARNING: TLS verification DISABLED for %s "
+              "(insecure-skip-tls-verify)\n", cfg.base_url.c_str());
+    }
     args.push_back("-k");
+  }
   if (!body.empty()) {
     args.insert(args.end(), {"-H", "Content-Type: " + content_type,
                              "--data-binary", std::string("@") + body_path});
@@ -341,10 +379,15 @@ bool Config::InCluster(Config* out) {
     out->ca_file = ca;
   } else {
     // Never downgrade to unverified TLS silently — a missing projected CA
-    // is a misconfiguration worth shouting about.
+    // is a misconfiguration worth shouting about. Requests will FAIL until
+    // the projection is fixed or the operand is deployed with the explicit
+    // --insecure-skip-tls-verify flag (set by the caller, never here: the
+    // in-cluster path is exactly where the ServiceAccount token the check
+    // protects lives).
     fprintf(stderr,
-            "kubeclient: WARNING: %s unreadable; apiserver TLS will NOT be "
-            "verified (curl -k)\n", ca.c_str());
+            "kubeclient: WARNING: %s unreadable; https requests will fail "
+            "until the CA projection is fixed (or the operand is run with "
+            "--insecure-skip-tls-verify)\n", ca.c_str());
   }
   return true;
 }

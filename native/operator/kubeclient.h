@@ -26,7 +26,12 @@ struct Response {
 struct Config {
   std::string base_url;     // e.g. https://10.96.0.1:443 or http://127.0.0.1:8001
   std::string token;        // bearer token ("" = none)
-  std::string ca_file;      // CA bundle for https ("" = curl -k)
+  std::string ca_file;      // CA bundle for https
+  // Without a ca_file, https requests FAIL unless this is set (sending a
+  // ServiceAccount token over unverified TLS would hand cluster-admin-ish
+  // credentials to any MITM). InCluster() sets it, loudly, when the
+  // projected CA is unreadable; the CLI path requires the explicit flag.
+  bool insecure_skip_tls_verify = false;
   int timeout_ms = 10000;
 
   // In-cluster defaults: KUBERNETES_SERVICE_HOST/PORT env + the mounted

@@ -55,6 +55,7 @@ struct Options {
   int status_port = 9402;    // 0 = disabled
   bool once = false;
   bool allow_empty_daemonsets = false;
+  bool insecure_skip_tls_verify = false;
 };
 
 struct BundleObject {
@@ -433,13 +434,17 @@ int main(int argc, char** argv) {
       opt.allow_empty_daemonsets = true;
       continue;
     }
+    if (strcmp(a, "--insecure-skip-tls-verify") == 0) {
+      opt.insecure_skip_tls_verify = true;
+      continue;
+    }
     fprintf(stderr,
             "tpu-operator: unknown flag %s\n"
             "usage: tpu-operator [--apiserver=URL] [--token-file=F] "
             "[--ca-file=F]\n"
             "  [--bundle-dir=DIR] [--interval=SECS] [--stage-timeout=SECS]\n"
             "  [--poll-ms=MS] [--status-port=PORT] [--once]\n"
-            "  [--allow-empty-daemonsets]\n",
+            "  [--allow-empty-daemonsets] [--insecure-skip-tls-verify]\n",
             a);
     return 2;
   }
@@ -455,6 +460,10 @@ int main(int argc, char** argv) {
             "tpu-operator: not in-cluster and no --apiserver given\n");
     return 2;
   }
+  // The explicit flag is the ONLY opt-in to unverified TLS — in-cluster too
+  // (a broken CA projection must fail requests, not silently downgrade the
+  // transport carrying the ServiceAccount token).
+  cfg.insecure_skip_tls_verify = opt.insecure_skip_tls_verify;
 
   signal(SIGINT, HandleSignal);
   signal(SIGTERM, HandleSignal);

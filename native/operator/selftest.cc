@@ -118,6 +118,40 @@ static void TestReadiness() {
       "{\"kind\": \"Job\", \"status\": {\"succeeded\": 1}}")));
   CHECK(!kubeapi::IsReady(*Obj("{\"kind\": \"Job\", \"status\": {}}")));
   CHECK(kubeapi::IsReady(*Obj("{\"kind\": \"ConfigMap\"}")));
+
+  // Upgrade semantics (kubectl rollout status parity): with generation
+  // tracking, old-generation status or lagging updated counts gate readiness
+  // even while the previous pods are still Ready.
+  CHECK(!kubeapi::IsReady(*Obj(
+      "{\"kind\": \"DaemonSet\", \"metadata\": {\"generation\": 2},"
+      " \"status\": {\"observedGeneration\": 1,"
+      " \"desiredNumberScheduled\": 2, \"numberReady\": 2,"
+      " \"updatedNumberScheduled\": 2}}")));
+  CHECK(!kubeapi::IsReady(*Obj(
+      "{\"kind\": \"DaemonSet\", \"metadata\": {\"generation\": 2},"
+      " \"status\": {\"observedGeneration\": 2,"
+      " \"desiredNumberScheduled\": 2, \"numberReady\": 2,"
+      " \"updatedNumberScheduled\": 1}}")));
+  CHECK(kubeapi::IsReady(*Obj(
+      "{\"kind\": \"DaemonSet\", \"metadata\": {\"generation\": 2},"
+      " \"status\": {\"observedGeneration\": 2,"
+      " \"desiredNumberScheduled\": 2, \"numberReady\": 2,"
+      " \"updatedNumberScheduled\": 2}}")));
+  CHECK(!kubeapi::IsReady(*Obj(
+      "{\"kind\": \"Deployment\", \"metadata\": {\"generation\": 3},"
+      " \"spec\": {\"replicas\": 2},"
+      " \"status\": {\"observedGeneration\": 2, \"readyReplicas\": 2,"
+      " \"updatedReplicas\": 2}}")));
+  CHECK(!kubeapi::IsReady(*Obj(
+      "{\"kind\": \"Deployment\", \"metadata\": {\"generation\": 3},"
+      " \"spec\": {\"replicas\": 2},"
+      " \"status\": {\"observedGeneration\": 3, \"readyReplicas\": 2,"
+      " \"updatedReplicas\": 1}}")));
+  CHECK(kubeapi::IsReady(*Obj(
+      "{\"kind\": \"Deployment\", \"metadata\": {\"generation\": 3},"
+      " \"spec\": {\"replicas\": 2},"
+      " \"status\": {\"observedGeneration\": 3, \"readyReplicas\": 2,"
+      " \"updatedReplicas\": 2}}")));
 }
 
 int main() {

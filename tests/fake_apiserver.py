@@ -40,16 +40,36 @@ def merge_patch(target: Any, patch: Any) -> Any:
     return out
 
 
+GENERATION_KINDS = ("DaemonSet", "Deployment")
+
+
 def ready_status(obj: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     kind = obj.get("kind")
+    gen = obj.get("metadata", {}).get("generation", 1)
     if kind == "DaemonSet":
-        return {"desiredNumberScheduled": 2, "numberReady": 2}
+        return {"desiredNumberScheduled": 2, "numberReady": 2,
+                "updatedNumberScheduled": 2, "observedGeneration": gen}
     if kind == "Deployment":
         want = obj.get("spec", {}).get("replicas", 1)
-        return {"readyReplicas": want, "availableReplicas": want}
+        return {"readyReplicas": want, "availableReplicas": want,
+                "updatedReplicas": want, "observedGeneration": gen}
     if kind == "Job":
         return {"succeeded": obj.get("spec", {}).get("completions", 1)}
     return None
+
+
+def make_self_signed(tmp_dir) -> Tuple[str, str]:
+    """Generate a 127.0.0.1 self-signed cert+key pair for TLS-mode tests."""
+    import subprocess
+    cert = f"{tmp_dir}/tls.crt"
+    key = f"{tmp_dir}/tls.key"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", cert, "-days", "1",
+         "-subj", "/CN=127.0.0.1",
+         "-addext", "subjectAltName=IP:127.0.0.1"],
+        check=True, capture_output=True)
+    return cert, key
 
 
 class FakeApiServer:
@@ -114,6 +134,10 @@ class FakeApiServer:
                         self._reply(409, {"kind": "Status", "code": 409,
                                           "reason": "AlreadyExists"})
                         return
+                    if obj.get("kind") in GENERATION_KINDS:
+                        obj = dict(obj)
+                        obj["metadata"] = dict(obj.get("metadata") or {})
+                        obj["metadata"]["generation"] = 1
                     if fake.auto_ready:
                         st = ready_status(obj)
                         if st:
@@ -140,7 +164,20 @@ class FakeApiServer:
                         self._reply(404, {"kind": "Status", "code": 404})
                         return
                     merged = merge_patch(cur, patch)
-                    if fake.auto_ready and "status" not in merged:
+                    # A spec change bumps metadata.generation (apiserver
+                    # behavior); the stored status keeps the old
+                    # observedGeneration until "the controller" catches up.
+                    if (merged.get("kind") in GENERATION_KINDS
+                            and isinstance(patch, dict) and "spec" in patch
+                            and merged.get("spec") != cur.get("spec")):
+                        merged["metadata"] = dict(merged.get("metadata") or {})
+                        merged["metadata"]["generation"] = \
+                            cur.get("metadata", {}).get("generation", 1) + 1
+                    if fake.auto_ready and not (isinstance(patch, dict)
+                                                and "status" in patch):
+                        # auto_ready simulates an instantly-converging
+                        # cluster: refresh status to the (possibly bumped)
+                        # generation unless the patch set status itself.
                         st = ready_status(merged)
                         if st:
                             merged["status"] = st

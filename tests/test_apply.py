@@ -57,6 +57,85 @@ def test_readiness_rules_match_cpp(spec):
                                "status": {}})
     assert not kubeapply.is_ready({"kind": "Job", "status": {}})
     assert kubeapply.is_ready({"kind": "ConfigMap"})
+    # Upgrade semantics (same goldens as selftest.cc TestReadiness): with
+    # generation tracking, old-generation status or lagging updated counts
+    # gate readiness even while the previous pods are still Ready.
+    assert not kubeapply.is_ready(
+        {"kind": "DaemonSet", "metadata": {"generation": 2},
+         "status": {"observedGeneration": 1, "desiredNumberScheduled": 2,
+                    "numberReady": 2, "updatedNumberScheduled": 2}})
+    assert not kubeapply.is_ready(
+        {"kind": "DaemonSet", "metadata": {"generation": 2},
+         "status": {"observedGeneration": 2, "desiredNumberScheduled": 2,
+                    "numberReady": 2, "updatedNumberScheduled": 1}})
+    assert kubeapply.is_ready(
+        {"kind": "DaemonSet", "metadata": {"generation": 2},
+         "status": {"observedGeneration": 2, "desiredNumberScheduled": 2,
+                    "numberReady": 2, "updatedNumberScheduled": 2}})
+    assert not kubeapply.is_ready(
+        {"kind": "Deployment", "metadata": {"generation": 3},
+         "spec": {"replicas": 2},
+         "status": {"observedGeneration": 2, "readyReplicas": 2,
+                    "updatedReplicas": 2}})
+    assert not kubeapply.is_ready(
+        {"kind": "Deployment", "metadata": {"generation": 3},
+         "spec": {"replicas": 2},
+         "status": {"observedGeneration": 3, "readyReplicas": 2,
+                    "updatedReplicas": 1}})
+    assert kubeapply.is_ready(
+        {"kind": "Deployment", "metadata": {"generation": 3},
+         "spec": {"replicas": 2},
+         "status": {"observedGeneration": 3, "readyReplicas": 2,
+                    "updatedReplicas": 2}})
+
+
+def test_client_refuses_unverified_https(tmp_path):
+    """ADVICE round-1 medium finding (Python twin): https without a CA file
+    must raise unless insecure_skip_tls_verify is explicitly set."""
+    from fake_apiserver import make_self_signed
+    cert, _key = make_self_signed(tmp_path)
+    with FakeApiServer(auto_ready=True,
+                       tls=(cert, str(tmp_path / "tls.key"))) as api:
+        with pytest.raises(kubeapply.ApplyError,
+                           match="refusing unverified https"):
+            kubeapply.Client(api.url).get("/api/v1/namespaces/x")
+        code, _ = kubeapply.Client(api.url, ca_file=cert).get(
+            "/api/v1/namespaces/x")
+        assert code == 404  # verified TLS, empty store
+        code, _ = kubeapply.Client(
+            api.url, insecure_skip_tls_verify=True).get(
+            "/api/v1/namespaces/x")
+        assert code == 404  # explicit opt-in works
+
+
+def test_upgrade_patch_gates_on_new_generation(spec):
+    """ADVICE round-1 medium finding: a re-apply that PATCHes an existing
+    DaemonSet must NOT pass the readiness gate on the old pods' Ready counts;
+    it must wait for the new generation to be observed and rolled."""
+    with FakeApiServer(auto_ready=False) as api:
+        client = kubeapply.Client(api.url)
+        ds = {"apiVersion": "apps/v1", "kind": "DaemonSet",
+              "metadata": {"name": "tpud", "namespace": NS},
+              "spec": {"template": {"spec": {"image": "tpud:v1"}}}}
+        assert client.apply(ds) == "created"
+        path = kubeapply.object_path(ds)
+        api.set_ready(f"{DS}/tpud")
+        client.wait_ready([ds], timeout=5, poll=0.02)
+
+        # Upgrade: spec change bumps generation; old status (gen 1) is stale.
+        ds2 = dict(ds)
+        ds2["spec"] = {"template": {"spec": {"image": "tpud:v2"}}}
+        assert client.apply(ds2) == "patched"
+        _, live = client.get(path)
+        assert live["metadata"]["generation"] == 2
+        assert not kubeapply.is_ready(live), (
+            "stale observedGeneration must not satisfy the gate")
+        with pytest.raises(kubeapply.ApplyError, match="timed out"):
+            client.wait_ready([ds2], timeout=0.2, poll=0.02)
+
+        # "Controller" observes the new generation -> gate opens.
+        api.set_ready(f"{DS}/tpud")
+        client.wait_ready([ds2], timeout=5, poll=0.02)
 
 
 def test_apply_groups_waits_and_orders(spec):
@@ -177,9 +256,12 @@ def test_apply_groups_kubectl_backend(spec):
     def fake_kubectl(argv, input_text=None):
         calls.append((list(argv), input_text))
         if argv[1] == "get":  # post-gate empty-DS re-check
+            # stderr carries a deprecation warning, as real kubectl often
+            # does — it must not corrupt the stdout JSON parse.
             return 0, json.dumps({"kind": "DaemonSet", "status": {
-                "desiredNumberScheduled": 2, "numberReady": 2}})
-        return 0, "ok"
+                "desiredNumberScheduled": 2, "numberReady": 2}}), \
+                "Warning: v1 ComponentStatus is deprecated"
+        return 0, "ok", ""
 
     groups = manifests.rollout_groups(spec)
     result = kubeapply.apply_groups_kubectl(groups, wait=True,
@@ -203,8 +285,8 @@ def test_apply_groups_kubectl_backend(spec):
 def test_apply_kubectl_backend_fails_on_unready(spec):
     def failing_rollout(argv, input_text=None):
         if argv[1] in ("rollout", "wait"):
-            return 1, "error: timed out waiting for the condition"
-        return 0, "ok"
+            return 1, "", "error: timed out waiting for the condition"
+        return 0, "ok", ""
 
     with pytest.raises(kubeapply.ApplyError, match="timed out"):
         kubeapply.apply_groups_kubectl(manifests.rollout_groups(spec),
@@ -217,8 +299,8 @@ def test_apply_kubectl_backend_empty_daemonset_guard(spec):
     def kubectl_zero_desired(argv, input_text=None):
         if argv[1] == "get":
             return 0, json.dumps({"kind": "DaemonSet", "status": {
-                "desiredNumberScheduled": 0, "numberReady": 0}})
-        return 0, "ok"
+                "desiredNumberScheduled": 0, "numberReady": 0}}), ""
+        return 0, "ok", ""
 
     groups = manifests.rollout_groups(spec)
     with pytest.raises(kubeapply.ApplyError, match="no node matches"):

@@ -20,6 +20,7 @@ DEFAULT_VALUES = {
     "namespace": "tpu-system",
     "image": "ghcr.io/tpu-native/tpu-stack:0.1.0",
     "accelerator": "v5e-8",
+    "expectChips": 8,
 }
 
 

@@ -275,14 +275,8 @@ def test_operator_https_curl_transport(native_build, bundle_dir, tmp_path):
     """The in-cluster transport for real: HTTPS apiserver, CA verification,
     bearer token via curl header file (never argv) — the full CurlHttps
     path in native/operator/kubeclient.cc."""
-    cert = tmp_path / "tls.crt"
-    key = tmp_path / "tls.key"
-    subprocess.run(
-        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
-         "-keyout", str(key), "-out", str(cert), "-days", "1",
-         "-subj", "/CN=127.0.0.1",
-         "-addext", "subjectAltName=IP:127.0.0.1"],
-        check=True, capture_output=True)
+    from fake_apiserver import make_self_signed
+    cert, key = make_self_signed(tmp_path)
     tok = tmp_path / "token"
     tok.write_text("https-sekrit\n")
     with FakeApiServer(auto_ready=True, tls=(str(cert), str(key))) as api:
@@ -298,6 +292,100 @@ def test_operator_https_curl_transport(native_build, bundle_dir, tmp_path):
         auths = {h.get("Authorization") for h in api.headers_seen}
         assert auths == {"Bearer https-sekrit"}
         assert api.get(f"{DS}/tpu-device-plugin") is not None
+
+
+@pytest.mark.parametrize("reply", [
+    # Status line without a space: must be a malformed-response error, not
+    # atoi("HTTP/...") -> status 0 via the npos+1 wraparound.
+    b"HTTP/1.1\r\nContent-Length: 2\r\n\r\n{}",
+    # Chunked body cut off before the terminating 0-length chunk: the
+    # truncated JSON prefix must not reach the reconciler.
+    b"HTTP/1.1 200 OK\r\nTransfer-Encoding: chunked\r\n\r\n"
+    b"400\r\n" + b"{" + b"x" * 1023 + b"\r\n",
+], ids=["no-space-status-line", "truncated-chunked-body"])
+def test_operator_survives_malformed_http_replies(native_build, bundle_dir,
+                                                  reply):
+    """ADVICE round-1 low finding: PlainHttp must treat a malformed status
+    line / truncated chunked body as a transport error (fail the pass), not
+    misparse it into a usable response."""
+    import socket
+    import threading
+
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    port = srv.getsockname()[1]
+    stop = threading.Event()
+
+    def serve():
+        srv.settimeout(0.2)
+        while not stop.is_set():
+            try:
+                conn, _ = srv.accept()
+            except socket.timeout:
+                continue
+            with conn:
+                try:
+                    conn.recv(65536)
+                    conn.sendall(reply)
+                except OSError:
+                    pass
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    try:
+        proc = run_operator(
+            native_build, f"--apiserver=http://127.0.0.1:{port}",
+            f"--bundle-dir={bundle_dir}", "--once", "--poll-ms=20",
+            "--stage-timeout=1", "--status-port=0", timeout=60)
+        assert proc.returncode != 0  # pass failed cleanly, no crash
+        status = json.loads(proc.stdout)
+        assert not status["healthy"]
+    finally:
+        stop.set()
+        t.join(timeout=5)
+        srv.close()
+
+
+def test_operator_refuses_unverified_https(native_build, bundle_dir,
+                                           tmp_path):
+    """ADVICE round-1 medium finding: https without a CA file must FAIL
+    unless --insecure-skip-tls-verify is given — never silently curl -k."""
+    from fake_apiserver import make_self_signed
+    cert, key = make_self_signed(tmp_path)
+    with FakeApiServer(auto_ready=True, tls=(str(cert), str(key))) as api:
+        # No --ca-file, no opt-in: every request fails, nothing is created.
+        proc = run_operator(
+            native_build, f"--apiserver={api.url}",
+            f"--bundle-dir={bundle_dir}", "--once", "--poll-ms=20",
+            "--stage-timeout=1", "--status-port=0", timeout=120)
+        assert proc.returncode != 0
+        assert "refusing unverified https" in proc.stderr
+        assert api.get(f"{DS}/tpu-device-plugin") is None
+
+        # Explicit opt-in: works, with a loud warning.
+        proc = run_operator(
+            native_build, f"--apiserver={api.url}",
+            f"--bundle-dir={bundle_dir}", "--insecure-skip-tls-verify",
+            "--once", "--poll-ms=20", "--stage-timeout=20",
+            "--status-port=0", timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        assert "TLS verification DISABLED" in proc.stderr
+        assert api.get(f"{DS}/tpu-device-plugin") is not None
+
+        # In-cluster config with an unreadable CA projection must hard-fail
+        # too — the production path never self-grants the downgrade.
+        host, port = api.url.rsplit("//", 1)[1].rsplit(":", 1)
+        env = dict(os.environ, KUBERNETES_SERVICE_HOST=host,
+                   KUBERNETES_SERVICE_PORT=port)
+        proc = subprocess.run(
+            [binpath(native_build, "tpu-operator"),
+             f"--bundle-dir={bundle_dir}", "--once", "--poll-ms=20",
+             "--stage-timeout=1", "--status-port=0"],
+            env=env, capture_output=True, text=True, timeout=120)
+        assert proc.returncode != 0
+        assert "refusing unverified https" in proc.stderr
 
 
 def test_operator_bundle_render_shape():

@@ -85,8 +85,9 @@ def cmd_apply(args) -> int:
             if args.token_file:
                 with open(args.token_file, encoding="utf-8") as f:
                     token = f.read().strip()
-            client = kubeapply.Client(args.apiserver, token=token,
-                                      ca_file=args.ca_file)
+            client = kubeapply.Client(
+                args.apiserver, token=token, ca_file=args.ca_file,
+                insecure_skip_tls_verify=args.insecure_skip_tls_verify)
             kubeapply.apply_groups(
                 client, groups, wait=args.wait,
                 stage_timeout=args.stage_timeout, poll=args.poll,
@@ -161,6 +162,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "omit to use kubectl from PATH")
     p.add_argument("--token-file", default="")
     p.add_argument("--ca-file", default=None)
+    p.add_argument("--insecure-skip-tls-verify", action="store_true",
+                   help="allow https to an apiserver without CA verification "
+                        "(DANGEROUS: exposes the bearer token to MITM)")
     p.add_argument("--operator", action="store_true",
                    help="install the in-cluster tpu-operator instead of "
                         "applying operands directly")

@@ -72,17 +72,35 @@ def object_path(obj: Dict[str, Any]) -> str:
 
 def is_ready(obj: Dict[str, Any],
              allow_empty_daemonsets: bool = False) -> bool:
-    """Same readiness rules as kubeapi::IsReady (pinned by test_apply.py)."""
+    """Same readiness rules as kubeapi::IsReady (pinned by test_apply.py).
+
+    Upgrade semantics (kubectl ``rollout status`` parity): when the object
+    carries ``metadata.generation``, a status from an older generation must
+    not satisfy the gate — on a re-reconcile that PATCHes an existing
+    DaemonSet/Deployment the old pods are still Ready, so without the
+    ``observedGeneration`` and updated-count checks the stage gate would pass
+    before the new pods roll. Objects without generation tracking (hand-made
+    fixtures) keep the plain count rules.
+    """
     kind = obj.get("kind")
     status = obj.get("status") or {}
+    gen = (obj.get("metadata") or {}).get("generation")
+    tracked = gen is not None
+    if tracked and kind in ("DaemonSet", "Deployment") \
+            and status.get("observedGeneration", 0) < gen:
+        return False
     if kind == "DaemonSet":
         desired = status.get("desiredNumberScheduled", -1)
         ready = status.get("numberReady", -2)
         if desired == 0 and allow_empty_daemonsets:
             return True
+        if tracked and status.get("updatedNumberScheduled", 0) < desired:
+            return False
         return desired > 0 and desired == ready
     if kind == "Deployment":
         want = (obj.get("spec") or {}).get("replicas", 1)
+        if tracked and status.get("updatedReplicas", 0) < want:
+            return False
         return status.get("readyReplicas", 0) >= want
     if kind == "Job":
         want = (obj.get("spec") or {}).get("completions", 1)
@@ -96,6 +114,12 @@ class Client:
     token: str = ""
     ca_file: Optional[str] = None
     timeout: float = 10.0
+    # Without a ca_file, https requests FAIL unless this is set: sending a
+    # bearer ServiceAccount token over unverified TLS hands cluster-admin-ish
+    # credentials to any MITM, so disabling verification must be an explicit
+    # opt-in (mirrors the C++ kubeclient and kubectl's flag of the same name).
+    insecure_skip_tls_verify: bool = False
+    _warned_insecure: bool = field(default=False, repr=False, compare=False)
 
     def _request(self, method: str, path: str,
                  body: Optional[Dict[str, Any]] = None,
@@ -110,8 +134,18 @@ class Client:
             req.add_header("Content-Type", content_type)
         ctx = None
         if self.base_url.startswith("https"):
+            if not self.ca_file and not self.insecure_skip_tls_verify:
+                raise ApplyError(
+                    f"refusing unverified https to {self.base_url}: no CA "
+                    f"file; pass --ca-file or --insecure-skip-tls-verify")
             ctx = ssl.create_default_context(cafile=self.ca_file)
             if not self.ca_file:
+                if not self._warned_insecure:
+                    self._warned_insecure = True
+                    import sys
+                    print(f"kubeapply: WARNING: TLS verification DISABLED "
+                          f"for {self.base_url} (insecure-skip-tls-verify)",
+                          file=sys.stderr)
                 ctx.check_hostname = False
                 ctx.verify_mode = ssl.CERT_NONE
         try:
@@ -181,6 +215,10 @@ class GroupResult:
 
 def kubectl_runner(argv: Sequence[str], input_text: Optional[str] = None,
                    timeout: float = 900):
+    """Returns ``(rc, stdout, stderr)``. Streams stay separate so JSON output
+    can be parsed from stdout alone — kubectl routinely writes deprecation /
+    version-skew warnings to stderr, and concatenating them would corrupt
+    ``kubectl get -o json`` parses."""
     import subprocess
     try:
         # Always provide stdin (empty when there's no payload): inheriting
@@ -189,10 +227,10 @@ def kubectl_runner(argv: Sequence[str], input_text: Optional[str] = None,
                               capture_output=True, text=True,
                               timeout=timeout)
     except FileNotFoundError:
-        return 127, "kubectl not found on PATH"
+        return 127, "", "kubectl not found on PATH"
     except subprocess.TimeoutExpired:
-        return 124, f"kubectl killed after {timeout:.0f}s"
-    return proc.returncode, proc.stdout + proc.stderr
+        return 124, "", f"kubectl killed after {timeout:.0f}s"
+    return proc.returncode, proc.stdout, proc.stderr
 
 
 def apply_groups_kubectl(groups: Sequence[Sequence[Dict[str, Any]]],
@@ -217,9 +255,10 @@ def apply_groups_kubectl(groups: Sequence[Sequence[Dict[str, Any]]],
     result = GroupResult()
     for i, group in enumerate(groups):
         text = yaml.dump_all(group, sort_keys=False)
-        rc, out = runner(["kubectl", "apply", "-f", "-"], text)
+        rc, out, err = runner(["kubectl", "apply", "-f", "-"], text)
         if rc != 0:
-            raise ApplyError(f"kubectl apply (group {i + 1}): {out[-400:]}")
+            raise ApplyError(
+                f"kubectl apply (group {i + 1}): {(out + err)[-400:]}")
         for obj in group:
             result.actions.append(
                 f"applied {obj['kind']}/{obj['metadata']['name']}")
@@ -242,18 +281,20 @@ def apply_groups_kubectl(groups: Sequence[Sequence[Dict[str, Any]]],
             else:
                 cmd = ["kubectl", "rollout", "status",
                        f"{kind.lower()}/{name}", "-n", ns, timeout_arg]
-            rc, out = runner(cmd)
+            rc, out, err = runner(cmd)
             if rc != 0:
+                combined = out + err
                 reason = ("timed out waiting for readiness"
-                          if rc == 124 or "timed out" in out
+                          if rc == 124 or "timed out" in combined
                           else "readiness gate failed")
-                raise ApplyError(f"{reason}: {kind}/{name}: {out[-400:]}")
+                raise ApplyError(f"{reason}: {kind}/{name}: {combined[-400:]}")
             if kind == "DaemonSet" and not allow_empty_daemonsets:
                 # rollout status exits 0 for a DaemonSet with 0 desired
                 # pods; re-check with the REST path's rule so a mislabeled
-                # cluster can't report silent success.
-                rc, out = runner(["kubectl", "get", "daemonset", name,
-                                  "-n", ns, "-o", "json"])
+                # cluster can't report silent success. Parse stdout only —
+                # kubectl warnings on stderr must not corrupt the JSON.
+                rc, out, err = runner(["kubectl", "get", "daemonset", name,
+                                       "-n", ns, "-o", "json"])
                 try:
                     live = jsonmod.loads(out) if rc == 0 else None
                 except ValueError:
@@ -263,7 +304,7 @@ def apply_groups_kubectl(groups: Sequence[Sequence[Dict[str, Any]]],
                     # guard in exactly the case it exists for.
                     raise ApplyError(
                         f"readiness gate failed: could not re-check "
-                        f"DaemonSet/{name}: {out[-200:]}")
+                        f"DaemonSet/{name}: {(out + err)[-200:]}")
                 if not is_ready(live):
                     desired = (live.get("status") or {}).get(
                         "desiredNumberScheduled", 0)

@@ -62,6 +62,14 @@ def force_virtual_cpu_mesh(n_devices: int) -> list:
         flags = (flags + " " + want).strip()
     os.environ["XLA_FLAGS"] = flags
 
+    if (xla_bridge.backends_are_initialized()
+            and jax.config.jax_platforms == "cpu"):
+        devices = jax.devices("cpu")
+        if len(devices) >= n_devices:
+            # Already forced at sufficient size: skip the backend/cache
+            # flush (a full flush costs seconds of XLA retrace+recompile).
+            return devices
+
     if xla_bridge.backends_are_initialized():
         # jax_num_cpu_devices rejects updates after init; clear first.
         clear_backend_caches()
