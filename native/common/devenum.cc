@@ -1,0 +1,50 @@
+#include "devenum.h"
+
+#include <glob.h>
+
+#include <algorithm>
+#include <cctype>
+#include <cstdlib>
+
+namespace devenum {
+
+std::string Reroot(const std::string& pattern, const std::string& root) {
+  if (root.empty()) return pattern;
+  std::string rel = pattern;
+  while (!rel.empty() && rel[0] == '/') rel.erase(0, 1);
+  return root + "/" + rel;
+}
+
+int ParseIndex(const std::string& basename) {
+  if (basename.empty()) return -1;
+  size_t digits = 0;
+  size_t pos = basename.rfind("accel");
+  if (pos != std::string::npos) {
+    digits = pos + 5;
+    if (digits < basename.size() && basename[digits] == '_') ++digits;
+  }
+  // else: all-digit basename (VFIO group node), digits start at 0
+  if (digits >= basename.size()) return -1;
+  for (size_t i = digits; i < basename.size(); ++i)
+    if (!isdigit(static_cast<unsigned char>(basename[i]))) return -1;
+  return atoi(basename.c_str() + digits);
+}
+
+std::vector<Node> Enumerate(const std::string& pattern,
+                            const std::string& devfs_root) {
+  std::vector<Node> out;
+  glob_t g = {};
+  if (glob(Reroot(pattern, devfs_root).c_str(), 0, nullptr, &g) == 0) {
+    for (size_t i = 0; i < g.gl_pathc; ++i) {
+      std::string path = g.gl_pathv[i];
+      int idx = ParseIndex(path.substr(path.find_last_of('/') + 1));
+      if (idx >= 0) out.push_back({idx, path});
+    }
+  }
+  globfree(&g);
+  std::sort(out.begin(), out.end(),
+            [](const Node& a, const Node& b) { return a.index < b.index; });
+  return out;
+}
+
+}  // namespace devenum

@@ -1,0 +1,36 @@
+// Shared TPU device-node enumeration for the native daemons.
+//
+// All four daemons (tpud, tpu-info, tpu-metrics-exporter, tpu-tfd) discover
+// chips from the host device tree the same way: glob a pattern
+// (re-rootable under a fake tree for tests), parse the chip index from the
+// basename, sort by index. One implementation here so the daemons cannot
+// drift on which device nodes they count.
+//
+// Accepted basenames (matches the Python oracle regex accel(?:_)?(\d+)$ in
+// tpu_cluster/discovery/devices.py, plus all-digit VFIO group nodes):
+//   accel0, accel_7  -> index from the trailing digits
+//   45               -> index 45 (/dev/vfio/<group>)
+#pragma once
+
+#include <string>
+#include <vector>
+
+namespace devenum {
+
+struct Node {
+  int index;
+  std::string path;
+};
+
+// Re-root an absolute glob pattern under `root` ("" = unchanged):
+// Reroot("/dev/accel*", "/tmp/t") == "/tmp/t/dev/accel*".
+std::string Reroot(const std::string& pattern, const std::string& root);
+
+// -1 when the basename is not a device node name.
+int ParseIndex(const std::string& basename);
+
+// Glob + parse + sort by index.
+std::vector<Node> Enumerate(const std::string& pattern,
+                            const std::string& devfs_root);
+
+}  // namespace devenum

@@ -1,0 +1,409 @@
+// tpu-feature-discovery — native label publisher (gpu-feature-discovery
+// analog, reference README.md:108,209).
+//
+// The reference's feature discovery is a Go daemon (NFD sidecar) that labels
+// accelerator nodes so the operator and workloads can target them
+// (reference README.md:119). This C++ daemon reproduces that for TPU nodes:
+//
+//  - discovers chips from the host device tree (/dev/accel* or /dev/vfio/*,
+//    re-rootable via --devfs-root for the fake-device-tree test story,
+//    SURVEY.md §4 point 2);
+//  - computes the label set (present/type/generation/topology/count/
+//    ici-domain) and PATCHes it onto this Node via the Kubernetes API;
+//  - with --conditions also publishes a TpuReady Node condition
+//    (node-problem-detector style) from the chip census on the status
+//    subresource;
+//  - clusterless modes for tests: --print emits the record as JSON,
+//    --out-file appends it (the fake-apiserver story).
+//
+// The label/condition *semantics* are pinned to the Python oracle
+// (tpu_cluster/discovery/labels.py + labeler.py): tests/test_discovery.py
+// runs both against the same fake device tree and diffs the JSON records
+// byte-for-byte (timestamps normalized), so the two implementations cannot
+// drift. JSON output therefore matches Python's
+// json.dumps(..., sort_keys=True) formatting exactly.
+//
+// Unlike the Python stand-in it replaces, apiserver errors back off
+// exponentially with jitter (fleet-safe at large node counts) and the
+// daemon can target an explicit --apiserver (fake apiserver in tests) in
+// addition to the in-cluster ServiceAccount config.
+
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <map>
+#include <optional>
+#include <string>
+#include <vector>
+
+#include "../common/devenum.h"
+#include "../operator/kubeclient.h"
+#include "../plugin/topology.h"
+
+namespace {
+
+volatile sig_atomic_t g_stop = 0;
+void HandleSignal(int) { g_stop = 1; }
+
+struct Options {
+  std::string accelerator = "v5e-8";
+  std::string device_glob = "/dev/accel*";
+  std::string devfs_root;
+  double interval_s = 60;
+  bool conditions = false;
+  bool oneshot = false;
+  bool print_only = false;
+  std::string out_file;
+  // apiserver access (tests); empty apiserver = in-cluster config
+  std::string apiserver;
+  std::string token_file;
+  std::string ca_file;
+  bool insecure_skip_tls_verify = false;
+};
+
+// ------------------------------------------------------------ labels
+
+// Ordered map with optional values; nullopt serialises to JSON null, which
+// deletes the key in a strategic-merge patch (stale-label cleanup, see
+// labels.py compute_labels docstring).
+using LabelMap = std::map<std::string, std::optional<std::string>>;
+
+LabelMap ComputeLabels(const tpud::AcceleratorType& acc, int count,
+                       const std::string& node_name) {
+  LabelMap out;
+  if (count == 0) {
+    out["google.com/tpu.present"] = std::string("false");
+    out["google.com/tpu.accelerator-type"] = std::nullopt;
+    out["google.com/tpu.generation"] = std::nullopt;
+    out["google.com/tpu.topology"] = std::nullopt;
+    out["google.com/tpu.count"] = std::nullopt;
+    out["google.com/tpu.ici-domain"] = std::nullopt;
+    return out;
+  }
+  out["google.com/tpu.present"] = std::string("true");
+  out["google.com/tpu.accelerator-type"] = acc.name;
+  out["google.com/tpu.generation"] = acc.generation;
+  out["google.com/tpu.topology"] = acc.LabelTopology();
+  out["google.com/tpu.count"] = std::to_string(count);
+  out["google.com/tpu.ici-domain"] =
+      node_name.empty() ? std::string("local") : node_name;
+  return out;
+}
+
+struct Condition {
+  std::string status, reason, message;
+  std::string heartbeat, transition;  // empty = omit (matches Python now="")
+};
+
+Condition TpuReadyCondition(const tpud::AcceleratorType& acc, int found,
+                            const std::string& now,
+                            const Condition* previous) {
+  Condition c;
+  int expected = acc.chips_per_host;
+  char msg[128];
+  if (found == expected) {
+    c.status = "True";
+    c.reason = "AllChipsPresent";
+    snprintf(msg, sizeof(msg), "%d/%d TPU chips present", found, expected);
+  } else if (found == 0) {
+    c.status = "False";
+    c.reason = "NoTpuDevices";
+    snprintf(msg, sizeof(msg), "no TPU device nodes (expected %d)", expected);
+  } else {
+    c.status = "False";
+    c.reason = "DegradedChipSet";
+    snprintf(msg, sizeof(msg), "%d/%d TPU chips present", found, expected);
+  }
+  c.message = msg;
+  if (!now.empty()) {
+    c.heartbeat = now;
+    // Preserve lastTransitionTime across heartbeats when status unchanged
+    // (kubelet-condition semantics; see labeler.tpu_ready_condition).
+    if (previous && previous->status == c.status &&
+        !previous->transition.empty())
+      c.transition = previous->transition;
+    else
+      c.transition = now;
+  }
+  return c;
+}
+
+// ------------------------------------------------------------ JSON emit
+// Matches Python json.dumps(..., sort_keys=True): ", " and ": " separators,
+// keys sorted at every level. Our strings are plain ASCII label/reason text
+// so escaping is limited to the JSON-mandatory set.
+
+void AppendJsonString(std::string* out, const std::string& s) {
+  out->push_back('"');
+  for (char ch : s) {
+    switch (ch) {
+      case '"': *out += "\\\""; break;
+      case '\\': *out += "\\\\"; break;
+      case '\n': *out += "\\n"; break;
+      case '\t': *out += "\\t"; break;
+      case '\r': *out += "\\r"; break;
+      default: out->push_back(ch);
+    }
+  }
+  out->push_back('"');
+}
+
+std::string LabelsJson(const LabelMap& labels) {
+  std::string out = "{";
+  bool first = true;
+  for (const auto& [k, v] : labels) {  // std::map iterates sorted
+    if (!first) out += ", ";
+    first = false;
+    AppendJsonString(&out, k);
+    out += ": ";
+    if (v)
+      AppendJsonString(&out, *v);
+    else
+      out += "null";
+  }
+  out += "}";
+  return out;
+}
+
+std::string ConditionJson(const Condition& c) {
+  // Sorted keys: lastHeartbeatTime, lastTransitionTime, message, reason,
+  // status, type.
+  std::string out = "{";
+  bool first = true;
+  auto emit = [&](const char* key, const std::string& val) {
+    if (val.empty()) return;
+    if (!first) out += ", ";
+    first = false;
+    AppendJsonString(&out, key);
+    out += ": ";
+    AppendJsonString(&out, val);
+  };
+  emit("lastHeartbeatTime", c.heartbeat);
+  emit("lastTransitionTime", c.transition);
+  emit("message", c.message);
+  emit("reason", c.reason);
+  emit("status", c.status);
+  out += first ? "\"type\": \"TpuReady\"}" : ", \"type\": \"TpuReady\"}";
+  return out;
+}
+
+std::string RecordJson(const LabelMap& labels, const Condition* cond) {
+  // Sorted record keys: "condition" < "labels".
+  std::string out = "{";
+  if (cond) {
+    out += "\"condition\": " + ConditionJson(*cond) + ", ";
+  }
+  out += "\"labels\": " + LabelsJson(labels) + "}";
+  return out;
+}
+
+std::string NodePatch(const LabelMap& labels) {
+  return "{\"metadata\": {\"labels\": " + LabelsJson(labels) + "}}";
+}
+
+std::string StatusPatch(const Condition& c) {
+  return "{\"status\": {\"conditions\": [" + ConditionJson(c) + "]}}";
+}
+
+std::string NowUtc() {
+  char buf[32];
+  time_t t = time(nullptr);
+  struct tm tm_utc;
+  gmtime_r(&t, &tm_utc);
+  strftime(buf, sizeof(buf), "%Y-%m-%dT%H:%M:%SZ", &tm_utc);
+  return buf;
+}
+
+// ------------------------------------------------------------ publish
+
+bool PatchNode(const kubeclient::Config& cfg, const std::string& node,
+               const std::string& patch, bool status_subresource,
+               std::string* err) {
+  std::string path = "/api/v1/nodes/" + node;
+  if (status_subresource) path += "/status";
+  kubeclient::Response r =
+      kubeclient::Call(cfg, "PATCH", path, patch,
+                       "application/strategic-merge-patch+json");
+  if (!r.ok()) {
+    *err = "PATCH " + path + " -> " + std::to_string(r.status) + " " +
+           (r.status ? r.body.substr(0, 160) : r.error);
+    return false;
+  }
+  return true;
+}
+
+// One discovery+publish cycle; mirrors labeler.run_once. Returns false only
+// on publish failure (print/out-file modes cannot fail discovery).
+bool RunOnce(const Options& opt, const tpud::AcceleratorType& acc,
+             const kubeclient::Config& cfg, const std::string& node_name,
+             std::optional<Condition>* previous, std::string* err) {
+  std::vector<devenum::Node> found =
+      devenum::Enumerate(opt.device_glob, opt.devfs_root);
+  if (found.empty())  // VFIO fallback, like devices.discover_vfio
+    found = devenum::Enumerate("/dev/vfio/*", opt.devfs_root);
+  LabelMap labels =
+      ComputeLabels(acc, static_cast<int>(found.size()), node_name);
+  std::optional<Condition> cond;
+  if (opt.conditions) {
+    const Condition* prev = previous->has_value() ? &**previous : nullptr;
+    cond = TpuReadyCondition(acc, static_cast<int>(found.size()), NowUtc(),
+                             prev);
+  }
+  std::string record = RecordJson(labels, cond ? &*cond : nullptr);
+  if (opt.print_only) {
+    printf("%s\n", record.c_str());
+  } else if (!opt.out_file.empty()) {
+    FILE* f = fopen(opt.out_file.c_str(), "a");
+    if (!f) {
+      *err = "cannot open " + opt.out_file;
+      return false;
+    }
+    fprintf(f, "%s\n", record.c_str());
+    fclose(f);
+  } else {
+    if (!PatchNode(cfg, node_name, NodePatch(labels), false, err))
+      return false;
+    fprintf(stderr, "patched node %s labels\n", node_name.c_str());
+    if (cond) {
+      if (!PatchNode(cfg, node_name, StatusPatch(*cond), true, err))
+        return false;
+      fprintf(stderr, "patched node %s condition TpuReady=%s\n",
+              node_name.c_str(), cond->status.c_str());
+    }
+  }
+  *previous = cond;
+  return true;
+}
+
+// Sleep interval with ±10% jitter (de-synchronises the fleet's apiserver
+// load), doubling up to 5 min after consecutive failures.
+void JitteredSleep(double base_s, int failures) {
+  double backoff = base_s;
+  for (int i = 0; i < failures && backoff < 300; ++i) backoff *= 2;
+  if (backoff > 300) backoff = 300;
+  double jitter = 0.9 + 0.2 * (static_cast<double>(rand()) / RAND_MAX);
+  int total_ms = static_cast<int>(backoff * jitter * 1000);
+  for (int left = total_ms; left > 0 && !g_stop; left -= 50)
+    usleep(std::min(left, 50) * 1000);
+}
+
+bool FlagVal(const char* arg, const char* name, std::string* out) {
+  size_t n = strlen(name);
+  if (strncmp(arg, name, n) == 0 && arg[n] == '=') {
+    *out = arg + n + 1;
+    return true;
+  }
+  return false;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  Options opt;
+  std::string sval;
+  for (int i = 1; i < argc; ++i) {
+    const char* a = argv[i];
+    if (FlagVal(a, "--accelerator", &opt.accelerator)) continue;
+    if (FlagVal(a, "--device-glob", &opt.device_glob)) continue;
+    if (FlagVal(a, "--devfs-root", &opt.devfs_root)) continue;
+    if (FlagVal(a, "--interval", &sval)) {
+      char* end = nullptr;
+      opt.interval_s = strtod(sval.c_str(), &end);
+      // Garbage or non-positive intervals must fail loudly (argparse-style,
+      // like the Python oracle), not turn into a zero-delay apiserver
+      // hot loop across the fleet.
+      if (end == sval.c_str() || *end != '\0' || opt.interval_s <= 0) {
+        fprintf(stderr, "tpu-tfd: invalid --interval=%s\n", sval.c_str());
+        return 2;
+      }
+      continue;
+    }
+    if (FlagVal(a, "--out-file", &opt.out_file)) continue;
+    if (FlagVal(a, "--apiserver", &opt.apiserver)) continue;
+    if (FlagVal(a, "--token-file", &opt.token_file)) continue;
+    if (FlagVal(a, "--ca-file", &opt.ca_file)) continue;
+    if (strcmp(a, "--conditions") == 0) { opt.conditions = true; continue; }
+    if (strcmp(a, "--oneshot") == 0) { opt.oneshot = true; continue; }
+    if (strcmp(a, "--print") == 0) { opt.print_only = true; continue; }
+    if (strcmp(a, "--insecure-skip-tls-verify") == 0) {
+      opt.insecure_skip_tls_verify = true;
+      continue;
+    }
+    fprintf(stderr,
+            "tpu-tfd: unknown flag %s\n"
+            "usage: tpu-tfd [--accelerator=T] [--device-glob=G] "
+            "[--devfs-root=D]\n"
+            "  [--interval=SECS] [--conditions] [--oneshot] [--print] "
+            "[--out-file=F]\n"
+            "  [--apiserver=URL] [--token-file=F] [--ca-file=F] "
+            "[--insecure-skip-tls-verify]\n",
+            a);
+    return 2;
+  }
+
+  // Permanent configuration errors must crash the pod (CrashLoopBackOff is
+  // the operator-visible signal), not retry forever looking healthy.
+  const tpud::AcceleratorType* acc = tpud::FindAccelerator(opt.accelerator);
+  if (!acc) {
+    std::string known;
+    for (const auto& n : tpud::KnownAccelerators())
+      known += (known.empty() ? "" : ", ") + n;
+    fprintf(stderr, "fatal: unknown accelerator type '%s'; known: %s\n",
+            opt.accelerator.c_str(), known.c_str());
+    return 2;
+  }
+
+  const char* node_env = getenv("NODE_NAME");
+  std::string node_name = node_env ? node_env : "";
+  bool clusterless = opt.print_only || !opt.out_file.empty();
+  if (!clusterless && node_name.empty()) {
+    fprintf(stderr,
+            "fatal: NODE_NAME env not set (downward-API fieldRef missing "
+            "from the DaemonSet manifest?)\n");
+    return 2;
+  }
+
+  kubeclient::Config cfg;
+  if (!clusterless) {
+    if (!opt.apiserver.empty()) {
+      cfg.base_url = opt.apiserver;
+      if (!opt.token_file.empty())
+        kubeclient::ReadFileTrim(opt.token_file, &cfg.token);
+      cfg.ca_file = opt.ca_file;
+    } else if (!kubeclient::Config::InCluster(&cfg)) {
+      fprintf(stderr, "fatal: not in-cluster and no --apiserver given\n");
+      return 2;
+    }
+    cfg.insecure_skip_tls_verify = opt.insecure_skip_tls_verify;
+  }
+
+  signal(SIGINT, HandleSignal);
+  signal(SIGTERM, HandleSignal);
+  signal(SIGPIPE, SIG_IGN);
+  srand(static_cast<unsigned>(getpid() ^ time(nullptr)));
+
+  std::optional<Condition> previous;
+  int failures = 0;
+  while (!g_stop) {
+    std::string err;
+    if (RunOnce(opt, *acc, cfg, node_name, &previous, &err)) {
+      failures = 0;
+    } else {
+      if (opt.oneshot) {
+        fprintf(stderr, "tpu-tfd: %s\n", err.c_str());
+        return 1;
+      }
+      ++failures;
+      fprintf(stderr, "label refresh failed (will retry): %s\n",
+              err.c_str());
+    }
+    if (opt.oneshot) return 0;
+    JitteredSleep(opt.interval_s, failures);
+  }
+  return 0;
+}

@@ -19,7 +19,6 @@
 #include <arpa/inet.h>
 #include <errno.h>
 #include <fcntl.h>
-#include <glob.h>
 #include <netinet/in.h>
 #include <poll.h>
 #include <signal.h>
@@ -34,6 +33,7 @@
 #include <string>
 #include <vector>
 
+#include "../common/devenum.h"
 #include "../plugin/topology.h"
 
 namespace {
@@ -62,25 +62,8 @@ std::vector<std::pair<int, std::string>> DiscoverChips(const Options& opt) {
       chips.push_back({i, "/dev/accel" + std::to_string(i)});
     return chips;
   }
-  std::string pattern = opt.device_glob;
-  if (!opt.devfs_root.empty()) {
-    std::string rel = pattern[0] == '/' ? pattern.substr(1) : pattern;
-    pattern = opt.devfs_root + "/" + rel;
-  }
-  glob_t g;
-  memset(&g, 0, sizeof(g));
-  if (glob(pattern.c_str(), 0, nullptr, &g) == 0) {
-    for (size_t i = 0; i < g.gl_pathc; ++i) {
-      std::string path = g.gl_pathv[i];
-      const char* base = strrchr(path.c_str(), '/');
-      base = base ? base + 1 : path.c_str();
-      const char* digits = base;
-      while (*digits && (*digits < '0' || *digits > '9')) ++digits;
-      if (!*digits) continue;
-      chips.push_back({atoi(digits), path});
-    }
-  }
-  globfree(&g);
+  for (const auto& node : devenum::Enumerate(opt.device_glob, opt.devfs_root))
+    chips.push_back({node.index, node.path});
   return chips;
 }
 

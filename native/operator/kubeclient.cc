@@ -219,9 +219,14 @@ Response PlainHttp(const Config& cfg, const Url& url,
     while (pos < resp.body.size()) {
       size_t nl = resp.body.find("\r\n", pos);
       if (nl == std::string::npos) break;
-      long chunk = strtol(resp.body.c_str() + pos, nullptr, 16);
-      if (chunk <= 0) {
-        terminated = chunk == 0;
+      // strtol returns 0 for both a real "0" terminator and an unparseable
+      // size line — distinguish via endptr so a corrupted chunk header is a
+      // truncation error, not a silently-empty 200 body.
+      char* end = nullptr;
+      long chunk = strtol(resp.body.c_str() + pos, &end, 16);
+      if (end == resp.body.c_str() + pos || chunk < 0) break;
+      if (chunk == 0) {
+        terminated = true;
         break;
       }
       if (nl + 2 + chunk > resp.body.size()) break;  // truncated data

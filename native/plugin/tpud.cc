@@ -15,7 +15,6 @@
 // by watching the registration socket inode; the plugin re-registers, which
 // is the subtle lifecycle requirement SURVEY.md §7 ranks hard-part #1.
 
-#include <glob.h>
 #include <signal.h>
 #include <stdio.h>
 #include <stdlib.h>
@@ -31,6 +30,7 @@
 #include <vector>
 
 #include "deviceplugin.pb.h"
+#include "../common/devenum.h"
 #include "../grpcmin/grpc.h"
 #include "topology.h"
 
@@ -89,34 +89,12 @@ std::vector<ChipDevice> DiscoverDevices(const Options& opt) {
       out.push_back({i, "/dev/accel" + std::to_string(i), true, -1});
     return out;
   }
-  std::string pattern = opt.device_glob;
-  if (!opt.devfs_root.empty()) {
-    std::string rel = pattern;
-    if (!rel.empty() && rel[0] == '/') rel = rel.substr(1);
-    pattern = opt.devfs_root + "/" + rel;
-  }
-  glob_t g;
-  memset(&g, 0, sizeof(g));
-  if (glob(pattern.c_str(), 0, nullptr, &g) == 0) {
-    for (size_t i = 0; i < g.gl_pathc; ++i) {
-      std::string path = g.gl_pathv[i];
-      const char* base = strrchr(path.c_str(), '/');
-      base = base ? base + 1 : path.c_str();
-      // accept accelN / accel_N
-      const char* digits = base;
-      while (*digits && (*digits < '0' || *digits > '9')) ++digits;
-      if (!*digits) continue;
-      int idx = atoi(digits);
-      out.push_back({idx, path, access(path.c_str(), F_OK) == 0,
-                     ReadNumaNode(path)});
-    }
-  }
-  globfree(&g);
-  // sort by parsed number for deterministic ids
-  std::sort(out.begin(), out.end(),
-            [](const ChipDevice& a, const ChipDevice& b) {
-              return a.index < b.index;
-            });
+  // Shared enumeration (native/common/devenum.cc): glob, basename parse,
+  // sorted by index — same nodes every native daemon counts.
+  for (const auto& node : devenum::Enumerate(opt.device_glob, opt.devfs_root))
+    out.push_back({node.index, node.path,
+                   access(node.path.c_str(), F_OK) == 0,
+                   ReadNumaNode(node.path)});
   // VFIO group nodes carry host-global IOMMU group numbers (e.g.
   // /dev/vfio/45..48), which are NOT chip topology coordinates. Re-rank
   // them densely 0..N-1 (sorted group order) so device ids, sub-mesh math,

@@ -13,7 +13,6 @@
 //   tpu_hbm_used_bytes{chip="0"} 1073741824
 // The same file feeds tpu-metrics-exporter; see docs/DELTAS.md.
 
-#include <glob.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
@@ -23,6 +22,7 @@
 #include <string>
 #include <vector>
 
+#include "../common/devenum.h"
 #include "../plugin/topology.h"
 
 namespace {
@@ -57,26 +57,9 @@ std::vector<Chip> Discover(const std::string& device_glob,
       chips.push_back({i, "/dev/accel" + std::to_string(i), true});
     return chips;
   }
-  std::string pattern = device_glob;
-  if (!devfs_root.empty()) {
-    std::string rel = pattern[0] == '/' ? pattern.substr(1) : pattern;
-    pattern = devfs_root + "/" + rel;
-  }
-  glob_t g;
-  memset(&g, 0, sizeof(g));
-  if (glob(pattern.c_str(), 0, nullptr, &g) == 0) {
-    for (size_t i = 0; i < g.gl_pathc; ++i) {
-      std::string path = g.gl_pathv[i];
-      const char* base = strrchr(path.c_str(), '/');
-      base = base ? base + 1 : path.c_str();
-      const char* digits = base;
-      while (*digits && (*digits < '0' || *digits > '9')) ++digits;
-      if (!*digits) continue;
-      chips.push_back({atoi(digits), path, access(path.c_str(), F_OK) == 0,
-                       ReadNuma(path)});
-    }
-  }
-  globfree(&g);
+  for (const auto& node : devenum::Enumerate(device_glob, devfs_root))
+    chips.push_back({node.index, node.path,
+                     access(node.path.c_str(), F_OK) == 0, ReadNuma(node.path)});
   return chips;
 }
 

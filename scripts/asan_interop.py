@@ -172,6 +172,52 @@ def probe_tpu_info(build: str) -> None:
     print("tpu-info probes: clean")
 
 
+def hammer_tfd(build: str, rounds: int = 10) -> None:
+    """tpu-tfd through its publish path: glob discovery, hand-rolled JSON
+    emission, apiserver PATCHes — repeatedly, against every tree shape."""
+    import json
+    import urllib.request
+
+    from fake_apiserver import FakeApiServer
+    from tpu_cluster.discovery import devices
+
+    trees = []
+    for n, vfio in [(8, False), (5, False), (0, False), (8, True)]:
+        root = tempfile.mkdtemp()
+        devices.make_fake_tree(root, n, vfio=vfio)
+        trees.append(root)
+    with FakeApiServer() as api:
+        for path, body in [
+            ("/api/v1/nodes/n1", {"kind": "Node",
+                                  "metadata": {"name": "n1"}}),
+            ("/api/v1/nodes/n1/status", {"status": {"conditions": []}}),
+        ]:
+            req = urllib.request.Request(
+                api.url + path, data=json.dumps(body).encode(), method="PUT",
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req)
+        env = dict(os.environ, NODE_NAME="n1")
+        for _ in range(rounds):
+            for root in trees:
+                proc = subprocess.run(
+                    [os.path.join(build, "tpu-tfd"), "--oneshot",
+                     "--conditions", f"--devfs-root={root}",
+                     f"--apiserver={api.url}"],
+                    capture_output=True, text=True, env=env, timeout=30)
+                check_clean("tpu-tfd", proc.stderr)
+                if proc.returncode != 0:
+                    print(f"tpu-tfd rc={proc.returncode}:\n"
+                          f"{proc.stderr[-2000:]}", file=sys.stderr)
+                    raise SystemExit(1)
+        # clusterless print path too (no apiserver in the loop)
+        proc = subprocess.run(
+            [os.path.join(build, "tpu-tfd"), "--oneshot", "--print",
+             "--conditions", f"--devfs-root={trees[0]}"],
+            capture_output=True, text=True, timeout=30)
+        check_clean("tpu-tfd", proc.stderr)
+    print(f"tpu-tfd hammer ({rounds} rounds x 4 trees): clean")
+
+
 def main() -> int:
     build = sys.argv[1] if len(sys.argv) > 1 else \
         os.path.join(REPO, "native", "build-asan")
@@ -179,6 +225,7 @@ def main() -> int:
     converge_operator(build)
     hammer_exporter(build)
     probe_tpu_info(build)
+    hammer_tfd(build)
     return 0
 
 

@@ -13,3 +13,23 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from tpu_cluster.virtualmesh import force_virtual_cpu_mesh  # noqa: E402
 
 force_virtual_cpu_mesh(8)
+
+import subprocess  # noqa: E402
+
+import pytest  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE_DIR = os.path.join(REPO, "native")
+NATIVE_BUILD_DIR = os.path.join(NATIVE_DIR, "build")
+
+
+@pytest.fixture(scope="session")
+def native_build():
+    """Configure+build the native tree once per test session (cached)."""
+    if not os.path.exists(os.path.join(NATIVE_BUILD_DIR, "build.ninja")):
+        subprocess.run(
+            ["cmake", "-S", NATIVE_DIR, "-B", NATIVE_BUILD_DIR, "-G", "Ninja"],
+            check=True, capture_output=True)
+    subprocess.run(["ninja", "-C", NATIVE_BUILD_DIR], check=True,
+                   capture_output=True, timeout=600)
+    return NATIVE_BUILD_DIR

@@ -118,3 +118,112 @@ def test_labeler_conditions_flag(tmp_path, capsys):
     assert rec["labels"]["google.com/tpu.present"] == "true"
     assert rec["condition"]["status"] == "True"
     assert rec["condition"]["lastHeartbeatTime"].endswith("Z")
+
+
+# ---------------------------------------------------------------- native tfd
+# The deployed feature-discovery operand is the C++ tpu-tfd daemon
+# (native/discovery/tfd_main.cc); this Python module is its oracle. These
+# tests run both against identical fake device trees and diff the JSON
+# records (timestamps normalized), then drive the native daemon's publish
+# path against the fake apiserver.
+
+import os
+import subprocess
+import sys
+
+from fake_apiserver import FakeApiServer
+
+
+def _tfd(native_build):
+    return os.path.join(native_build, "tpu-tfd")
+
+
+def _normalize(rec):
+    cond = rec.get("condition")
+    if cond:
+        for key in ("lastHeartbeatTime", "lastTransitionTime"):
+            assert cond[key].endswith("Z")
+            cond[key] = "<time>"
+    return rec
+
+
+def _run_record(cmd, env_extra=None):
+    env = dict(os.environ, **(env_extra or {}))
+    out = subprocess.run(cmd, check=True, capture_output=True, env=env,
+                         text=True).stdout
+    return json.loads(out.strip())
+
+
+def _python_labeler_cmd(*args):
+    return [sys.executable, "-m", "tpu_cluster.discovery.labeler", *args]
+
+
+def test_native_tfd_matches_python_oracle(native_build, tmp_path):
+    """C++ and Python label/condition records agree on every tree shape."""
+    trees = {}
+    for name, n, vfio in [("full", 8, False), ("degraded", 5, False),
+                          ("empty", 0, False), ("vfio", 8, True)]:
+        root = tmp_path / name
+        devices.make_fake_tree(str(root), n, vfio=vfio)
+        trees[name] = str(root)
+    for name, root in trees.items():
+        args = ["--print", "--oneshot", "--conditions",
+                "--accelerator=v5e-8", f"--devfs-root={root}"]
+        env = {"NODE_NAME": "node-x"}
+        got_cpp = _normalize(_run_record([_tfd(native_build), *args], env))
+        got_py = _normalize(_run_record(_python_labeler_cmd(*args), env))
+        assert got_cpp == got_py, f"tree {name}: native != oracle"
+
+
+def test_native_tfd_outfile_and_unknown_accelerator(native_build, tmp_path):
+    devices.make_fake_tree(str(tmp_path), 8)
+    out = tmp_path / "rec.jsonl"
+    subprocess.run(
+        [_tfd(native_build), "--oneshot", f"--devfs-root={tmp_path}",
+         f"--out-file={out}"], check=True)
+    rec = json.loads(out.read_text().strip())
+    assert rec["labels"]["google.com/tpu.count"] == "8"
+    assert "condition" not in rec
+    # unknown accelerator -> exit 2 (CrashLoopBackOff signal), like the oracle
+    proc = subprocess.run([_tfd(native_build), "--accelerator=v99",
+                           "--oneshot", "--print"], capture_output=True)
+    assert proc.returncode == 2
+    assert b"fatal" in proc.stderr
+
+
+def test_native_tfd_patches_node_via_apiserver(native_build, tmp_path):
+    """Publish path: labels PATCH on the Node, TpuReady on nodes/status."""
+    devices.make_fake_tree(str(tmp_path), 8)
+    with FakeApiServer() as api:
+        # seed the Node object (PATCH on a missing path 404s, like the real
+        # apiserver for a node that doesn't exist)
+        import urllib.request
+        for path, body in [
+            ("/api/v1/nodes/node-x",
+             {"kind": "Node", "metadata": {"name": "node-x", "labels": {
+                 "google.com/tpu.count": "7"}}}),
+            # the fake stores the status subresource at its literal path
+            ("/api/v1/nodes/node-x/status", {"status": {"conditions": []}}),
+        ]:
+            req = urllib.request.Request(
+                api.url + path, data=json.dumps(body).encode(),
+                method="PUT", headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req)
+        env = dict(os.environ, NODE_NAME="node-x")
+        subprocess.run(
+            [_tfd(native_build), "--oneshot", "--conditions",
+             f"--devfs-root={tmp_path}", f"--apiserver={api.url}"],
+            check=True, env=env, capture_output=True)
+        node = api.get("/api/v1/nodes/node-x")
+        assert node["metadata"]["labels"]["google.com/tpu.count"] == "8"
+        assert node["metadata"]["labels"]["google.com/tpu.present"] == "true"
+        status = api.get("/api/v1/nodes/node-x/status")
+        conds = status["status"]["conditions"]
+        assert conds and conds[0]["type"] == "TpuReady"
+        assert conds[0]["status"] == "True"
+        patches = [(m, p) for (m, p) in api.log if m == "PATCH"]
+        assert ("PATCH", "/api/v1/nodes/node-x") in patches
+        assert ("PATCH", "/api/v1/nodes/node-x/status") in patches
+        ctypes = [h.get("Content-Type") for h in api.headers_seen
+                  if h.get("Content-Type")]
+        assert "application/strategic-merge-patch+json" in ctypes

@@ -18,20 +18,11 @@ import grpc
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-NATIVE = os.path.join(REPO, "native")
-BUILD = os.path.join(NATIVE, "build")
 GOLDEN = os.path.join(REPO, "tests", "data", "topology_golden.json")
 
 
-@pytest.fixture(scope="session")
-def native_build():
-    """Configure+build the native tree once per test session (cached)."""
-    if not os.path.exists(os.path.join(BUILD, "build.ninja")):
-        subprocess.run(["cmake", "-S", NATIVE, "-B", BUILD, "-G", "Ninja"],
-                       check=True, capture_output=True)
-    subprocess.run(["ninja", "-C", BUILD], check=True, capture_output=True,
-                   timeout=600)
-    return BUILD
+# The native_build session fixture lives in conftest.py (shared with the
+# feature-discovery oracle tests in test_discovery.py).
 
 
 def binpath(build, name):

@@ -16,7 +16,7 @@ from fake_apiserver import FakeApiServer
 from tpu_cluster import spec as specmod
 from tpu_cluster.render import operator_bundle
 
-from test_native import native_build, binpath  # noqa: F401  (fixture reuse)
+from test_native import binpath  # noqa: F401  (native_build comes via conftest)
 
 NS = "tpu-system"
 DS = f"/apis/apps/v1/namespaces/{NS}/daemonsets"

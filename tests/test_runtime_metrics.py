@@ -7,7 +7,7 @@ import subprocess
 
 from tpu_cluster.workloads import runtime_metrics, validate
 
-from test_native import native_build, binpath  # noqa: F401
+from test_native import binpath  # noqa: F401  (native_build comes via conftest)
 
 
 def test_writer_atomic_and_prefixed(tmp_path):

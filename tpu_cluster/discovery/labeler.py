@@ -1,4 +1,11 @@
-"""tpu-feature-discovery daemon entrypoint.
+"""tpu-feature-discovery daemon entrypoint (Python oracle).
+
+The *deployed* operand is the native ``tpu-tfd`` daemon
+(native/discovery/tfd_main.cc), matching the reference's Go daemon in kind
+(SURVEY.md §2 native-parity rule). This module is the behavioral oracle the
+native binary is pinned to — tests/test_discovery.py runs both against the
+same fake device trees and diffs the JSON records — and stays fully
+functional as a clusterless fallback.
 
 Periodically discovers TPU device nodes and patches the labels from
 ``labels.compute_labels`` onto this Node via the Kubernetes API (in-cluster

@@ -212,8 +212,11 @@ def feature_discovery(spec: ClusterSpec) -> List[Dict[str, Any]]:
     """Label publisher — the gpu-feature-discovery analog (README.md:209).
 
     Publishes google.com/tpu.present, accelerator type, per-host topology, and
-    chip count (tpu_cluster.discovery.labels computes the set). Needs RBAC to
-    patch its own Node object.
+    chip count. Runs the native ``tpu-tfd`` daemon (native/discovery) — the
+    reference operand is a Go daemon, so the deployed publisher is native per
+    the SURVEY.md §2 parity rule; ``tpu_cluster.discovery`` remains the label
+    *oracle* the native binary is golden-pinned to (tests/test_discovery.py).
+    Needs RBAC to patch its own Node object.
     """
     ns = spec.tpu.namespace
     sa = {
@@ -245,7 +248,7 @@ def feature_discovery(spec: ClusterSpec) -> List[Dict[str, Any]]:
         "containers": [{
             "name": "tfd",
             "image": _image(spec, "featureDiscovery"),
-            "command": ["python3", "-m", "tpu_cluster.discovery.labeler"],
+            "command": ["tpu-tfd"],
             "args": [f"--accelerator={spec.tpu.accelerator}",
                      f"--device-glob={spec.tpu.device_glob}",
                      "--interval=60",
