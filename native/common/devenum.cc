@@ -16,17 +16,13 @@ std::string Reroot(const std::string& pattern, const std::string& root) {
 }
 
 int ParseIndex(const std::string& basename) {
-  if (basename.empty()) return -1;
-  size_t digits = 0;
-  size_t pos = basename.rfind("accel");
-  if (pos != std::string::npos) {
-    digits = pos + 5;
-    if (digits < basename.size() && basename[digits] == '_') ++digits;
-  }
-  // else: all-digit basename (VFIO group node), digits start at 0
-  if (digits >= basename.size()) return -1;
-  for (size_t i = digits; i < basename.size(); ++i)
-    if (!isdigit(static_cast<unsigned char>(basename[i]))) return -1;
+  if (basename.empty() ||
+      !isdigit(static_cast<unsigned char>(basename.back())))
+    return -1;
+  size_t digits = basename.size();
+  while (digits > 0 &&
+         isdigit(static_cast<unsigned char>(basename[digits - 1])))
+    --digits;
   return atoi(basename.c_str() + digits);
 }
 

@@ -6,10 +6,13 @@
 // basename, sort by index. One implementation here so the daemons cannot
 // drift on which device nodes they count.
 //
-// Accepted basenames (matches the Python oracle regex accel(?:_)?(\d+)$ in
-// tpu_cluster/discovery/devices.py, plus all-digit VFIO group nodes):
-//   accel0, accel_7  -> index from the trailing digits
-//   45               -> index 45 (/dev/vfio/<group>)
+// Accepted basenames (matches the Python oracle rule in
+// tpu_cluster/discovery/devices.py): the chip index is the trailing digit
+// run, whatever the prefix — the glob names the device namespace:
+//   accel0, accel_7  -> 0, 7
+//   tpu3             -> 3 (custom --device-glob)
+//   45               -> 45 (/dev/vfio/<group>)
+//   vfio, README     -> rejected (no trailing digits)
 #pragma once
 
 #include <string>

@@ -18,6 +18,11 @@ const std::vector<AcceleratorType>& Catalogue() {
       {"v5p-8", "v5p", 4, 2, 2, 95, {4}, {{4, {2, 2}}}},
       {"v6e-8", "v6e", 8, 2, 4, 32, {1, 4, 8},
        {{1, {1, 1}}, {4, {2, 2}}, {8, {2, 4}}}},
+      // Multi-host slices: whole-host-group allocation only (aligned 8),
+      // hosts tile the slice grid; mirrors tpu_cluster/topology.py.
+      {"v5e-16", "v5e", 8, 2, 4, 16, {8}, {{8, {2, 4}}}, 2, 2, 1, 1},
+      {"v5e-32", "v5e", 8, 2, 4, 16, {8}, {{8, {2, 4}}}, 4, 2, 2, 1},
+      {"v6e-16", "v6e", 8, 2, 4, 32, {8}, {{8, {2, 4}}}, 2, 2, 1, 1},
   };
   return kTypes;
 }

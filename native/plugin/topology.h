@@ -21,9 +21,21 @@ struct AcceleratorType {
   std::vector<int> aligned_sizes;
   // size -> sub-mesh rectangle (w, h)
   std::vector<std::pair<int, std::pair<int, int>>> sub_mesh_shapes;
+  // Multi-host slices: hosts tiling the slice grid (1,1,1 = single host).
+  // Drives the TPU_HOST_BOUNDS env in Allocate (tpud.cc); per-host
+  // ListAndWatch/Allocate semantics are unchanged.
+  int num_hosts = 1;
+  int hosts_x = 1, hosts_y = 1, hosts_z = 1;
 
+  // Slice chip grid (hosts x per-host grid) — matches Python
+  // label_topology(); equals the per-host grid on 1-host types.
   std::string LabelTopology() const {
-    return std::to_string(topo_x) + "x" + std::to_string(topo_y);
+    return std::to_string(topo_x * hosts_x) + "x" +
+           std::to_string(topo_y * hosts_y);
+  }
+  std::string HostBounds() const {
+    return std::to_string(hosts_x) + "," + std::to_string(hosts_y) + "," +
+           std::to_string(hosts_z);
   }
 };
 

@@ -284,7 +284,11 @@ class Plugin {
     envs["TPU_VISIBLE_DEVICES"] = visible;
     envs["TPU_CHIPS_PER_HOST_BOUNDS"] =
         std::to_string(w) + "," + std::to_string(h) + ",1";
-    envs["TPU_HOST_BOUNDS"] = "1,1,1";
+    // Host tiling of the slice from the accelerator catalogue — "1,1,1" on
+    // single-host types, "2,1,1" on v5e-16 etc. Worker identity within the
+    // slice (TPU_WORKER_ID / TPU_WORKER_HOSTNAMES) is Job-level, injected
+    // by the Indexed-Job manifest (render/jobs.py), not per-Allocate.
+    envs["TPU_HOST_BOUNDS"] = acc_.HostBounds();
     envs["TPU_SKIP_MDS_QUERY"] = "true";
     envs["TPU_ACCELERATOR_TYPE"] = acc_.name;
     envs["TPU_DEVICE_COUNT"] = std::to_string(sorted_ids.size());

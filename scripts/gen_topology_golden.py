@@ -1,0 +1,45 @@
+#!/usr/bin/env python3
+"""Regenerate tests/data/topology_golden.json from the Python policy.
+
+The golden file pins the Python (tpu_cluster/topology.py) and C++
+(native/plugin/topology.cc) allocation policies to the same vectors
+(tests/test_topology.py + tests/test_native.py). Rerun after adding an
+accelerator type to the catalogue — in BOTH implementations.
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tpu_cluster import topology  # noqa: E402
+
+OUT = os.path.join(REPO, "tests", "data", "topology_golden.json")
+
+
+def main() -> int:
+    accs = []
+    for name in sorted(topology.ACCELERATOR_TYPES):
+        acc = topology.get(name)
+        accs.append({
+            "name": acc.name,
+            "chips_per_host": acc.chips_per_host,
+            "topology": list(acc.topology),
+            "aligned_sizes": list(acc.aligned_sizes),
+            "aligned_subsets": {
+                str(size): [list(s) for s in topology.aligned_subsets(acc, size)]
+                for size in acc.aligned_sizes
+            },
+            "validate_cases": topology.all_validation_cases(acc),
+        })
+    with open(OUT, "w", encoding="utf-8") as f:
+        json.dump({"accelerators": accs}, f, indent=1)
+        f.write("\n")
+    print(f"wrote {OUT}: {len(accs)} accelerator types")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

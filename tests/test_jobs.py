@@ -93,3 +93,46 @@ def test_device_query_fails_on_partial_chip_set(capsys):
     out = json.loads(capsys.readouterr().out)
     assert rc == 1 and not out["ok"]
     assert out["expected_devices"] == 16 and out["device_count"] == 8
+
+
+def test_multihost_jobs_derive_hosts_from_slice_type():
+    """A v5e-16 spec renders the DCN validation pair automatically, spanning
+    the slice's host count, with a burnin (train-step) variant."""
+    spec = specmod.default_spec()
+    spec.tpu.accelerator = "v5e-16"
+    objs = jobs.render_validation_jobs(spec)
+    by_name = {}
+    for o in objs:
+        by_name.setdefault(o["metadata"]["name"], []).append(o)
+    # NO single-pod jobs: the plugin rejects 1-chip requests on v5e-16 and
+    # every pod gets full-slice TPU_HOST_BOUNDS, so only Indexed worker
+    # sets spanning the slice can run
+    assert all("multihost" in name for name in by_name), sorted(by_name)
+    for mode in ("device-query", "psum", "burnin"):
+        name = f"tpu-{mode}-multihost"
+        kinds = {o["kind"] for o in by_name[name]}
+        assert kinds == {"Service", "Job"}, name
+        job = next(o for o in by_name[name] if o["kind"] == "Job")
+        assert job["spec"]["completionMode"] == "Indexed"
+        assert job["spec"]["completions"] == 2
+        assert job["spec"]["parallelism"] == 2
+        container = job["spec"]["template"]["spec"]["containers"][0]
+        assert f"--mode={mode}" in container["args"]
+        hostnames = next(e["value"] for e in container["env"]
+                         if e["name"] == "TPU_WORKER_HOSTNAMES")
+        assert len(hostnames.split(",")) == 2
+        # every worker pod takes its host's whole chip group
+        assert container["resources"]["limits"]["google.com/tpu"] == "8"
+    # a worker set not matching the slice's host count is a render error
+    with pytest.raises(ValueError):
+        jobs.multihost_psum_job(spec, num_hosts=3)
+    with pytest.raises(ValueError):
+        jobs.multihost_psum_job(specmod.default_spec(), num_hosts=1)
+    # single-host spec: no multihost jobs unless explicitly requested
+    single = specmod.default_spec()
+    names = [o["metadata"]["name"]
+             for o in jobs.render_validation_jobs(single)]
+    assert not any("multihost" in n for n in names)
+    names = [o["metadata"]["name"]
+             for o in jobs.render_validation_jobs(single, multihost_hosts=2)]
+    assert "tpu-psum-multihost" in names and "tpu-burnin-multihost" in names

@@ -115,3 +115,27 @@ def test_two_process_global_psum_via_validate_job():
         assert gp["ok"] and gp["processes"] == 2
         assert gp["total"] == 28.0  # sum(0..7) across both processes
         assert doc["bootstrap"]["process_id"] == idx
+
+
+def test_two_process_sharded_train_step():
+    """SURVEY.md §2.4(b) beyond psum: the flagship DP x TP train step over a
+    mesh spanning two processes — model axis within each process (ICI
+    analog), data axis across them (DCN). Both workers run the SAME entry
+    point the rendered multi-host burnin Job uses (validate --mode=burnin)."""
+    results = run_two_workers(
+        [sys.executable, "-m", "tpu_cluster.workloads.validate",
+         "--mode=burnin"])
+    docs = []
+    for idx, (rc, out, err, _) in enumerate(results):
+        assert rc == 0, f"worker {idx} failed:\n{err[-2000:]}"
+        docs.append(json.loads(out[out.index("{"):]))
+    for idx, doc in enumerate(docs):
+        assert doc["ok"], doc
+        assert doc["processes"] == 2
+        assert doc["devices"] == 8          # 2 procs x 4 virtual devices
+        # data axis (2) spans the processes; model axis (4) stays local
+        assert doc["mesh"] == {"data": 2, "model": 4}
+        assert doc["loss_decreasing"] is True
+        assert doc["bootstrap"]["process_id"] == idx
+    # SPMD: the replicated loss history must be identical on both workers
+    assert docs[0]["losses"] == docs[1]["losses"]

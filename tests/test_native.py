@@ -468,3 +468,27 @@ def test_tpud_survives_malformed_input(native_build, tmp_path):
     finally:
         proc.terminate()
         proc.wait(timeout=5)
+
+
+def test_allocate_multihost_slice_env(native_build, tmp_path):
+    """v5e-16 (2 hosts x 8): Allocate derives TPU_HOST_BOUNDS from the
+    catalogue instead of hardcoding single-host bounds, and sub-host
+    requests are rejected (whole-host-group rule for multi-host slices)."""
+    from tpu_cluster.plugin_api.client import DevicePluginClient
+    proc, sock = start_tpud(native_build, tmp_path, "--fake-devices=8",
+                            "--no-register", "--accelerator=v5e-16")
+    c = DevicePluginClient(sock)
+    try:
+        resp = c.allocate([f"tpu-{i}" for i in range(8)])
+        envs = resp.container_responses[0].envs
+        assert envs["TPU_HOST_BOUNDS"] == "2,1,1"
+        assert envs["TPU_CHIPS_PER_HOST_BOUNDS"] == "2,4,1"
+        assert envs["TPU_ACCELERATOR_TYPE"] == "v5e-16"
+        with pytest.raises(grpc.RpcError) as ei:
+            c.allocate(["tpu-0", "tpu-1", "tpu-2", "tpu-3"])
+        assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        assert "not aligned" in ei.value.details()
+    finally:
+        c.close()
+        proc.terminate()
+        proc.wait(timeout=5)

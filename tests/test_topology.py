@@ -86,3 +86,26 @@ def test_golden_vectors_match():
             assert got == subsets, (entry["name"], size_str)
         got_cases = topology.all_validation_cases(acc)
         assert got_cases == entry["validate_cases"], entry["name"]
+
+
+def test_multihost_slice_types():
+    """Multi-host slices (SURVEY.md §2.4(b)): whole-host-group allocation
+    only, host bounds drive the plugin's TPU_HOST_BOUNDS env."""
+    acc = topology.get("v5e-16")
+    assert acc.num_hosts == 2
+    assert acc.host_bounds == (2, 1, 1)
+    assert acc.chips_per_host == 8          # per-host surface unchanged
+    assert acc.total_chips == 16
+    assert acc.aligned_sizes == (8,)        # no sub-host allocation
+    assert acc.label_topology() == "4x4"    # slice grid, not per-host
+    ok, _ = topology.validate_allocation(acc, list(range(8)))
+    assert ok
+    ok, reason = topology.validate_allocation(acc, [0, 1, 2, 3])
+    assert not ok and "not aligned" in reason
+    v32 = topology.get("v5e-32")
+    assert (v32.num_hosts, v32.host_bounds) == (4, (2, 2, 1))
+    assert v32.label_topology() == "4x8"
+    # single-host types keep identity bounds and per-host label
+    v8 = topology.get("v5e-8")
+    assert (v8.num_hosts, v8.host_bounds) == (1, (1, 1, 1))
+    assert v8.label_topology() == "2x4"

@@ -24,7 +24,11 @@ class TpuDevice:
     vfio: bool = False
 
 
-_ACCEL_RE = re.compile(r"accel(?:_)?(\d+)$")
+# Chip index = trailing digits of the basename, whatever the prefix: the
+# glob names the device namespace (accel3, accel_3, tpu0, vfio group "45");
+# a basename without trailing digits is not a device node. The native
+# daemons share the rule (native/common/devenum.cc ParseIndex).
+_ACCEL_RE = re.compile(r"(\d+)$")
 
 
 def discover(device_glob: str = "/dev/accel*", devfs_root: str = "") -> List[TpuDevice]:

@@ -81,27 +81,46 @@ def psum_job(spec: ClusterSpec) -> Dict[str, Any]:
     return _job(spec, "tpu-psum", ["--mode=psum"], chips)
 
 
-def multihost_psum_job(spec: ClusterSpec,
-                       num_hosts: int = 2) -> List[Dict[str, Any]]:
+def multihost_psum_job(spec: ClusterSpec, num_hosts: int = 0,
+                       mode: str = "psum") -> List[Dict[str, Any]]:
     """The DCN half of BASELINE config 5: an Indexed Job spanning
     ``num_hosts`` TPU hosts plus the headless Service that gives each pod the
     stable DNS name the coordinator address needs (SURVEY.md §2.4(b), §7
     hard-part #4).
+
+    ``num_hosts=0`` derives the host count from the accelerator type — a
+    multi-host slice (v5e-16 etc., topology.num_hosts > 1) spans all its
+    hosts; single-host types default to a 2-host pair. ``mode`` selects the
+    validate entry point: "psum" (collective acceptance) or "burnin"
+    (sharded DP x TP train step over ICI + DCN).
 
     Env contract per pod (consumed by workloads/multihost.plan):
       JOB_COMPLETION_INDEX  set automatically by Indexed completion mode
       TPU_WORKER_HOSTNAMES  all pods' stable FQDNs, index order
       TPU_COORDINATOR_PORT  worker 0's jax.distributed port
     """
-    name = "tpu-psum-multihost"
+    acc = spec.tpu.accelerator_type
+    if num_hosts <= 0:
+        num_hosts = acc.num_hosts if acc.num_hosts > 1 else 2
+    if num_hosts < 2:
+        raise ValueError(
+            f"multihost job needs >= 2 hosts, got {num_hosts}")
+    if acc.num_hosts > 1 and num_hosts != acc.num_hosts:
+        # Every pod on a multi-host slice gets TPU_HOST_BOUNDS for the FULL
+        # slice from the plugin; a worker set of any other size waits
+        # forever for missing peers (or has extras that never join).
+        raise ValueError(
+            f"{acc.name} is a {acc.num_hosts}-host slice; the Indexed Job "
+            f"must span exactly {acc.num_hosts} workers, got {num_hosts}")
+    name = f"tpu-{mode}-multihost"
     svc_name = name
     ns = spec.tpu.namespace
-    chips = spec.tpu.accelerator_type.chips_per_host
+    chips = acc.chips_per_host
     hostnames = [
         f"{name}-{i}.{svc_name}.{ns}.svc.cluster.local"
         for i in range(num_hosts)
     ]
-    job = _job(spec, name, ["--mode=psum"], chips)
+    job = _job(spec, name, [f"--mode={mode}"], chips)
     job["spec"].update({
         "completionMode": "Indexed",
         "completions": num_hosts,
@@ -137,8 +156,23 @@ def multihost_psum_job(spec: ClusterSpec,
 
 def render_validation_jobs(spec: ClusterSpec,
                            multihost_hosts: int = 0) -> List[Dict[str, Any]]:
-    """All validation Jobs in runbook order (docs/GUIDE.md Phase 4); the
-    multi-host pair is included when ``multihost_hosts`` >= 2."""
+    """All validation Jobs in runbook order (docs/GUIDE.md Phase 4).
+
+    Single-host accelerator types get the four single-pod Jobs, plus the
+    DCN pairs when ``multihost_hosts`` >= 2 (a cluster of several
+    single-host nodes). Multi-host slice types (v5e-16 etc.) get ONLY
+    Indexed multi-host Jobs: the plugin refuses sub-host-group allocations
+    on them and hands every pod full-slice TPU_HOST_BOUNDS, so a single-pod
+    Job could never start (1-chip requests) or would wait forever for slice
+    peers — the whole validation surface must be worker sets spanning the
+    slice.
+    """
+    acc = spec.tpu.accelerator_type
+    if acc.num_hosts > 1:
+        objs: List[Dict[str, Any]] = []
+        for mode in ("device-query", "psum", "burnin"):
+            objs.extend(multihost_psum_job(spec, mode=mode))
+        return objs
     objs = [
         device_query_job(spec),
         vector_add_job(spec),
@@ -147,4 +181,5 @@ def render_validation_jobs(spec: ClusterSpec,
     ]
     if multihost_hosts >= 2:
         objs.extend(multihost_psum_job(spec, multihost_hosts))
+        objs.extend(multihost_psum_job(spec, multihost_hosts, mode="burnin"))
     return objs

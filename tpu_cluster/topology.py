@@ -41,9 +41,24 @@ class AcceleratorType:
     aligned_sizes: Tuple[int, ...]
     sub_mesh_shapes: Dict[int, Tuple[int, int]] = field(default_factory=dict)
     peak_bf16_tflops: float = 0.0  # per-chip, for bench reporting
+    # Multi-host slices (SURVEY.md §2.4(b)): how many hosts compose the slice
+    # and how they tile the slice grid. Single-host types keep (1, 1, 1).
+    # The device plugin derives the TPU_HOST_BOUNDS env from this instead of
+    # hardcoding single-host bounds; per-host ListAndWatch/Allocate semantics
+    # are unchanged (each host still advertises chips_per_host chips).
+    num_hosts: int = 1
+    host_bounds: Tuple[int, int, int] = (1, 1, 1)
 
     def label_topology(self) -> str:
-        return f"{self.topology[0]}x{self.topology[1]}"
+        """The slice chip grid (hosts x per-host grid) — what GKE publishes
+        as the topology label; equals the per-host grid on 1-host types."""
+        x = self.topology[0] * self.host_bounds[0]
+        y = self.topology[1] * self.host_bounds[1]
+        return f"{x}x{y}"
+
+    @property
+    def total_chips(self) -> int:
+        return self.chips_per_host * self.num_hosts
 
 
 # Per-host accelerator catalogue. Only per-host shapes matter to the device
@@ -97,6 +112,35 @@ V6E_8 = _register(AcceleratorType(
     hbm_gib_per_chip=32, aligned_sizes=(1, 4, 8),
     sub_mesh_shapes={1: (1, 1), 4: (2, 2), 8: (2, 4)},
     peak_bf16_tflops=918.0,
+))
+
+# Multi-host slices: each host contributes its full 2x4 chip group; hosts
+# tile the slice grid along x (v5e-16 is the 4x4 slice = 2 hosts of 2x4).
+# Pods must take whole host groups (aligned size 8 only) — the GKE rule for
+# multi-host v5e slices — and workers coordinate over DCN
+# (workloads/multihost.py renders/consumes the Indexed-Job env contract).
+V5E_16 = _register(AcceleratorType(
+    name="v5e-16", generation="v5e", chips_per_host=8, topology=(2, 4),
+    hbm_gib_per_chip=16, aligned_sizes=(8,),
+    sub_mesh_shapes={8: (2, 4)},
+    peak_bf16_tflops=197.0,
+    num_hosts=2, host_bounds=(2, 1, 1),
+))
+
+V5E_32 = _register(AcceleratorType(
+    name="v5e-32", generation="v5e", chips_per_host=8, topology=(2, 4),
+    hbm_gib_per_chip=16, aligned_sizes=(8,),
+    sub_mesh_shapes={8: (2, 4)},
+    peak_bf16_tflops=197.0,
+    num_hosts=4, host_bounds=(2, 2, 1),
+))
+
+V6E_16 = _register(AcceleratorType(
+    name="v6e-16", generation="v6e", chips_per_host=8, topology=(2, 4),
+    hbm_gib_per_chip=32, aligned_sizes=(8,),
+    sub_mesh_shapes={8: (2, 4)},
+    peak_bf16_tflops=918.0,
+    num_hosts=2, host_bounds=(2, 1, 1),
 ))
 
 

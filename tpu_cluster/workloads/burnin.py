@@ -140,24 +140,32 @@ def default_mesh_shape(n: int) -> Tuple[int, int]:
 def make_sharded_step(mesh: Mesh, cfg: BurninConfig):
     """Returns (step_fn, params, batch) with params sharded over 'model' and
     batch over 'data'; step jitted with explicit out_shardings so updated
-    params stay put (no host round-trips between steps)."""
-    pspecs = param_specs()
-    params = init_params(cfg, jax.random.PRNGKey(0))
-    params = {
-        k: jax.device_put(v, NamedSharding(mesh, pspecs[k]))
-        for k, v in params.items()
-    }
-    batch_spec = NamedSharding(mesh, P("data", None))
-    key = jax.random.PRNGKey(1)
-    tokens = jax.random.randint(key, (cfg.batch, cfg.seq), 0, cfg.vocab)
-    targets = jnp.roll(tokens, -1, axis=1)
-    batch = (jax.device_put(tokens, batch_spec),
-             jax.device_put(targets, batch_spec))
+    params stay put (no host round-trips between steps).
 
-    out_shardings = (
-        {k: NamedSharding(mesh, pspecs[k]) for k in params},
-        NamedSharding(mesh, P()),
-    )
+    Params and batch are initialised *inside* jit with out_shardings rather
+    than host-materialised and device_put: each device computes only its own
+    shard (no full-size host array, no host->device transfer of replicated
+    data), and — the multi-host point — the same code works when ``mesh``
+    spans processes over DCN, where a host-local array cannot be device_put
+    onto non-addressable devices. Every process runs the identical traced
+    computation; XLA materialises each process's shards locally.
+    """
+    pspecs = param_specs()
+    param_shardings = {k: NamedSharding(mesh, s) for k, s in pspecs.items()}
+    params = jax.jit(
+        lambda: init_params(cfg, jax.random.PRNGKey(0)),
+        out_shardings=param_shardings,
+    )()
+    batch_spec = NamedSharding(mesh, P("data", None))
+
+    def make_batch():
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (cfg.batch, cfg.seq), 0, cfg.vocab)
+        return tokens, jnp.roll(tokens, -1, axis=1)
+
+    batch = jax.jit(make_batch, out_shardings=(batch_spec, batch_spec))()
+
+    out_shardings = (param_shardings, NamedSharding(mesh, P()))
     step = jax.jit(
         lambda p, b: train_step(p, b, cfg),
         out_shardings=out_shardings,
@@ -181,6 +189,7 @@ def run(mesh_shape: Tuple[int, int] = None, steps: int = 5,
     decreasing = losses[-1] < losses[0]
     return {
         "check": "burnin", "mesh": {"data": shape[0], "model": shape[1]},
+        "devices": n, "processes": jax.process_count(),
         "steps": steps, "losses": [round(l, 4) for l in losses],
         "seconds": dt, "loss_decreasing": bool(decreasing),
         "ok": bool(decreasing and np.isfinite(losses).all()),

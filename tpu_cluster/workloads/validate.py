@@ -12,7 +12,8 @@ Modes map to the reference's validation workloads (SURVEY.md §2.3):
   vector-add    jnp.add on one chip               (cuda-vector-add analog)
   matmul        bf16 matmul throughput            (compute smoke)
   psum          collective matrix over the mesh   (NCCL all-reduce analog)
-  suite         all of the above
+  burnin        sharded train step over the mesh  (DP x TP; loss decreases)
+  suite         all of the above (except burnin)
 
 Multi-host Jobs run the same modes: ``multihost.initialize()`` is called
 first and is a no-op unless the Indexed-Job env (TPU_WORKER_HOSTNAMES …) is
@@ -75,6 +76,13 @@ def run(mode: str, matmul_dim: int = 2048, psum_devices: int = 0,
             result["ok"] = bool(result.get("ok")) and gp["ok"]
         else:
             result.update(collectives.collective_matrix(psum_devices))
+    elif mode == "burnin":
+        # Sharded DP x TP train step over the full (possibly multi-process)
+        # mesh — the deepest acceptance check: device plugin allocation ->
+        # jax.distributed bootstrap -> XLA collectives over ICI + DCN inside
+        # a real training step (SURVEY.md §2.4(b)).
+        from . import burnin
+        result.update(burnin.run())
     elif mode == "suite":
         result.update(smoke.run_suite(matmul_dim=matmul_dim))
         result["psum"] = collectives.collective_matrix(psum_devices)
@@ -88,7 +96,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="tpu_cluster.workloads.validate")
     ap.add_argument("--mode", default="suite",
                     choices=["device-query", "vector-add", "matmul", "psum",
-                             "suite"])
+                             "burnin", "suite"])
     ap.add_argument("--matmul-dim", type=int, default=2048)
     ap.add_argument("--psum-devices", type=int, default=0,
                     help="0 = all local devices")
