@@ -32,6 +32,31 @@ echo "--- creating kind cluster"
 kind create cluster --name "$CLUSTER" --wait 120s
 kind load docker-image "$IMG" --name "$CLUSTER"
 
+if command -v helm >/dev/null 2>&1; then
+  echo "--- helm install --wait (the reference's L4->L5 seam, README.md:101)"
+  # Real Helm renders + installs the generated chart and blocks on operand
+  # readiness. libtpuPrep/nodeStatusExporter expect real device nodes, so
+  # they stay off; the device plugin comes up advertising 0 chips on the
+  # TPU-less kind nodes and feature discovery labels present=false — both
+  # DaemonSets must still go Ready or --wait fails the job.
+  helm install tpu-helm "$REPO/deploy/chart/tpu-stack" \
+    --set namespace=tpu-helm \
+    --set image="$IMG" \
+    --set libtpuPrep.enabled=false \
+    --set nodeStatusExporter.enabled=false \
+    --wait --timeout 180s
+  kubectl -n tpu-helm get pods
+  helm uninstall tpu-helm --wait --timeout 120s
+  # cluster-scoped RBAC must be gone before the kubectl-apply path reuses
+  # the same names
+  kubectl delete clusterrole tpu-feature-discovery --ignore-not-found
+  kubectl delete clusterrolebinding tpu-feature-discovery --ignore-not-found
+  kubectl delete namespace tpu-helm --ignore-not-found --wait=true
+  echo "helm install/uninstall OK"
+else
+  echo "NOTICE: helm not available - skipping helm install exercise"
+fi
+
 echo "--- rendering manifests (fake-device mode)"
 SPEC=$(mktemp)
 cat >"$SPEC" <<EOF

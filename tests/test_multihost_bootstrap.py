@@ -139,3 +139,19 @@ def test_two_process_sharded_train_step():
         assert doc["bootstrap"]["process_id"] == idx
     # SPMD: the replicated loss history must be identical on both workers
     assert docs[0]["losses"] == docs[1]["losses"]
+
+
+def test_two_process_device_query_checks_global_slice():
+    """Multi-host device-query must verify the ASSEMBLED slice: per-worker
+    local count against the catalogue AND the global device count across
+    all workers (a half-joined slice must fail, not pass per-pod)."""
+    results = run_two_workers(
+        [sys.executable, "-m", "tpu_cluster.workloads.validate",
+         "--mode=device-query", "--expect-devices=4"])
+    for idx, (rc, out, err, _) in enumerate(results):
+        assert rc == 0, f"worker {idx} failed:\n{err[-2000:]}"
+        doc = json.loads(out[out.index("{"):])
+        assert doc["ok"], doc
+        assert doc["local_device_count"] == 4
+        assert doc["expected_global_devices"] == 8
+        assert doc["global_device_count"] == 8

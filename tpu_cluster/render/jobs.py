@@ -120,7 +120,12 @@ def multihost_psum_job(spec: ClusterSpec, num_hosts: int = 0,
         f"{name}-{i}.{svc_name}.{ns}.svc.cluster.local"
         for i in range(num_hosts)
     ]
-    job = _job(spec, name, [f"--mode={mode}"], chips)
+    args = [f"--mode={mode}"]
+    if mode == "device-query":
+        # pin the expectation to the catalogue, not to the plugin's own
+        # Allocate env (which would compare one source against itself)
+        args.append(f"--expect-devices={chips}")
+    job = _job(spec, name, args, chips)
     job["spec"].update({
         "completionMode": "Indexed",
         "completions": num_hosts,
@@ -169,9 +174,11 @@ def render_validation_jobs(spec: ClusterSpec,
     """
     acc = spec.tpu.accelerator_type
     if acc.num_hosts > 1:
+        # forward an explicit host count so a mismatch with the slice's
+        # host count raises here instead of rendering a hung worker set
         objs: List[Dict[str, Any]] = []
         for mode in ("device-query", "psum", "burnin"):
-            objs.extend(multihost_psum_job(spec, mode=mode))
+            objs.extend(multihost_psum_job(spec, multihost_hosts, mode=mode))
         return objs
     objs = [
         device_query_job(spec),

@@ -55,6 +55,15 @@ def run(mode: str, matmul_dim: int = 2048, psum_devices: int = 0,
         # A partially-initialized node (degraded ICI, dead chip) must FAIL
         # the nvidia-smi-analog check, not pass with fewer devices.
         result["ok"] = rep["local_device_count"] == expected
+        if bootstrap["multihost"]:
+            # the assembled slice: every worker's chips must be globally
+            # visible, or a missing/half-joined host passes unnoticed
+            import jax
+            want_global = expected * bootstrap["num_processes"]
+            result["expected_global_devices"] = want_global
+            result["global_device_count"] = jax.device_count()
+            result["ok"] = (result["ok"]
+                            and jax.device_count() == want_global)
     elif mode == "vector-add":
         result.update(smoke.vector_add())
     elif mode == "matmul":
