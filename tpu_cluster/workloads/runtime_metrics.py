@@ -12,8 +12,18 @@ Metrics published per local device (names shared with the tpu-info probe,
 which renders tpu_hbm_used_bytes in its table — native/tpuinfo):
   tpu_hbm_used_bytes{chip=...}     from device.memory_stats()
   tpu_hbm_limit_bytes{chip=...}
+  tpu_hbm_source{source=...}       where the HBM numbers came from
   tpu_process_devices              local device count of the writer
   tpu_runtime_metrics_timestamp_seconds  staleness marker for scrapers
+
+``device.memory_stats()`` returns None on some runtimes (observed: the
+tunneled v5e backend); the limit gauge then falls back to the accelerator
+catalogue (tpu_cluster.topology, resolved from the TPU_ACCELERATOR_TYPE env
+the device plugin's Allocate injects, else the JAX device_kind), flagged
+``tpu_hbm_source{source="catalogue"}``. Used-bytes is only published when
+the runtime reports it — a fabricated value would be worse than an absent
+one — so scrapers alert on capacity present + usage missing via the source
+gauge, never on silently-empty output.
 
 The write is atomic (tmp + rename) so the exporter never relays a torn file.
 """
@@ -35,6 +45,7 @@ def collect_lines(now: Optional[float] = None) -> List[str]:
         "owning JAX process)",
         "# TYPE tpu_hbm_used_bytes gauge",
     ]
+    from .. import topology
     from .smoke import hbm_stats
 
     devices = jax.local_devices()
@@ -45,12 +56,35 @@ def collect_lines(now: Optional[float] = None) -> List[str]:
             in_use[d.id] = stats["bytes_in_use"]
         if "bytes_limit" in stats:
             limits[d.id] = stats["bytes_limit"]
+    source = "memory_stats"
+    if not limits and devices and devices[0].platform == "tpu":
+        # Runtime exposes no memory stats (tunneled backends return None):
+        # capacity from the catalogue so the limit gauge is never silently
+        # absent. Used-bytes stays runtime-only. source="none" marks the
+        # double-miss (unknown device kind, no Allocate env) so scrapers can
+        # tell "runtime supplied stats" from "nobody could".
+        acc = None
+        acc_env = os.environ.get("TPU_ACCELERATOR_TYPE", "")
+        if acc_env in topology.ACCELERATOR_TYPES:
+            acc = topology.get(acc_env)
+        if acc is None:
+            acc = topology.from_device_kind(devices[0].device_kind)
+        if acc is not None:
+            source = "catalogue"
+            limits = {d.id: acc.hbm_gib_per_chip << 30 for d in devices}
+        else:
+            source = "none"
     for chip, val in sorted(in_use.items()):
         lines.append(f'tpu_hbm_used_bytes{{chip="{chip}"}} {val}')
     lines += ["# HELP tpu_hbm_limit_bytes HBM capacity visible to the runtime",
               "# TYPE tpu_hbm_limit_bytes gauge"]
     for chip, val in sorted(limits.items()):
         lines.append(f'tpu_hbm_limit_bytes{{chip="{chip}"}} {val}')
+    lines += [
+        "# HELP tpu_hbm_source where the HBM gauges came from",
+        "# TYPE tpu_hbm_source gauge",
+        f'tpu_hbm_source{{source="{source}"}} 1',
+    ]
     lines += [
         "# HELP tpu_process_devices local devices owned by the writer",
         "# TYPE tpu_process_devices gauge",
