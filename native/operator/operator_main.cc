@@ -267,6 +267,7 @@ class Operator {
   }
 
   void RunForever() {
+    int failures = 0;
     while (!g_stop) {
       // The bundle is a mounted ConfigMap that kubelet live-updates; reload
       // each pass so a re-rendered bundle rolls out without a pod restart
@@ -281,8 +282,26 @@ class Operator {
       }
       bool ok = ReconcilePass();
       healthy_ = ok;
-      if (ok) fprintf(stderr, "tpu-operator: pass %d converged\n", passes_);
-      Sleep(opt_.interval_s * 1000);
+      if (ok) {
+        failures = 0;
+        fprintf(stderr, "tpu-operator: pass %d converged\n", passes_);
+      } else {
+        ++failures;
+      }
+      // Failed passes back off exponentially with +/-10% jitter: an
+      // apiserver bounce must not be met with a synchronized full-rate
+      // retry storm from every operator in the fleet. The 5-min cap only
+      // bounds the BACKOFF — a configured interval above it is honored.
+      int sleep_ms = opt_.interval_s * 1000;
+      if (failures > 0) {
+        int cap_ms = std::max(300 * 1000, sleep_ms);
+        for (int i = 0; i < failures && sleep_ms < cap_ms; ++i)
+          sleep_ms *= 2;
+        sleep_ms = std::min(sleep_ms, cap_ms);
+      }
+      sleep_ms = static_cast<int>(
+          sleep_ms * (0.9 + 0.2 * (rand() / double(RAND_MAX))));
+      Sleep(sleep_ms);
     }
   }
 
@@ -351,7 +370,21 @@ class Operator {
       std::string coll = kubeapi::CollectionPath(*bo->obj, &err);
       kubeclient::Response post =
           kubeclient::Call(cfg_, "POST", coll, bo->obj->Dump());
-      if (!post.ok()) {
+      if (post.status == 409) {
+        // AlreadyExists despite our 404 read: stale-read window after an
+        // apiserver bounce/HA failover (or a concurrent creator). The
+        // object is there — patch it, don't fail the pass.
+        kubeclient::Response patch =
+            kubeclient::Call(cfg_, "PATCH", obj_path, bo->obj->Dump(),
+                             "application/merge-patch+json");
+        if (!patch.ok()) {
+          bo->error = "PATCH after 409 " + obj_path + " -> " +
+                      std::to_string(patch.status) + " " +
+                      (patch.status ? patch.body.substr(0, 160)
+                                    : patch.error);
+          return false;
+        }
+      } else if (!post.ok()) {
         bo->error = "POST " + coll + " -> " + std::to_string(post.status) +
                     " " + (post.status ? post.body.substr(0, 160) : post.error);
         return false;
@@ -465,6 +498,7 @@ int main(int argc, char** argv) {
   // transport carrying the ServiceAccount token).
   cfg.insecure_skip_tls_verify = opt.insecure_skip_tls_verify;
 
+  srand(static_cast<unsigned>(getpid() ^ time(nullptr)));
   signal(SIGINT, HandleSignal);
   signal(SIGTERM, HandleSignal);
   signal(SIGPIPE, SIG_IGN);

@@ -75,12 +75,23 @@ def make_self_signed(tmp_dir) -> Tuple[str, str]:
 class FakeApiServer:
     """``tls`` = (certfile, keyfile) serves HTTPS — used to exercise the
     operator's in-cluster transport (exec-of-curl with --cacert + bearer
-    token) without a real apiserver."""
+    token) without a real apiserver.
 
-    def __init__(self, auto_ready: bool = True, tls=None):
+    Restart simulation: ``port`` pins the listen port so a second instance
+    can come up where a stopped one was, and ``store`` seeds the object
+    store (the bounced apiserver kept etcd). ``ghost_get_404`` lists paths
+    whose GET lies 404 while the object IS stored — the stale-read window
+    after a bounce/HA failover, where a client's create races the object's
+    existence and must handle POST -> 409 AlreadyExists by patching;
+    the window clears after the first ghosted read."""
+
+    def __init__(self, auto_ready: bool = True, tls=None, port: int = 0,
+                 store: Optional[Dict[str, Dict[str, Any]]] = None,
+                 ghost_get_404=()):
         self.auto_ready = auto_ready
         self._tls = tls
-        self.store: Dict[str, Dict[str, Any]] = {}
+        self.store: Dict[str, Dict[str, Any]] = dict(store or {})
+        self.ghost_get_404 = set(ghost_get_404)
         self.log: List[Tuple[str, str]] = []  # (method, path)
         self.created: List[str] = []          # stored object paths, in order
         self.headers_seen: List[Dict[str, str]] = []
@@ -116,6 +127,9 @@ class FakeApiServer:
                 self._record()
                 with fake._lock:
                     obj = fake.store.get(self.path)
+                    if self.path in fake.ghost_get_404:
+                        obj = None  # stale read: stored but reported absent
+                        fake.ghost_get_404.discard(self.path)
                 if obj is None:
                     self._reply(404, {"kind": "Status", "code": 404})
                 else:
@@ -190,7 +204,7 @@ class FakeApiServer:
                     gone = fake.store.pop(self.path, None)
                 self._reply(200 if gone is not None else 404, {})
 
-        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
         if tls is not None:
             import ssl
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
@@ -232,6 +246,11 @@ class FakeApiServer:
         with self._lock:
             obj = self.store.get(path)
             return json.loads(json.dumps(obj)) if obj else None
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Deep copy of the store under the lock (restart-carryover seed)."""
+        with self._lock:
+            return json.loads(json.dumps(self.store))
 
     def set_ready(self, path: str, ready: bool = True):
         """Flip a workload object's readiness (the node-simulator stand-in)."""

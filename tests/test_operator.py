@@ -445,3 +445,82 @@ def test_operator_rbac_covers_bundle_grants():
                     for res in rule["resources"]:
                         for v in rule["verbs"]:
                             assert covered(g, res, v), (name, g, res, v)
+
+
+def test_post_409_falls_back_to_patch(native_build, bundle_dir):
+    """Stale-read window after an apiserver bounce: GET says 404, POST says
+    409 AlreadyExists. The operator must PATCH instead of failing the pass
+    (the duplicate-create path from the round-1 verdict, next-round #8)."""
+    ghost = f"{DS}/tpu-device-plugin"
+    seed = {
+        ghost: {"apiVersion": "apps/v1", "kind": "DaemonSet",
+                "metadata": {"name": "tpu-device-plugin", "namespace": NS,
+                             "generation": 1},
+                "spec": {"selector": {}},
+                "status": {"desiredNumberScheduled": 2, "numberReady": 2,
+                           "updatedNumberScheduled": 2,
+                           "observedGeneration": 1}},
+    }
+    with FakeApiServer(auto_ready=True, store=seed,
+                       ghost_get_404=[ghost]) as api:
+        proc = run_operator(
+            native_build, f"--apiserver={api.url}",
+            f"--bundle-dir={bundle_dir}", "--once", "--poll-ms=20",
+            "--stage-timeout=10", "--status-port=0")
+        assert proc.returncode == 0, proc.stderr
+        status = json.loads(proc.stdout)
+        assert status["healthy"], status
+        # the wire saw the race: POST (rejected 409) then PATCH on the path
+        posts = [(m, p) for (m, p) in api.log
+                 if m == "POST" and p == DS]
+        patches = [(m, p) for (m, p) in api.log
+                   if m == "PATCH" and p == ghost]
+        assert posts and patches, api.log
+        # and the object carries the operator's spec after the patch
+        obj = api.get(ghost)
+        assert obj["spec"]["template"], "PATCH after 409 did not apply spec"
+
+
+def test_operator_survives_apiserver_bounce(native_build, bundle_dir):
+    """Kill the apiserver mid-reconcile, bring it back on the same port
+    with the same store (etcd survived): the operator must reconverge on
+    its own, with no duplicate-create errors — only GET->PATCH repairs."""
+    # every bundle object must have landed before the snapshot, or the
+    # revived server legitimately gets POSTs for the missing tail
+    bundle_size = len(os.listdir(bundle_dir))
+    with FakeApiServer(auto_ready=True) as api:
+        port = int(api.url.rsplit(":", 1)[1])
+        proc = start_operator(
+            native_build, f"--apiserver={api.url}",
+            f"--bundle-dir={bundle_dir}", "--interval=1", "--poll-ms=20",
+            "--stage-timeout=10", "--status-port=0")
+        try:
+            # first pass converges fully against server A
+            assert wait_until(lambda: len(api.created) == bundle_size,
+                              timeout=20), api.created
+            carried = api.snapshot()
+            api.stop()  # the bounce — mid-run, operator keeps reconciling
+
+            time.sleep(1.5)  # at least one pass fails against a dead server
+            with FakeApiServer(auto_ready=True, port=port,
+                               store=carried) as api2:
+                # reconvergence: a full pass lands on the revived server
+                assert wait_until(
+                    lambda: any(m == "PATCH" and p.endswith(
+                        "tpu-node-status-exporter")
+                        for (m, p) in api2.log),
+                    timeout=30), api2.log
+                # no duplicate creates: every object survived in the store,
+                # so the repair pass is pure GET->PATCH
+                assert api2.created == [], api2.created
+                posts = [(m, p) for (m, p) in api2.log if m == "POST"]
+                assert posts == [], posts
+        finally:
+            api.stop()  # idempotent if the bounce already happened
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        stderr = proc.stderr.read()
+        assert "converged" in stderr

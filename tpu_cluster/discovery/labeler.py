@@ -24,6 +24,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import random
 import sys
 import time
 import urllib.request
@@ -169,17 +170,28 @@ def main(argv=None) -> int:
               "from the DaemonSet manifest?)", file=sys.stderr)
         return 2
     previous_condition: Optional[dict] = None
+    failures = 0
     while True:
         try:
             record = run_once(args, previous_condition)
             previous_condition = record.get("condition")
+            failures = 0
         except Exception as exc:  # keep the daemon alive across apiserver blips
             if args.oneshot:
                 raise
+            failures += 1
             print(f"label refresh failed (will retry): {exc}", file=sys.stderr)
         if args.oneshot:
             return 0
-        time.sleep(args.interval)
+        # Exponential backoff on apiserver errors, +/-10% jitter always
+        # (fleet-desynchronised refresh; mirrors the native tpu-tfd daemon).
+        # The 5-min cap bounds only the backoff; a configured interval above
+        # it is honored as-is.
+        delay = args.interval
+        if failures:
+            delay = min(args.interval * (2 ** failures),
+                        max(300.0, args.interval))
+        time.sleep(delay * random.uniform(0.9, 1.1))
 
 
 if __name__ == "__main__":

@@ -177,6 +177,16 @@ class Client:
                                                       'failure')}")
         if code == 404:
             code, resp = self._request("POST", collection_path(obj), obj)
+            if code == 409:
+                # AlreadyExists despite our 404 read: stale-read window
+                # after an apiserver bounce/HA failover (or a concurrent
+                # creator). The object is there — patch it, don't fail.
+                code, resp = self._request("PATCH", path, obj,
+                                           "application/merge-patch+json")
+                if code != 200:
+                    raise ApplyError(
+                        f"PATCH after 409 {path}: {code} {resp}")
+                return "patched"
             if code not in (200, 201, 202):
                 raise ApplyError(f"POST {path}: {code} {resp}")
             return "created"
