@@ -10,11 +10,16 @@ from tpu_cluster import spec as specmod, triage, verify
 
 def node(name, ready=True, tpu=8, labeled=True):
     labels = {"google.com/tpu.present": "true"} if labeled else {}
+    conditions = [{"type": "Ready", "status": "True" if ready else "False"}]
+    if labeled:
+        # what tpu-tfd --conditions publishes for this census
+        conditions.append(
+            {"type": "TpuReady", "status": "True" if tpu == 8 else "False",
+             "reason": "AllChipsPresent" if tpu == 8 else "DegradedChipSet"})
     return {
         "metadata": {"name": name, "labels": labels},
         "status": {
-            "conditions": [{"type": "Ready",
-                            "status": "True" if ready else "False"}],
+            "conditions": conditions,
             "allocatable": ({"google.com/tpu": str(tpu)} if tpu else {}),
         },
     }
@@ -108,6 +113,7 @@ def test_checks_fail_loudly_on_broken_cluster(spec):
     assert not results["operands"].ok
     assert "tpu-device-plugin" in results["operands"].detail
     assert not results["labels"].ok
+    assert not results["conditions"].ok
     assert not results["allocatable"].ok and "4" in results["allocatable"].detail
     assert not results["metrics"].ok
     assert not results["psum"].ok and "failed 2" in results["psum"].detail
@@ -173,3 +179,15 @@ def test_triage_collects_describe_and_logs_for_problem_pods(spec):
     # healthy pod not described (runbook discipline: triage what's broken)
     assert "describe tpu-libtpu-prep-def" not in text
     assert "hints" in text
+
+
+def test_conditions_catch_degraded_labeled_node(spec):
+    """A node still labeled present=true but with a degraded chip census
+    (TpuReady=False) must fail `conditions` even though `labels` passes."""
+    runner = CannedRunner(healthy=True)
+    runner.responses["get nodes -l google.com/tpu.present=true"] = {
+        "items": [node("tpu-node-0"), node("tpu-node-1", tpu=5)]}
+    assert verify.check_labels(runner, spec).ok
+    res = verify.check_conditions(runner, spec)
+    assert not res.ok
+    assert "tpu-node-1: DegradedChipSet" in res.detail

@@ -121,6 +121,30 @@ def check_labels(runner: Runner, spec: ClusterSpec) -> CheckResult:
     return CheckResult("labels", True, f"TPU nodes: {names}")
 
 
+def check_conditions(runner: Runner, spec: ClusterSpec) -> CheckResult:
+    """TpuReady node condition (published by tpu-tfd --conditions; the
+    node-problem-detector-style health surface, SURVEY.md §5). A labeled TPU
+    node whose chip census degraded must show here before anything schedules
+    onto it."""
+    nodes = _kubectl_json(runner, ["get", "nodes", "-l",
+                                   "google.com/tpu.present=true"])
+    if not nodes or not nodes.get("items"):
+        return CheckResult("conditions", False,
+                           "no nodes labeled google.com/tpu.present=true")
+    bad = []
+    for n in nodes["items"]:
+        conds = {c.get("type"): c
+                 for c in n["status"].get("conditions", [])}
+        tr = conds.get("TpuReady")
+        if not tr or tr.get("status") != "True":
+            why = (tr or {}).get("reason", "condition absent")
+            bad.append(f'{n["metadata"]["name"]}: {why}')
+    if bad:
+        return CheckResult("conditions", False, "; ".join(bad))
+    return CheckResult("conditions", True,
+                       f"TpuReady=True on {len(nodes['items'])} node(s)")
+
+
 def check_allocatable(runner: Runner, spec: ClusterSpec) -> CheckResult:
     """Extended resource in Allocatable (reference README.md:122 analog) —
     the BASELINE.json headline metric."""
@@ -237,6 +261,7 @@ CHECKS: Dict[str, Callable[[Runner, ClusterSpec], CheckResult]] = {
     "smoke": check_smoke,
     "operands": check_operands,
     "labels": check_labels,
+    "conditions": check_conditions,
     "allocatable": check_allocatable,
     "device-query": check_device_query,
     "vector-add": check_vector_add,
