@@ -217,6 +217,24 @@ def main() -> int:
         # would indicate measurement error, not magic.
         doc["peak_bf16_tflops"] = acc.peak_bf16_tflops
         doc["mfu"] = round(value / acc.peak_bf16_tflops, 3)
+        # Training-step realism: the flagship burn-in model's full train
+        # step (fwd+bwd+update, FLOPs from XLA's own cost analysis), not
+        # just the raw matmul kernel.
+        from jax.sharding import Mesh
+        import numpy as np
+
+        from tpu_cluster.workloads import burnin
+        mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1),
+                    ("data", "model"))
+        cfg = burnin.BurninConfig(vocab=8192, d_model=2048, d_ff=8192,
+                                  n_heads=16, seq=512, batch=16)
+        ts = burnin.timed_steps(mesh, cfg, steps=10)
+        doc["train_step"] = {
+            "tflops": round(ts["tflops"], 2),
+            "mfu": round(ts["tflops"] / acc.peak_bf16_tflops, 3),
+            "tokens_per_s": round(ts["tokens_per_s"]),
+            "points": ts["points"],
+        }
     print(json.dumps(doc))
     return 0
 

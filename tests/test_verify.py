@@ -191,3 +191,49 @@ def test_conditions_catch_degraded_labeled_node(spec):
     res = verify.check_conditions(runner, spec)
     assert not res.ok
     assert "tpu-node-1: DegradedChipSet" in res.detail
+
+
+def test_multihost_slice_checks_use_worker_set_jobs():
+    """On a v5e-16 spec the rendered Jobs are the Indexed worker sets:
+    verify must look for them (and the global device count), and vector-add
+    is n/a rather than a false failure."""
+    s = specmod.load("tpu: {accelerator: v5e-16}")
+    runner = CannedRunner(healthy=True)
+    runner.responses["get job -n tpu-system tpu-psum-multihost"] = \
+        job("tpu-psum-multihost", completions=2, succeeded=2)
+    runner.responses["get job -n tpu-system tpu-burnin-multihost"] = \
+        job("tpu-burnin-multihost", completions=2, succeeded=2)
+    runner.responses["get job -n tpu-system tpu-device-query-multihost"] = \
+        job("tpu-device-query-multihost", completions=2, succeeded=2)
+    # worker logs report the assembled slice: 16 global devices
+    runner.device_query_logs = json.dumps(
+        {"device_count": 16, "platform": "tpu"})
+    orig = runner.__call__
+
+    def with_mh_logs(argv):
+        rest = [a for a in argv[1:] if a not in ("-o", "json")]
+        if rest[0] == "logs" and rest[-1] == "job/tpu-device-query-multihost":
+            return 0, runner.device_query_logs
+        return orig(argv)
+
+    assert verify.check_psum(with_mh_logs, s).ok
+    assert verify.check_burnin(with_mh_logs, s).ok
+    res = verify.check_device_query(with_mh_logs, s)
+    assert res.ok and "16/16" in res.detail
+    va = verify.check_vector_add(with_mh_logs, s)
+    assert va.ok and "n/a" in va.detail
+    # a worker set that only saw one host's chips must fail
+    runner.device_query_logs = json.dumps(
+        {"device_count": 8, "platform": "tpu"})
+    res = verify.check_device_query(with_mh_logs, s)
+    assert not res.ok and "expected 16" in res.detail
+
+
+def test_burnin_check_optional_on_single_host(spec):
+    runner = CannedRunner(healthy=True)
+    res = verify.check_burnin(runner, spec)
+    assert res.ok and "not rendered" in res.detail
+    runner.responses["get job -n tpu-system tpu-burnin-multihost"] = \
+        job("tpu-burnin-multihost", completions=2, succeeded=1, failed=1)
+    res = verify.check_burnin(runner, spec)
+    assert not res.ok  # applied but failing must not be glossed over

@@ -92,3 +92,22 @@ def test_multihost_missing_hosts():
 def test_multihost_missing_worker_id_is_diagnosable():
     with pytest.raises(RuntimeError, match="completionMode"):
         multihost.plan({"TPU_WORKER_HOSTNAMES": "a,b"})
+
+
+def test_timed_steps_measures_train_throughput():
+    """bench.py's train-step MFU source: scan-batched steps, single-step
+    XLA cost analysis x steps, fetch-synced two-point timing."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    import jax
+    from tpu_cluster.workloads import burnin
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("data", "model"))
+    cfg = burnin.BurninConfig(vocab=64, d_model=32, d_ff=64, n_heads=2,
+                              seq=8, batch=4)
+    ts = burnin.timed_steps(mesh, cfg, steps=2, reps=1)
+    assert ts["flops_per_step"] > 0          # cost analysis produced FLOPs
+    assert ts["tflops"] >= 0
+    assert [p["steps"] for p in ts["points"]] == [2, 6]
+    assert ts["tokens_per_s"] > 0

@@ -200,15 +200,26 @@ def _check_job(runner: Runner, spec: ClusterSpec, check: str,
                        f"{job} succeeded {got}/{want}, failed {failed}")
 
 
+def _multihost_slice(spec: ClusterSpec) -> bool:
+    """Multi-host slice types render ONLY Indexed worker-set Jobs
+    (render/jobs.py): the Job names and expected device counts differ."""
+    return spec.tpu.accelerator_type.num_hosts > 1
+
+
 def check_device_query(runner: Runner, spec: ClusterSpec) -> CheckResult:
     """BASELINE config 2: the nvidia-smi analog Job — status AND golden
     output (the runbook pastes the expected table; we assert the parsed
-    device count, reference README.md:157-168 analog)."""
-    res = _check_job(runner, spec, "device-query", "tpu-device-query")
+    device count, reference README.md:157-168 analog). On multi-host slice
+    types the Job is the Indexed worker set and the golden count is the
+    assembled slice's GLOBAL device count."""
+    acc = spec.tpu.accelerator_type
+    job = ("tpu-device-query-multihost" if _multihost_slice(spec)
+           else "tpu-device-query")
+    res = _check_job(runner, spec, "device-query", job)
     if not res.ok:
         return res
     rc, out = runner(["kubectl", "logs", "-n", spec.tpu.namespace,
-                      "job/tpu-device-query"])
+                      f"job/{job}"])
     if rc != 0:
         # Fail closed (like the apply gates): a Job whose pods were GC'd
         # proves nothing about the current chip set.
@@ -219,7 +230,7 @@ def check_device_query(runner: Runner, spec: ClusterSpec) -> CheckResult:
     if doc is None:
         return CheckResult("device-query", False,
                            "job logs are not the expected JSON report")
-    want = spec.tpu.accelerator_type.chips_per_host
+    want = acc.total_chips if _multihost_slice(spec) else acc.chips_per_host
     got = doc.get("device_count")
     if got != want:
         return CheckResult("device-query", False,
@@ -230,12 +241,37 @@ def check_device_query(runner: Runner, spec: ClusterSpec) -> CheckResult:
 
 def check_vector_add(runner: Runner, spec: ClusterSpec) -> CheckResult:
     """BASELINE config 3: the cuda-vector-add analog Job."""
+    if _multihost_slice(spec):
+        # single-pod Jobs cannot run on a multi-host slice (the plugin only
+        # allocates whole host groups); compute correctness is covered by
+        # the psum/burnin worker sets
+        return CheckResult(
+            "vector-add", True,
+            f"n/a on {spec.tpu.accelerator} (multi-host slice; covered by "
+            "the psum/burnin worker sets)")
     return _check_job(runner, spec, "vector-add", "tpu-vector-add")
 
 
 def check_psum(runner: Runner, spec: ClusterSpec) -> CheckResult:
-    """BASELINE config 5: all-reduce over ICI."""
-    return _check_job(runner, spec, "psum", "tpu-psum")
+    """BASELINE config 5: all-reduce over ICI (single host) or ICI+DCN
+    (multi-host slice worker set)."""
+    job = ("tpu-psum-multihost" if _multihost_slice(spec) else "tpu-psum")
+    return _check_job(runner, spec, "psum", job)
+
+
+def check_burnin(runner: Runner, spec: ClusterSpec) -> CheckResult:
+    """The sharded DP x TP train-step Job. Rendered unconditionally for
+    multi-host slice types (required there); optional on single-host specs
+    unless the user applied it via --multihost."""
+    if _multihost_slice(spec):
+        return _check_job(runner, spec, "burnin", "tpu-burnin-multihost")
+    doc = _kubectl_json(runner, ["get", "job", "-n", spec.tpu.namespace,
+                                 "tpu-burnin-multihost"])
+    if doc is None:
+        return CheckResult("burnin", True,
+                           "not rendered (optional on single-host specs; "
+                           "tpuctl render --multihost N to enable)")
+    return _check_job(runner, spec, "burnin", "tpu-burnin-multihost")
 
 
 def check_metrics(runner: Runner, spec: ClusterSpec) -> CheckResult:
@@ -267,6 +303,7 @@ CHECKS: Dict[str, Callable[[Runner, ClusterSpec], CheckResult]] = {
     "vector-add": check_vector_add,
     "metrics": check_metrics,
     "psum": check_psum,
+    "burnin": check_burnin,
 }
 
 
