@@ -492,3 +492,33 @@ def test_allocate_multihost_slice_env(native_build, tmp_path):
         c.close()
         proc.terminate()
         proc.wait(timeout=5)
+
+
+def test_device_add_pushes_listandwatch_update(native_build, tmp_path):
+    """The inverse of hot-unplug: a chip coming (back) online — e.g. a
+    repaired node, or libtpu-prep creating nodes late — must be pushed to
+    kubelet without a plugin restart, or the node under-advertises until
+    the pod is bounced."""
+    from tpu_cluster.discovery import devices as pydev
+    from tpu_cluster.plugin_api.client import DevicePluginClient
+    devfs = tmp_path / "devfs"
+    pydev.make_fake_tree(str(devfs), 4)
+    proc, sock = start_tpud(
+        native_build, tmp_path, f"--devfs-root={devfs}",
+        "--rescan-interval=1", "--no-register")
+    try:
+        c = DevicePluginClient(sock)
+        stream = c.list_and_watch()
+        first = next(stream)
+        assert len(first.devices) == 4
+        for i in range(4, 8):
+            (devfs / "dev" / f"accel{i}").write_text("")
+        second = next(stream)
+        assert len(second.devices) == 8
+        assert sorted(d.ID for d in second.devices) == [
+            f"tpu-{i}" for i in range(8)]
+        stream.cancel()
+        c.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
