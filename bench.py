@@ -29,7 +29,6 @@ from __future__ import annotations
 
 import json
 import os
-import shutil
 import socket
 import subprocess
 import sys
@@ -42,12 +41,14 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 T4_FP16_PEAK_TFLOPS = 65.0
 
 
-def measure_tflops(smoke) -> dict:
+def measure_tflops() -> dict:
     """Two-point measurement: the per-dispatch constant cancels in the
     difference, leaving the sustained MXU rate (nccl-tests busbw
     methodology). The constant is NOT negligible here: through the
     remote-chip tunnel a single dispatch+sync costs ~85ms, an order of
     magnitude above the 100-iter compute time."""
+    from tpu_cluster.workloads import smoke
+
     dim, lo_iters, hi_iters, reps = 4096, 200, 2000, 3
     # Best-of-N per point: the tunnel's dispatch+sync constant varies tens
     # of ms run-to-run, which the subtraction would otherwise inherit; the
@@ -79,9 +80,11 @@ def measure_tflops(smoke) -> dict:
     return out
 
 
-def validate_matrix(validate) -> dict:
+def validate_matrix() -> dict:
     """validate --mode=suite on the hardware, reduced to per-check verdicts
     (full documents would dwarf the bench line)."""
+    from tpu_cluster.workloads import validate
+
     doc = validate.run("suite")
     psum = doc.get("psum", {})
     return {
@@ -117,14 +120,15 @@ def _exporter_binary() -> str:
     return path if os.path.exists(path) else ""
 
 
-def metrics_scrape_roundtrip(runtime_metrics, platform: str) -> dict:
+def metrics_scrape_roundtrip(platform: str) -> dict:
     """BASELINE config 4 end to end: write real runtime metrics, relay them
     through the native exporter, scrape over HTTP, report the gauge names."""
-    if not (shutil.which("cmake") or _exporter_binary()):
-        return {"ok": False, "skipped": "no native toolchain"}
+    from tpu_cluster.workloads import runtime_metrics
+
     exporter = _exporter_binary()
     if not exporter:
-        return {"ok": False, "skipped": "exporter build failed"}
+        return {"ok": False,
+                "skipped": "no exporter (toolchain missing or build failed)"}
     with tempfile.TemporaryDirectory() as tmp:
         metrics_file = os.path.join(tmp, "metrics.prom")
         written = runtime_metrics.write(metrics_file)
@@ -183,19 +187,19 @@ def main() -> int:
     import jax
 
     from tpu_cluster import topology
-    from tpu_cluster.workloads import runtime_metrics, smoke, validate
+    from tpu_cluster.workloads import smoke
 
     device = jax.devices()[0]
     platform = device.platform
     # Acceptance matrix first (doubles as compile warm-up); its wall-clock
     # is the BASELINE.json north-star 'smoke Job' time.
-    checks = validate_matrix(validate)
+    checks = validate_matrix()
     if platform == "cpu":
         # Clusterless fallback: tiny shapes so CI stays fast.
         mm = smoke.matmul(512, 512, 512, iters=3)
         measured = {"tflops": round(mm["tflops"], 2), "points": []}
     else:
-        measured = measure_tflops(smoke)
+        measured = measure_tflops()
     value = measured["tflops"]
 
     doc = {
@@ -207,7 +211,7 @@ def main() -> int:
         "devices": jax.device_count(),
         "measure_points": measured["points"],
         "validate": checks,
-        "metrics_scrape": metrics_scrape_roundtrip(runtime_metrics, platform),
+        "metrics_scrape": metrics_scrape_roundtrip(platform),
     }
     if "note" in measured:
         doc["measure_note"] = measured["note"]

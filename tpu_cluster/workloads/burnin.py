@@ -137,19 +137,15 @@ def default_mesh_shape(n: int) -> Tuple[int, int]:
     return (n, 1)
 
 
-def make_sharded_step(mesh: Mesh, cfg: BurninConfig):
-    """Returns (step_fn, params, batch) with params sharded over 'model' and
-    batch over 'data'; step jitted with explicit out_shardings so updated
-    params stay put (no host round-trips between steps).
-
-    Params and batch are initialised *inside* jit with out_shardings rather
-    than host-materialised and device_put: each device computes only its own
-    shard (no full-size host array, no host->device transfer of replicated
-    data), and — the multi-host point — the same code works when ``mesh``
-    spans processes over DCN, where a host-local array cannot be device_put
-    onto non-addressable devices. Every process runs the identical traced
-    computation; XLA materialises each process's shards locally.
-    """
+def _global_init(mesh: Mesh, cfg: BurninConfig):
+    """Sharded params + batch, initialised *inside* jit with out_shardings
+    rather than host-materialised and device_put: each device computes only
+    its own shard (no full-size host array, no host->device transfer of
+    replicated data), and — the multi-host point — the same code works when
+    ``mesh`` spans processes over DCN, where a host-local array cannot be
+    device_put onto non-addressable devices. Every process runs the
+    identical traced computation; XLA materialises each process's shards
+    locally. Returns (param_shardings, params, batch)."""
     pspecs = param_specs()
     param_shardings = {k: NamedSharding(mesh, s) for k, s in pspecs.items()}
     params = jax.jit(
@@ -164,6 +160,15 @@ def make_sharded_step(mesh: Mesh, cfg: BurninConfig):
         return tokens, jnp.roll(tokens, -1, axis=1)
 
     batch = jax.jit(make_batch, out_shardings=(batch_spec, batch_spec))()
+    return param_shardings, params, batch
+
+
+def make_sharded_step(mesh: Mesh, cfg: BurninConfig):
+    """Returns (step_fn, params, batch) with params sharded over 'model' and
+    batch over 'data' (see _global_init); step jitted with explicit
+    out_shardings so updated params stay put (no host round-trips between
+    steps)."""
+    param_shardings, params, batch = _global_init(mesh, cfg)
 
     out_shardings = (param_shardings, NamedSharding(mesh, P()))
     step = jax.jit(
@@ -197,18 +202,7 @@ def timed_steps(mesh: Mesh, cfg: BurninConfig, steps: int = 20,
       trip count, so analyzing the scanned computation would under-report
       by ``steps``x).
     """
-    pspecs = param_specs()
-    param_shardings = {k: NamedSharding(mesh, s) for k, s in pspecs.items()}
-    params = jax.jit(lambda: init_params(cfg, jax.random.PRNGKey(0)),
-                     out_shardings=param_shardings)()
-    batch_spec = NamedSharding(mesh, P("data", None))
-
-    def make_batch():
-        tokens = jax.random.randint(
-            jax.random.PRNGKey(1), (cfg.batch, cfg.seq), 0, cfg.vocab)
-        return tokens, jnp.roll(tokens, -1, axis=1)
-
-    batch = jax.jit(make_batch, out_shardings=(batch_spec, batch_spec))()
+    param_shardings, params, batch = _global_init(mesh, cfg)
 
     one = jax.jit(lambda p, b: train_step(p, b, cfg),
                   out_shardings=(param_shardings,
