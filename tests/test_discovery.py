@@ -227,3 +227,53 @@ def test_native_tfd_patches_node_via_apiserver(native_build, tmp_path):
         ctypes = [h.get("Content-Type") for h in api.headers_seen
                   if h.get("Content-Type")]
         assert "application/strategic-merge-patch+json" in ctypes
+
+
+def test_native_tfd_preserves_transition_time_across_cycles(native_build,
+                                                            tmp_path):
+    """Kubelet-condition semantics in the live daemon: heartbeats advance
+    but lastTransitionTime only moves when the status flips (answerable
+    'how long has this node been degraded'). The oneshot oracle tests can't
+    see this — it needs consecutive cycles in one process."""
+    import time as _time
+    devices.make_fake_tree(str(tmp_path), 8)
+    out = tmp_path / "rec.jsonl"
+    proc = subprocess.Popen(
+        [_tfd(native_build), "--interval=0.4", "--conditions",
+         "--accelerator=v5e-8", f"--devfs-root={tmp_path}",
+         f"--out-file={out}"],
+        stderr=subprocess.PIPE)
+    try:
+        def records():
+            if not out.exists():
+                return []
+            return [json.loads(l) for l in out.read_text().splitlines()]
+
+        deadline = _time.time() + 15
+        while len(records()) < 3 and _time.time() < deadline:
+            _time.sleep(0.1)
+        _time.sleep(1.2)  # ensure the flip lands in a later wall-second
+        for i in (5, 6, 7):  # degrade 8 -> 5 chips
+            os.unlink(str(tmp_path / "dev" / f"accel{i}"))
+        deadline = _time.time() + 15
+        while (not any(r["condition"]["status"] == "False"
+                       for r in records())
+               or records()[-1]["condition"]["status"] != "False"
+               or len([r for r in records()
+                       if r["condition"]["status"] == "False"]) < 2) \
+                and _time.time() < deadline:
+            _time.sleep(0.1)
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+    recs = records()
+    true_recs = [r["condition"] for r in recs if r["condition"]["status"] == "True"]
+    false_recs = [r["condition"] for r in recs if r["condition"]["status"] == "False"]
+    assert len(true_recs) >= 3 and len(false_recs) >= 2, recs
+    # heartbeats advance; transition pinned to the first True cycle
+    assert len({c["lastTransitionTime"] for c in true_recs}) == 1
+    assert true_recs[0]["lastTransitionTime"] == true_recs[0]["lastHeartbeatTime"]
+    # the flip starts a new transition epoch, shared by later False cycles
+    assert len({c["lastTransitionTime"] for c in false_recs}) == 1
+    assert false_recs[0]["lastTransitionTime"] > true_recs[0]["lastTransitionTime"]
+    assert all(c["reason"] == "DegradedChipSet" for c in false_recs)
