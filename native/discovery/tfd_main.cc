@@ -54,6 +54,7 @@ struct Options {
   std::string accelerator = "v5e-8";
   std::string device_glob = "/dev/accel*";
   std::string devfs_root;
+  int fake_devices = -1;  // >=0: synthesize N chips (kind e2e; mirrors tpud)
   double interval_s = 60;
   bool conditions = false;
   bool oneshot = false;
@@ -242,10 +243,15 @@ bool PatchNode(const kubeclient::Config& cfg, const std::string& node,
 bool RunOnce(const Options& opt, const tpud::AcceleratorType& acc,
              const kubeclient::Config& cfg, const std::string& node_name,
              std::optional<Condition>* previous, std::string* err) {
-  std::vector<devenum::Node> found =
-      devenum::Enumerate(opt.device_glob, opt.devfs_root);
-  if (found.empty())  // VFIO fallback, like devices.discover_vfio
-    found = devenum::Enumerate("/dev/vfio/*", opt.devfs_root);
+  std::vector<devenum::Node> found;
+  if (opt.fake_devices >= 0) {
+    for (int i = 0; i < opt.fake_devices; ++i)
+      found.push_back({i, "/dev/accel" + std::to_string(i)});
+  } else {
+    found = devenum::Enumerate(opt.device_glob, opt.devfs_root);
+    if (found.empty())  // VFIO fallback, like devices.discover_vfio
+      found = devenum::Enumerate("/dev/vfio/*", opt.devfs_root);
+  }
   LabelMap labels =
       ComputeLabels(acc, static_cast<int>(found.size()), node_name);
   std::optional<Condition> cond;
@@ -311,6 +317,7 @@ int main(int argc, char** argv) {
     if (FlagVal(a, "--accelerator", &opt.accelerator)) continue;
     if (FlagVal(a, "--device-glob", &opt.device_glob)) continue;
     if (FlagVal(a, "--devfs-root", &opt.devfs_root)) continue;
+    if (FlagVal(a, "--fake-devices", &sval)) { opt.fake_devices = atoi(sval.c_str()); continue; }
     if (FlagVal(a, "--interval", &sval)) {
       char* end = nullptr;
       opt.interval_s = strtod(sval.c_str(), &end);
@@ -337,7 +344,7 @@ int main(int argc, char** argv) {
     fprintf(stderr,
             "tpu-tfd: unknown flag %s\n"
             "usage: tpu-tfd [--accelerator=T] [--device-glob=G] "
-            "[--devfs-root=D]\n"
+            "[--devfs-root=D] [--fake-devices=N]\n"
             "  [--interval=SECS] [--conditions] [--oneshot] [--print] "
             "[--out-file=F]\n"
             "  [--apiserver=URL] [--token-file=F] [--ca-file=F] "

@@ -67,7 +67,9 @@ tpu:
     devicePlugin:
       image: $IMG
       extraArgs: ["--fake-devices=8"]
-    featureDiscovery: {image: $IMG}
+    featureDiscovery:
+      image: $IMG
+      extraArgs: ["--fake-devices=8"]
     metricsExporter: {image: $IMG, extraArgs: ["--fake-devices=8"]}
     nodeStatusExporter: {enabled: false}  # expects real chips
 EOF
@@ -85,6 +87,21 @@ for i in $(seq 1 30); do
 done
 [ "${GOT:-}" = "8" ] || { echo "FAIL: allocatable google.com/tpu='$GOT'"; exit 1; }
 echo "allocatable OK: google.com/tpu=8"
+
+echo "--- asserting feature-discovery labels (tpu-tfd, fake census)"
+for i in $(seq 1 30); do
+  LABELED=$(kubectl get nodes -l google.com/tpu.present=true \
+    -o jsonpath='{.items[*].metadata.name}')
+  [ -n "${LABELED:-}" ] && break
+  sleep 2
+done
+[ -n "${LABELED:-}" ] || { echo "FAIL: no node labeled google.com/tpu.present=true"; exit 1; }
+TOPO=$(kubectl get node "${LABELED%% *}" \
+  -o jsonpath='{.metadata.labels.google\.com/tpu\.topology}')
+[ "$TOPO" = "2x4" ] || { echo "FAIL: topology label '$TOPO' != 2x4"; exit 1; }
+echo "labels OK: $LABELED (topology=$TOPO)"
+# with the node labeled, the exporter's nodeSelector is satisfiable
+kubectl -n tpu-system rollout status ds/tpu-metrics-exporter --timeout=120s
 
 echo "--- running a pod that consumes the resource"
 kubectl apply -f - <<'EOF'

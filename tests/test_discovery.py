@@ -277,3 +277,17 @@ def test_native_tfd_preserves_transition_time_across_cycles(native_build,
     assert len({c["lastTransitionTime"] for c in false_recs}) == 1
     assert false_recs[0]["lastTransitionTime"] > true_recs[0]["lastTransitionTime"]
     assert all(c["reason"] == "DegradedChipSet" for c in false_recs)
+
+
+def test_fake_devices_mode_matches_oracle(native_build, tmp_path):
+    """--fake-devices (the kind-e2e census source, mirroring tpud): both
+    implementations label present=true with the synthetic chip count."""
+    args = ["--print", "--oneshot", "--conditions", "--accelerator=v5e-8",
+            "--fake-devices=8"]
+    env = {"NODE_NAME": "kind-node"}
+    got_cpp = _normalize(_run_record([_tfd(native_build), *args], env))
+    got_py = _normalize(_run_record(_python_labeler_cmd(*args), env))
+    assert got_cpp == got_py
+    assert got_cpp["labels"]["google.com/tpu.present"] == "true"
+    assert got_cpp["labels"]["google.com/tpu.count"] == "8"
+    assert got_cpp["condition"]["status"] == "True"

@@ -117,9 +117,16 @@ def run_once(args: argparse.Namespace,
     """One discovery+publish cycle. Returns ``{"labels": ..}`` plus
     ``"condition"`` when --conditions is on — the same record shape in every
     output mode (print / out-file / in-cluster patch)."""
-    found = devs.discover(args.device_glob, args.devfs_root)
-    if not found:
-        found = devs.discover_vfio(args.devfs_root)
+    if args.fake_devices >= 0:
+        # clusterless/kind e2e: synthesize the chip census, mirroring
+        # tpud --fake-devices, so label-dependent scheduling is exercisable
+        # on TPU-less nodes
+        found = [devs.TpuDevice(i, f"/dev/accel{i}")
+                 for i in range(args.fake_devices)]
+    else:
+        found = devs.discover(args.device_glob, args.devfs_root)
+        if not found:
+            found = devs.discover_vfio(args.devfs_root)
     labels = lbl.compute_labels(args.accelerator, found,
                                 os.environ.get("NODE_NAME", ""))
     record: dict = {"labels": labels}
@@ -150,6 +157,9 @@ def main(argv=None) -> int:
     p.add_argument("--accelerator", default="v5e-8")
     p.add_argument("--device-glob", default="/dev/accel*")
     p.add_argument("--devfs-root", default="")
+    p.add_argument("--fake-devices", type=int, default=-1,
+                   help="synthesize N chips instead of scanning the device "
+                        "tree (clusterless/kind e2e; mirrors tpud)")
     p.add_argument("--interval", type=float, default=60)
     p.add_argument("--conditions", action="store_true",
                    help="also publish the TpuReady Node condition")
