@@ -237,3 +237,20 @@ def test_burnin_check_optional_on_single_host(spec):
         job("tpu-burnin-multihost", completions=2, succeeded=1, failed=1)
     res = verify.check_burnin(runner, spec)
     assert not res.ok  # applied but failing must not be glossed over
+
+
+def test_cli_verify_json_and_subset(spec, monkeypatch, capsys):
+    """tpuctl verify --json --config a,b: machine-readable runbook result."""
+    from tpu_cluster import __main__ as cli
+
+    runner = CannedRunner(healthy=True)
+    real_run_checks = verify.run_checks
+    monkeypatch.setattr(verify, "run_checks",
+                        lambda names, s, r=None: real_run_checks(
+                            names, s, runner))
+    rc = cli.main(["verify", "--json", "--config", "labels,conditions"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["ok"]
+    assert [c["name"] for c in out["checks"]] == ["labels", "conditions"]
+    rc = cli.main(["verify", "--config", "warp-drive"])
+    assert rc == 2

@@ -119,15 +119,24 @@ def cmd_apply(args) -> int:
 def cmd_verify(args) -> int:
     spec = _load_spec(args.spec)
     names = (list(verify.CHECKS) if args.config == "all"
-             else [args.config])
+             else [c.strip() for c in args.config.split(",") if c.strip()])
     try:
         results = verify.run_checks(names, spec)
     except KeyError as exc:
         print(exc, file=sys.stderr)
         return 2
-    for res in results:
-        print(res.line())
-    return 0 if all(r.ok for r in results) else 1
+    ok = all(r.ok for r in results)
+    if args.json:
+        # machine-readable runbook result (CI gates, driver artifacts)
+        print(json.dumps({
+            "ok": ok,
+            "checks": [{"name": r.name, "ok": r.ok, "detail": r.detail}
+                       for r in results],
+        }))
+    else:
+        for res in results:
+            print(res.line())
+    return 0 if ok else 1
 
 
 def cmd_triage(args) -> int:
@@ -179,7 +188,11 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("verify", help="run the acceptance runbook")
     p.add_argument("--spec", default="")
     p.add_argument("--config", default="all",
-                   help=f"all | {' | '.join(verify.CHECKS)}")
+                   help="all | comma-separated subset of: "
+                        f"{' | '.join(verify.CHECKS)}")
+    p.add_argument("--json", action="store_true",
+                   help="one machine-readable JSON line instead of "
+                        "PASS/FAIL lines")
     p.set_defaults(fn=cmd_verify)
 
     p = sub.add_parser("triage", help="run the troubleshooting runbook")
