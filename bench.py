@@ -229,12 +229,8 @@ def main() -> int:
         # Training-step realism: the flagship burn-in model's full train
         # step (fwd+bwd+update, FLOPs from XLA's own cost analysis), not
         # just the raw matmul kernel.
-        from jax.sharding import Mesh
-        import numpy as np
-
         from tpu_cluster.workloads import burnin
-        mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1),
-                    ("data", "model"))
+        mesh = burnin.make_mesh((1, 1))
         cfg = burnin.BurninConfig(vocab=8192, d_model=2048, d_ff=8192,
                                   n_heads=16, seq=512, batch=16)
         try:

@@ -76,8 +76,12 @@ class CannedRunner:
     def __call__(self, argv):
         assert argv[0] == "kubectl"
         self.calls.append(argv)
-        rest = [a for a in argv[1:] if a not in ("-o", "json")]
+        ignore_not_found = "--ignore-not-found" in argv
+        rest = [a for a in argv[1:]
+                if a not in ("-o", "json", "--ignore-not-found")]
         key = " ".join(rest)
+        if ignore_not_found and key not in self.responses:
+            return 0, ""  # kubectl semantics: absent object, rc 0, no output
         if rest[:2] == ["get", "--raw"]:
             for frag, payload in self.raw.items():
                 if frag in rest[2]:
@@ -237,6 +241,9 @@ def test_burnin_check_optional_on_single_host(spec):
         job("tpu-burnin-multihost", completions=2, succeeded=1, failed=1)
     res = verify.check_burnin(runner, spec)
     assert not res.ok  # applied but failing must not be glossed over
+    # transport failure (rc != 0) fails closed, never "optional, pass"
+    res = verify.check_burnin(lambda argv: (1, ""), spec)
+    assert not res.ok and "failed" in res.detail
 
 
 def test_cli_verify_json_and_subset(spec, monkeypatch, capsys):
@@ -254,3 +261,5 @@ def test_cli_verify_json_and_subset(spec, monkeypatch, capsys):
     assert [c["name"] for c in out["checks"]] == ["labels", "conditions"]
     rc = cli.main(["verify", "--config", "warp-drive"])
     assert rc == 2
+    # an empty selection must not be a free pass
+    assert cli.main(["verify", "--config", ","]) == 2

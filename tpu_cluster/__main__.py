@@ -120,6 +120,11 @@ def cmd_verify(args) -> int:
     spec = _load_spec(args.spec)
     names = (list(verify.CHECKS) if args.config == "all"
              else [c.strip() for c in args.config.split(",") if c.strip()])
+    if not names:
+        # a typo'd empty list must not turn the runbook into a free pass
+        print(f"--config selected no checks; known: {list(verify.CHECKS)}",
+              file=sys.stderr)
+        return 2
     try:
         results = verify.run_checks(names, spec)
     except KeyError as exc:

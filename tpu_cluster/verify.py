@@ -184,13 +184,7 @@ def _trailing_json_object(text: str) -> Optional[dict]:
     return None
 
 
-def _check_job(runner: Runner, spec: ClusterSpec, check: str,
-               job: str) -> CheckResult:
-    doc = _kubectl_json(runner,
-                        ["get", "job", "-n", spec.tpu.namespace, job])
-    if doc is None:
-        return CheckResult(check, False, f"job {job} not found (render+apply "
-                                         "it: tpuctl render --only jobs)")
+def _job_status(check: str, job: str, doc: dict) -> CheckResult:
     want = (doc.get("spec") or {}).get("completions", 1)
     got = (doc.get("status") or {}).get("succeeded", 0)
     if got >= want:
@@ -198,6 +192,16 @@ def _check_job(runner: Runner, spec: ClusterSpec, check: str,
     failed = (doc.get("status") or {}).get("failed", 0)
     return CheckResult(check, False,
                        f"{job} succeeded {got}/{want}, failed {failed}")
+
+
+def _check_job(runner: Runner, spec: ClusterSpec, check: str,
+               job: str) -> CheckResult:
+    doc = _kubectl_json(runner,
+                        ["get", "job", "-n", spec.tpu.namespace, job])
+    if doc is None:
+        return CheckResult(check, False, f"job {job} not found (render+apply "
+                                         "it: tpuctl render --only jobs)")
+    return _job_status(check, job, doc)
 
 
 def _multihost_slice(spec: ClusterSpec) -> bool:
@@ -262,16 +266,29 @@ def check_psum(runner: Runner, spec: ClusterSpec) -> CheckResult:
 def check_burnin(runner: Runner, spec: ClusterSpec) -> CheckResult:
     """The sharded DP x TP train-step Job. Rendered unconditionally for
     multi-host slice types (required there); optional on single-host specs
-    unless the user applied it via --multihost."""
+    unless the user applied it via --multihost. Only a confirmed job-absent
+    404 is treated as 'optional, pass' — a kubectl/transport failure fails
+    closed like every other check."""
+    job = "tpu-burnin-multihost"
     if _multihost_slice(spec):
-        return _check_job(runner, spec, "burnin", "tpu-burnin-multihost")
-    doc = _kubectl_json(runner, ["get", "job", "-n", spec.tpu.namespace,
-                                 "tpu-burnin-multihost"])
-    if doc is None:
+        return _check_job(runner, spec, "burnin", job)
+    # --ignore-not-found: rc 0 + empty stdout is a CONFIRMED absence (the
+    # optional case); any nonzero rc is a transport/RBAC failure and fails
+    # closed (kubectl's NotFound text goes to stderr, which the runner
+    # protocol doesn't carry — absence must be distinguished on stdout).
+    rc, out = runner(["kubectl", "get", "job", "-n", spec.tpu.namespace,
+                      job, "--ignore-not-found", "-o", "json"])
+    if rc != 0:
+        return CheckResult("burnin", False, "kubectl get job failed")
+    if not out.strip():
         return CheckResult("burnin", True,
                            "not rendered (optional on single-host specs; "
                            "tpuctl render --multihost N to enable)")
-    return _check_job(runner, spec, "burnin", "tpu-burnin-multihost")
+    try:
+        doc = json.loads(out)
+    except ValueError:
+        return CheckResult("burnin", False, "kubectl returned invalid JSON")
+    return _job_status("burnin", job, doc)
 
 
 def check_metrics(runner: Runner, spec: ClusterSpec) -> CheckResult:
