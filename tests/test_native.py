@@ -39,7 +39,7 @@ def start_tpud(build, tmp_path, *extra_args):
     ]
     proc = subprocess.Popen(args, stderr=subprocess.PIPE)
     sock = os.path.join(str(tmp_path), "tpud.sock")
-    for _ in range(100):
+    for _ in range(300):  # up to 15s: loaded 1-core hosts start slowly
         if os.path.exists(sock):
             break
         if proc.poll() is not None:
