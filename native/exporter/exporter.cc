@@ -252,6 +252,12 @@ int main(int argc, char** argv) {
     if (rc <= 0) continue;
     int cfd = accept(lfd, nullptr, nullptr);
     if (cfd < 0) continue;
+    // A silent or stuck client must not wedge the single-threaded daemon:
+    // bound both directions of the exchange (same guard as the operator's
+    // status server).
+    struct timeval tv = {0, 500 * 1000};
+    setsockopt(cfd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    setsockopt(cfd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
     char buf[2048];
     ssize_t n = read(cfd, buf, sizeof(buf) - 1);
     if (n > 0) {

@@ -522,3 +522,39 @@ def test_device_add_pushes_listandwatch_update(native_build, tmp_path):
     finally:
         proc.terminate()
         proc.wait(timeout=5)
+
+
+def test_exporter_not_wedged_by_silent_client(native_build, tmp_path):
+    """A client that connects and sends nothing must not block the
+    single-threaded exporter: a concurrent scrape still answers within the
+    500ms read-timeout budget."""
+    import socket as socketmod
+
+    sock = socketmod.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    proc = subprocess.Popen(
+        [binpath(native_build, "tpu-metrics-exporter"), f"--port={port}",
+         "--fake-devices=8"], stderr=subprocess.PIPE)
+    silent = None
+    try:
+        for _ in range(100):
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=2).read()
+                break
+            except OSError:
+                time.sleep(0.1)
+        # park a silent connection, then scrape: must answer despite it
+        silent = socketmod.create_connection(("127.0.0.1", port), timeout=5)
+        t0 = time.time()
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read()
+        assert b"tpu_chips_total 8" in body
+        assert time.time() - t0 < 5, "scrape stalled behind silent client"
+    finally:
+        if silent is not None:
+            silent.close()
+        proc.terminate()
+        proc.wait(timeout=10)
