@@ -18,6 +18,7 @@ const std::map<std::string, std::string>& Plurals() {
       {"ServiceAccount", "serviceaccounts"},
       {"Pod", "pods"},
       {"DaemonSet", "daemonsets"},
+      {"Event", "events"},
       {"Deployment", "deployments"},
       {"StatefulSet", "statefulsets"},
       {"Job", "jobs"},

@@ -199,6 +199,15 @@ class StatusServer {
   int fd_ = -1;
 };
 
+std::string NowRfc3339() {
+  char buf[32];
+  time_t t = time(nullptr);
+  struct tm tm_utc;
+  gmtime_r(&t, &tm_utc);
+  strftime(buf, sizeof(buf), "%Y-%m-%dT%H:%M:%SZ", &tm_utc);
+  return buf;
+}
+
 class Operator {
  public:
   Operator(const Options& opt, kubeclient::Config cfg)
@@ -236,6 +245,9 @@ class Operator {
           fprintf(stderr, "tpu-operator: stage %s: apply %s failed: %s\n",
                   stage.c_str(), bundle_[j].file.c_str(),
                   bundle_[j].error.c_str());
+          EmitEvent("ApplyFailed",
+                    "stage " + stage + ": " + bundle_[j].error,
+                    *bundle_[j].obj);
           return false;
         }
       }
@@ -251,11 +263,16 @@ class Operator {
         if (all_ready) break;
         if (time(nullptr) >= deadline) {
           for (size_t j = i; j < stage_end; ++j) {
-            if (!bundle_[j].ready)
+            if (!bundle_[j].ready) {
               fprintf(stderr,
                       "tpu-operator: stage %s: %s not ready after %ds\n",
                       stage.c_str(), bundle_[j].file.c_str(),
                       opt_.stage_timeout_s);
+              EmitEvent("StageTimeout",
+                        "stage " + stage + ": not ready after " +
+                            std::to_string(opt_.stage_timeout_s) + "s",
+                        *bundle_[j].obj);
+            }
           }
           return false;
         }
@@ -358,6 +375,47 @@ class Operator {
     status_.Pump(ms, StatusJson(), Metrics(), healthy_);
   }
 
+  // Surface a reconcile problem as a Kubernetes Event on the operand
+  // object (`kubectl describe ds ...` / `kubectl get events` visibility,
+  // like the reference's gpu-operator). Best-effort: event delivery must
+  // never change reconcile behavior, and an unreachable apiserver would
+  // fail the POST exactly when the pass already failed.
+  void EmitEvent(const std::string& reason, const std::string& message,
+                 const minijson::Value& involved) {
+    using minijson::Value;
+    std::string ns = involved.PathString("metadata.namespace", "default");
+    auto ev = Value::MakeObject();
+    ev->Set("apiVersion", std::make_shared<Value>(std::string("v1")));
+    ev->Set("kind", std::make_shared<Value>(std::string("Event")));
+    auto meta = Value::MakeObject();
+    meta->Set("name", std::make_shared<Value>(
+        "tpu-operator." + std::to_string(time(nullptr)) + "." +
+        std::to_string(++event_seq_)));
+    meta->Set("namespace", std::make_shared<Value>(ns));
+    ev->Set("metadata", meta);
+    auto obj = Value::MakeObject();
+    obj->Set("apiVersion", std::make_shared<Value>(
+        involved.PathString("apiVersion")));
+    obj->Set("kind", std::make_shared<Value>(involved.PathString("kind")));
+    obj->Set("name", std::make_shared<Value>(
+        involved.PathString("metadata.name")));
+    obj->Set("namespace", std::make_shared<Value>(ns));
+    ev->Set("involvedObject", obj);
+    ev->Set("reason", std::make_shared<Value>(reason));
+    ev->Set("message", std::make_shared<Value>(message.substr(0, 1024)));
+    ev->Set("type", std::make_shared<Value>(std::string("Warning")));
+    auto src = Value::MakeObject();
+    src->Set("component", std::make_shared<Value>(
+        std::string("tpu-operator")));
+    ev->Set("source", src);
+    std::string now = NowRfc3339();
+    ev->Set("firstTimestamp", std::make_shared<Value>(now));
+    ev->Set("lastTimestamp", std::make_shared<Value>(now));
+    ev->Set("count", std::make_shared<Value>(1.0));
+    kubeclient::Call(cfg_, "POST", "/api/v1/namespaces/" + ns + "/events",
+                     ev->Dump());
+  }
+
   bool ApplyObject(BundleObject* bo) {
     std::string err;
     std::string obj_path = kubeapi::ObjectPath(*bo->obj, &err);
@@ -435,6 +493,7 @@ class Operator {
   std::vector<BundleObject> bundle_;
   StatusServer status_;
   int passes_ = 0;
+  int event_seq_ = 0;
   bool healthy_ = false;
 };
 

@@ -524,3 +524,26 @@ def test_operator_survives_apiserver_bounce(native_build, bundle_dir):
                 proc.kill()
         stderr = proc.stderr.read()
         assert "converged" in stderr
+
+
+def test_reconcile_failures_emit_events(native_build, bundle_dir):
+    """Failures surface as Kubernetes Events on the operand objects
+    (`kubectl describe`/`kubectl get events` visibility, like the
+    reference's gpu-operator) — not just operator stderr."""
+    with FakeApiServer(auto_ready=False) as api:
+        proc = run_operator(
+            native_build, f"--apiserver={api.url}",
+            f"--bundle-dir={bundle_dir}", "--once", "--poll-ms=20",
+            "--stage-timeout=1", "--status-port=0")
+        assert proc.returncode == 1  # stage never became ready
+        events = [api.get(p) for p in api.paths("/events/")]
+        assert events, "no Events posted on stage timeout"
+        ev = events[0]
+        assert ev["type"] == "Warning"
+        assert ev["reason"] == "StageTimeout"
+        assert ev["source"]["component"] == "tpu-operator"
+        inv = ev["involvedObject"]
+        assert inv["kind"] == "DaemonSet"
+        assert inv["name"] == "tpu-libtpu-prep"  # first gated stage
+        assert ev["metadata"]["namespace"] == inv["namespace"] == NS
+        assert "not ready after 1s" in ev["message"]

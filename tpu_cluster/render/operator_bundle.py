@@ -101,6 +101,11 @@ def rbac(spec: ClusterSpec) -> List[Dict[str, Any]]:
             {"apiGroups": [""],
              "resources": ["nodes", "nodes/status"],
              "verbs": ["get", "list", "watch", "patch"]},
+            # Reconcile failures surface as Events on the operand objects
+            # (`kubectl describe` visibility, like the gpu-operator).
+            {"apiGroups": [""],
+             "resources": ["events"],
+             "verbs": ["create"]},
         ],
     }
     binding = {
