@@ -71,6 +71,14 @@ class CannedRunner:
                 {"items": []}
             self.responses["get job -n tpu-system tpu-psum"] = \
                 job("tpu-psum", succeeded=0, failed=2)
+            self.responses["get events -n tpu-system "
+                           "--field-selector=type=Warning "
+                           "--sort-by=.lastTimestamp"] = {"items": [{
+                               "reason": "StageTimeout", "type": "Warning",
+                               "message": "stage 20: not ready after 600s",
+                               "involvedObject": {
+                                   "kind": "DaemonSet",
+                                   "name": "tpu-device-plugin"}}]}
             self.raw = {}
 
     def __call__(self, argv):
@@ -182,6 +190,9 @@ def test_triage_collects_describe_and_logs_for_problem_pods(spec):
     assert "canned describe output" in text
     # healthy pod not described (runbook discipline: triage what's broken)
     assert "describe tpu-libtpu-prep-def" not in text
+    # operator-posted Warning events folded into the report
+    assert "warning events in tpu-system" in text
+    assert "StageTimeout  DaemonSet/tpu-device-plugin" in text
     assert "hints" in text
 
 

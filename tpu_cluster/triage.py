@@ -56,6 +56,21 @@ def run_triage(spec: ClusterSpec,
         rc, out = runner(["kubectl", "logs", "-n", ns, pod, "--tail=50"])
         report.add(f"logs {pod}", out if rc == 0 else "logs unavailable")
 
+    # 2b. recent Warning events — the operator posts ApplyFailed /
+    # StageTimeout onto operand objects when a rollout wedges
+    rc, out = runner(["kubectl", "get", "events", "-n", ns,
+                      "--field-selector=type=Warning",
+                      "--sort-by=.lastTimestamp", "-o", "json"])
+    if rc == 0:
+        rows = []
+        for ev in json.loads(out).get("items", []):
+            inv = ev.get("involvedObject", {})
+            rows.append(f"{ev.get('reason', '?')}  "
+                        f"{inv.get('kind', '?')}/{inv.get('name', '?')}: "
+                        f"{ev.get('message', '')}")
+        if rows:
+            report.add(f"warning events in {ns}", "\n".join(rows[-20:]))
+
     # 3. per-node health from the node-status-exporter (the automated
     # version of "confirm the instance really has a GPU", README.md:187)
     if spec.tpu.operand("nodeStatusExporter").enabled:
