@@ -66,6 +66,7 @@ struct BundleObject {
   bool applied = false;
   bool ready = false;
   std::string error;
+  std::string uid;  // live object's metadata.uid (event correlation)
 };
 
 bool LoadBundle(const std::string& dir, std::vector<BundleObject>* out,
@@ -247,7 +248,7 @@ class Operator {
                   bundle_[j].error.c_str());
           EmitEvent("ApplyFailed",
                     "stage " + stage + ": " + bundle_[j].error,
-                    *bundle_[j].obj);
+                    bundle_[j]);
           return false;
         }
       }
@@ -271,7 +272,7 @@ class Operator {
               EmitEvent("StageTimeout",
                         "stage " + stage + ": not ready after " +
                             std::to_string(opt_.stage_timeout_s) + "s",
-                        *bundle_[j].obj);
+                        bundle_[j]);
             }
           }
           return false;
@@ -375,15 +376,30 @@ class Operator {
     status_.Pump(ms, StatusJson(), Metrics(), healthy_);
   }
 
+  // The namespace reconcile failures are reported into: cluster-scoped
+  // bundle objects (the stage-00 Namespace itself) have no namespace of
+  // their own, and 'default' is where none of the documented triage
+  // surfaces look — use the bundle's operand namespace instead.
+  std::string EventNamespace(const minijson::Value& involved) const {
+    std::string ns = involved.PathString("metadata.namespace");
+    if (!ns.empty()) return ns;
+    for (const auto& bo : bundle_) {
+      std::string n = bo.obj->PathString("metadata.namespace");
+      if (!n.empty()) return n;
+    }
+    return "default";
+  }
+
   // Surface a reconcile problem as a Kubernetes Event on the operand
   // object (`kubectl describe ds ...` / `kubectl get events` visibility,
   // like the reference's gpu-operator). Best-effort: event delivery must
   // never change reconcile behavior, and an unreachable apiserver would
   // fail the POST exactly when the pass already failed.
   void EmitEvent(const std::string& reason, const std::string& message,
-                 const minijson::Value& involved) {
+                 const BundleObject& bo) {
     using minijson::Value;
-    std::string ns = involved.PathString("metadata.namespace", "default");
+    const minijson::Value& involved = *bo.obj;
+    std::string ns = EventNamespace(involved);
     auto ev = Value::MakeObject();
     ev->Set("apiVersion", std::make_shared<Value>(std::string("v1")));
     ev->Set("kind", std::make_shared<Value>(std::string("Event")));
@@ -399,7 +415,12 @@ class Operator {
     obj->Set("kind", std::make_shared<Value>(involved.PathString("kind")));
     obj->Set("name", std::make_shared<Value>(
         involved.PathString("metadata.name")));
-    obj->Set("namespace", std::make_shared<Value>(ns));
+    obj->Set("namespace", std::make_shared<Value>(
+        involved.PathString("metadata.namespace")));
+    // kubectl describe filters events on involvedObject.uid — without the
+    // live object's uid the Event only shows in `kubectl get events`
+    if (!bo.uid.empty())
+      obj->Set("uid", std::make_shared<Value>(bo.uid));
     ev->Set("involvedObject", obj);
     ev->Set("reason", std::make_shared<Value>(reason));
     ev->Set("message", std::make_shared<Value>(message.substr(0, 1024)));
@@ -412,8 +433,19 @@ class Operator {
     ev->Set("firstTimestamp", std::make_shared<Value>(now));
     ev->Set("lastTimestamp", std::make_shared<Value>(now));
     ev->Set("count", std::make_shared<Value>(1.0));
-    kubeclient::Call(cfg_, "POST", "/api/v1/namespaces/" + ns + "/events",
-                     ev->Dump());
+    std::string err;
+    std::string coll = kubeapi::CollectionPath(*ev, &err);
+    if (!coll.empty()) kubeclient::Call(cfg_, "POST", coll, ev->Dump());
+  }
+
+  // Remember the live object's metadata.uid from an API response body
+  // (event correlation — kubectl describe matches on it).
+  void RememberUid(BundleObject* bo, const std::string& body) {
+    minijson::ValuePtr live = minijson::Parse(body);
+    if (live) {
+      std::string uid = live->PathString("metadata.uid");
+      if (!uid.empty()) bo->uid = uid;
+    }
   }
 
   bool ApplyObject(BundleObject* bo) {
@@ -424,6 +456,7 @@ class Operator {
       return false;
     }
     kubeclient::Response get = kubeclient::Call(cfg_, "GET", obj_path);
+    if (get.ok()) RememberUid(bo, get.body);
     if (get.status == 404) {
       std::string coll = kubeapi::CollectionPath(*bo->obj, &err);
       kubeclient::Response post =
@@ -442,10 +475,13 @@ class Operator {
                                     : patch.error);
           return false;
         }
+        RememberUid(bo, patch.body);
       } else if (!post.ok()) {
         bo->error = "POST " + coll + " -> " + std::to_string(post.status) +
                     " " + (post.status ? post.body.substr(0, 160) : post.error);
         return false;
+      } else {
+        RememberUid(bo, post.body);
       }
     } else if (get.ok()) {
       // merge-patch the desired state over whatever is there — reverts

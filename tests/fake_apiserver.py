@@ -148,9 +148,12 @@ class FakeApiServer:
                         self._reply(409, {"kind": "Status", "code": 409,
                                           "reason": "AlreadyExists"})
                         return
+                    obj = dict(obj)
+                    obj["metadata"] = dict(obj.get("metadata") or {})
+                    # apiserver behavior: every created object gets a uid
+                    obj["metadata"].setdefault(
+                        "uid", f"uid-{len(fake.store) + 1:04d}")
                     if obj.get("kind") in GENERATION_KINDS:
-                        obj = dict(obj)
-                        obj["metadata"] = dict(obj.get("metadata") or {})
                         obj["metadata"]["generation"] = 1
                     if fake.auto_ready:
                         st = ready_status(obj)

@@ -547,3 +547,7 @@ def test_reconcile_failures_emit_events(native_build, bundle_dir):
         assert inv["name"] == "tpu-libtpu-prep"  # first gated stage
         assert ev["metadata"]["namespace"] == inv["namespace"] == NS
         assert "not ready after 1s" in ev["message"]
+        # kubectl describe filters on involvedObject.uid: must match the
+        # live object the apiserver assigned
+        live = api.get(f"{DS}/tpu-libtpu-prep")
+        assert inv["uid"] == live["metadata"]["uid"]
