@@ -448,10 +448,20 @@ def test_tpud_survives_malformed_input(native_build, tmp_path):
     try:
         for payload in garbage:
             s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-            s.settimeout(2)
-            s.connect(sock_path)
-            s.sendall(payload)
+            s.settimeout(5)
+            for attempt in range(20):  # accept can lag on a loaded host
+                try:
+                    s.connect(sock_path)
+                    break
+                except OSError:
+                    if attempt == 19:
+                        raise
+                    time.sleep(0.25)
             try:
+                # tpud may (correctly) slam the connection mid-send on
+                # garbage — ECONNRESET here is its defense working, not a
+                # failure; the assertions that matter are liveness + service
+                s.sendall(payload)
                 s.recv(4096)
             except OSError:
                 pass

@@ -23,6 +23,10 @@ class DevicePluginClient:
     # core and run builds/JAX compiles alongside — a 5s deadline flaked
     # under load (observed ~1/5 full-suite runs); 30s still catches real
     # hangs. Responsiveness is asserted by dedicated tests, not this knob.
+    # Calls use wait_for_ready: grpc's default fail-fast turns a transient
+    # connect refusal (accept lagging on a loaded host) into an immediate
+    # UNAVAILABLE regardless of the deadline — the kubelet's grpc-go client
+    # likewise blocks on channel readiness.
     def __init__(self, socket_path: str, timeout: float = 30.0):
         self.channel = grpc.insecure_channel(f"unix:{socket_path}")
         self.timeout = timeout
@@ -56,13 +60,15 @@ class DevicePluginClient:
         self.channel.close()
 
     def get_options(self) -> pb.DevicePluginOptions:
-        return self._options(pb.Empty(), timeout=self.timeout)
+        return self._options(pb.Empty(), timeout=self.timeout,
+                             wait_for_ready=True)
 
     def list_and_watch(self, timeout=None):
         """Returns the response iterator (long-lived stream). ``timeout``
         bounds the whole stream — harnesses pass one so a wedged server
         fails the run instead of hanging it."""
-        return self._list_and_watch(pb.Empty(), timeout=timeout)
+        return self._list_and_watch(pb.Empty(), timeout=timeout,
+                                    wait_for_ready=True)
 
     def get_preferred_allocation(self, available, must_include, size
                                  ) -> pb.PreferredAllocationResponse:
@@ -73,14 +79,17 @@ class DevicePluginClient:
                 allocation_size=size,
             )
         ])
-        return self._preferred(req, timeout=self.timeout)
+        return self._preferred(req, timeout=self.timeout,
+                               wait_for_ready=True)
 
     def allocate(self, device_ids) -> pb.AllocateResponse:
         req = pb.AllocateRequest(container_requests=[
             pb.ContainerAllocateRequest(devicesIDs=list(device_ids))
         ])
-        return self._allocate(req, timeout=self.timeout)
+        return self._allocate(req, timeout=self.timeout,
+                              wait_for_ready=True)
 
     def pre_start_container(self, device_ids) -> pb.PreStartContainerResponse:
         req = pb.PreStartContainerRequest(devicesIDs=list(device_ids))
-        return self._prestart(req, timeout=self.timeout)
+        return self._prestart(req, timeout=self.timeout,
+                              wait_for_ready=True)
