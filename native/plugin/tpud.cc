@@ -241,8 +241,15 @@ class Plugin {
     // layout regardless of host devfs rerooting; VFIO-passthrough devices
     // must keep their /dev/vfio/N identity (libtpu opens them by that
     // name) plus the /dev/vfio/vfio container node, added once.
+    //
+    // Fake mode allocates env-only: the synthesized /dev/accelN paths
+    // don't exist on the host, and a DeviceSpec referencing a missing
+    // node makes runc fail container creation — which would break the
+    // very clusterless e2e (kind, SURVEY.md §4 point 3) fake mode exists
+    // for. Real-device and devfs-rerooted paths keep full DeviceSpecs.
     bool vfio_ctl_added = false;
-    for (int idx : sorted_ids) {
+    const std::vector<int> kNoDevices;
+    for (int idx : opt_.fake_devices >= 0 ? kNoDevices : sorted_ids) {
       const ChipDevice* dev = FindDevice(idx);
       auto* spec = cresp->add_devices();
       if (dev && dev->vfio) {

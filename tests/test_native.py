@@ -128,9 +128,9 @@ def test_allocate_aligned(tpud_fake8):
     try:
         resp = c.allocate(["tpu-0", "tpu-1", "tpu-2", "tpu-3"])
         cr = resp.container_responses[0]
-        assert [d.container_path for d in cr.devices] == [
-            f"/dev/accel{i}" for i in range(4)]
-        assert all(d.permissions == "rw" for d in cr.devices)
+        # fake mode is env-only: DeviceSpecs for nodes that don't exist on
+        # the host would make runc fail container creation in the kind e2e
+        assert list(cr.devices) == []
         assert cr.envs["TPU_VISIBLE_DEVICES"] == "0,1,2,3"
         assert cr.envs["TPU_CHIPS_PER_HOST_BOUNDS"] == "2,2,1"
         assert cr.envs["TPU_HOST_BOUNDS"] == "1,1,1"
@@ -162,6 +162,33 @@ def test_allocate_unaligned_rejected(tpud_fake8):
         assert "sub-mesh" in ei.value.details()
     finally:
         c.close()
+
+
+def test_allocate_devfs_tree_device_specs(native_build, tmp_path):
+    """Real-device path (devfs-rerooted tree, not fake mode): Allocate
+    carries the DeviceSpecs with canonical /dev/accelN container paths and
+    rw permissions — the container-toolkit-replacing half of the contract
+    (docs/DELTAS.md §2)."""
+    from tpu_cluster.discovery import devices as pydev
+    from tpu_cluster.plugin_api.client import DevicePluginClient
+    devfs = tmp_path / "devfs"
+    pydev.make_fake_tree(str(devfs), 8)
+    proc, sock = start_tpud(native_build, tmp_path,
+                            f"--devfs-root={devfs}", "--no-register")
+    c = DevicePluginClient(sock)
+    try:
+        resp = c.allocate(["tpu-0", "tpu-1", "tpu-2", "tpu-3"])
+        cr = resp.container_responses[0]
+        assert [d.container_path for d in cr.devices] == [
+            f"/dev/accel{i}" for i in range(4)]
+        assert [d.host_path for d in cr.devices] == [
+            str(devfs / "dev" / f"accel{i}") for i in range(4)]
+        assert all(d.permissions == "rw" for d in cr.devices)
+        assert cr.envs["TPU_VISIBLE_DEVICES"] == "0,1,2,3"
+    finally:
+        c.close()
+        proc.terminate()
+        proc.wait(timeout=5)
 
 
 def test_prestart_and_unknown_method(tpud_fake8):
