@@ -248,32 +248,33 @@ class Plugin {
     // very clusterless e2e (kind, SURVEY.md §4 point 3) fake mode exists
     // for. Real-device and devfs-rerooted paths keep full DeviceSpecs.
     bool vfio_ctl_added = false;
-    const std::vector<int> kNoDevices;
-    for (int idx : opt_.fake_devices >= 0 ? kNoDevices : sorted_ids) {
-      const ChipDevice* dev = FindDevice(idx);
-      auto* spec = cresp->add_devices();
-      if (dev && dev->vfio) {
-        // keep the IOMMU group identity (basename), not the chip index —
-        // libtpu opens the group node by its real name
-        std::string group = dev->path.substr(dev->path.rfind('/') + 1);
-        spec->set_container_path("/dev/vfio/" + group);
-        spec->set_host_path(dev->path);
-        if (!vfio_ctl_added) {
-          vfio_ctl_added = true;
-          auto* ctl = cresp->add_devices();
-          ctl->set_container_path("/dev/vfio/vfio");
-          // honour devfs rerooting (tests): the control node sits beside
-          // the group nodes on the host
-          std::string dir = dev->path.substr(0, dev->path.rfind('/'));
-          ctl->set_host_path(dir + "/vfio");
-          ctl->set_permissions("rw");
+    if (opt_.fake_devices < 0) {
+      for (int idx : sorted_ids) {
+        const ChipDevice* dev = FindDevice(idx);
+        auto* spec = cresp->add_devices();
+        if (dev && dev->vfio) {
+          // keep the IOMMU group identity (basename), not the chip index —
+          // libtpu opens the group node by its real name
+          std::string group = dev->path.substr(dev->path.rfind('/') + 1);
+          spec->set_container_path("/dev/vfio/" + group);
+          spec->set_host_path(dev->path);
+          if (!vfio_ctl_added) {
+            vfio_ctl_added = true;
+            auto* ctl = cresp->add_devices();
+            ctl->set_container_path("/dev/vfio/vfio");
+            // honour devfs rerooting (tests): the control node sits beside
+            // the group nodes on the host
+            std::string dir = dev->path.substr(0, dev->path.rfind('/'));
+            ctl->set_host_path(dir + "/vfio");
+            ctl->set_permissions("rw");
+          }
+        } else {
+          spec->set_container_path("/dev/accel" + std::to_string(idx));
+          spec->set_host_path(dev ? dev->path
+                                  : "/dev/accel" + std::to_string(idx));
         }
-      } else {
-        spec->set_container_path("/dev/accel" + std::to_string(idx));
-        spec->set_host_path(dev ? dev->path
-                                : "/dev/accel" + std::to_string(idx));
+        spec->set_permissions("rw");
       }
-      spec->set_permissions("rw");
     }
 
     // Sub-mesh bounds of the allocated chip set (bounding box of coords).

@@ -65,10 +65,13 @@ class DevicePluginClient:
 
     def list_and_watch(self, timeout=None):
         """Returns the response iterator (long-lived stream). ``timeout``
-        bounds the whole stream — harnesses pass one so a wedged server
-        fails the run instead of hanging it."""
-        return self._list_and_watch(pb.Empty(), timeout=timeout,
-                                    wait_for_ready=True)
+        bounds the whole stream; the default applies the client deadline —
+        combined with wait_for_ready, an unbounded stream against a
+        never-ready server would otherwise hang the harness forever."""
+        return self._list_and_watch(
+            pb.Empty(),
+            timeout=self.timeout if timeout is None else timeout,
+            wait_for_ready=True)
 
     def get_preferred_allocation(self, available, must_include, size
                                  ) -> pb.PreferredAllocationResponse:
