@@ -136,3 +136,19 @@ def test_multihost_jobs_derive_hosts_from_slice_type():
     names = [o["metadata"]["name"]
              for o in jobs.render_validation_jobs(single, multihost_hosts=2)]
     assert "tpu-psum-multihost" in names and "tpu-burnin-multihost" in names
+
+
+def test_cli_render_multihost_mismatch_clean_error(capsys):
+    """A worker count not matching the slice renders a clean CLI error,
+    not a traceback."""
+    from tpu_cluster import __main__ as cli
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".yaml") as f:
+        f.write("tpu: {accelerator: v5e-16}\n")
+        f.flush()
+        rc = cli.main(["render", "--spec", f.name, "--multihost", "3",
+                       "--only", "jobs"])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "2-host slice" in err and "got 3" in err

@@ -54,7 +54,12 @@ _EXT = {"nodeprep": "sh", "kubeadm-packages": "sh", "kubeadm-init": "sh",
 
 def cmd_render(args) -> int:
     spec = _load_spec(args.spec)
-    artifacts = _render_artifacts(spec, args.multihost)
+    try:
+        artifacts = _render_artifacts(spec, args.multihost)
+    except ValueError as exc:
+        # e.g. --multihost N not matching a multi-host slice's host count
+        print(f"render: {exc}", file=sys.stderr)
+        return 2
     if args.only:
         print(artifacts[args.only], end="")
         return 0
