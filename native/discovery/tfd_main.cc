@@ -287,11 +287,14 @@ bool RunOnce(const Options& opt, const tpud::AcceleratorType& acc,
 }
 
 // Sleep interval with ±10% jitter (de-synchronises the fleet's apiserver
-// load), doubling up to 5 min after consecutive failures.
+// load), doubling after consecutive failures. The cap bounds only the
+// failure backoff — and is max(5 min, interval) so a configured --interval
+// above 300s is honored, mirroring the Python oracle (labeler.py).
 void JitteredSleep(double base_s, int failures) {
   double backoff = base_s;
-  for (int i = 0; i < failures && backoff < 300; ++i) backoff *= 2;
-  if (backoff > 300) backoff = 300;
+  double cap = base_s > 300 ? base_s : 300;
+  for (int i = 0; i < failures && backoff < cap; ++i) backoff *= 2;
+  if (failures > 0 && backoff > cap) backoff = cap;
   double jitter = 0.9 + 0.2 * (static_cast<double>(rand()) / RAND_MAX);
   int total_ms = static_cast<int>(backoff * jitter * 1000);
   for (int left = total_ms; left > 0 && !g_stop; left -= 50)

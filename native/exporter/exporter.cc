@@ -27,6 +27,7 @@
 #include <string.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
+#include <time.h>
 #include <unistd.h>
 
 #include <sstream>
@@ -258,10 +259,24 @@ int main(int argc, char** argv) {
     struct timeval tv = {0, 500 * 1000};
     setsockopt(cfd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
     setsockopt(cfd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
-    char buf[2048];
-    ssize_t n = read(cfd, buf, sizeof(buf) - 1);
-    if (n > 0) {
-      buf[n] = 0;
+    // Read until the end of the request head (\r\n\r\n): a client may
+    // legitimately split the head across TCP segments. RCVTIMEO bounds
+    // each read but not the total — a drip-feeding client would otherwise
+    // hold the single-threaded daemon for buf-size reads — so the whole
+    // head also gets one wall-clock deadline.
+    char buf[8192];
+    size_t have = 0;
+    time_t head_deadline = time(nullptr) + 2;
+    while (have < sizeof(buf) - 1 && !g_stop &&
+           time(nullptr) <= head_deadline) {
+      ssize_t n = read(cfd, buf + have, sizeof(buf) - 1 - have);
+      if (n <= 0) break;  // EOF, error, or RCVTIMEO
+      have += static_cast<size_t>(n);
+      buf[have] = 0;
+      if (strstr(buf, "\r\n\r\n")) break;
+    }
+    if (have > 0) {
+      buf[have] = 0;
       char method[8], path[256];
       if (sscanf(buf, "%7s %255s", method, path) == 2 &&
           strcmp(method, "GET") == 0) {

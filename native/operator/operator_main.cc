@@ -376,18 +376,15 @@ class Operator {
     status_.Pump(ms, StatusJson(), Metrics(), healthy_);
   }
 
-  // The namespace reconcile failures are reported into: cluster-scoped
-  // bundle objects (the stage-00 Namespace itself) have no namespace of
-  // their own, and 'default' is where none of the documented triage
-  // surfaces look — use the bundle's operand namespace instead.
+  // The namespace reconcile failures are reported into. Cluster-scoped
+  // bundle objects (the stage-00 Namespace/ClusterRole themselves) have no
+  // namespace of their own, and apiserver core/v1 Event validation requires
+  // the Event's namespace to be 'default' when involvedObject.namespace is
+  // empty — posting such events into the operand namespace gets them
+  // 422-rejected and silently dropped (the POST is best-effort).
   std::string EventNamespace(const minijson::Value& involved) const {
     std::string ns = involved.PathString("metadata.namespace");
-    if (!ns.empty()) return ns;
-    for (const auto& bo : bundle_) {
-      std::string n = bo.obj->PathString("metadata.namespace");
-      if (!n.empty()) return n;
-    }
-    return "default";
+    return ns.empty() ? "default" : ns;
   }
 
   // Surface a reconcile problem as a Kubernetes Event on the operand

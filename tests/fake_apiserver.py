@@ -87,11 +87,14 @@ class FakeApiServer:
 
     def __init__(self, auto_ready: bool = True, tls=None, port: int = 0,
                  store: Optional[Dict[str, Dict[str, Any]]] = None,
-                 ghost_get_404=()):
+                 ghost_get_404=(), reject_posts: Optional[Dict[str, int]] = None):
         self.auto_ready = auto_ready
         self._tls = tls
         self.store: Dict[str, Dict[str, Any]] = dict(store or {})
         self.ghost_get_404 = set(ghost_get_404)
+        # exact collection path -> HTTP status: force POST failures (RBAC
+        # denial / admission-webhook rejection simulation)
+        self.reject_posts: Dict[str, int] = dict(reject_posts or {})
         self.log: List[Tuple[str, str]] = []  # (method, path)
         self.created: List[str] = []          # stored object paths, in order
         self.headers_seen: List[Dict[str, str]] = []
@@ -138,10 +141,27 @@ class FakeApiServer:
             def do_POST(self):
                 self._record()
                 obj = self._body()
+                rc = fake.reject_posts.get(self.path)
+                if rc:
+                    self._reply(rc, {"kind": "Status", "code": rc,
+                                     "reason": "Forbidden"})
+                    return
                 name = (obj or {}).get("metadata", {}).get("name")
                 if not name:
                     self._reply(422, {"message": "metadata.name required"})
                     return
+                # Real apiserver core/v1 Event validation: the Event's
+                # namespace must agree with involvedObject.namespace —
+                # 'default' when the involved object is cluster-scoped.
+                if obj.get("kind") == "Event":
+                    ev_ns = obj.get("metadata", {}).get("namespace", "")
+                    inv_ns = obj.get("involvedObject", {}).get(
+                        "namespace", "")
+                    if ev_ns != (inv_ns or "default"):
+                        self._reply(422, {
+                            "message": "event namespace does not match "
+                                       "involvedObject namespace"})
+                        return
                 path = f"{self.path}/{name}"
                 with fake._lock:
                     if path in fake.store:

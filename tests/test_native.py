@@ -371,6 +371,44 @@ def test_exporter_scrape(native_build, tmp_path):
         proc.wait(timeout=5)
 
 
+def test_exporter_split_header_request(native_build, tmp_path):
+    """The request head split across TCP segments must still be served:
+    the exporter loops its read until \\r\\n\\r\\n (bounded by RCVTIMEO),
+    not just the first segment (advisor round-2 weak #5)."""
+    import socket as socketmod
+
+    from tpu_cluster.discovery import devices as pydev
+    pydev.make_fake_tree(str(tmp_path), 2)
+    port = _free_port()
+    proc = subprocess.Popen(
+        [binpath(native_build, "tpu-metrics-exporter"), f"--port={port}",
+         f"--devfs-root={tmp_path}"],
+        stderr=subprocess.PIPE)
+    try:
+        for _ in range(50):
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=1).read()
+                break
+            except Exception:
+                time.sleep(0.1)
+        with socketmod.create_connection(("127.0.0.1", port), timeout=5) as s:
+            for part in (b"GET /met", b"rics HTTP/1.1\r\n",
+                         b"Host: localhost\r\n", b"\r\n"):
+                s.sendall(part)
+                time.sleep(0.05)  # force distinct segments
+            body = b""
+            while True:
+                chunk = s.recv(4096)
+                if not chunk:
+                    break
+                body += chunk
+        assert b"200 OK" in body and b"tpu_chips_total 2" in body
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
 def test_exporter_status_mode(native_build, tmp_path):
     from tpu_cluster.discovery import devices as pydev
     pydev.make_fake_tree(str(tmp_path), 8)

@@ -551,3 +551,24 @@ def test_reconcile_failures_emit_events(native_build, bundle_dir):
         # live object the apiserver assigned
         live = api.get(f"{DS}/tpu-libtpu-prep")
         assert inv["uid"] == live["metadata"]["uid"]
+
+
+def test_cluster_scoped_apply_failure_event_lands(native_build, bundle_dir):
+    """An ApplyFailed Event for a cluster-scoped object (the stage-00
+    Namespace) must go to the 'default' namespace with an empty
+    involvedObject.namespace — the apiserver's core/v1 Event namespace-
+    agreement rule; anything else is 422-rejected and silently lost
+    (advisor round-2 finding). The fake apiserver enforces the rule."""
+    with FakeApiServer(auto_ready=True,
+                       reject_posts={"/api/v1/namespaces": 403}) as api:
+        proc = run_operator(
+            native_build, f"--apiserver={api.url}",
+            f"--bundle-dir={bundle_dir}", "--once", "--poll-ms=20",
+            "--stage-timeout=5", "--status-port=0")
+        assert proc.returncode == 1  # namespace create was denied
+        events = [api.get(p) for p in api.paths("/events/")]
+        assert events, "ApplyFailed event was not stored (422-rejected?)"
+        ev = next(e for e in events if e["reason"] == "ApplyFailed")
+        assert ev["involvedObject"]["kind"] == "Namespace"
+        assert not ev["involvedObject"].get("namespace")
+        assert ev["metadata"]["namespace"] == "default"
