@@ -23,6 +23,9 @@ const std::vector<AcceleratorType>& Catalogue() {
       {"v5e-16", "v5e", 8, 2, 4, 16, {8}, {{8, {2, 4}}}, 2, 2, 1, 1},
       {"v5e-32", "v5e", 8, 2, 4, 16, {8}, {{8, {2, 4}}}, 4, 2, 2, 1},
       {"v6e-16", "v6e", 8, 2, 4, 32, {8}, {{8, {2, 4}}}, 2, 2, 1, 1},
+      // v5p hosts stack along the torus z axis: 2 hosts of flat 2x2 chips
+      // form the 2x2x2 cube, TPU_HOST_BOUNDS "1,1,2" (mirrors topology.py).
+      {"v5p-16", "v5p", 4, 2, 2, 95, {4}, {{4, {2, 2}}}, 2, 1, 1, 2},
   };
   return kTypes;
 }
@@ -104,13 +107,24 @@ bool ValidateAllocation(const AcceleratorType& acc,
     for (size_t i = 0; i < v.size(); ++i) os << (i ? "," : "") << v[i];
     return os.str();
   };
+  // Rejections carry actionable hints: the allowed sizes WITH an example
+  // aligned chip set each, so the pod event tells the user what to request
+  // instead of only what failed (SURVEY.md §7 hard-part #2 UX).
+  auto examples = [&] {
+    std::ostringstream os;
+    os << "; valid sizes (example chip set): ";
+    for (size_t i = 0; i < acc.aligned_sizes.size(); ++i) {
+      auto subsets = AlignedSubsets(acc, acc.aligned_sizes[i]);
+      os << (i ? ", " : "") << acc.aligned_sizes[i];
+      if (!subsets.empty()) os << " (" << join(subsets[0]) << ")";
+    }
+    return os.str();
+  };
   if (std::find(acc.aligned_sizes.begin(), acc.aligned_sizes.end(), n) ==
       acc.aligned_sizes.end()) {
     std::ostringstream os;
     os << "request size " << n << " is not aligned for " << acc.name
-       << "; allowed sizes: ";
-    for (size_t i = 0; i < acc.aligned_sizes.size(); ++i)
-      os << (i ? "," : "") << acc.aligned_sizes[i];
+       << examples();
     *reason = os.str();
     return false;
   }
@@ -129,9 +143,13 @@ bool ValidateAllocation(const AcceleratorType& acc,
     *reason = "aligned sub-mesh";
     return true;
   }
-  *reason = "device set " + join(ids) +
-            " is not an ICI-contiguous sub-mesh of " + acc.name + " (" +
-            acc.LabelTopology() + ")";
+  std::ostringstream os;
+  os << "device set " << join(ids) << " is not an ICI-contiguous sub-mesh of "
+     << acc.name << " (" << acc.LabelTopology() << "); valid sets of size "
+     << n << ": ";
+  for (size_t i = 0; i < subsets.size(); ++i)
+    os << (i ? " " : "") << "[" << join(subsets[i]) << "]";
+  *reason = os.str();
   return false;
 }
 

@@ -28,10 +28,16 @@ struct AcceleratorType {
   int hosts_x = 1, hosts_y = 1, hosts_z = 1;
 
   // Slice chip grid (hosts x per-host grid) — matches Python
-  // label_topology(); equals the per-host grid on 1-host types.
+  // label_topology(); equals the per-host grid on 1-host types. v4/v5p
+  // slices tile a 3D torus: their labels carry the z extent (= hosts_z,
+  // per-host grids are always flat), the GKE convention for those
+  // generations.
   std::string LabelTopology() const {
-    return std::to_string(topo_x * hosts_x) + "x" +
-           std::to_string(topo_y * hosts_y);
+    std::string label = std::to_string(topo_x * hosts_x) + "x" +
+                        std::to_string(topo_y * hosts_y);
+    if (generation == "v4" || generation == "v5p")
+      label += "x" + std::to_string(hosts_z);
+    return label;
   }
   std::string HostBounds() const {
     return std::to_string(hosts_x) + "," + std::to_string(hosts_y) + "," +

@@ -1,0 +1,85 @@
+"""Train-step MFU tuning harness (run on the real chip).
+
+Measures burnin.timed_steps across candidate configurations so the bench
+config (burnin.bench_config) is chosen from data, not guesses. Each variant
+prints one JSON line; the winner's settings are recorded in
+burnin.bench_config's docstring. Usage:
+
+    python scripts/tune_trainstep.py              # all variants
+    python scripts/tune_trainstep.py base dots32  # named subset
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from dataclasses import replace
+
+sys.path.insert(0, ".")
+
+from tpu_cluster import topology  # noqa: E402
+from tpu_cluster.workloads import burnin  # noqa: E402
+
+BASE = burnin.bench_config()
+
+VARIANTS = {
+    "base": BASE,
+    "dots": replace(BASE, remat="dots"),
+    "b32": replace(BASE, batch=32),
+    "b32_dots": replace(BASE, batch=32, remat="dots"),
+    "b32_s1k_dots": replace(BASE, batch=32, seq=1024, remat="dots"),
+    "b64_dots": replace(BASE, batch=64, remat="dots"),
+    # fused CE + cast-once + f32-accum LM head are unconditional now; the
+    # flash variants additionally swap in the Pallas attention kernel.
+    "flash": replace(BASE, attention="flash"),
+    "flash_dots": replace(BASE, attention="flash", remat="dots"),
+    "b32_flash": replace(BASE, batch=32, attention="flash"),
+    "b32_flash_dots": replace(BASE, batch=32, attention="flash",
+                              remat="dots"),
+    "b32_s1k_flash": replace(BASE, batch=32, seq=1024, attention="flash"),
+    # shape probes: shorter seq cuts the [B,H,S,S] f32 attention traffic
+    # per token; wider FFN raises matmul fraction per token
+    "s256_b32": replace(BASE, seq=256, batch=32),
+    "ff16k": replace(BASE, d_ff=16384, batch=8),
+    "ff16k_b16": replace(BASE, d_ff=16384),
+    "ff16k_b32": replace(BASE, d_ff=16384, batch=32),
+    "ff16k_s1k": replace(BASE, d_ff=16384, seq=1024),
+    "d4096": replace(BASE, d_model=4096, d_ff=16384, n_heads=32, batch=8),
+    # the [B,H,S,S] attention traffic scales with n_heads; the FFN fraction
+    # scales with d_ff — push both in the matmul-heavy direction
+    "d4096_h16": replace(BASE, d_model=4096, d_ff=16384, n_heads=16,
+                         batch=8),
+    "ff32k": replace(BASE, d_ff=32768),
+    "ff32k_b32": replace(BASE, d_ff=32768, batch=32),
+    "d4096_h16_flash": replace(BASE, d_model=4096, d_ff=16384, n_heads=16,
+                               batch=8, attention="flash"),
+}
+
+
+def main() -> int:
+    import jax
+
+    names = sys.argv[1:] or list(VARIANTS)
+    acc = topology.from_device_kind(jax.devices()[0].device_kind)
+    peak = acc.peak_bf16_tflops if acc else 0.0
+    mesh = burnin.make_mesh((1, 1))
+    for name in names:
+        cfg = VARIANTS[name]
+        try:
+            ts = burnin.timed_steps(mesh, cfg, steps=10)
+            print(json.dumps({
+                "variant": name, "batch": cfg.batch, "seq": cfg.seq,
+                "remat": cfg.remat,
+                "tflops": round(ts["tflops"], 2),
+                "mfu": round(ts["tflops"] / peak, 3) if peak else None,
+                "tokens_per_s": round(ts["tokens_per_s"]),
+                "points": ts["points"],
+            }), flush=True)
+        except Exception as exc:  # noqa: BLE001 — keep sweeping
+            print(json.dumps({"variant": name, "error": repr(exc)[:200]}),
+                  flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

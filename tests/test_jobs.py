@@ -138,6 +138,23 @@ def test_multihost_jobs_derive_hosts_from_slice_type():
     assert "tpu-psum-multihost" in names and "tpu-burnin-multihost" in names
 
 
+def test_multihost_jobs_v5p16_3d_slice():
+    """v5p-16 renders Indexed worker sets spanning its 2 hosts, each pod
+    taking the host's whole 4-chip group — the 3D-torus slice shape
+    (hosts stacked along z; the plugin side of the contract injects
+    TPU_HOST_BOUNDS="1,1,2" per test_native.py)."""
+    spec = specmod.default_spec()
+    spec.tpu.accelerator = "v5p-16"
+    objs = jobs.render_validation_jobs(spec)
+    job = next(o for o in objs
+               if o["kind"] == "Job"
+               and o["metadata"]["name"] == "tpu-psum-multihost")
+    assert job["spec"]["completionMode"] == "Indexed"
+    assert job["spec"]["completions"] == 2
+    container = job["spec"]["template"]["spec"]["containers"][0]
+    assert container["resources"]["limits"]["google.com/tpu"] == "4"
+
+
 def test_cli_render_multihost_mismatch_clean_error(capsys):
     """A worker count not matching the slice renders a clean CLI error,
     not a traceback."""

@@ -569,6 +569,30 @@ def test_allocate_multihost_slice_env(native_build, tmp_path):
         proc.wait(timeout=5)
 
 
+def test_allocate_v5p16_3d_host_bounds(native_build, tmp_path):
+    """v5p-16 (2 hosts of flat 2x2 chips stacked along the torus z axis):
+    Allocate's TPU_HOST_BOUNDS carries the real z extent "1,1,2" from the
+    catalogue — the 3D half of the HOST_BOUNDS contract (round-2 verdict
+    next-step #7)."""
+    from tpu_cluster.plugin_api.client import DevicePluginClient
+    proc, sock = start_tpud(native_build, tmp_path, "--fake-devices=4",
+                            "--no-register", "--accelerator=v5p-16")
+    c = DevicePluginClient(sock)
+    try:
+        resp = c.allocate([f"tpu-{i}" for i in range(4)])
+        envs = resp.container_responses[0].envs
+        assert envs["TPU_HOST_BOUNDS"] == "1,1,2"
+        assert envs["TPU_CHIPS_PER_HOST_BOUNDS"] == "2,2,1"
+        assert envs["TPU_ACCELERATOR_TYPE"] == "v5p-16"
+        with pytest.raises(grpc.RpcError) as ei:
+            c.allocate(["tpu-0", "tpu-1"])  # sub-host: whole groups only
+        assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    finally:
+        c.close()
+        proc.terminate()
+        proc.wait(timeout=5)
+
+
 def test_device_add_pushes_listandwatch_update(native_build, tmp_path):
     """The inverse of hot-unplug: a chip coming (back) online — e.g. a
     repaired node, or libtpu-prep creating nodes late — must be pushed to

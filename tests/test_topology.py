@@ -111,6 +111,28 @@ def test_multihost_slice_types():
     assert v8.label_topology() == "2x4"
 
 
+def test_v5p_3d_torus_slice():
+    """v4/v5p slices tile a 3D torus: hosts stack along z, the topology
+    label carries all three extents, and TPU_HOST_BOUNDS gets a real z
+    (round-2 verdict next-step #7)."""
+    acc = topology.get("v5p-16")
+    assert acc.num_hosts == 2
+    assert acc.host_bounds == (1, 1, 2)     # hosts stacked along z
+    assert acc.chips_per_host == 4          # flat 2x2 per host
+    assert acc.total_chips == 8             # "-16" counts TensorCores
+    assert acc.aligned_sizes == (4,)        # whole host groups only
+    assert acc.label_topology() == "2x2x2"  # the cube
+    # single-host v4/v5p labels carry the (identity) z extent too
+    assert topology.get("v5p-8").label_topology() == "2x2x1"
+    assert topology.get("v4-8").label_topology() == "2x2x1"
+    # 2D generations keep 2D labels
+    assert topology.get("v6e-16").label_topology() == "4x4"
+    ok, _ = topology.validate_allocation(acc, [0, 1, 2, 3])
+    assert ok
+    ok, reason = topology.validate_allocation(acc, [0, 1])
+    assert not ok and "not aligned" in reason
+
+
 def test_from_device_kind():
     """JAX device_kind strings resolve to catalogue generations (observed:
     the tunneled runtime reports 'TPU v5 lite')."""

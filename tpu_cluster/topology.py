@@ -51,15 +51,26 @@ class AcceleratorType:
 
     def label_topology(self) -> str:
         """The slice chip grid (hosts x per-host grid) — what GKE publishes
-        as the topology label; equals the per-host grid on 1-host types."""
+        as the topology label; equals the per-host grid on 1-host types.
+
+        v4/v5p slices tile a 3D torus, so their labels carry the z extent
+        ("2x2x1", "2x2x2" — the GKE convention for those generations); the
+        per-host grid is always flat (z=1), so the slice z extent equals
+        hosts_z. v5e/v6e slices are 2D and keep the "XxY" form."""
         x = self.topology[0] * self.host_bounds[0]
         y = self.topology[1] * self.host_bounds[1]
+        if self.generation in TORUS_3D_GENERATIONS:
+            return f"{x}x{y}x{self.host_bounds[2]}"
         return f"{x}x{y}"
 
     @property
     def total_chips(self) -> int:
         return self.chips_per_host * self.num_hosts
 
+
+# Generations whose slices tile a 3D torus (z > 1 possible at the slice
+# level); their topology labels carry all three extents.
+TORUS_3D_GENERATIONS = ("v4", "v5p")
 
 # Per-host accelerator catalogue. Only per-host shapes matter to the device
 # plugin (multi-host slices are composed of per-host groups over DCN; see
@@ -133,6 +144,18 @@ V5E_32 = _register(AcceleratorType(
     sub_mesh_shapes={8: (2, 4)},
     peak_bf16_tflops=197.0,
     num_hosts=4, host_bounds=(2, 2, 1),
+))
+
+# v5p multi-host: each host contributes a flat 2x2 chip group; hosts stack
+# along the torus z axis (v5p-16 = 8 chips = 2 hosts as the 2x2x2 cube —
+# the "-16" counts TensorCores, 2 per chip, the v4/v5p naming convention).
+# Whole-host-group allocation (aligned 4), 3D TPU_HOST_BOUNDS "1,1,2".
+V5P_16 = _register(AcceleratorType(
+    name="v5p-16", generation="v5p", chips_per_host=4, topology=(2, 2),
+    hbm_gib_per_chip=95, aligned_sizes=(4,),
+    sub_mesh_shapes={4: (2, 2)},
+    peak_bf16_tflops=459.0,
+    num_hosts=2, host_bounds=(1, 1, 2),
 ))
 
 V6E_16 = _register(AcceleratorType(
@@ -257,9 +280,12 @@ def validate_allocation(acc: AcceleratorType, device_ids: Sequence[int]) -> Tupl
     ids = tuple(sorted(device_ids))
     n = len(ids)
     if n not in acc.aligned_sizes:
+        examples = ", ".join(
+            f"{s} ({','.join(map(str, aligned_subsets(acc, s)[0]))})"
+            for s in acc.aligned_sizes if aligned_subsets(acc, s))
         return False, (
             f"request size {n} is not aligned for {acc.name}; "
-            f"allowed sizes: {list(acc.aligned_sizes)}"
+            f"valid sizes (example chip set): {examples}"
         )
     if any(i < 0 or i >= acc.chips_per_host for i in ids):
         return False, f"device ids {ids} out of range for {acc.name}"

@@ -36,6 +36,7 @@ def run_triage(spec: ClusterSpec,
     # 1. pod inventory with phases (the "kubectl get pods" first look)
     rc, out = runner(["kubectl", "get", "pods", "-n", ns, "-o", "json"])
     problem_pods: List[str] = []
+    admission_errors: List[tuple] = []
     if rc != 0:
         report.add(f"pods in {ns}", "ERROR: cannot list pods — is the stack "
                                     "installed? (tpuctl apply)")
@@ -47,7 +48,32 @@ def run_triage(spec: ClusterSpec,
             lines.append(f"{name}  {phase}")
             if phase not in ("Running", "Succeeded"):
                 problem_pods.append(name)
+            if pod["status"].get("reason") == "UnexpectedAdmissionError":
+                admission_errors.append(
+                    (name, pod["status"].get("message", "")))
         report.add(f"pods in {ns}", "\n".join(lines) or "(none)")
+
+    # 1b. UnexpectedAdmissionError = the device plugin rejected Allocate
+    # (unaligned google.com/tpu request); surface the plugin's reason and
+    # the accelerator's valid shapes right here instead of making the user
+    # decode a gRPC error string (docs/GUIDE.md triage runbook).
+    if admission_errors:
+        from . import topology
+        body = []
+        for name, message in admission_errors:
+            body.append(f"{name}: {message or '(no status message)'}")
+        try:
+            acc = topology.get(spec.tpu.accelerator)
+            shapes = ", ".join(
+                f"{s} chips e.g. {list(topology.aligned_subsets(acc, s)[0])}"
+                for s in acc.aligned_sizes if topology.aligned_subsets(acc, s))
+            body.append(
+                f"fix: request an aligned google.com/tpu count for "
+                f"{acc.name} — {shapes}")
+        except KeyError:
+            pass
+        report.add("UnexpectedAdmissionError pods (unaligned TPU request)",
+                   "\n".join(body))
 
     # 2. describe + logs for every problem pod (reference README.md:179-184)
     for pod in problem_pods:

@@ -16,14 +16,17 @@ are static; the step is one ``jit``.
 
 from __future__ import annotations
 
+import contextlib
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import runtime_metrics
 
 
 @dataclass(frozen=True)
@@ -35,12 +38,26 @@ class BurninConfig:
     seq: int = 64
     batch: int = 8
     lr: float = 1e-3
+    # Rematerialisation policy for the fwd pass inside grad: "none" saves
+    # every intermediate (XLA default), "dots" saves only matmul outputs and
+    # recomputes elementwise chains in the bwd pass (jax.checkpoint
+    # dots_with_no_batch_dims_saveable) — trades a few % FLOPs for the HBM
+    # round-trips of the attention/softmax intermediates, a net win when the
+    # step is bandwidth-bound.
+    remat: str = "none"
+    # "xla": masked-softmax attention materialising the [B,H,S,S] scores
+    # (runs everywhere, incl. the virtual CPU mesh). "flash": the Pallas TPU
+    # flash-attention kernel (jax.experimental.pallas.ops.tpu) — tiled
+    # online-softmax on-chip, never materialises the score matrix in HBM;
+    # TPU-only (Mosaic), requires d_head a multiple of 128.
+    attention: str = "xla"
 
     def scaled(self, factor: int) -> "BurninConfig":
         return BurninConfig(
             vocab=self.vocab, d_model=self.d_model * factor,
             d_ff=self.d_ff * factor, n_heads=self.n_heads,
-            seq=self.seq, batch=self.batch, lr=self.lr,
+            seq=self.seq, batch=self.batch, lr=self.lr, remat=self.remat,
+            attention=self.attention,
         )
 
 
@@ -80,8 +97,17 @@ def param_specs() -> Dict[str, P]:
 
 def forward(params: Dict[str, Any], tokens: jnp.ndarray,
             cfg: BurninConfig) -> jnp.ndarray:
-    """One pre-norm transformer block + LM head, bf16 compute / f32 params."""
-    x = params["embed"].astype(jnp.bfloat16)[tokens]       # [B, S, D]
+    """One pre-norm transformer block + LM head, bf16 compute / f32 params.
+
+    Bandwidth-conscious choices (each measured on a real v5e chip via
+    scripts/tune_trainstep.py): params are cast f32->bf16 once per use site
+    and XLA CSEs the casts across fwd/bwd; the LM head accumulates in f32 on
+    the MXU (``preferred_element_type``) so the [B,S,V] logits never take a
+    bf16->f32 round trip through HBM; ``cfg.attention="flash"`` swaps the
+    masked-softmax attention (which materialises [B,H,S,S] scores in f32)
+    for the Pallas TPU flash-attention kernel.
+    """
+    x = params["embed"][tokens].astype(jnp.bfloat16)       # [B, S, D]
     h = cfg.n_heads
     d_head = cfg.d_model // h
 
@@ -94,30 +120,75 @@ def forward(params: Dict[str, Any], tokens: jnp.ndarray,
     q = (y @ params["wq"].astype(jnp.bfloat16)).reshape(*y.shape[:2], h, d_head)
     k = (y @ params["wk"].astype(jnp.bfloat16)).reshape(*y.shape[:2], h, d_head)
     v = (y @ params["wv"].astype(jnp.bfloat16)).reshape(*y.shape[:2], h, d_head)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d_head)
-    mask = jnp.tril(jnp.ones((y.shape[1], y.shape[1]), bool))
-    logits = jnp.where(mask, logits.astype(jnp.float32), -1e30)
-    attn = jax.nn.softmax(logits, axis=-1).astype(jnp.bfloat16)
-    o = jnp.einsum("bhqk,bkhd->bqhd", attn, v).reshape(y.shape)
+    if cfg.attention == "flash":
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention)
+        o = flash_attention(
+            q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3), causal=True,
+            sm_scale=float(1.0 / np.sqrt(d_head)),
+        ).transpose(0, 2, 1, 3).reshape(y.shape)
+    else:
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d_head)
+        mask = jnp.tril(jnp.ones((y.shape[1], y.shape[1]), bool))
+        logits = jnp.where(mask, logits.astype(jnp.float32), -1e30)
+        attn = jax.nn.softmax(logits, axis=-1).astype(jnp.bfloat16)
+        o = jnp.einsum("bhqk,bkhd->bqhd", attn, v).reshape(y.shape)
     x = x + o @ params["wo"].astype(jnp.bfloat16)
     y = rms(x)
     ff = jax.nn.gelu(y @ params["w1"].astype(jnp.bfloat16))
     x = x + ff @ params["w2"].astype(jnp.bfloat16)
-    return (rms(x) @ params["out"].astype(jnp.bfloat16)).astype(jnp.float32)
+    return jnp.einsum("bsd,dv->bsv", rms(x),
+                      params["out"].astype(jnp.bfloat16),
+                      preferred_element_type=jnp.float32)
 
 
 def loss_fn(params, batch, cfg: BurninConfig):
     tokens, targets = batch
-    logits = forward(params, tokens, cfg)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
-    return jnp.mean(nll)
+    fwd = forward
+    if cfg.remat == "dots":
+        fwd = jax.checkpoint(
+            forward, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            static_argnums=(2,))
+    elif cfg.remat == "full":
+        fwd = jax.checkpoint(forward, static_argnums=(2,))
+    logits = fwd(params, tokens, cfg)
+    # Fused cross-entropy: mean(logsumexp - gold logit) never materialises
+    # the [B,S,V] log-probabilities (log_softmax would cost a full extra
+    # HBM round trip of the largest tensor in the model); algebraically
+    # identical to mean(-log_softmax[target]).
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(lse - gold)
 
 
 def train_step(params, batch, cfg: BurninConfig):
     loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg)
     new_params = jax.tree.map(lambda p, g: p - cfg.lr * g, params, grads)
     return new_params, loss
+
+
+def bench_config() -> BurninConfig:
+    """The train-step configuration bench.py measures MFU at (single v5e
+    chip), chosen from the scripts/tune_trainstep.py sweep on real hardware
+    (round 3; best-of measurements, ±0.03 tunnel variance):
+
+      d2048/f8192/h16/b16/s512 (round-2 shape) . 0.65-0.69 MFU
+       + fused CE (no [B,S,V] log-softmax), cast-once params,
+         f32-accum LM head ........................ 0.71-0.75
+      remat=dots / batch 32 / seq 256|1024 ....... all regressions
+      pallas flash-attention ..................... 0.64-0.72 (S=512 too
+         short to amortise the kernel; its win case is long-seq)
+      d4096/f16384/h16/b8 ........................ 0.80
+      d2048/f32768/h16/b16/s512 (this config) .... 0.82-0.84
+
+    The dominant overheads at f8192 were per-token HBM traffic of the f32
+    [B,H,S,S] attention scores and [B,S,V] logits chains plus the f32
+    optimizer update; widening the FFN raises the matmul fraction per token
+    past them. FLOPs are XLA cost-analysis of the no-remat step (see
+    timed_steps)."""
+    return BurninConfig(vocab=8192, d_model=2048, d_ff=32768,
+                        n_heads=16, seq=512, batch=16)
 
 
 def make_mesh(shape: Tuple[int, int], devices=None) -> Mesh:
@@ -204,7 +275,12 @@ def timed_steps(mesh: Mesh, cfg: BurninConfig, steps: int = 20,
     """
     param_shardings, params, batch = _global_init(mesh, cfg)
 
-    one = jax.jit(lambda p, b: train_step(p, b, cfg),
+    # FLOPs denominator from the NO-remat step regardless of cfg.remat:
+    # rematerialisation re-executes parts of the fwd pass, and counting the
+    # recomputed FLOPs would inflate MFU — the model does not get more
+    # useful work done per step by recomputing.
+    flops_cfg = replace(cfg, remat="none")
+    one = jax.jit(lambda p, b: train_step(p, b, flops_cfg),
                   out_shardings=(param_shardings,
                                  NamedSharding(mesh, P())))
     cost = one.lower(params, batch).compile().cost_analysis()
@@ -219,14 +295,18 @@ def timed_steps(mesh: Mesh, cfg: BurninConfig, steps: int = 20,
                 return p, loss
             return jax.lax.scan(body, params, None, length=n)
 
+        # NB: params are NOT donated here — the same param buffers feed every
+        # rep and both timing points; donation would delete them after the
+        # first call.
         jitted = jax.jit(multi, out_shardings=(
             param_shardings, NamedSharding(mesh, P(None))))
         float(jitted(params, batch)[1][-1])  # compile + warm-up
         best = None
         for _ in range(reps):
             t0 = time.perf_counter()
-            losses = jitted(params, batch)[1]
-            float(losses[-1])  # the true sync (see docstring)
+            with runtime_metrics.device_busy():  # duty-cycle producer
+                losses = jitted(params, batch)[1]
+                float(losses[-1])  # the true sync (see docstring)
             dt = time.perf_counter() - t0
             best = dt if best is None else min(best, dt)
         return best
@@ -255,9 +335,14 @@ def run(mesh_shape: Tuple[int, int] = None, steps: int = 5,
     step, params, batch = make_sharded_step(mesh, cfg)
     losses = []
     t0 = time.perf_counter()
-    for _ in range(steps):
-        params, loss = step(params, batch)
-        losses.append(float(loss))
+    for i in range(steps):
+        # duty-cycle producer region per synced step; the first step is
+        # excluded — it is dominated by XLA compilation (host work, not
+        # device execution).
+        ctx = runtime_metrics.device_busy() if i else contextlib.nullcontext()
+        with ctx:
+            params, loss = step(params, batch)
+            losses.append(float(loss))
     dt = time.perf_counter() - t0
     decreasing = losses[-1] < losses[0]
     return {

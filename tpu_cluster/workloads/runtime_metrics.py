@@ -10,31 +10,118 @@ validated lines into its ``/metrics`` endpoint
 
 Metrics published per local device (names shared with the tpu-info probe,
 which renders tpu_hbm_used_bytes in its table — native/tpuinfo):
-  tpu_hbm_used_bytes{chip=...}     from device.memory_stats()
+  tpu_hbm_used_bytes{chip=...}     from device.memory_stats(), else live-
+                                   array accounting (see below)
   tpu_hbm_limit_bytes{chip=...}
   tpu_hbm_source{source=...}       where the HBM numbers came from
+  tpu_duty_cycle_percent{chip=...} fraction of wall-time the workload had
+                                   device execution in flight (see below)
   tpu_process_devices              local device count of the writer
   tpu_runtime_metrics_timestamp_seconds  staleness marker for scrapers
 
-``device.memory_stats()`` returns None on some runtimes (observed: the
-tunneled v5e backend); the limit gauge then falls back to the accelerator
-catalogue (tpu_cluster.topology, resolved from the TPU_ACCELERATOR_TYPE env
-the device plugin's Allocate injects, else the JAX device_kind), flagged
-``tpu_hbm_source{source="catalogue"}``. Used-bytes is only published when
-the runtime reports it — a fabricated value would be worse than an absent
-one — so scrapers alert on capacity present + usage missing via the source
-gauge, never on silently-empty output.
+HBM degradation ladder (tpu_hbm_source names the rung):
+  "memory_stats"  the runtime reported both gauges — published as-is.
+  "live_arrays"   memory_stats() is None (observed: the tunneled v5e
+                  backend); used-bytes is the per-device sum of the
+                  process's live ``jax.Array`` buffers (jax.live_arrays) —
+                  a measured lower bound that misses runtime-internal
+                  scratch, honestly labeled — and the limit comes from the
+                  accelerator catalogue (tpu_cluster.topology, resolved
+                  from the TPU_ACCELERATOR_TYPE env the device plugin's
+                  Allocate injects, else the JAX device_kind).
+  "catalogue"     no memory_stats AND no live buffers on the local
+                  devices: capacity only, used-bytes absent (a fabricated
+                  value would be worse than an absent one).
+  "none"          the double-miss: unknown device kind, no Allocate env.
+
+Duty cycle (the dcgm-exporter utilization analog, reference README.md:166
+"0%"): libtpu exposes no system daemon to ask, so the owning workload
+samples itself — ``duty_cycle_window()`` opens a measurement window and
+``device_busy()`` marks the regions where device execution is in flight
+(dispatch..sync, e.g. around burnin's timed steps). The gauge is
+busy/wall over the window, attributed to every local chip the process
+owns. No window or an empty window publishes nothing — the gauge is only
+ever a measured value.
 
 The write is atomic (tmp + rename) so the exporter never relays a torn file.
 """
 
 from __future__ import annotations
 
+import contextlib
 import os
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 DEFAULT_PATH = "/run/tpu/metrics.prom"
+
+
+class DutyCycleSampler:
+    """Accumulates device-busy seconds against a wall-clock window."""
+
+    def __init__(self) -> None:
+        self._t0 = time.monotonic()
+        self._busy = 0.0
+
+    def add_busy(self, seconds: float) -> None:
+        if seconds > 0:
+            self._busy += seconds
+
+    def percent(self) -> Optional[float]:
+        wall = time.monotonic() - self._t0
+        if wall <= 0 or self._busy <= 0:
+            return None
+        return min(100.0, 100.0 * self._busy / wall)
+
+
+_active_sampler: Optional[DutyCycleSampler] = None
+
+
+@contextlib.contextmanager
+def duty_cycle_window():
+    """Open a duty-cycle measurement window; ``collect_lines`` publishes the
+    gauge while the window is active (and writers called inside it see it)."""
+    global _active_sampler
+    sampler = DutyCycleSampler()
+    prev, _active_sampler = _active_sampler, sampler
+    try:
+        yield sampler
+    finally:
+        _active_sampler = prev
+
+
+@contextlib.contextmanager
+def device_busy():
+    """Mark a region with device execution in flight (dispatch..sync).
+    No-op when no duty-cycle window is open, so workloads can annotate
+    unconditionally."""
+    sampler = _active_sampler
+    t0 = time.monotonic()
+    try:
+        yield
+    finally:
+        if sampler is not None:
+            sampler.add_busy(time.monotonic() - t0)
+
+
+def _live_array_bytes(devices) -> Dict[int, int]:
+    """Per-device bytes held by this process's live jax.Arrays — the
+    used-bytes fallback when the runtime exposes no memory_stats. Only
+    shards on ``devices`` count (a CPU-side array must not be attributed
+    to a TPU chip id)."""
+    import jax
+
+    wanted = {id(d): d.id for d in devices}
+    out: Dict[int, int] = {}
+    for arr in jax.live_arrays():
+        try:
+            for shard in arr.addressable_shards:
+                chip = wanted.get(id(shard.device))
+                if chip is not None and shard.data is not None:
+                    out[chip] = out.get(chip, 0) + shard.data.nbytes
+        except Exception:  # noqa: BLE001 — deleted-mid-walk arrays etc.
+            continue
+    return out
 
 
 def collect_lines(now: Optional[float] = None) -> List[str]:
@@ -59,8 +146,9 @@ def collect_lines(now: Optional[float] = None) -> List[str]:
     source = "memory_stats"
     if not limits and devices and devices[0].platform == "tpu":
         # Runtime exposes no memory stats (tunneled backends return None):
-        # capacity from the catalogue so the limit gauge is never silently
-        # absent. Used-bytes stays runtime-only. source="none" marks the
+        # walk down the degradation ladder (module docstring) — live-array
+        # accounting for used-bytes, catalogue for capacity so the limit
+        # gauge is never silently absent. source="none" marks the
         # double-miss (unknown device kind, no Allocate env) so scrapers can
         # tell "runtime supplied stats" from "nobody could".
         acc = None
@@ -69,11 +157,14 @@ def collect_lines(now: Optional[float] = None) -> List[str]:
             acc = topology.get(acc_env)
         if acc is None:
             acc = topology.from_device_kind(devices[0].device_kind)
+        if not in_use:
+            in_use = _live_array_bytes(devices)
         if acc is not None:
-            source = "catalogue"
+            source = "live_arrays" if in_use else "catalogue"
             limits = {d.id: acc.hbm_gib_per_chip << 30 for d in devices}
         else:
             source = "none"
+            in_use = {}
     for chip, val in sorted(in_use.items()):
         lines.append(f'tpu_hbm_used_bytes{{chip="{chip}"}} {val}')
     lines += ["# HELP tpu_hbm_limit_bytes HBM capacity visible to the runtime",
@@ -85,6 +176,16 @@ def collect_lines(now: Optional[float] = None) -> List[str]:
         "# TYPE tpu_hbm_source gauge",
         f'tpu_hbm_source{{source="{source}"}} 1',
     ]
+    duty = _active_sampler.percent() if _active_sampler else None
+    if duty is not None:
+        lines += [
+            "# HELP tpu_duty_cycle_percent fraction of wall-time the owning "
+            "workload had device execution in flight",
+            "# TYPE tpu_duty_cycle_percent gauge",
+        ]
+        for d in devices:
+            lines.append(
+                f'tpu_duty_cycle_percent{{chip="{d.id}"}} {duty:.1f}')
     lines += [
         "# HELP tpu_process_devices local devices owned by the writer",
         "# TYPE tpu_process_devices gauge",

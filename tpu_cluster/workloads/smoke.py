@@ -95,15 +95,19 @@ def matmul(m: int = 4096, k: int = 4096, n: int = 4096,
         out, _ = jax.lax.scan(step, x, None, length=iters)
         return out
 
+    from . import runtime_metrics
+
     chain(a, b).block_until_ready()  # compile
     t0 = time.perf_counter()
-    out = chain(a, b)
-    out.block_until_ready()
-    # On the tunneled backend block_until_ready has been observed returning
-    # before execution for some output kinds (burnin.timed_steps docstring);
-    # a one-element fetch is the guaranteed sync. Its roundtrip is a
-    # constant, cancelled by callers using the two-point delta (bench.py).
-    np.asarray(out[:1, :1])
+    with runtime_metrics.device_busy():  # duty-cycle producer region
+        out = chain(a, b)
+        out.block_until_ready()
+        # On the tunneled backend block_until_ready has been observed
+        # returning before execution for some output kinds (burnin.timed_steps
+        # docstring); a one-element fetch is the guaranteed sync. Its
+        # roundtrip is a constant, cancelled by callers using the two-point
+        # delta (bench.py).
+        np.asarray(out[:1, :1])
     dt = time.perf_counter() - t0
     flops = 2.0 * m * k * n * iters
     finite = bool(jnp.isfinite(out.astype(jnp.float32)).all())
