@@ -55,7 +55,9 @@ def test_load_rejects_unknowns():
         specmod.load("cluster: {podCidr: not-a-cidr}")
     with pytest.raises(specmod.SpecError):
         specmod.load("cluster: {podCidr: garbage/999}")
-    with pytest.raises(KeyError):
+    # unknown accelerator surfaces as SpecError so the CLI prints a clean
+    # `spec error:` line (not a KeyError traceback)
+    with pytest.raises(specmod.SpecError, match="unknown accelerator"):
         specmod.load("tpu: {accelerator: v99-1}")
     # nested sections are set programmatically; naming them directly is an
     # error, not a silent overwrite

@@ -102,7 +102,13 @@ class TpuSpec:
                            "metricsExporter", "nodeStatusExporter")
 
     def validate(self) -> None:
-        topology.get(self.accelerator)  # raises on unknown
+        try:
+            topology.get(self.accelerator)
+        except KeyError as exc:
+            # KeyError's message is the quoted repr of its arg; unwrap it so
+            # the CLI prints a clean `spec error: unknown accelerator ...`
+            # line instead of a traceback.
+            raise SpecError(exc.args[0]) from None
         for name, op in self.operands.items():
             if name not in self.OPERAND_NAMES:
                 raise SpecError(
