@@ -53,7 +53,10 @@ class CannedRunner:
             **{f"get job -n tpu-system {j}": job(j)
                for j in verify.VALIDATION_JOBS},
         }
-        self.raw = {"proxy/metrics": "tpu_chips_total 8\ntpu_chip_present 1\n",
+        self.raw = {"proxy/metrics": "tpu_chips_total 8\n"
+                                     "tpu_chip_present 1\n"
+                                     'tpu_hbm_capacity_bytes{chip="0"} '
+                                     "17179869184\n",
                     "proxy/status": '{"healthy": true}'}
         # golden output of the device-query Job (nvidia-smi table analog);
         # kubectl logs interleaves stderr warnings with the JSON report
@@ -194,6 +197,30 @@ def test_triage_collects_describe_and_logs_for_problem_pods(spec):
     assert "warning events in tpu-system" in text
     assert "StageTimeout  DaemonSet/tpu-device-plugin" in text
     assert "hints" in text
+
+
+def test_metrics_check_requires_hbm_capacity(spec):
+    """BASELINE config 4 names per-chip HBM as part of the scrape surface:
+    a scrape that serves only the census gauges (exporter running with an
+    unknown accelerator type) must fail, and workload-produced gauges are
+    reported when present."""
+    runner = CannedRunner(healthy=True)
+    runner.raw["proxy/metrics"] = "tpu_chips_total 8\n"
+    res = verify.check_metrics(runner, spec)
+    assert not res.ok and "tpu_hbm_capacity_bytes" in res.detail
+    # the HELP comment alone (zero chips discovered) must NOT satisfy it
+    runner.raw["proxy/metrics"] = (
+        "tpu_chips_total 0\n"
+        "# HELP tpu_hbm_capacity_bytes HBM capacity per chip\n"
+        "# TYPE tpu_hbm_capacity_bytes gauge\n")
+    res = verify.check_metrics(runner, spec)
+    assert not res.ok
+    runner.raw["proxy/metrics"] = (
+        "tpu_chips_total 8\n"
+        'tpu_hbm_capacity_bytes{chip="0"} 17179869184\n'
+        'tpu_duty_cycle_percent{chip="0"} 42.0\n')
+    res = verify.check_metrics(runner, spec)
+    assert res.ok and "tpu_duty_cycle_percent" in res.detail
 
 
 def test_triage_explains_unexpected_admission_error(spec):

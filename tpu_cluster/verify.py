@@ -305,8 +305,24 @@ def check_metrics(runner: Runner, spec: ClusterSpec) -> CheckResult:
     if "tpu_chips_total" not in out:
         return CheckResult("metrics", False,
                            "scrape lacks tpu_chips_total gauge")
+    if not any(ln.startswith("tpu_hbm_capacity_bytes{")
+               for ln in out.splitlines()):
+        # BASELINE config 4 names the per-chip HBM surface; capacity comes
+        # from the exporter's own catalogue collector, per discovered chip.
+        # Matching a sample line (not the HELP comment) means "accelerator
+        # type unknown" AND "zero chips discovered" both fail — don't shrug.
+        return CheckResult("metrics", False,
+                           "scrape lacks per-chip tpu_hbm_capacity_bytes "
+                           "samples")
     line = next((ln for ln in out.splitlines()
                  if ln.startswith("tpu_chips_total")), "")
+    # Workload-produced gauges (duty cycle / HBM used) relay through the
+    # same endpoint but only exist while a JAX workload is publishing —
+    # report their presence rather than failing an idle node.
+    extras = [g for g in ("tpu_duty_cycle_percent", "tpu_hbm_used_bytes")
+              if g in out]
+    if extras:
+        line += f" (+ workload gauges: {', '.join(extras)})"
     return CheckResult("metrics", True, line or "tpu_chips_total present")
 
 

@@ -143,6 +143,42 @@ def forward(params: Dict[str, Any], tokens: jnp.ndarray,
                       preferred_element_type=jnp.float32)
 
 
+@jax.custom_vjp
+def softmax_xent(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    """Mean token cross-entropy with a hand-fused backward pass.
+
+    Forward: mean(logsumexp - gold logit) — algebraically identical to
+    mean(-log_softmax[target]) but never materialises the [B,S,V]
+    log-probabilities (a full HBM round trip of the largest tensor in the
+    model). Backward: the classic closed form d = (softmax - onehot)/N in
+    ONE elementwise pass — autodiff of the gather instead emits a scatter
+    over [B,S,V], which measured ~1ms/step slower on a v5e chip at the
+    bench shape (scripts/tune_trainstep.py round-3 sweep)."""
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(lse - gold)
+
+
+def _softmax_xent_fwd(logits, targets):
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(lse - gold), (logits, targets, lse)
+
+
+def _softmax_xent_bwd(res, g):
+    logits, targets, lse = res
+    probs = jnp.exp(logits - lse[..., None])
+    onehot = (jax.lax.broadcasted_iota(jnp.int32, logits.shape,
+                                       logits.ndim - 1)
+              == targets[..., None])
+    scale = g / np.prod(logits.shape[:-1])
+    d = (probs - onehot.astype(logits.dtype)) * scale
+    return d, None
+
+
+softmax_xent.defvjp(_softmax_xent_fwd, _softmax_xent_bwd)
+
+
 def loss_fn(params, batch, cfg: BurninConfig):
     tokens, targets = batch
     fwd = forward
@@ -153,13 +189,7 @@ def loss_fn(params, batch, cfg: BurninConfig):
     elif cfg.remat == "full":
         fwd = jax.checkpoint(forward, static_argnums=(2,))
     logits = fwd(params, tokens, cfg)
-    # Fused cross-entropy: mean(logsumexp - gold logit) never materialises
-    # the [B,S,V] log-probabilities (log_softmax would cost a full extra
-    # HBM round trip of the largest tensor in the model); algebraically
-    # identical to mean(-log_softmax[target]).
-    lse = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(lse - gold)
+    return softmax_xent(logits, targets)
 
 
 def train_step(params, batch, cfg: BurninConfig):
@@ -181,12 +211,14 @@ def bench_config() -> BurninConfig:
          short to amortise the kernel; its win case is long-seq)
       d4096/f16384/h16/b8 ........................ 0.80
       d2048/f32768/h16/b16/s512 (this config) .... 0.82-0.84
+       + hand-fused cross-entropy backward ....... 0.81-0.85
 
-    The dominant overheads at f8192 were per-token HBM traffic of the f32
-    [B,H,S,S] attention scores and [B,S,V] logits chains plus the f32
-    optimizer update; widening the FFN raises the matmul fraction per token
-    past them. FLOPs are XLA cost-analysis of the no-remat step (see
-    timed_steps)."""
+    Component ablations at this config (fwd+bwd, ms/step): attention chain
+    ~4 (stock pallas flash kernel measured 3.5x slower than the XLA chain
+    at S=512/d_head=128 standalone — not used), CE loss ~3 (halved by the
+    custom-vjp backward in softmax_xent), gelu/rms/SGD-update ~0 (XLA
+    fuses them into neighbouring ops). FLOPs are XLA cost-analysis of the
+    no-remat step (see timed_steps)."""
     return BurninConfig(vocab=8192, d_model=2048, d_ff=32768,
                         n_heads=16, seq=512, batch=16)
 
