@@ -23,9 +23,11 @@ const std::vector<AcceleratorType>& Catalogue() {
       {"v5e-16", "v5e", 8, 2, 4, 16, {8}, {{8, {2, 4}}}, 2, 2, 1, 1},
       {"v5e-32", "v5e", 8, 2, 4, 16, {8}, {{8, {2, 4}}}, 4, 2, 2, 1},
       {"v6e-16", "v6e", 8, 2, 4, 32, {8}, {{8, {2, 4}}}, 2, 2, 1, 1},
-      // v5p hosts stack along the torus z axis: 2 hosts of flat 2x2 chips
-      // form the 2x2x2 cube, TPU_HOST_BOUNDS "1,1,2" (mirrors topology.py).
+      // v4/v5p hosts stack along the torus z axis: flat 2x2 chip groups
+      // form 2x2xZ tori, TPU_HOST_BOUNDS "1,1,Z" (mirrors topology.py).
       {"v5p-16", "v5p", 4, 2, 2, 95, {4}, {{4, {2, 2}}}, 2, 1, 1, 2},
+      {"v5p-32", "v5p", 4, 2, 2, 95, {4}, {{4, {2, 2}}}, 4, 1, 1, 4},
+      {"v4-16", "v4", 4, 2, 2, 32, {4}, {{4, {2, 2}}}, 2, 1, 1, 2},
   };
   return kTypes;
 }

@@ -36,6 +36,19 @@ def test_validate_runner_publishes_metrics(tmp_path, capsys, monkeypatch):
     assert "tpu_process_devices" in path.read_text()
 
 
+def test_validate_runner_publishes_duty_cycle(tmp_path, capsys, monkeypatch):
+    """On a cluster, the validation Job is the workload the exporter
+    scrapes: its runner opens a duty-cycle window around the whole run, so
+    the published gauges include a measured utilization value even for the
+    collective-only psum mode."""
+    path = tmp_path / "m.prom"
+    monkeypatch.setenv("TPU_METRICS_FILE", str(path))
+    rc = validate.main(["--mode=psum"])
+    capsys.readouterr()
+    assert rc == 0
+    assert "tpu_duty_cycle_percent{" in path.read_text()
+
+
 def test_exporter_relays_only_tpu_lines(native_build, tmp_path):
     """End-to-end: writer output flows through the C++ exporter; hostile
     series in the textfile are filtered."""

@@ -131,6 +131,13 @@ def test_v5p_3d_torus_slice():
     assert ok
     ok, reason = topology.validate_allocation(acc, [0, 1])
     assert not ok and "not aligned" in reason
+    # the longer z-stacks and the v4 cube follow the same scheme
+    v5p32 = topology.get("v5p-32")
+    assert (v5p32.num_hosts, v5p32.host_bounds) == (4, (1, 1, 4))
+    assert v5p32.label_topology() == "2x2x4"
+    v416 = topology.get("v4-16")
+    assert (v416.num_hosts, v416.host_bounds) == (2, (1, 1, 2))
+    assert v416.label_topology() == "2x2x2"
 
 
 def test_from_device_kind():

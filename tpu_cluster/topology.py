@@ -146,16 +146,32 @@ V5E_32 = _register(AcceleratorType(
     num_hosts=4, host_bounds=(2, 2, 1),
 ))
 
-# v5p multi-host: each host contributes a flat 2x2 chip group; hosts stack
-# along the torus z axis (v5p-16 = 8 chips = 2 hosts as the 2x2x2 cube —
-# the "-16" counts TensorCores, 2 per chip, the v4/v5p naming convention).
-# Whole-host-group allocation (aligned 4), 3D TPU_HOST_BOUNDS "1,1,2".
+# v4/v5p multi-host: each host contributes a flat 2x2 chip group; hosts
+# stack along the torus z axis (v5p-16 = 8 chips = 2 hosts as the 2x2x2
+# cube — the "-16" counts TensorCores, 2 per chip, the v4/v5p naming
+# convention). Whole-host-group allocation (aligned 4), 3D TPU_HOST_BOUNDS.
 V5P_16 = _register(AcceleratorType(
     name="v5p-16", generation="v5p", chips_per_host=4, topology=(2, 2),
     hbm_gib_per_chip=95, aligned_sizes=(4,),
     sub_mesh_shapes={4: (2, 2)},
     peak_bf16_tflops=459.0,
     num_hosts=2, host_bounds=(1, 1, 2),
+))
+
+V5P_32 = _register(AcceleratorType(
+    name="v5p-32", generation="v5p", chips_per_host=4, topology=(2, 2),
+    hbm_gib_per_chip=95, aligned_sizes=(4,),
+    sub_mesh_shapes={4: (2, 2)},
+    peak_bf16_tflops=459.0,
+    num_hosts=4, host_bounds=(1, 1, 4),   # the 2x2x4 torus
+))
+
+V4_16 = _register(AcceleratorType(
+    name="v4-16", generation="v4", chips_per_host=4, topology=(2, 2),
+    hbm_gib_per_chip=32, aligned_sizes=(4,),
+    sub_mesh_shapes={4: (2, 2)},
+    peak_bf16_tflops=275.0,
+    num_hosts=2, host_bounds=(1, 1, 2),   # the 2x2x2 cube
 ))
 
 V6E_16 = _register(AcceleratorType(

@@ -318,9 +318,11 @@ def check_metrics(runner: Runner, spec: ClusterSpec) -> CheckResult:
                  if ln.startswith("tpu_chips_total")), "")
     # Workload-produced gauges (duty cycle / HBM used) relay through the
     # same endpoint but only exist while a JAX workload is publishing —
-    # report their presence rather than failing an idle node.
+    # report their presence rather than failing an idle node. Sample lines
+    # only: the relayed HELP comments appear even with zero samples.
+    lines = out.splitlines()
     extras = [g for g in ("tpu_duty_cycle_percent", "tpu_hbm_used_bytes")
-              if g in out]
+              if any(ln.startswith(g + "{") for ln in lines)]
     if extras:
         line += f" (+ workload gauges: {', '.join(extras)})"
     return CheckResult("metrics", True, line or "tpu_chips_total present")

@@ -49,9 +49,19 @@ def psum_check(n_devices: int = 0, elems_per_device: int = 1 << 16) -> Dict[str,
         jnp.arange(n, dtype=jnp.float32)[:, None], (n, elems_per_device)
     )
     x = jax.device_put(x, NamedSharding(mesh, P("chips")))
-    out = jax.jit(allreduce)(x)
+    jitted = jax.jit(allreduce)
+    out = jitted(x)  # compile + correctness
     expect = float(n * (n - 1) / 2)
     ok = bool(jnp.all(out == expect))
+    # one compiled repetition marked as a device-execution region, so a
+    # psum validation Job publishes a measured duty-cycle gauge (compile
+    # time deliberately excluded — host work). Synced via a one-element
+    # host fetch, NOT block_until_ready: the tunneled backend returns from
+    # block_until_ready before sharded outputs execute (smoke.matmul has
+    # the same guard), which would make the busy window hollow.
+    from . import runtime_metrics
+    with runtime_metrics.device_busy():
+        np.asarray(jitted(x)[:1, :1])
     return {"check": "psum", "devices": n, "expected": expect, "ok": ok}
 
 

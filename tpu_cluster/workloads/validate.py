@@ -113,14 +113,19 @@ def main(argv=None) -> int:
                     help="device-query: required jax.local_device_count() "
                          "(0 = TPU_DEVICE_COUNT env from Allocate, else 1)")
     args = ap.parse_args(argv)
-    result = run(args.mode, args.matmul_dim, args.psum_devices,
-                 args.expect_devices)
-    # Publish HBM gauges for the metrics-exporter relay (no-op when the
-    # /run/tpu hostPath isn't mounted) — BASELINE config 4's data source.
+    # The whole run is one duty-cycle measurement window so the published
+    # gauges include a real utilization number (the workloads mark their
+    # device-execution regions via runtime_metrics.device_busy) — on a
+    # cluster, the validation Job IS the workload the exporter scrapes.
     from . import runtime_metrics
     import os
-    written = runtime_metrics.write(
-        os.environ.get("TPU_METRICS_FILE", runtime_metrics.DEFAULT_PATH))
+    with runtime_metrics.duty_cycle_window():
+        result = run(args.mode, args.matmul_dim, args.psum_devices,
+                     args.expect_devices)
+        # Publish gauges for the metrics-exporter relay (no-op when the
+        # /run/tpu hostPath isn't mounted) — BASELINE config 4's data source.
+        written = runtime_metrics.write(
+            os.environ.get("TPU_METRICS_FILE", runtime_metrics.DEFAULT_PATH))
     if written:
         result["metrics_file"] = written
     print(json.dumps(result, indent=2))
