@@ -49,6 +49,22 @@ def test_validate_runner_publishes_duty_cycle(tmp_path, capsys, monkeypatch):
     assert "tpu_duty_cycle_percent{" in path.read_text()
 
 
+def test_burnin_publishes_metrics_mid_run(tmp_path, monkeypatch):
+    """A long burn-in publishes gauges DURING the run (dcgm continuous-
+    sampling analog at textfile cadence), not only at Job end — a scraper
+    mid-run must see live values."""
+    from tpu_cluster.workloads import burnin
+
+    path = tmp_path / "m.prom"
+    monkeypatch.setenv("TPU_METRICS_FILE", str(path))
+    with runtime_metrics.duty_cycle_window():
+        r = burnin.run(steps=3, publish_interval_s=0.0)  # publish each step
+    assert r["ok"], r
+    text = path.read_text()
+    assert "tpu_duty_cycle_percent{" in text
+    assert "tpu_process_devices 8" in text  # virtual mesh
+
+
 def test_exporter_relays_only_tpu_lines(native_build, tmp_path):
     """End-to-end: writer output flows through the C++ exporter; hostile
     series in the textfile are filtered."""

@@ -360,13 +360,16 @@ def timed_steps(mesh: Mesh, cfg: BurninConfig, steps: int = 20,
 
 
 def run(mesh_shape: Tuple[int, int] = None, steps: int = 5,
-        cfg: BurninConfig = BurninConfig()) -> Dict[str, Any]:
+        cfg: BurninConfig = BurninConfig(),
+        publish_interval_s: float = 5.0) -> Dict[str, Any]:
     n = jax.device_count()
     shape = mesh_shape or default_mesh_shape(n)
     mesh = make_mesh(shape)
     step, params, batch = make_sharded_step(mesh, cfg)
     losses = []
+    metrics_path = runtime_metrics.resolved_path()
     t0 = time.perf_counter()
+    last_publish = time.monotonic()
     for i in range(steps):
         # duty-cycle producer region per synced step; the first step is
         # excluded — it is dominated by XLA compilation (host work, not
@@ -375,6 +378,17 @@ def run(mesh_shape: Tuple[int, int] = None, steps: int = 5,
         with ctx:
             params, loss = step(params, batch)
             losses.append(float(loss))
+        # periodic mid-run publication (no-op without the exporter
+        # hostPath): a scraper during a long burn-in sees live gauges, not
+        # only the end-of-Job snapshot — the dcgm continuous-sampling
+        # analog, at textfile cadence.
+        now = time.monotonic()
+        if now - last_publish >= publish_interval_s:
+            runtime_metrics.write(metrics_path)
+            last_publish = now
+    # final snapshot: a run shorter than the interval must still publish,
+    # and longer runs must not leave an interval-stale last value
+    runtime_metrics.write(metrics_path)
     dt = time.perf_counter() - t0
     decreasing = losses[-1] < losses[0]
     return {

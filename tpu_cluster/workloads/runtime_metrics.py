@@ -56,6 +56,14 @@ from typing import Dict, List, Optional
 DEFAULT_PATH = "/run/tpu/metrics.prom"
 
 
+def resolved_path() -> str:
+    """The textfile path a workload should publish to: the TPU_METRICS_FILE
+    env (tests / custom mounts) else the exporter's default hostPath. One
+    place, so every publisher (validate runner, burn-in loop) resolves
+    identically."""
+    return os.environ.get("TPU_METRICS_FILE", DEFAULT_PATH)
+
+
 class DutyCycleSampler:
     """Accumulates device-busy seconds against a wall-clock window."""
 

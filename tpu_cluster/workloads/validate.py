@@ -118,14 +118,12 @@ def main(argv=None) -> int:
     # device-execution regions via runtime_metrics.device_busy) — on a
     # cluster, the validation Job IS the workload the exporter scrapes.
     from . import runtime_metrics
-    import os
     with runtime_metrics.duty_cycle_window():
         result = run(args.mode, args.matmul_dim, args.psum_devices,
                      args.expect_devices)
         # Publish gauges for the metrics-exporter relay (no-op when the
         # /run/tpu hostPath isn't mounted) — BASELINE config 4's data source.
-        written = runtime_metrics.write(
-            os.environ.get("TPU_METRICS_FILE", runtime_metrics.DEFAULT_PATH))
+        written = runtime_metrics.write(runtime_metrics.resolved_path())
     if written:
         result["metrics_file"] = written
     print(json.dumps(result, indent=2))
