@@ -37,6 +37,8 @@ VARIANTS = {
     "b32_flash_dots": replace(BASE, batch=32, attention="flash",
                               remat="dots"),
     "b32_s1k_flash": replace(BASE, batch=32, seq=1024, attention="flash"),
+    # remat probe: recompute only the attention block in bwd
+    "attn_remat": replace(BASE, remat="attn"),
     # shape probes: shorter seq cuts the [B,H,S,S] f32 attention traffic
     # per token; wider FFN raises matmul fraction per token
     "s256_b32": replace(BASE, seq=256, batch=32),

