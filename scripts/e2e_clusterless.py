@@ -218,6 +218,21 @@ def stage_metrics(t: Transcript, tmp: str) -> None:
             "exporter's own census gauge served over HTTP")
     t.check("tpu_duty_cycle_percent{" in body,
             "workload-produced duty-cycle gauge relayed end-to-end")
+    # the nvidia-smi-analog probe renders the same produced metrics
+    from tpu_cluster.discovery import devices as pydev
+    tree = os.path.join(tmp, "devfs")
+    pydev.make_fake_tree(tree, 8)
+    probe = subprocess.run(
+        [binpath("tpu-info"), f"--devfs-root={tree}",
+         f"--metrics-file={metrics_file}", "--json"],
+        capture_output=True, text=True, timeout=30)
+    doc = json.loads(probe.stdout) if probe.returncode == 0 else {}
+    duty = (doc.get("chips") or [{}])[0].get("duty_cycle_percent")
+    t.emit(f"\n`tpu-info --json` chip 0: duty_cycle_percent={duty}")
+    t.check(probe.returncode == 0 and isinstance(duty, (int, float))
+            and duty > 0,
+            "tpu-info renders the measured duty cycle (nvidia-smi util% "
+            "analog)")
 
 
 def main() -> int:

@@ -60,15 +60,12 @@ def test_remat_knobs_train_identically():
     """Every remat policy ("none"/"attn"/"dots"/"full") computes the same
     training math — rematerialisation changes what is saved for the bwd
     pass, never the result. Losses after 2 steps must agree across knobs."""
-    import dataclasses
-
     import jax
 
     histories = {}
     for remat in ("none", "attn", "dots", "full"):
-        cfg = dataclasses.replace(
-            burnin.BurninConfig(vocab=64, d_model=32, d_ff=64, n_heads=2,
-                                seq=8, batch=4), remat=remat)
+        cfg = burnin.BurninConfig(vocab=64, d_model=32, d_ff=64, n_heads=2,
+                                  seq=8, batch=4, remat=remat)
         mesh = burnin.make_mesh((2, 2))
         step, params, batch = burnin.make_sharded_step(mesh, cfg)
         losses = []

@@ -45,7 +45,8 @@ class BurninConfig:
     #           there, see bench_config)
     #   "attn"  recompute only the attention block (its [B,H,S,S] tensors
     #           are the largest saves; the flash-attention trade without
-    #           the kernel)
+    #           the kernel). Applies to the "xla" attention path only — the
+    #           flash kernel already rematerialises internally.
     #   "dots"  save only matmul outputs (jax.checkpoint
     #           dots_with_no_batch_dims_saveable)
     #   "full"  save nothing, recompute the whole fwd pass
