@@ -70,18 +70,36 @@ std::vector<std::pair<int, std::string>> DiscoverChips(const Options& opt) {
 
 // Relay validated lines from the runtime-metrics textfile: only tpu_-prefixed
 // metric lines and comments pass through (prevents a hostile writer from
-// injecting arbitrary series).
+// injecting arbitrary series). Relay size is bounded — the writer shares the
+// node but not the exporter's memory budget; a runaway file must not balloon
+// every scrape response — with the truncation surfaced as its own gauge so
+// scrapers can alert instead of silently missing series.
+constexpr size_t kRelayLimitBytes = 1 << 20;  // 1 MiB
+
 std::string RelayRuntimeMetrics(const std::string& file) {
   FILE* f = fopen(file.c_str(), "r");
   if (!f) return "";
   std::ostringstream os;
   char line[1024];
+  size_t seen = 0;  // bytes READ, not bytes relayed: a runaway file full
+                    // of filtered lines must not stall the scrape either
+  bool truncated = false;
   while (fgets(line, sizeof(line), f)) {
+    seen += strlen(line);
+    if (seen > kRelayLimitBytes) {
+      truncated = true;
+      break;
+    }
     if (line[0] == '#' || strncmp(line, "tpu_", 4) == 0) os << line;
   }
   fclose(f);
   std::string s = os.str();
   if (!s.empty() && s.back() != '\n') s += "\n";
+  if (truncated)
+    s += "# HELP tpu_relay_truncated runtime-metrics file exceeded the relay "
+         "limit; series beyond it were dropped\n"
+         "# TYPE tpu_relay_truncated gauge\n"
+         "tpu_relay_truncated 1\n";
   return s;
 }
 

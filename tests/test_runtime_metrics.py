@@ -82,6 +82,37 @@ def test_exporter_relays_only_tpu_lines(native_build, tmp_path):
     assert "tpu_process_devices 8" in proc.stdout      # relayed from writer
     assert "tpu_custom_gauge 7" in proc.stdout
     assert "evil_metric" not in proc.stdout            # filtered
+    assert "tpu_relay_truncated" not in proc.stdout    # normal size
+
+
+def test_exporter_relay_bounded(native_build, tmp_path):
+    """A runaway metrics file must not balloon the scrape response: the
+    relay stops at its limit and surfaces the truncation as a gauge."""
+    path = tmp_path / "metrics.prom"
+    with open(path, "w") as f:
+        f.write("tpu_first_gauge 1\n")
+        for i in range(60000):  # ~1.4 MiB of valid tpu_ lines
+            f.write(f'tpu_flood{{i="{i}"}} 1\n')
+    proc = subprocess.run(
+        [binpath(native_build, "tpu-metrics-exporter"), "--once",
+         f"--metrics-file={path}", "--fake-devices=2",
+         "--accelerator=v5e-8"],
+        capture_output=True, text=True, check=True)
+    assert "tpu_first_gauge 1" in proc.stdout          # prefix relayed
+    assert "tpu_relay_truncated 1" in proc.stdout      # truncation surfaced
+    assert len(proc.stdout) < (2 << 20)                # bounded response
+    # the cap bounds bytes READ, not relayed: a flood of filtered lines
+    # must hit the limit too (otherwise a garbage file stalls every scrape)
+    with open(path, "w") as f:
+        for i in range(80000):
+            f.write(f"garbage_{i} 1\n")
+    proc = subprocess.run(
+        [binpath(native_build, "tpu-metrics-exporter"), "--once",
+         f"--metrics-file={path}", "--fake-devices=2",
+         "--accelerator=v5e-8"],
+        capture_output=True, text=True, check=True)
+    assert "tpu_relay_truncated 1" in proc.stdout
+    assert "garbage_" not in proc.stdout
 
 
 class _FakeTpuDevice:
