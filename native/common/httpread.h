@@ -1,0 +1,36 @@
+// Shared bounded HTTP request-head reader for the single-threaded daemons
+// (tpu-metrics-exporter, tpu-operator status server).
+//
+// Reads from fd until the end of the request head (\r\n\r\n), the buffer
+// fills, EOF/error/RCVTIMEO, the wall-clock deadline passes (RCVTIMEO only
+// bounds each read — a drip-feeding client must not hold the daemon for
+// buffer-size reads), or *stop is raised. Returns the byte count read;
+// buf is always NUL-terminated.
+#pragma once
+
+#include <signal.h>
+#include <string.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <cstddef>
+
+namespace httpread {
+
+inline size_t ReadRequestHead(int fd, char* buf, size_t cap,
+                              volatile sig_atomic_t* stop,
+                              int deadline_s = 2) {
+  size_t have = 0;
+  buf[0] = 0;
+  time_t deadline = time(nullptr) + deadline_s;
+  while (have < cap - 1 && !(stop && *stop) && time(nullptr) <= deadline) {
+    ssize_t n = read(fd, buf + have, cap - 1 - have);
+    if (n <= 0) break;  // EOF, error, or RCVTIMEO
+    have += static_cast<size_t>(n);
+    buf[have] = 0;
+    if (strstr(buf, "\r\n\r\n")) break;
+  }
+  return have;
+}
+
+}  // namespace httpread

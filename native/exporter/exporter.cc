@@ -35,6 +35,7 @@
 #include <vector>
 
 #include "../common/devenum.h"
+#include "../common/httpread.h"
 #include "../plugin/topology.h"
 
 namespace {
@@ -277,24 +278,12 @@ int main(int argc, char** argv) {
     struct timeval tv = {0, 500 * 1000};
     setsockopt(cfd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
     setsockopt(cfd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
-    // Read until the end of the request head (\r\n\r\n): a client may
-    // legitimately split the head across TCP segments. RCVTIMEO bounds
-    // each read but not the total — a drip-feeding client would otherwise
-    // hold the single-threaded daemon for buf-size reads — so the whole
-    // head also gets one wall-clock deadline.
+    // A client may legitimately split the head across TCP segments; the
+    // shared reader loops until \r\n\r\n under a wall-clock deadline
+    // (native/common/httpread.h).
     char buf[8192];
-    size_t have = 0;
-    time_t head_deadline = time(nullptr) + 2;
-    while (have < sizeof(buf) - 1 && !g_stop &&
-           time(nullptr) <= head_deadline) {
-      ssize_t n = read(cfd, buf + have, sizeof(buf) - 1 - have);
-      if (n <= 0) break;  // EOF, error, or RCVTIMEO
-      have += static_cast<size_t>(n);
-      buf[have] = 0;
-      if (strstr(buf, "\r\n\r\n")) break;
-    }
+    size_t have = httpread::ReadRequestHead(cfd, buf, sizeof(buf), &g_stop);
     if (have > 0) {
-      buf[have] = 0;
       char method[8], path[256];
       if (sscanf(buf, "%7s %255s", method, path) == 2 &&
           strcmp(method, "GET") == 0) {

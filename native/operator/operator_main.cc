@@ -35,6 +35,7 @@
 #include <string>
 #include <vector>
 
+#include "../common/httpread.h"
 #include "kubeapi.h"
 #include "kubeclient.h"
 #include "minijson.h"
@@ -160,12 +161,15 @@ class StatusServer {
           struct timeval tv = {0, 500 * 1000};
           setsockopt(cfd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
           setsockopt(cfd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
-          char buf[1024];
-          ssize_t n = read(cfd, buf, sizeof(buf) - 1);
+          // Read the whole request head (\r\n\r\n) — a split first line
+          // would otherwise mis-parse the path (shared bounded reader,
+          // native/common/httpread.h).
+          char buf[2048];
+          size_t have =
+              httpread::ReadRequestHead(cfd, buf, sizeof(buf), &g_stop);
           std::string body = status_json, ctype = "application/json";
           int code = 200;
-          if (n > 0) {
-            buf[n] = 0;
+          if (have > 0) {
             char method[8], path[128];
             if (sscanf(buf, "%7s %127s", method, path) == 2) {
               if (strcmp(path, "/metrics") == 0) {

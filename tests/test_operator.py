@@ -143,6 +143,22 @@ def test_drift_recreated_and_status_served(native_build, bundle_dir):
             assert code == 200 and "tpu_operator_healthy 1" in metrics
             code, _ = fetch("/healthz")
             assert code == 200
+
+            # request head split across TCP segments still routes to the
+            # requested path (same discipline as the exporter's read loop)
+            import socket as socketmod
+            with socketmod.create_connection(
+                    ("127.0.0.1", 19402), timeout=5) as s:
+                for part in (b"GET /met", b"rics HTTP/1.1\r\n", b"\r\n"):
+                    s.sendall(part)
+                    time.sleep(0.05)
+                raw = b""
+                while True:
+                    chunk = s.recv(4096)
+                    if not chunk:
+                        break
+                    raw += chunk
+            assert b"200 OK" in raw and b"tpu_operator_healthy 1" in raw
         finally:
             op.send_signal(signal.SIGTERM)
             op.wait(timeout=10)
