@@ -80,21 +80,33 @@ constexpr size_t kRelayLimitBytes = 1 << 20;  // 1 MiB
 std::string RelayRuntimeMetrics(const std::string& file) {
   FILE* f = fopen(file.c_str(), "r");
   if (!f) return "";
-  std::ostringstream os;
-  char line[1024];
-  size_t seen = 0;  // bytes READ, not bytes relayed: a runaway file full
-                    // of filtered lines must not stall the scrape either
+  std::string s, cur;
+  char chunk[1024];
   bool truncated = false;
-  while (fgets(line, sizeof(line), f)) {
-    seen += strlen(line);
-    if (seen > kRelayLimitBytes) {
+  // Lines are accumulated whole before the filter/emit decision, so a
+  // line longer than the chunk buffer is relayed (or dropped) WHOLE — a
+  // continuation chunk can neither masquerade as a fresh series nor leave
+  // an unterminated fragment — and the truncation break discards any
+  // partial line rather than emitting it. Consumption is measured with
+  // ftell, not strlen: embedded NUL bytes (crashed writer, sparse file)
+  // must not defeat the read bound.
+  while (fgets(chunk, sizeof(chunk), f)) {
+    cur += chunk;
+    long consumed = ftell(f);
+    if (consumed < 0 || static_cast<size_t>(consumed) > kRelayLimitBytes) {
       truncated = true;
       break;
     }
-    if (line[0] == '#' || strncmp(line, "tpu_", 4) == 0) os << line;
+    if (!cur.empty() && cur.back() == '\n') {
+      if (cur[0] == '#' || cur.compare(0, 4, "tpu_") == 0) s += cur;
+      cur.clear();
+    }
   }
+  // trailing line without a final newline: relay it if it passes
+  if (!truncated && !cur.empty() &&
+      (cur[0] == '#' || cur.compare(0, 4, "tpu_") == 0))
+    s += cur;
   fclose(f);
-  std::string s = os.str();
   if (!s.empty() && s.back() != '\n') s += "\n";
   if (truncated)
     s += "# HELP tpu_relay_truncated runtime-metrics file exceeded the relay "

@@ -101,6 +101,10 @@ def test_exporter_relay_bounded(native_build, tmp_path):
     assert "tpu_first_gauge 1" in proc.stdout          # prefix relayed
     assert "tpu_relay_truncated 1" in proc.stdout      # truncation surfaced
     assert len(proc.stdout) < (2 << 20)                # bounded response
+    # whole-line invariant holds at the cutoff: no partial sample emitted
+    flood_lines = [ln for ln in proc.stdout.splitlines()
+                   if ln.startswith("tpu_flood{")]
+    assert flood_lines and all(ln.endswith("} 1") for ln in flood_lines)
     # the cap bounds bytes READ, not relayed: a flood of filtered lines
     # must hit the limit too (otherwise a garbage file stalls every scrape)
     with open(path, "w") as f:
@@ -113,6 +117,33 @@ def test_exporter_relay_bounded(native_build, tmp_path):
         capture_output=True, text=True, check=True)
     assert "tpu_relay_truncated 1" in proc.stdout
     assert "garbage_" not in proc.stdout
+
+
+def test_exporter_relay_long_lines_whole(native_build, tmp_path):
+    """Lines longer than the relay's read buffer must be relayed (or
+    dropped) WHOLE: the filter decision is made at the true line start and
+    carried across buffer-sized chunks, so a garbage line engineered to
+    place 'tpu_' at a chunk boundary cannot smuggle a fragment through,
+    and a long valid line is not emitted unterminated."""
+    path = tmp_path / "metrics.prom"
+    long_label = "x" * 2000
+    # garbage line with "tpu_" positioned exactly at the 1024-byte chunk
+    # boundary (1023 chars + fgets NUL split)
+    evil = "g" * 1023 + "tpu_smuggled 666"
+    with open(path, "w") as f:
+        f.write(f'tpu_long{{pad="{long_label}"}} 1\n')
+        f.write(evil + "\n")
+        f.write("tpu_after 2\n")
+    proc = subprocess.run(
+        [binpath(native_build, "tpu-metrics-exporter"), "--once",
+         f"--metrics-file={path}", "--fake-devices=2",
+         "--accelerator=v5e-8"],
+        capture_output=True, text=True, check=True)
+    lines = proc.stdout.splitlines()
+    long_lines = [ln for ln in lines if ln.startswith("tpu_long{")]
+    assert long_lines and long_lines[0].endswith("} 1")  # whole, terminated
+    assert "tpu_smuggled" not in proc.stdout             # fragment dropped
+    assert "tpu_after 2" in lines                        # stream resyncs
 
 
 class _FakeTpuDevice:
