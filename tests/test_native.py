@@ -338,6 +338,24 @@ def _free_port():
     return port
 
 
+def _wait_ready(port, proc=None, attempts=100):
+    """Poll the exporter's /metrics until it serves; fail loudly (with the
+    daemon's stderr when available) instead of letting a dead server
+    masquerade as the scenario under test."""
+    for _ in range(attempts):
+        if proc is not None and proc.poll() is not None:
+            break
+        try:
+            return urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=2).read().decode()
+        except OSError:
+            time.sleep(0.1)
+    err = b""
+    if proc is not None and proc.poll() is not None and proc.stderr:
+        err = proc.stderr.read() or b""
+    raise AssertionError(f"exporter never came up: {err.decode()[-500:]}")
+
+
 def test_exporter_scrape(native_build, tmp_path):
     """BASELINE config 4: metrics scrape returns per-chip HBM/duty-cycle."""
     from tpu_cluster.discovery import devices as pydev
@@ -351,15 +369,7 @@ def test_exporter_scrape(native_build, tmp_path):
          f"--devfs-root={tmp_path}", f"--metrics-file={mf}"],
         stderr=subprocess.PIPE)
     try:
-        body = None
-        for _ in range(50):
-            try:
-                body = urllib.request.urlopen(
-                    f"http://127.0.0.1:{port}/metrics", timeout=1).read().decode()
-                break
-            except Exception:
-                time.sleep(0.1)
-        assert body is not None, "exporter never came up"
+        body = _wait_ready(port, proc)
         assert "tpu_chips_total 8" in body
         assert "tpu_chips_expected 8" in body
         assert 'tpu_chip_present{chip="7"' in body
@@ -385,13 +395,7 @@ def test_exporter_split_header_request(native_build, tmp_path):
          f"--devfs-root={tmp_path}"],
         stderr=subprocess.PIPE)
     try:
-        for _ in range(50):
-            try:
-                urllib.request.urlopen(
-                    f"http://127.0.0.1:{port}/healthz", timeout=1).read()
-                break
-            except Exception:
-                time.sleep(0.1)
+        _wait_ready(port, proc)
         with socketmod.create_connection(("127.0.0.1", port), timeout=5) as s:
             for part in (b"GET /met", b"rics HTTP/1.1\r\n",
                          b"Host: localhost\r\n", b"\r\n"):
@@ -638,13 +642,7 @@ def test_exporter_not_wedged_by_silent_client(native_build, tmp_path):
          "--fake-devices=8"], stderr=subprocess.PIPE)
     silent = None
     try:
-        for _ in range(100):
-            try:
-                urllib.request.urlopen(
-                    f"http://127.0.0.1:{port}/metrics", timeout=2).read()
-                break
-            except OSError:
-                time.sleep(0.1)
+        _wait_ready(port, proc)
         # park a silent connection, then scrape: must answer despite it
         silent = socketmod.create_connection(("127.0.0.1", port), timeout=5)
         t0 = time.time()
@@ -655,5 +653,48 @@ def test_exporter_not_wedged_by_silent_client(native_build, tmp_path):
     finally:
         if silent is not None:
             silent.close()
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_exporter_not_wedged_by_drip_feed_client(native_build, tmp_path):
+    """A slow-loris client dripping bytes that never complete the request
+    head must be cut off by the 2s head deadline (RCVTIMEO alone only
+    bounds each read), so a subsequent scrape answers promptly."""
+    import socket as socketmod
+    import threading
+
+    port = _free_port()
+    proc = subprocess.Popen(
+        [binpath(native_build, "tpu-metrics-exporter"), f"--port={port}",
+         "--fake-devices=8"], stderr=subprocess.PIPE)
+    stop = threading.Event()
+
+    def drip():
+        try:
+            with socketmod.create_connection(
+                    ("127.0.0.1", port), timeout=10) as s:
+                while not stop.is_set():
+                    s.sendall(b"G")  # never reaches \r\n\r\n
+                    time.sleep(0.1)
+        except OSError:
+            pass  # server cut us off — expected
+
+    t = None
+    try:
+        _wait_ready(port, proc)
+        t = threading.Thread(target=drip, daemon=True)
+        t.start()
+        time.sleep(0.3)  # let the drip occupy the accept loop
+        t0 = time.time()
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=15).read()
+        assert b"tpu_chips_total 8" in body
+        # served within the drip client's head deadline plus slack
+        assert time.time() - t0 < 6, "scrape stalled behind drip feeder"
+    finally:
+        stop.set()
+        if t is not None:
+            t.join(timeout=5)
         proc.terminate()
         proc.wait(timeout=10)
