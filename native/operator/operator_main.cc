@@ -32,6 +32,7 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <map>
 #include <string>
 #include <vector>
 
@@ -50,6 +51,7 @@ struct Options {
   std::string token_file;
   std::string ca_file;
   std::string bundle_dir = "/etc/tpu-operator/bundle";
+  std::string policy;        // TpuStackPolicy name; "" = no policy gating
   int interval_s = 15;
   int stage_timeout_s = 600;
   int poll_ms = 1000;
@@ -59,13 +61,23 @@ struct Options {
   bool insecure_skip_tls_verify = false;
 };
 
+// The runtime feature-flag surface (ClusterPolicy analog, reference
+// README.md:101-110): bundle objects are labeled with the operand key they
+// belong to, and the live TpuStackPolicy CR decides which operands run.
+// Must match tpu_cluster/render/operator_bundle.py.
+const char kOperandLabel[] = "tpu-stack.dev/operand";
+const char kPolicyPathPrefix[] =
+    "/apis/tpu-stack.dev/v1alpha1/tpustackpolicies/";
+
 struct BundleObject {
   std::string file;
   std::string stage;
+  std::string operand;  // kOperandLabel value; "" = not operand-gated
   minijson::ValuePtr obj;
   // reconcile state (refreshed every pass)
   bool applied = false;
   bool ready = false;
+  bool disabled = false;  // policy-gated off this pass
   std::string error;
   std::string uid;  // live object's metadata.uid (event correlation)
 };
@@ -110,6 +122,10 @@ bool LoadBundle(const std::string& dir, std::vector<BundleObject>* out,
     bo.stage = sep == std::string::npos ? name.substr(0, name.size() - 5)
                                         : name.substr(0, sep);
     bo.obj = obj;
+    minijson::ValuePtr meta = obj->Get("metadata");
+    minijson::ValuePtr labels = meta ? meta->Get("labels") : nullptr;
+    minijson::ValuePtr operand = labels ? labels->Get(kOperandLabel) : nullptr;
+    if (operand && operand->is_string()) bo.operand = operand->as_string();
     out->push_back(std::move(bo));
   }
   return true;
@@ -229,13 +245,22 @@ class Operator {
 
   bool Listen() { return status_.Listen(opt_.status_port); }
 
-  // One full reconcile pass: apply + gate stage by stage. Returns true when
-  // every object applied and became ready.
+  // One full reconcile pass: fetch the policy, apply + gate stage by stage,
+  // report back through the CR's status subresource. Returns true when
+  // every enabled object applied and became ready.
   bool ReconcilePass() {
+    bool ok = ReconcileObjects();
+    WritePolicyStatus(ok);
+    return ok;
+  }
+
+  bool ReconcileObjects() {
     ++passes_;
+    FetchPolicy();
     for (auto& bo : bundle_) {
       bo.applied = false;
       bo.ready = false;
+      bo.disabled = false;
       bo.error.clear();
     }
     size_t i = 0;
@@ -244,8 +269,24 @@ class Operator {
       size_t stage_end = i;
       while (stage_end < bundle_.size() && bundle_[stage_end].stage == stage)
         ++stage_end;
-      // apply every object of the stage
+      // apply every enabled object of the stage; a policy-disabled
+      // operand's live objects are deleted instead (helm switch-flip
+      // analog — `--set metricsExporter.enabled=false` rolls the operand
+      // out of the cluster, reference README.md:104-110)
       for (size_t j = i; j < stage_end; ++j) {
+        if (!OperandEnabled(bundle_[j].operand)) {
+          if (!DeleteDisabled(&bundle_[j])) {
+            fprintf(stderr,
+                    "tpu-operator: stage %s: delete disabled %s failed: %s\n",
+                    stage.c_str(), bundle_[j].file.c_str(),
+                    bundle_[j].error.c_str());
+            EmitEvent("DeleteFailed",
+                      "stage " + stage + ": " + bundle_[j].error,
+                      bundle_[j]);
+            return false;
+          }
+          continue;
+        }
         if (!ApplyObject(&bundle_[j])) {
           fprintf(stderr, "tpu-operator: stage %s: apply %s failed: %s\n",
                   stage.c_str(), bundle_[j].file.c_str(),
@@ -257,18 +298,19 @@ class Operator {
         }
       }
       // gate on readiness of the stage's workload objects (helm --wait
-      // analog, reference README.md:101)
+      // analog, reference README.md:101); disabled objects don't gate
       time_t deadline = time(nullptr) + opt_.stage_timeout_s;
       while (!g_stop) {
         bool all_ready = true;
         for (size_t j = i; j < stage_end; ++j) {
+          if (bundle_[j].disabled) continue;
           if (!bundle_[j].ready && !CheckReady(&bundle_[j]))
             all_ready = false;
         }
         if (all_ready) break;
         if (time(nullptr) >= deadline) {
           for (size_t j = i; j < stage_end; ++j) {
-            if (!bundle_[j].ready) {
+            if (!bundle_[j].ready && !bundle_[j].disabled) {
               fprintf(stderr,
                       "tpu-operator: stage %s: %s not ready after %ds\n",
                       stage.c_str(), bundle_[j].file.c_str(),
@@ -338,31 +380,46 @@ class Operator {
       o->Set("stage", std::make_shared<minijson::Value>(bo.stage));
       o->Set("applied", std::make_shared<minijson::Value>(bo.applied));
       o->Set("ready", std::make_shared<minijson::Value>(bo.ready));
+      if (bo.disabled)
+        o->Set("disabled", std::make_shared<minijson::Value>(true));
       if (!bo.error.empty())
         o->Set("error", std::make_shared<minijson::Value>(bo.error));
       arr->Append(o);
     }
     root->Set("objects", arr);
+    if (!opt_.policy.empty()) {
+      auto p = minijson::Value::MakeObject();
+      p->Set("name", std::make_shared<minijson::Value>(opt_.policy));
+      p->Set("generation",
+             std::make_shared<minijson::Value>(policy_generation_));
+      p->Set("missing", std::make_shared<minijson::Value>(policy_missing_));
+      root->Set("policy", p);
+    }
     return root->Dump() + "\n";
   }
 
   std::string Metrics() const {
-    int applied = 0, ready = 0;
+    int applied = 0, ready = 0, disabled = 0;
     for (const auto& bo : bundle_) {
       applied += bo.applied;
       ready += bo.ready;
+      disabled += bo.disabled;
     }
-    char buf[512];
+    char buf[768];
     snprintf(buf, sizeof(buf),
              "# TYPE tpu_operator_objects gauge\n"
              "tpu_operator_objects{state=\"desired\"} %zu\n"
              "tpu_operator_objects{state=\"applied\"} %d\n"
              "tpu_operator_objects{state=\"ready\"} %d\n"
+             "tpu_operator_objects{state=\"disabled\"} %d\n"
              "# TYPE tpu_operator_passes_total counter\n"
              "tpu_operator_passes_total %d\n"
              "# TYPE tpu_operator_healthy gauge\n"
-             "tpu_operator_healthy %d\n",
-             bundle_.size(), applied, ready, passes_, healthy_ ? 1 : 0);
+             "tpu_operator_healthy %d\n"
+             "# TYPE tpu_operator_policy_generation gauge\n"
+             "tpu_operator_policy_generation %.0f\n",
+             bundle_.size(), applied, ready, disabled, passes_,
+             healthy_ ? 1 : 0, policy_generation_);
     return buf;
   }
 
@@ -378,6 +435,133 @@ class Operator {
       return;
     }
     status_.Pump(ms, StatusJson(), Metrics(), healthy_);
+  }
+
+  // --- TpuStackPolicy (ClusterPolicy analog) ---------------------------
+
+  std::string PolicyPath() const { return kPolicyPathPrefix + opt_.policy; }
+
+  // Poll the CR once per pass. Fail-open semantics: a missing CR enables
+  // everything (deleting the CR must not tear the stack down), and a
+  // transport error keeps the last known policy (a flapping apiserver must
+  // not flap operands in and out of the cluster).
+  void FetchPolicy() {
+    if (opt_.policy.empty()) return;
+    kubeclient::Response get = kubeclient::Call(cfg_, "GET", PolicyPath());
+    if (get.ok()) {
+      minijson::ValuePtr cr = minijson::Parse(get.body);
+      if (!cr || !cr->is_object()) {
+        fprintf(stderr, "tpu-operator: policy %s: unparseable body; "
+                "keeping last known policy\n", opt_.policy.c_str());
+        return;
+      }
+      std::map<std::string, bool> enabled;
+      minijson::ValuePtr spec = cr->Get("spec");
+      minijson::ValuePtr ops = spec ? spec->Get("operands") : nullptr;
+      if (ops && ops->is_object()) {
+        for (const auto& kv : ops->items()) {
+          minijson::ValuePtr e = kv.second ? kv.second->Get("enabled")
+                                           : nullptr;
+          // absent `enabled` means enabled — a partial CR only turns
+          // operands OFF explicitly
+          enabled[kv.first] = e && e->is_bool() ? e->as_bool() : true;
+        }
+      }
+      if (policy_missing_)
+        fprintf(stderr, "tpu-operator: policy %s found; gating resumed\n",
+                opt_.policy.c_str());
+      policy_enabled_ = std::move(enabled);
+      policy_generation_ = cr->PathNumber("metadata.generation", 0);
+      policy_seen_ = true;
+      policy_missing_ = false;
+    } else if (get.status == 404) {
+      if (!policy_missing_)
+        fprintf(stderr, "tpu-operator: policy %s not found; all operands "
+                "enabled (fail-open)\n", opt_.policy.c_str());
+      policy_missing_ = true;
+      policy_enabled_.clear();
+    } else {
+      fprintf(stderr, "tpu-operator: policy fetch -> %d %s; keeping last "
+              "known policy\n", get.status,
+              get.status ? get.body.substr(0, 160).c_str()
+                         : get.error.c_str());
+    }
+  }
+
+  bool OperandEnabled(const std::string& operand) const {
+    if (operand.empty()) return true;  // un-gated (the namespace itself)
+    auto it = policy_enabled_.find(operand);
+    return it == policy_enabled_.end() ? true : it->second;
+  }
+
+  // Remove a policy-disabled operand object from the cluster. Idempotent:
+  // already-absent is success; only an actual removal is logged.
+  bool DeleteDisabled(BundleObject* bo) {
+    bo->disabled = true;
+    std::string err;
+    std::string obj_path = kubeapi::ObjectPath(*bo->obj, &err);
+    if (obj_path.empty()) {
+      bo->error = err;
+      return false;
+    }
+    kubeclient::Response del = kubeclient::Call(cfg_, "DELETE", obj_path);
+    if (del.ok()) {
+      fprintf(stderr, "tpu-operator: operand %s disabled by policy %s: "
+              "deleted %s\n", bo->operand.c_str(), opt_.policy.c_str(),
+              bo->file.c_str());
+      return true;
+    }
+    if (del.status == 404) return true;
+    bo->error = "DELETE " + obj_path + " -> " + std::to_string(del.status) +
+                " " + (del.status ? del.body.substr(0, 160) : del.error);
+    return false;
+  }
+
+  // Report observed state through the CR's status subresource — what
+  // `kubectl get tsp` renders (observedGeneration gates staleness the same
+  // way the workload readiness checks do).
+  void WritePolicyStatus(bool pass_ok) {
+    if (opt_.policy.empty() || !policy_seen_ || policy_missing_) return;
+    using minijson::Value;
+    struct Agg { int total = 0, applied = 0, ready = 0, disabled = 0; };
+    std::map<std::string, Agg> per;
+    int want = 0, have = 0;
+    for (const auto& bo : bundle_) {
+      if (bo.operand.empty()) continue;
+      Agg& a = per[bo.operand];
+      ++a.total;
+      a.applied += bo.applied;
+      a.ready += bo.ready;
+      a.disabled += bo.disabled;
+      if (!bo.disabled) {
+        ++want;
+        have += bo.ready;
+      }
+    }
+    auto ops = Value::MakeObject();
+    for (const auto& kv : per) {
+      const Agg& a = kv.second;
+      auto o = Value::MakeObject();
+      o->Set("enabled", std::make_shared<Value>(a.disabled == 0));
+      o->Set("applied", std::make_shared<Value>(a.applied == a.total));
+      o->Set("ready", std::make_shared<Value>(
+          a.disabled == 0 && a.ready == a.total));
+      ops->Set(kv.first, o);
+    }
+    auto st = Value::MakeObject();
+    st->Set("observedGeneration",
+            std::make_shared<Value>(policy_generation_));
+    st->Set("phase", std::make_shared<Value>(
+        std::string(pass_ok ? "Ready" : "Progressing")));
+    st->Set("readySummary", std::make_shared<Value>(
+        std::to_string(have) + "/" + std::to_string(want) + " ready"));
+    st->Set("operands", ops);
+    st->Set("lastReconcileTime", std::make_shared<Value>(NowRfc3339()));
+    auto root = Value::MakeObject();
+    root->Set("status", st);
+    // best-effort, like Events: status delivery must never fail the pass
+    kubeclient::Call(cfg_, "PATCH", PolicyPath() + "/status", root->Dump(),
+                     "application/merge-patch+json");
   }
 
   // The namespace reconcile failures are reported into. Cluster-scoped
@@ -532,6 +716,11 @@ class Operator {
   int passes_ = 0;
   int event_seq_ = 0;
   bool healthy_ = false;
+  // policy state (see FetchPolicy for the fail-open/stale semantics)
+  std::map<std::string, bool> policy_enabled_;
+  double policy_generation_ = 0;
+  bool policy_seen_ = false;
+  bool policy_missing_ = false;
 };
 
 bool FlagVal(const char* arg, const char* name, std::string* out) {
@@ -554,6 +743,7 @@ int main(int argc, char** argv) {
     if (FlagVal(a, "--token-file", &opt.token_file)) continue;
     if (FlagVal(a, "--ca-file", &opt.ca_file)) continue;
     if (FlagVal(a, "--bundle-dir", &opt.bundle_dir)) continue;
+    if (FlagVal(a, "--policy", &opt.policy)) continue;
     if (FlagVal(a, "--interval", &sval)) { opt.interval_s = atoi(sval.c_str()); continue; }
     if (FlagVal(a, "--stage-timeout", &sval)) { opt.stage_timeout_s = atoi(sval.c_str()); continue; }
     if (FlagVal(a, "--poll-ms", &sval)) { opt.poll_ms = atoi(sval.c_str()); continue; }
@@ -571,7 +761,8 @@ int main(int argc, char** argv) {
             "tpu-operator: unknown flag %s\n"
             "usage: tpu-operator [--apiserver=URL] [--token-file=F] "
             "[--ca-file=F]\n"
-            "  [--bundle-dir=DIR] [--interval=SECS] [--stage-timeout=SECS]\n"
+            "  [--bundle-dir=DIR] [--policy=NAME] [--interval=SECS] "
+            "[--stage-timeout=SECS]\n"
             "  [--poll-ms=MS] [--status-port=PORT] [--once]\n"
             "  [--allow-empty-daemonsets] [--insecure-skip-tls-verify]\n",
             a);

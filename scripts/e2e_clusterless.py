@@ -74,13 +74,22 @@ class Transcript:
             self.failures += 1
 
 
+POLICY_PATH = "/apis/tpu-stack.dev/v1alpha1/tpustackpolicies/default"
+EXPORTER_DS = ("/apis/apps/v1/namespaces/tpu-system/daemonsets/"
+               "tpu-metrics-exporter")
+
+
 def stage_operator(t: Transcript, api, bundle_dir: str) -> None:
     t.h2("Stage 1 — operator rollout (helm install --wait analog)")
-    proc = subprocess.run(
-        [binpath("tpu-operator"), f"--apiserver={api.url}",
-         f"--bundle-dir={bundle_dir}", "--once", "--poll-ms=20",
-         "--stage-timeout=30", "--status-port=0"],
-        capture_output=True, text=True, timeout=120)
+
+    def reconcile_once():
+        return subprocess.run(
+            [binpath("tpu-operator"), f"--apiserver={api.url}",
+             f"--bundle-dir={bundle_dir}", "--policy=default", "--once",
+             "--poll-ms=20", "--stage-timeout=30", "--status-port=0"],
+            capture_output=True, text=True, timeout=120)
+
+    proc = reconcile_once()
     status = json.loads(proc.stdout) if proc.returncode == 0 else {}
     t.emit(f"`tpu-operator --once` rc={proc.returncode}; "
            f"healthy={status.get('healthy')}; "
@@ -96,6 +105,31 @@ def stage_operator(t: Transcript, api, bundle_dir: str) -> None:
             < names.find("tpu-feature-discovery"),
             "rollout order: namespace < libtpu-prep < device-plugin < "
             "feature-discovery")
+
+    # Day-2 operand toggle through the live TpuStackPolicy CR (ClusterPolicy
+    # analog, reference README.md:104-110): `kubectl patch tsp default ...`
+    t.emit("\nPolicy toggle — disable metricsExporter in the live CR "
+           "(generation 1 -> 2), reconcile:")
+    api.store[POLICY_PATH]["spec"]["operands"]["metricsExporter"] = {
+        "enabled": False}
+    api.store[POLICY_PATH]["metadata"]["generation"] = 2
+    proc2 = reconcile_once()
+    cr_status = (api.get(POLICY_PATH) or {}).get("status", {})
+    t.code(json.dumps(cr_status, indent=2), "json")
+    t.check(proc2.returncode == 0 and api.get(EXPORTER_DS) is None,
+            "exporter DaemonSet rolled out of the cluster by the policy")
+    t.check(cr_status.get("observedGeneration") == 2
+            and cr_status.get("phase") == "Ready"
+            and cr_status.get("operands", {})
+                         .get("metricsExporter", {}).get("enabled") is False,
+            "CR status subresource reports the observed toggle")
+
+    api.store[POLICY_PATH]["spec"]["operands"]["metricsExporter"] = {
+        "enabled": True}
+    api.store[POLICY_PATH]["metadata"]["generation"] = 3
+    proc3 = reconcile_once()
+    t.check(proc3.returncode == 0 and api.get(EXPORTER_DS) is not None,
+            "re-enabling the operand recreates it next pass")
 
 
 def stage_device_plugin(t: Transcript, tmp: str) -> None:
@@ -266,6 +300,9 @@ def main() -> int:
                 "status": {"conditions": []}},
             # the fake stores the status subresource at its literal path
             f"/api/v1/nodes/{NODE}/status": {"status": {"conditions": []}},
+            # the default TpuStackPolicy `tpuctl apply --operator` installs
+            POLICY_PATH: {**operator_bundle.policy(specmod.default_spec()),
+                          "metadata": {"name": "default", "generation": 1}},
         }
         with FakeApiServer(auto_ready=True, store=seed) as api:
             stage_operator(t, api, bundle_dir)

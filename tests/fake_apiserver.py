@@ -40,7 +40,10 @@ def merge_patch(target: Any, patch: Any) -> Any:
     return out
 
 
-GENERATION_KINDS = ("DaemonSet", "Deployment")
+# Kinds whose metadata.generation tracks spec changes. TpuStackPolicy is
+# the operator's CR (status subresource declared in its CRD), so spec edits
+# bump generation exactly like the workload kinds.
+GENERATION_KINDS = ("DaemonSet", "Deployment", "TpuStackPolicy")
 
 
 def ready_status(obj: Dict[str, Any]) -> Optional[Dict[str, Any]]:
@@ -55,6 +58,10 @@ def ready_status(obj: Dict[str, Any]) -> Optional[Dict[str, Any]]:
                 "updatedReplicas": want, "observedGeneration": gen}
     if kind == "Job":
         return {"succeeded": obj.get("spec", {}).get("completions", 1)}
+    if kind == "CustomResourceDefinition":
+        # real apiservers establish a valid CRD within moments; the apply
+        # backends gate CR creation on this condition
+        return {"conditions": [{"type": "Established", "status": "True"}]}
     return None
 
 
@@ -195,6 +202,26 @@ class FakeApiServer:
             def do_PATCH(self):
                 self._record()
                 patch = self._body()
+                # Status subresource: PATCH <object>/status applies only the
+                # patch's status field to the parent object and never bumps
+                # metadata.generation (real-apiserver semantics; the
+                # operator's TpuStackPolicy status write-back relies on it).
+                # Tests that seed the literal "<path>/status" key keep the
+                # original flat-store simplification instead.
+                if (self.path.endswith("/status")
+                        and self.path not in fake.store):
+                    parent_path = self.path[: -len("/status")]
+                    with fake._lock:
+                        parent = fake.store.get(parent_path)
+                        if parent is not None:
+                            st = (patch or {}).get("status")
+                            parent["status"] = merge_patch(
+                                parent.get("status"), st)
+                    if parent is None:
+                        self._reply(404, {"kind": "Status", "code": 404})
+                    else:
+                        self._reply(200, parent)
+                    return
                 with fake._lock:
                     cur = fake.store.get(self.path)
                     if cur is None:

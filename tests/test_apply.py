@@ -311,3 +311,62 @@ def test_apply_kubectl_backend_empty_daemonset_guard(spec):
         groups, wait=True, runner=kubectl_zero_desired,
         allow_empty_daemonsets=True)
     assert result.actions
+
+
+def test_operator_install_crd_waves_and_rest_establishment(spec):
+    """The TpuStackPolicy CR must trail its CRD's establishment: waves put
+    the CRD in group 1 and the CR in group 2, and the REST backend polls
+    the CRD's Established condition at the wave boundary (a real apiserver
+    404s CR creation before then; the fake establishes on create)."""
+    groups = operator_bundle.operator_install_groups(spec)
+    assert [o["kind"] for o in groups[0]][-1] == "CustomResourceDefinition"
+    assert [o["kind"] for o in groups[1]][0] == "TpuStackPolicy"
+
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        kubeapply.apply_groups(client, groups, wait=True, stage_timeout=10,
+                               poll=0.05)
+        crd_path = ("/apis/apiextensions.k8s.io/v1/customresourcedefinitions"
+                    "/tpustackpolicies.tpu-stack.dev")
+        cr_path = "/apis/tpu-stack.dev/v1alpha1/tpustackpolicies/default"
+        assert api.get(cr_path) is not None
+        # the establishment poll (GET on the CRD) happened before the CR POST
+        log = api.log
+        est_get = log.index(("GET", crd_path))
+        cr_post = log.index(
+            ("POST", "/apis/tpu-stack.dev/v1alpha1/tpustackpolicies"))
+        assert est_get < cr_post
+
+
+def test_operator_install_kubectl_gates_on_crd_established(spec):
+    calls = []
+
+    def fake_kubectl(argv, input_text=None):
+        calls.append(list(argv))
+        if argv[1] == "get":
+            return 0, json.dumps({"kind": "DaemonSet", "status": {
+                "desiredNumberScheduled": 2, "numberReady": 2}}), ""
+        return 0, "ok", ""
+
+    kubeapply.apply_groups_kubectl(
+        operator_bundle.operator_install_groups(spec), wait=True,
+        stage_timeout=30, runner=fake_kubectl)
+    flat = [" ".join(c) for c in calls]
+    est = next(i for i, c in enumerate(flat)
+               if "--for=condition=established" in c
+               and "tpustackpolicies.tpu-stack.dev" in c)
+    # the established wait sits between the two apply waves
+    applies = [i for i, c in enumerate(flat) if c.startswith("kubectl apply")]
+    assert applies[0] < est < applies[1]
+
+
+def test_operator_install_kubectl_fails_if_crd_never_established(spec):
+    def failing_established(argv, input_text=None):
+        if argv[1] == "wait" and "--for=condition=established" in argv[2]:
+            return 1, "", "error: timed out waiting for the condition"
+        return 0, "ok", ""
+
+    with pytest.raises(kubeapply.ApplyError, match="not Established"):
+        kubeapply.apply_groups_kubectl(
+            operator_bundle.operator_install_groups(spec), wait=False,
+            runner=failing_established)

@@ -410,17 +410,24 @@ def test_operator_bundle_render_shape():
     stages = [n.split("--")[0] for n in sorted(files)]
     assert stages[0] == "00-namespace"
     assert stages == sorted(stages)
-    # disabling an operand drops its stage (reference --set flag analog)
+    # disabling an operand in the spec does NOT prune the bundle — the
+    # switch seeds the policy CR instead, so a day-2 CR re-enable has
+    # manifests to apply (reference --set flag analog, runtime-gated)
     s2 = specmod.load("tpu: {operands: {metricsExporter: false, "
                       "nodeStatusExporter: false}}")
-    assert not any("40-observability" in n
-                   for n in operator_bundle.bundle_files(s2))
+    assert any("40-observability" in n
+               for n in operator_bundle.bundle_files(s2))
+    cr2 = operator_bundle.policy(s2)
+    assert cr2["spec"]["operands"]["metricsExporter"] == {"enabled": False}
+    assert cr2["spec"]["operands"]["devicePlugin"] == {"enabled": True}
 
     install = operator_bundle.operator_install(spec)
     kinds = [o["kind"] for o in install]
+    # CRD before its CR before the controller that polls it
     assert kinds == ["Namespace", "ServiceAccount", "ClusterRole",
-                     "ClusterRoleBinding", "ConfigMap", "Deployment"]
-    cm = install[4]
+                     "ClusterRoleBinding", "CustomResourceDefinition",
+                     "TpuStackPolicy", "ConfigMap", "Deployment"]
+    cm = install[6]
     assert set(cm["data"]) == set(files)
     # bundle documents round-trip through the ConfigMap encoding
     for name, text in cm["data"].items():
@@ -588,3 +595,115 @@ def test_cluster_scoped_apply_failure_event_lands(native_build, bundle_dir):
         assert ev["involvedObject"]["kind"] == "Namespace"
         assert not ev["involvedObject"].get("namespace")
         assert ev["metadata"]["namespace"] == "default"
+
+
+# --- TpuStackPolicy: the ClusterPolicy-CR analog (reference README.md:101-110:
+# the helm --set operand booleans land in a CR the controller watches) ---
+
+POLICY_PATH = "/apis/tpu-stack.dev/v1alpha1/tpustackpolicies/default"
+
+
+def seeded_policy(generation=1, **enabled_overrides):
+    cr = operator_bundle.policy(specmod.default_spec())
+    for name, on in enabled_overrides.items():
+        cr["spec"]["operands"][name] = {"enabled": on}
+    cr["metadata"]["generation"] = generation
+    return cr
+
+
+def test_policy_crd_cr_and_labels_render():
+    spec = specmod.default_spec()
+    crd = operator_bundle.crd()
+    assert crd["spec"]["group"] == "tpu-stack.dev"
+    assert crd["spec"]["scope"] == "Cluster"
+    version = crd["spec"]["versions"][0]
+    # the operator writes observed state through the status subresource
+    assert version["subresources"] == {"status": {}}
+    schema_operands = (version["schema"]["openAPIV3Schema"]["properties"]
+                       ["spec"]["properties"]["operands"]["properties"])
+    assert set(schema_operands) == set(specmod.TpuSpec.OPERAND_NAMES)
+
+    cr = operator_bundle.policy(spec)
+    assert cr["apiVersion"] == "tpu-stack.dev/v1alpha1"
+    for name in specmod.TpuSpec.OPERAND_NAMES:
+        assert cr["spec"]["operands"][name] == {"enabled": True}
+
+    # every operand object carries the gating label; the namespace (never
+    # policy-gated) does not
+    for fname, obj in operator_bundle.bundle_files(spec).items():
+        labels = obj["metadata"].get("labels", {})
+        if obj["kind"] == "Namespace":
+            assert operator_bundle.OPERAND_LABEL not in labels
+        else:
+            assert (labels[operator_bundle.OPERAND_LABEL]
+                    in specmod.TpuSpec.OPERAND_NAMES), fname
+
+    # the controller is told which CR to poll
+    dep = operator_bundle.deployment(spec)
+    args = dep["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert f"--policy={operator_bundle.POLICY_NAME}" in args
+
+
+def test_policy_toggle_rolls_operand_out_and_back(native_build, bundle_dir):
+    """Day-2 operand toggle through the live CR: disabling metricsExporter
+    deletes its objects on the next pass (helm switch-flip analog), status
+    reports it back with the observed generation, re-enabling recreates."""
+    exporter_ds = f"{DS}/tpu-metrics-exporter"
+    exporter_svc = f"/api/v1/namespaces/{NS}/services/tpu-metrics-exporter"
+    with FakeApiServer(auto_ready=True,
+                       store={POLICY_PATH: seeded_policy()}) as api:
+        def reconcile_once():
+            return run_operator(
+                native_build, f"--apiserver={api.url}",
+                f"--bundle-dir={bundle_dir}", "--policy=default", "--once",
+                "--status-port=0")
+
+        p1 = reconcile_once()
+        assert p1.returncode == 0, p1.stderr
+        assert api.get(exporter_ds) is not None
+        st = api.get(POLICY_PATH)["status"]
+        assert st["phase"] == "Ready"
+        assert st["observedGeneration"] == 1
+        assert st["operands"]["metricsExporter"] == {
+            "enabled": True, "applied": True, "ready": True}
+
+        # spec edit bumps metadata.generation, like the real apiserver
+        api.store[POLICY_PATH]["spec"]["operands"]["metricsExporter"] = {
+            "enabled": False}
+        api.store[POLICY_PATH]["metadata"]["generation"] = 2
+        p2 = reconcile_once()
+        assert p2.returncode == 0, p2.stderr
+        assert api.get(exporter_ds) is None
+        assert api.get(exporter_svc) is None
+        # the other operands are untouched
+        assert api.get(f"{DS}/tpu-device-plugin") is not None
+        st = api.get(POLICY_PATH)["status"]
+        assert st["phase"] == "Ready"
+        assert st["observedGeneration"] == 2
+        assert st["operands"]["metricsExporter"]["enabled"] is False
+        assert st["operands"]["metricsExporter"]["ready"] is False
+        assert "deleted" in p2.stderr
+
+        api.store[POLICY_PATH]["spec"]["operands"]["metricsExporter"] = {
+            "enabled": True}
+        api.store[POLICY_PATH]["metadata"]["generation"] = 3
+        p3 = reconcile_once()
+        assert p3.returncode == 0, p3.stderr
+        assert api.get(exporter_ds) is not None
+        st = api.get(POLICY_PATH)["status"]
+        assert st["observedGeneration"] == 3
+        assert st["operands"]["metricsExporter"]["ready"] is True
+
+
+def test_policy_missing_fails_open(native_build, bundle_dir):
+    """A deleted/absent CR must not tear the stack down: everything stays
+    enabled, and no status write is attempted against the missing object."""
+    with FakeApiServer(auto_ready=True) as api:
+        proc = run_operator(
+            native_build, f"--apiserver={api.url}",
+            f"--bundle-dir={bundle_dir}", "--policy=default", "--once",
+            "--status-port=0")
+        assert proc.returncode == 0, proc.stderr
+        assert "fail-open" in proc.stderr
+        assert api.get(f"{DS}/tpu-metrics-exporter") is not None
+        assert not any(m == "PATCH" and POLICY_PATH in p for m, p in api.log)

@@ -324,3 +324,59 @@ def test_cli_verify_json_and_subset(spec, monkeypatch, capsys):
     assert rc == 2
     # an empty selection must not be a free pass
     assert cli.main(["verify", "--config", ","]) == 2
+
+
+def policy_cr(generation=2, observed=2, phase="Ready", disabled=()):
+    operands = {n: {"enabled": n not in disabled, "applied": n not in disabled,
+                    "ready": n not in disabled}
+                for n in specmod.TpuSpec.OPERAND_NAMES}
+    return {"metadata": {"name": "default", "generation": generation},
+            "spec": {"operands": {}},
+            "status": {"observedGeneration": observed, "phase": phase,
+                       "readySummary": "6/6 ready", "operands": operands}}
+
+
+def test_policy_check_absent_passes_with_note(spec):
+    """The plain-apply and helm-only paths never install the CRD — genuine
+    absence (--ignore-not-found: rc 0, empty) is not a failure, but says so
+    explicitly."""
+    res = verify.check_policy(CannedRunner(healthy=True), spec)
+    assert res.ok and "not installed" in res.detail
+
+
+def test_policy_check_fails_on_transport_error(spec):
+    """An unreachable apiserver / RBAC denial must FAIL, not read as 'not
+    installed' — the false-PASS would mask exactly the health signal the
+    check gates on."""
+    res = verify.check_policy(lambda argv: (1, ""), spec)
+    assert not res.ok and "cannot query" in res.detail
+
+
+def test_policy_check_crd_without_cr_notes_fail_open(spec):
+    runner = CannedRunner(healthy=True)
+    runner.responses["get crd tpustackpolicies.tpu-stack.dev"] = {
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": "tpustackpolicies.tpu-stack.dev"}}
+    res = verify.check_policy(runner, spec)
+    assert res.ok and "fails open" in res.detail
+
+
+def test_policy_check_ready_stale_and_degraded(spec):
+    runner = CannedRunner(healthy=True)
+    runner.responses["get crd tpustackpolicies.tpu-stack.dev"] = {
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": "tpustackpolicies.tpu-stack.dev"}}
+    key = "get tpustackpolicies.tpu-stack.dev default"
+
+    runner.responses[key] = policy_cr(disabled=("metricsExporter",))
+    res = verify.check_policy(runner, spec)
+    assert res.ok and "disabled by policy: metricsExporter" in res.detail
+
+    # status lagging the spec edit: the operator is not reconciling
+    runner.responses[key] = policy_cr(generation=3, observed=2)
+    res = verify.check_policy(runner, spec)
+    assert not res.ok and "stale" in res.detail
+
+    runner.responses[key] = policy_cr(phase="Progressing")
+    res = verify.check_policy(runner, spec)
+    assert not res.ok and "Progressing" in res.detail

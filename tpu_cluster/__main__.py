@@ -81,7 +81,9 @@ def cmd_render(args) -> int:
 def cmd_apply(args) -> int:
     spec = _load_spec(args.spec)
     if args.operator:
-        groups = [operator_bundle.operator_install(spec)]
+        # two waves: the TpuStackPolicy CR must trail its CRD's
+        # establishment (see operator_bundle.operator_install_groups)
+        groups = operator_bundle.operator_install_groups(spec)
     else:
         groups = manifests.rollout_groups(spec)
     try:

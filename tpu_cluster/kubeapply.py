@@ -40,6 +40,9 @@ _KINDS: Dict[str, tuple] = {
     "ClusterRoleBinding": ("clusterrolebindings", True),
     "Role": ("roles", False),
     "RoleBinding": ("rolebindings", False),
+    # the operator's runtime flag surface (ClusterPolicy analog)
+    "CustomResourceDefinition": ("customresourcedefinitions", True),
+    "TpuStackPolicy": ("tpustackpolicies", True),
 }
 
 WORKLOAD_KINDS = ("DaemonSet", "Deployment", "Job")
@@ -198,6 +201,27 @@ class Client:
             raise ApplyError(f"PATCH {path}: {code} {resp}")
         return "patched"
 
+    def wait_crd_established(self, name: str, timeout: float,
+                             poll: float = 1.0) -> None:
+        """Block until a just-applied CRD reports Established — the window
+        where the apiserver doesn't yet serve the CRD's endpoints, during
+        which creating a CR of that kind 404s."""
+        path = ("/apis/apiextensions.k8s.io/v1/"
+                f"customresourcedefinitions/{name}")
+        deadline = time.monotonic() + timeout
+        while True:
+            code, live = self.get(path)
+            conditions = ((live or {}).get("status") or {}).get(
+                "conditions", [])
+            if code == 200 and any(c.get("type") == "Established"
+                                   and c.get("status") == "True"
+                                   for c in conditions):
+                return
+            if time.monotonic() >= deadline:
+                raise ApplyError(
+                    f"timed out waiting for CRD {name} to be Established")
+            time.sleep(poll)
+
     def wait_ready(self, objs: Sequence[Dict[str, Any]], timeout: float,
                    poll: float = 1.0,
                    allow_empty_daemonsets: bool = False) -> None:
@@ -272,6 +296,19 @@ def apply_groups_kubectl(groups: Sequence[Sequence[Dict[str, Any]]],
         for obj in group:
             result.actions.append(
                 f"applied {obj['kind']}/{obj['metadata']['name']}")
+        # CRD establishment gates the next group's CRs even with wait=False
+        # (same correctness rule as the REST path).
+        for obj in group:
+            if obj.get("kind") != "CustomResourceDefinition":
+                continue
+            name = obj["metadata"]["name"]
+            rc, out, err = runner(
+                ["kubectl", "wait", "--for=condition=established",
+                 f"--timeout={max(1, int(stage_timeout))}s",
+                 f"customresourcedefinition/{name}"])
+            if rc != 0:
+                raise ApplyError(
+                    f"CRD {name} not Established: {(out + err)[-400:]}")
         if not wait:
             continue
         # stage_timeout bounds the WHOLE group (matching the REST path):
@@ -345,6 +382,12 @@ def apply_groups(client: Client, groups: Sequence[Sequence[Dict[str, Any]]],
             name = f"{obj['kind']}/{obj['metadata']['name']}"
             result.actions.append(f"{action} {name}")
             log(f"{action} {name}")
+        # CRD establishment is a correctness gate for the NEXT group's CRs,
+        # not a readiness nicety — enforce it even with wait=False.
+        for obj in group:
+            if obj.get("kind") == "CustomResourceDefinition":
+                client.wait_crd_established(obj["metadata"]["name"],
+                                            stage_timeout, poll)
         if wait:
             client.wait_ready(group, stage_timeout, poll,
                               allow_empty_daemonsets)

@@ -26,6 +26,19 @@ BUNDLE_CONFIGMAP = "tpu-operator-bundle"
 BUNDLE_MOUNT = "/etc/tpu-operator/bundle"
 STATUS_PORT = 9402
 
+# The runtime feature-flag surface: a cluster-scoped custom resource the
+# operator polls each pass, mirroring the reference controller's
+# ClusterPolicy CR (reference README.md:101-110 — the helm `--set
+# devicePlugin.enabled=...` booleans land in a CR the operator watches).
+# Toggling an operand in the live CR rolls it in/out without re-rendering
+# the bundle; the operator reports back through the status subresource.
+POLICY_GROUP = "tpu-stack.dev"
+POLICY_VERSION = "v1alpha1"
+POLICY_KIND = "TpuStackPolicy"
+POLICY_PLURAL = "tpustackpolicies"
+POLICY_NAME = "default"
+OPERAND_LABEL = f"{POLICY_GROUP}/operand"
+
 
 def _fname(stage: str, obj: Dict[str, Any]) -> str:
     return f"{stage}--{obj['kind'].lower()}-{obj['metadata']['name']}.json"
@@ -33,27 +46,37 @@ def _fname(stage: str, obj: Dict[str, Any]) -> str:
 
 def bundle_files(spec: ClusterSpec) -> Dict[str, Dict[str, Any]]:
     """filename -> manifest, in rollout order. Stage prefixes mirror the
-    reference's operand dependency chain (reference README.md:201-213)."""
-    t = spec.tpu
-    stages: List[tuple] = [("00-namespace", [manifests.namespace(spec)])]
-    if t.operand("libtpuPrep").enabled:
-        stages.append(("10-libtpu-prep", [manifests.libtpu_prep(spec)]))
-    if t.operand("devicePlugin").enabled:
-        stages.append(("20-device-plugin", [manifests.device_plugin(spec)]))
-    if t.operand("featureDiscovery").enabled:
-        stages.append(("30-feature-discovery",
-                       manifests.feature_discovery(spec)))
-    tail: List[Dict[str, Any]] = []
-    if t.operand("metricsExporter").enabled:
-        tail.extend(manifests.metrics_exporter(spec))
-    if t.operand("nodeStatusExporter").enabled:
-        tail.append(manifests.node_status_exporter(spec))
-    if tail:
-        stages.append(("40-observability", tail))
+    reference's operand dependency chain (reference README.md:201-213).
+    Every operand object carries ``OPERAND_LABEL`` naming its policy key so
+    the operator can gate it on the live TpuStackPolicy.
+
+    The bundle always contains ALL operands: spec-level switches seed the
+    policy CR (:func:`policy`), they don't prune the bundle — otherwise a
+    day-2 ``kubectl patch tsp default`` re-enable of a render-time-disabled
+    operand would silently no-op (no labeled manifests for the operator to
+    apply) and its status entry would vanish."""
+    stages: List[tuple] = [
+        ("00-namespace", [(None, manifests.namespace(spec))]),
+        ("10-libtpu-prep", [("libtpuPrep", manifests.libtpu_prep(spec))]),
+        ("20-device-plugin",
+         [("devicePlugin", manifests.device_plugin(spec))]),
+        ("30-feature-discovery",
+         [("featureDiscovery", o)
+          for o in manifests.feature_discovery(spec)]),
+        ("40-observability",
+         [("metricsExporter", o)
+          for o in manifests.metrics_exporter(spec)]
+         + [("nodeStatusExporter",
+             manifests.node_status_exporter(spec))]),
+    ]
 
     out: Dict[str, Dict[str, Any]] = {}
     for stage, objs in stages:
-        for obj in objs:
+        for operand, obj in objs:
+            if operand is not None:
+                labels = obj.setdefault("metadata", {}).setdefault(
+                    "labels", {})
+                labels[OPERAND_LABEL] = operand
             out[_fname(stage, obj)] = obj
     return out
 
@@ -71,6 +94,91 @@ def write_bundle(spec: ClusterSpec, directory: str) -> List[str]:
             f.write(json.dumps(obj))
         written.append(path)
     return written
+
+
+def crd() -> Dict[str, Any]:
+    """CustomResourceDefinition for TpuStackPolicy — the ClusterPolicy-CRD
+    analog (reference README.md:110 `operator.cleanupCRD=true` implies the
+    reference operator's CRD-driven config). Structural schema: one
+    ``enabled`` boolean per operand, plus a status subresource the operator
+    writes observed state into."""
+    operand_props = {
+        name: {
+            "type": "object",
+            "properties": {"enabled": {"type": "boolean"}},
+        }
+        for name in ("libtpuPrep", "devicePlugin", "featureDiscovery",
+                     "metricsExporter", "nodeStatusExporter")
+    }
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {
+            "name": f"{POLICY_PLURAL}.{POLICY_GROUP}",
+            "labels": {"app.kubernetes.io/part-of": "tpu-stack"},
+        },
+        "spec": {
+            "group": POLICY_GROUP,
+            "scope": "Cluster",
+            "names": {
+                "kind": POLICY_KIND,
+                "plural": POLICY_PLURAL,
+                "singular": POLICY_KIND.lower(),
+                "shortNames": ["tsp"],
+            },
+            "versions": [{
+                "name": POLICY_VERSION,
+                "served": True,
+                "storage": True,
+                "subresources": {"status": {}},
+                "schema": {"openAPIV3Schema": {
+                    "type": "object",
+                    "properties": {
+                        "spec": {
+                            "type": "object",
+                            "properties": {
+                                "operands": {
+                                    "type": "object",
+                                    "properties": operand_props,
+                                },
+                            },
+                        },
+                        "status": {
+                            "type": "object",
+                            "x-kubernetes-preserve-unknown-fields": True,
+                        },
+                    },
+                }},
+                "additionalPrinterColumns": [
+                    {"name": "Phase", "type": "string",
+                     "jsonPath": ".status.phase"},
+                    {"name": "Ready", "type": "string",
+                     "jsonPath": ".status.readySummary"},
+                ],
+            }],
+        },
+    }
+
+
+def policy(spec: ClusterSpec) -> Dict[str, Any]:
+    """The default TpuStackPolicy instance, seeded from the cluster spec's
+    operand switches — `helm --set devicePlugin.enabled=true` analog
+    (reference README.md:104-110). Day-2 toggles edit this live object;
+    the operator reacts on its next pass."""
+    return {
+        "apiVersion": f"{POLICY_GROUP}/{POLICY_VERSION}",
+        "kind": POLICY_KIND,
+        "metadata": {
+            "name": POLICY_NAME,
+            "labels": {"app.kubernetes.io/part-of": "tpu-stack"},
+        },
+        "spec": {
+            "operands": {
+                name: {"enabled": spec.tpu.operand(name).enabled}
+                for name in spec.tpu.OPERAND_NAMES
+            },
+        },
+    }
 
 
 def rbac(spec: ClusterSpec) -> List[Dict[str, Any]]:
@@ -106,6 +214,11 @@ def rbac(spec: ClusterSpec) -> List[Dict[str, Any]]:
             {"apiGroups": [""],
              "resources": ["events"],
              "verbs": ["create"]},
+            # The operator polls its TpuStackPolicy each pass and reports
+            # back through the status subresource (ClusterPolicy analog).
+            {"apiGroups": [POLICY_GROUP],
+             "resources": [POLICY_PLURAL, f"{POLICY_PLURAL}/status"],
+             "verbs": ["get", "list", "watch", "patch"]},
         ],
     }
     binding = {
@@ -153,6 +266,7 @@ def deployment(spec: ClusterSpec) -> Dict[str, Any]:
                         "command": ["tpu-operator"],
                         "args": [f"--bundle-dir={BUNDLE_MOUNT}",
                                  f"--status-port={STATUS_PORT}",
+                                 f"--policy={POLICY_NAME}",
                                  "--allow-empty-daemonsets"],
                         "ports": [{"name": "status",
                                    "containerPort": STATUS_PORT}],
@@ -178,9 +292,20 @@ def deployment(spec: ClusterSpec) -> Dict[str, Any]:
     }
 
 
+def operator_install_groups(spec: ClusterSpec) -> List[List[Dict[str, Any]]]:
+    """Apply waves for ``tpuctl apply --operator``. The CRD rides in the
+    first wave and the TpuStackPolicy CR in the second: a real apiserver
+    serves a new CRD's endpoints only once it is Established, so creating
+    the CR in the same breath races that window (REST: 404; kubectl: "no
+    matches for kind"). The apply backends gate on CRD establishment at the
+    wave boundary."""
+    return [
+        [manifests.namespace(spec)] + rbac(spec) + [crd()],
+        [policy(spec), bundle_configmap(spec), deployment(spec)],
+    ]
+
+
 def operator_install(spec: ClusterSpec) -> List[Dict[str, Any]]:
-    """Everything ``tpuctl apply --operator`` needs, in apply order: the
-    namespace first (the SA/ConfigMap/Deployment live in it), then RBAC,
-    bundle, controller."""
-    return ([manifests.namespace(spec)] + rbac(spec)
-            + [bundle_configmap(spec), deployment(spec)])
+    """Flat view of :func:`operator_install_groups`, in apply order —
+    chart generation and shape tests consume this."""
+    return [obj for group in operator_install_groups(spec) for obj in group]

@@ -328,12 +328,63 @@ def check_metrics(runner: Runner, spec: ClusterSpec) -> CheckResult:
     return CheckResult("metrics", True, line or "tpu_chips_total present")
 
 
+def check_policy(runner: Runner, spec: ClusterSpec) -> CheckResult:
+    """TpuStackPolicy health (operator mode's ClusterPolicy analog): the
+    controller's status must be current (observedGeneration == generation)
+    and Ready. Genuine absence passes with a note — the plain `tpuctl
+    apply` and helm-only paths never install the CRD, and the operator
+    itself fails open on a deleted CR — but absence is probed with
+    ``--ignore-not-found`` (rc 0, empty output) so an unreachable apiserver
+    or RBAC denial FAILS instead of masquerading as 'not installed'."""
+    rc, out = runner(["kubectl", "get", "crd",
+                      "tpustackpolicies.tpu-stack.dev",
+                      "--ignore-not-found", "-o", "json"])
+    if rc != 0:
+        return CheckResult("policy", False,
+                           f"cannot query CRDs (kubectl rc {rc})")
+    if not out.strip():
+        return CheckResult("policy", True,
+                           "TpuStackPolicy CRD not installed "
+                           "(operator-managed rollouts only)")
+    rc, out = runner(["kubectl", "get", "tpustackpolicies.tpu-stack.dev",
+                      "default", "--ignore-not-found", "-o", "json"])
+    if rc != 0:
+        return CheckResult("policy", False,
+                           f"cannot query TpuStackPolicy (kubectl rc {rc})")
+    if not out.strip():
+        return CheckResult("policy", True,
+                           "CRD installed but 'default' CR absent — "
+                           "operator fails open (all operands enabled)")
+    try:
+        cr = json.loads(out)
+    except ValueError:
+        return CheckResult("policy", False,
+                           "unparseable TpuStackPolicy JSON")
+    st = cr.get("status") or {}
+    gen = cr.get("metadata", {}).get("generation")
+    observed = st.get("observedGeneration")
+    if gen is not None and observed != gen:
+        return CheckResult("policy", False,
+                           f"status stale: observedGeneration={observed} "
+                           f"!= generation={gen} (operator not reconciling?)")
+    if st.get("phase") != "Ready":
+        return CheckResult("policy", False,
+                           f"phase={st.get('phase', 'absent')}")
+    disabled = [n for n, o in (st.get("operands") or {}).items()
+                if not o.get("enabled")]
+    line = f"Ready, {st.get('readySummary', '?')}"
+    if disabled:
+        line += f" (disabled by policy: {', '.join(sorted(disabled))})"
+    return CheckResult("policy", True, line)
+
+
 CHECKS: Dict[str, Callable[[Runner, ClusterSpec], CheckResult]] = {
     "smoke": check_smoke,
     "operands": check_operands,
     "labels": check_labels,
     "conditions": check_conditions,
     "allocatable": check_allocatable,
+    "policy": check_policy,
     "device-query": check_device_query,
     "vector-add": check_vector_add,
     "metrics": check_metrics,
