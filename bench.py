@@ -193,6 +193,7 @@ def metrics_scrape_roundtrip(platform: str) -> dict:
     # values so the artifact proves they carried real numbers end-to-end.
     duty = first_value("tpu_duty_cycle_percent{")
     hbm_used = first_value("tpu_hbm_used_bytes{")
+    tc_util = first_value("tpu_tensorcore_utilization_percent{")
     # Round trip proven when a writer-origin gauge came back through the
     # exporter's relay; on real TPU the per-chip HBM capacity gauge must be
     # there too (memory_stats or the catalogue fallback — never absent).
@@ -204,6 +205,8 @@ def metrics_scrape_roundtrip(platform: str) -> dict:
         out["duty_cycle_percent"] = duty
     if hbm_used is not None:
         out["hbm_used_bytes"] = int(hbm_used)
+    if tc_util is not None:
+        out["tensorcore_utilization_percent"] = tc_util
     return out
 
 
@@ -221,7 +224,8 @@ def main() -> int:
     # device_busy), and the metrics scrape at the end publishes the measured
     # busy/wall fraction as tpu_duty_cycle_percent — the dcgm utilization
     # analog, produced end-to-end rather than from a fixture.
-    with runtime_metrics.duty_cycle_window():
+    with runtime_metrics.duty_cycle_window(), \
+            runtime_metrics.tensorcore_window():
         # Acceptance matrix first (doubles as compile warm-up); its
         # wall-clock is the BASELINE.json north-star 'smoke Job' time.
         checks = validate_matrix()

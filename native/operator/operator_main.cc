@@ -523,7 +523,7 @@ class Operator {
   void WritePolicyStatus(bool pass_ok) {
     if (opt_.policy.empty() || !policy_seen_ || policy_missing_) return;
     using minijson::Value;
-    struct Agg { int total = 0, applied = 0, ready = 0, disabled = 0; };
+    struct Agg { int total = 0, applied = 0, ready = 0; };
     std::map<std::string, Agg> per;
     int want = 0, have = 0;
     for (const auto& bo : bundle_) {
@@ -532,8 +532,10 @@ class Operator {
       ++a.total;
       a.applied += bo.applied;
       a.ready += bo.ready;
-      a.disabled += bo.disabled;
-      if (!bo.disabled) {
+      // "enabled" reports the FETCHED policy, not this pass's deletion
+      // progress — a pass that fails before reaching a disabled operand's
+      // stage must not report the toggle as un-honored
+      if (OperandEnabled(bo.operand)) {
         ++want;
         have += bo.ready;
       }
@@ -541,11 +543,12 @@ class Operator {
     auto ops = Value::MakeObject();
     for (const auto& kv : per) {
       const Agg& a = kv.second;
+      bool enabled = OperandEnabled(kv.first);
       auto o = Value::MakeObject();
-      o->Set("enabled", std::make_shared<Value>(a.disabled == 0));
+      o->Set("enabled", std::make_shared<Value>(enabled));
       o->Set("applied", std::make_shared<Value>(a.applied == a.total));
       o->Set("ready", std::make_shared<Value>(
-          a.disabled == 0 && a.ready == a.total));
+          enabled && a.ready == a.total));
       ops->Set(kv.first, o);
     }
     auto st = Value::MakeObject();

@@ -33,6 +33,7 @@ struct Chip {
   bool present;
   int numa = -1;
   double duty_cycle = -1;   // percent; -1 = unknown
+  double tc_util = -1;      // tensorcore utilization percent
   double hbm_used = -1;     // bytes
 };
 
@@ -81,6 +82,8 @@ void MergeRuntimeMetrics(const std::string& file, std::vector<Chip>* chips) {
     for (auto& ch : *chips) {
       if (ch.index != chip) continue;
       if (strcmp(name, "tpu_duty_cycle_percent") == 0) ch.duty_cycle = value;
+      if (strcmp(name, "tpu_tensorcore_utilization_percent") == 0)
+        ch.tc_util = value;
       if (strcmp(name, "tpu_hbm_used_bytes") == 0) ch.hbm_used = value;
     }
   }
@@ -144,6 +147,8 @@ int main(int argc, char** argv) {
              c.present ? "true" : "false", c.numa);
       if (c.duty_cycle >= 0) printf(", \"duty_cycle_percent\": %g",
                                     c.duty_cycle);
+      if (c.tc_util >= 0)
+        printf(", \"tensorcore_utilization_percent\": %g", c.tc_util);
       if (c.hbm_used >= 0) printf(", \"hbm_used_bytes\": %.0f", c.hbm_used);
       printf("}");
     }

@@ -218,11 +218,11 @@ def stage_metrics(t: Transcript, tmp: str) -> None:
 
     t.h2("Stage 4 — metrics exporter scrape (BASELINE config 4)")
     metrics_file = os.path.join(tmp, "metrics.prom")
-    with runtime_metrics.duty_cycle_window():
-        import jax
-        import jax.numpy as jnp
-        with runtime_metrics.device_busy():
-            jax.block_until_ready(jax.jit(jnp.sum)(jnp.ones((512, 512))))
+    os.environ.setdefault("TPU_ACCELERATOR_TYPE", "v5e-8")
+    with runtime_metrics.duty_cycle_window(), \
+            runtime_metrics.tensorcore_window():
+        from tpu_cluster.workloads import smoke
+        smoke.matmul(256, 256, 256, iters=2)  # duty + FLOPs producer
         runtime_metrics.write(metrics_file)
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -245,13 +245,17 @@ def stage_metrics(t: Transcript, tmp: str) -> None:
         proc.terminate()
         proc.wait(timeout=10)
     shown = [ln for ln in body.splitlines()
-             if ln.startswith(("tpu_chips", "tpu_duty", "tpu_process"))]
+             if ln.startswith(("tpu_chips", "tpu_duty", "tpu_tensorcore",
+                               "tpu_process"))]
     t.emit(f"GET /metrics -> {len(body)} bytes; selected gauges:")
     t.code("\n".join(shown))
     t.check("tpu_chips_total 8" in body,
             "exporter's own census gauge served over HTTP")
     t.check("tpu_duty_cycle_percent{" in body,
             "workload-produced duty-cycle gauge relayed end-to-end")
+    t.check("tpu_tensorcore_utilization_percent{" in body,
+            "workload-produced tensorcore-utilization gauge relayed "
+            "end-to-end")
     # the nvidia-smi-analog probe renders the same produced metrics
     from tpu_cluster.discovery import devices as pydev
     tree = os.path.join(tmp, "devfs")

@@ -70,8 +70,27 @@ def test_operator_enabled_renders_bundle_install():
     docs = gotmpl.render_chart(CHART, {"operator": {"enabled": True}})
     base = kindnames(mf.render_objects(specmod.default_spec()))
     extra = [d for d in docs if kindnames([d]) - base]
-    want = operator_bundle.operator_install(specmod.default_spec())[1:]
+    # the CRD is NOT in templates/ — Helm installs crds/ before templates,
+    # which is the establishment gate for the TpuStackPolicy CR
+    want = [o for o in
+            operator_bundle.operator_install(specmod.default_spec())[1:]
+            if o["kind"] != "CustomResourceDefinition"]
     assert extra == want
+
+
+def test_chart_ships_crd_in_crds_dir():
+    """Helm's crds/ directory installs (and settles) before any template
+    renders — the chart-side analog of the apply backends' Established
+    gate."""
+    import yaml as yamlmod
+    path = os.path.join(CHART, "crds", "tpustackpolicy.yaml")
+    with open(path, encoding="utf-8") as f:
+        doc = yamlmod.safe_load(f)
+    assert doc == operator_bundle.crd()
+    tdir = os.path.join(CHART, "templates")
+    for name in os.listdir(tdir):
+        with open(os.path.join(tdir, name), encoding="utf-8") as f:
+            assert "CustomResourceDefinition" not in f.read(), name
 
 
 @pytest.mark.parametrize("switch", sorted(OPERAND_DOC_NAMES))

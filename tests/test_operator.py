@@ -707,3 +707,22 @@ def test_policy_missing_fails_open(native_build, bundle_dir):
         assert "fail-open" in proc.stderr
         assert api.get(f"{DS}/tpu-metrics-exporter") is not None
         assert not any(m == "PATCH" and POLICY_PATH in p for m, p in api.log)
+
+
+def test_policy_status_honest_on_failed_pass(native_build, bundle_dir):
+    """status.operands[*].enabled reports the FETCHED policy even when the
+    pass fails before reaching the disabled operand's stage — deletion
+    progress must not masquerade as the toggle being un-honored."""
+    with FakeApiServer(auto_ready=True,
+                       store={POLICY_PATH: seeded_policy(
+                           generation=2, metricsExporter=False)},
+                       reject_posts={DS: 403}) as api:
+        proc = run_operator(
+            native_build, f"--apiserver={api.url}",
+            f"--bundle-dir={bundle_dir}", "--policy=default", "--once",
+            "--stage-timeout=5", "--poll-ms=20", "--status-port=0")
+        assert proc.returncode == 1  # stage 10 DaemonSet POST denied
+        st = api.get(POLICY_PATH)["status"]
+        assert st["phase"] == "Progressing"
+        assert st["observedGeneration"] == 2
+        assert st["operands"]["metricsExporter"]["enabled"] is False

@@ -321,7 +321,8 @@ def check_metrics(runner: Runner, spec: ClusterSpec) -> CheckResult:
     # report their presence rather than failing an idle node. Sample lines
     # only: the relayed HELP comments appear even with zero samples.
     lines = out.splitlines()
-    extras = [g for g in ("tpu_duty_cycle_percent", "tpu_hbm_used_bytes")
+    extras = [g for g in ("tpu_duty_cycle_percent", "tpu_hbm_used_bytes",
+                          "tpu_tensorcore_utilization_percent")
               if any(ln.startswith(g + "{") for ln in lines)]
     if extras:
         line += f" (+ workload gauges: {', '.join(extras)})"

@@ -16,6 +16,14 @@ which renders tpu_hbm_used_bytes in its table — native/tpuinfo):
   tpu_hbm_source{source=...}       where the HBM numbers came from
   tpu_duty_cycle_percent{chip=...} fraction of wall-time the workload had
                                    device execution in flight (see below)
+  tpu_tensorcore_utilization_percent{chip=...}
+                                   achieved model FLOP rate vs the
+                                   catalogue's per-chip bf16 peak (MFU as
+                                   a percentage; FLOPs reported by the
+                                   workload via add_flops inside a
+                                   tensorcore_window — burnin reports XLA
+                                   cost-analysis FLOPs x synced steps,
+                                   smoke reports its matmul's 2mnk)
   tpu_process_devices              local device count of the writer
   tpu_runtime_metrics_timestamp_seconds  staleness marker for scrapers
 
@@ -85,6 +93,35 @@ class DutyCycleSampler:
 _active_sampler: Optional[DutyCycleSampler] = None
 
 
+class TensorcoreSampler:
+    """Accumulates executed model FLOPs against a wall-clock window — the
+    dcgm-exporter tensorcore-utilization analog (SURVEY.md §2.2 C6 names
+    the surface as duty cycle / HBM / tensorcore utilization). libtpu has
+    no counter daemon to ask, so the owning workload reports the FLOPs it
+    measurably executed (XLA cost analysis x synced step count) and the
+    gauge is achieved/peak against the catalogue's per-chip bf16 peak."""
+
+    def __init__(self) -> None:
+        self._t0 = time.monotonic()
+        self._flops = 0.0
+
+    def add_flops(self, flops: float) -> None:
+        if flops > 0:
+            self._flops += flops
+
+    def percent(self, n_devices: int,
+                peak_tflops_per_chip: float) -> Optional[float]:
+        wall = time.monotonic() - self._t0
+        if (wall <= 0 or self._flops <= 0 or n_devices <= 0
+                or peak_tflops_per_chip <= 0):
+            return None
+        achieved_per_chip = self._flops / wall / 1e12 / n_devices
+        return min(100.0, 100.0 * achieved_per_chip / peak_tflops_per_chip)
+
+
+_active_tensorcore: Optional[TensorcoreSampler] = None
+
+
 @contextlib.contextmanager
 def duty_cycle_window():
     """Open a duty-cycle measurement window; ``collect_lines`` publishes the
@@ -96,6 +133,27 @@ def duty_cycle_window():
         yield sampler
     finally:
         _active_sampler = prev
+
+
+@contextlib.contextmanager
+def tensorcore_window():
+    """Open a tensorcore-utilization window; workloads report executed
+    FLOPs via :func:`add_flops` and ``collect_lines`` publishes the gauge
+    while the window is active."""
+    global _active_tensorcore
+    sampler = TensorcoreSampler()
+    prev, _active_tensorcore = _active_tensorcore, sampler
+    try:
+        yield sampler
+    finally:
+        _active_tensorcore = prev
+
+
+def add_flops(flops: float) -> None:
+    """Report model FLOPs whose device execution has completed (call after
+    the sync). No-op without an open tensorcore window."""
+    if _active_tensorcore is not None:
+        _active_tensorcore.add_flops(flops)
 
 
 @contextlib.contextmanager
@@ -132,6 +190,19 @@ def _live_array_bytes(devices) -> Dict[int, int]:
     return out
 
 
+def _resolve_accelerator(devices):
+    """Catalogue entry for the local chips: the TPU_ACCELERATOR_TYPE env the
+    device plugin's Allocate injects wins, else the JAX device_kind."""
+    from .. import topology
+
+    acc_env = os.environ.get("TPU_ACCELERATOR_TYPE", "")
+    if acc_env in topology.ACCELERATOR_TYPES:
+        return topology.get(acc_env)
+    if devices:
+        return topology.from_device_kind(devices[0].device_kind)
+    return None
+
+
 def collect_lines(now: Optional[float] = None) -> List[str]:
     import jax
 
@@ -140,7 +211,6 @@ def collect_lines(now: Optional[float] = None) -> List[str]:
         "owning JAX process)",
         "# TYPE tpu_hbm_used_bytes gauge",
     ]
-    from .. import topology
     from .smoke import hbm_stats
 
     devices = jax.local_devices()
@@ -159,12 +229,7 @@ def collect_lines(now: Optional[float] = None) -> List[str]:
         # gauge is never silently absent. source="none" marks the
         # double-miss (unknown device kind, no Allocate env) so scrapers can
         # tell "runtime supplied stats" from "nobody could".
-        acc = None
-        acc_env = os.environ.get("TPU_ACCELERATOR_TYPE", "")
-        if acc_env in topology.ACCELERATOR_TYPES:
-            acc = topology.get(acc_env)
-        if acc is None:
-            acc = topology.from_device_kind(devices[0].device_kind)
+        acc = _resolve_accelerator(devices)
         if not in_use:
             in_use = _live_array_bytes(devices)
         if acc is not None:
@@ -194,6 +259,24 @@ def collect_lines(now: Optional[float] = None) -> List[str]:
         for d in devices:
             lines.append(
                 f'tpu_duty_cycle_percent{{chip="{d.id}"}} {duty:.1f}')
+    tc = None
+    if _active_tensorcore is not None:
+        acc = _resolve_accelerator(devices)
+        if acc is not None and acc.peak_bf16_tflops > 0:
+            tc = _active_tensorcore.percent(len(devices),
+                                            acc.peak_bf16_tflops)
+    if tc is not None:
+        lines += [
+            "# HELP tpu_tensorcore_utilization_percent achieved model "
+            "FLOP rate vs the per-chip bf16 peak (MFU, as a percentage)",
+            "# TYPE tpu_tensorcore_utilization_percent gauge",
+        ]
+        for d in devices:
+            # %.4g keeps a measured-but-tiny rate (CPU-mesh CI) nonzero
+            # instead of rounding it to an absent-looking 0.0
+            lines.append(
+                f'tpu_tensorcore_utilization_percent{{chip="{d.id}"}} '
+                f'{tc:.4g}')
     lines += [
         "# HELP tpu_process_devices local devices owned by the writer",
         "# TYPE tpu_process_devices gauge",

@@ -110,6 +110,7 @@ def matmul(m: int = 4096, k: int = 4096, n: int = 4096,
         np.asarray(out[:1, :1])
     dt = time.perf_counter() - t0
     flops = 2.0 * m * k * n * iters
+    runtime_metrics.add_flops(flops)  # tensorcore-utilization producer
     finite = bool(jnp.isfinite(out.astype(jnp.float32)).all())
     return {
         "check": "matmul", "m": m, "k": k, "n": n, "dtype": str(dtype.__name__

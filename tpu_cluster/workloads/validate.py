@@ -113,12 +113,14 @@ def main(argv=None) -> int:
                     help="device-query: required jax.local_device_count() "
                          "(0 = TPU_DEVICE_COUNT env from Allocate, else 1)")
     args = ap.parse_args(argv)
-    # The whole run is one duty-cycle measurement window so the published
-    # gauges include a real utilization number (the workloads mark their
-    # device-execution regions via runtime_metrics.device_busy) — on a
-    # cluster, the validation Job IS the workload the exporter scrapes.
+    # The whole run is one duty-cycle + tensorcore measurement window so the
+    # published gauges include real utilization numbers (the workloads mark
+    # their device-execution regions via runtime_metrics.device_busy and
+    # report synced FLOPs via add_flops) — on a cluster, the validation Job
+    # IS the workload the exporter scrapes.
     from . import runtime_metrics
-    with runtime_metrics.duty_cycle_window():
+    with runtime_metrics.duty_cycle_window(), \
+            runtime_metrics.tensorcore_window():
         result = run(args.mode, args.matmul_dim, args.psum_devices,
                      args.expect_devices)
         # Publish gauges for the metrics-exporter relay (no-op when the
