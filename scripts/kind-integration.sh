@@ -122,4 +122,42 @@ spec:
 EOF
 kubectl -n tpu-system wait --for=condition=complete job/tpu-consume --timeout=120s
 kubectl -n tpu-system logs job/tpu-consume
+
+echo "--- operator mode: CRD install + TpuStackPolicy day-2 toggle"
+# Adopts the operands applied above (merge-patch), installs the
+# TpuStackPolicy CRD/CR (kubectl backend waits for CRD establishment),
+# and starts the controller. The spec's disabled operands (libtpuPrep,
+# nodeStatusExporter) arrive disabled in the CR, so the operator never
+# schedules them onto the chipless kind nodes.
+PYTHONPATH="$REPO" python3 -m tpu_cluster apply --spec "$SPEC" \
+  --operator --wait --stage-timeout 180
+kubectl get tsp default
+
+kubectl patch tsp default --type merge \
+  -p '{"spec":{"operands":{"metricsExporter":{"enabled":false}}}}'
+for i in $(seq 1 60); do
+  kubectl -n tpu-system get ds tpu-metrics-exporter >/dev/null 2>&1 || break
+  sleep 2
+done
+if kubectl -n tpu-system get ds tpu-metrics-exporter >/dev/null 2>&1; then
+  echo "FAIL: exporter DaemonSet still present after policy disable"; exit 1
+fi
+EN=""
+for i in $(seq 1 60); do
+  EN=$(kubectl get tsp default \
+    -o jsonpath='{.status.operands.metricsExporter.enabled}')
+  [ "$EN" = "false" ] && break
+  sleep 2
+done
+[ "$EN" = "false" ] || { echo "FAIL: policy status enabled='$EN'"; exit 1; }
+echo "policy disable OK: exporter rolled out, status reports enabled=false"
+
+kubectl patch tsp default --type merge \
+  -p '{"spec":{"operands":{"metricsExporter":{"enabled":true}}}}'
+for i in $(seq 1 60); do
+  kubectl -n tpu-system get ds tpu-metrics-exporter >/dev/null 2>&1 && break
+  sleep 2
+done
+kubectl -n tpu-system rollout status ds/tpu-metrics-exporter --timeout=120s
+echo "policy re-enable OK: exporter recreated by the operator"
 echo "PASS: kind integration complete"

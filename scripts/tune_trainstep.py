@@ -20,10 +20,15 @@ sys.path.insert(0, ".")
 from tpu_cluster import topology  # noqa: E402
 from tpu_cluster.workloads import burnin  # noqa: E402
 
-BASE = burnin.bench_config()
+# The FIXED historical sweep baseline (the round-3 f32768/b16 shape), NOT
+# bench_config(): variants are defined relative to this, so their names
+# keep meaning run-to-run even as bench_config() moves to each sweep's
+# winner. "bench" always measures the current bench_config().
+BASE = replace(burnin.bench_config(), d_ff=32768, batch=16)
 
 VARIANTS = {
     "base": BASE,
+    "bench": burnin.bench_config(),
     "dots": replace(BASE, remat="dots"),
     "b32": replace(BASE, batch=32),
     "b32_dots": replace(BASE, batch=32, remat="dots"),
@@ -55,6 +60,19 @@ VARIANTS = {
     "ff32k_b32": replace(BASE, d_ff=32768, batch=32),
     "d4096_h16_flash": replace(BASE, d_model=4096, d_ff=16384, n_heads=16,
                                batch=8, attention="flash"),
+    # round-3 follow-ups beyond the f32k winner: even wider FFN and a
+    # larger d_model at the winning FFN width
+    "ff64k": replace(BASE, d_ff=65536, batch=8),
+    "ff64k_b16": replace(BASE, d_ff=65536),
+    "d4096_ff32k": replace(BASE, d_model=4096, d_ff=32768, n_heads=16,
+                           batch=8),
+    "b24": replace(BASE, batch=24),
+    "b8": replace(BASE, batch=8),
+    # ff64k/b8 measured 0.889 — probe the limit of the widen-FFN direction
+    "ff64k_b4": replace(BASE, d_ff=65536, batch=4),
+    "ff128k_b4": replace(BASE, d_ff=131072, batch=4),
+    "ff128k_b8": replace(BASE, d_ff=131072, batch=8),
+    "ff64k_s1k_b4": replace(BASE, d_ff=65536, seq=1024, batch=4),
 }
 
 

@@ -380,3 +380,21 @@ def test_policy_check_ready_stale_and_degraded(spec):
     runner.responses[key] = policy_cr(phase="Progressing")
     res = verify.check_policy(runner, spec)
     assert not res.ok and "Progressing" in res.detail
+
+
+def test_triage_reports_policy_disabled_operands(spec):
+    """'Where did my exporter go?' — when the TpuStackPolicy toggled it
+    off, triage says so with the exact re-enable command."""
+    runner = CannedRunner(healthy=True)
+    runner.responses["get crd tpustackpolicies.tpu-stack.dev"] = {
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": "tpustackpolicies.tpu-stack.dev"}}
+    runner.responses["get tpustackpolicies.tpu-stack.dev default"] = \
+        policy_cr(disabled=("metricsExporter",))
+    text = triage.run_triage(spec, runner).text()
+    assert "disabled by TpuStackPolicy" in text
+    assert "metricsExporter" in text and "kubectl patch tsp default" in text
+
+    # no CR (non-operator installs): no policy section, no failure
+    text = triage.run_triage(spec, CannedRunner(healthy=True)).text()
+    assert "disabled by TpuStackPolicy" not in text

@@ -97,6 +97,24 @@ def run_triage(spec: ClusterSpec,
         if rows:
             report.add(f"warning events in {ns}", "\n".join(rows[-20:]))
 
+    # 2c. policy-disabled operands: "where did my exporter go?" has a
+    # one-line answer when the TpuStackPolicy toggled it off — the operator
+    # deleted it on purpose, and status says so (operator-mode installs
+    # only; the CR is simply absent elsewhere, and triage ignores fetch
+    # errors — check_policy is the strict surface)
+    from .verify import fetch_policy, policy_disabled_operands
+    state, cr = fetch_policy(runner)
+    if state == "ok":
+        disabled = policy_disabled_operands(cr)
+        if disabled:
+            report.add(
+                "operands disabled by TpuStackPolicy",
+                "\n".join(f"{n}: rolled out of the cluster by the operator "
+                          "(re-enable: kubectl patch tsp default --type "
+                          "merge -p '{\"spec\":{\"operands\":{\"" + n +
+                          "\":{\"enabled\":true}}}}')"
+                          for n in disabled))
+
     # 3. per-node health from the node-status-exporter (the automated
     # version of "confirm the instance really has a GPU", README.md:187)
     if spec.tpu.operand("nodeStatusExporter").enabled:

@@ -329,38 +329,57 @@ def check_metrics(runner: Runner, spec: ClusterSpec) -> CheckResult:
     return CheckResult("metrics", True, line or "tpu_chips_total present")
 
 
+def fetch_policy(runner: Runner):
+    """Two-step TpuStackPolicy probe shared by :func:`check_policy` and
+    ``triage`` — returns ``(state, cr)`` where state is ``"no-crd"`` /
+    ``"no-cr"`` / ``"ok"`` / ``"error: ..."`` and cr is the parsed object
+    only for ``"ok"``. Absence is probed with ``--ignore-not-found`` (rc 0,
+    empty output), so an unreachable apiserver or RBAC denial surfaces as
+    an error instead of masquerading as 'not installed'."""
+    rc, out = runner(["kubectl", "get", "crd",
+                      "tpustackpolicies.tpu-stack.dev",
+                      "--ignore-not-found", "-o", "json"])
+    if rc != 0:
+        return f"error: cannot query CRDs (kubectl rc {rc})", None
+    if not out.strip():
+        return "no-crd", None
+    rc, out = runner(["kubectl", "get", "tpustackpolicies.tpu-stack.dev",
+                      "default", "--ignore-not-found", "-o", "json"])
+    if rc != 0:
+        return (f"error: cannot query TpuStackPolicy (kubectl rc {rc})",
+                None)
+    if not out.strip():
+        return "no-cr", None
+    try:
+        return "ok", json.loads(out)
+    except ValueError:
+        return "error: unparseable TpuStackPolicy JSON", None
+
+
+def policy_disabled_operands(cr) -> List[str]:
+    """Operand names the live CR's status reports as policy-disabled."""
+    status = (cr or {}).get("status") or {}
+    return sorted(name for name, op in (status.get("operands") or {}).items()
+                  if not op.get("enabled"))
+
+
 def check_policy(runner: Runner, spec: ClusterSpec) -> CheckResult:
     """TpuStackPolicy health (operator mode's ClusterPolicy analog): the
     controller's status must be current (observedGeneration == generation)
     and Ready. Genuine absence passes with a note — the plain `tpuctl
     apply` and helm-only paths never install the CRD, and the operator
-    itself fails open on a deleted CR — but absence is probed with
-    ``--ignore-not-found`` (rc 0, empty output) so an unreachable apiserver
-    or RBAC denial FAILS instead of masquerading as 'not installed'."""
-    rc, out = runner(["kubectl", "get", "crd",
-                      "tpustackpolicies.tpu-stack.dev",
-                      "--ignore-not-found", "-o", "json"])
-    if rc != 0:
-        return CheckResult("policy", False,
-                           f"cannot query CRDs (kubectl rc {rc})")
-    if not out.strip():
+    itself fails open on a deleted CR."""
+    state, cr = fetch_policy(runner)
+    if state.startswith("error"):
+        return CheckResult("policy", False, state[len("error: "):])
+    if state == "no-crd":
         return CheckResult("policy", True,
                            "TpuStackPolicy CRD not installed "
                            "(operator-managed rollouts only)")
-    rc, out = runner(["kubectl", "get", "tpustackpolicies.tpu-stack.dev",
-                      "default", "--ignore-not-found", "-o", "json"])
-    if rc != 0:
-        return CheckResult("policy", False,
-                           f"cannot query TpuStackPolicy (kubectl rc {rc})")
-    if not out.strip():
+    if state == "no-cr":
         return CheckResult("policy", True,
                            "CRD installed but 'default' CR absent — "
                            "operator fails open (all operands enabled)")
-    try:
-        cr = json.loads(out)
-    except ValueError:
-        return CheckResult("policy", False,
-                           "unparseable TpuStackPolicy JSON")
     st = cr.get("status") or {}
     gen = cr.get("metadata", {}).get("generation")
     observed = st.get("observedGeneration")
@@ -371,11 +390,10 @@ def check_policy(runner: Runner, spec: ClusterSpec) -> CheckResult:
     if st.get("phase") != "Ready":
         return CheckResult("policy", False,
                            f"phase={st.get('phase', 'absent')}")
-    disabled = [n for n, o in (st.get("operands") or {}).items()
-                if not o.get("enabled")]
+    disabled = policy_disabled_operands(cr)
     line = f"Ready, {st.get('readySummary', '?')}"
     if disabled:
-        line += f" (disabled by policy: {', '.join(sorted(disabled))})"
+        line += f" (disabled by policy: {', '.join(disabled)})"
     return CheckResult("policy", True, line)
 
 

@@ -227,11 +227,19 @@ def bench_config() -> BurninConfig:
       pallas flash-attention ..................... 0.64-0.72 (S=512 too
          short to amortise the kernel; its win case is long-seq)
       d4096/f16384/h16/b8 ........................ 0.80
-      d2048/f32768/h16/b16/s512 (this config) .... 0.82-0.84
+      d2048/f32768/h16/b16/s512 .................. 0.82-0.84
        + hand-fused cross-entropy backward ....... 0.81-0.85
        + remat="attn" on top ..................... 0.82 (regression —
          XLA's saved-residual schedule beats the recompute at S=512;
          the knob stays for long-sequence shapes)
+      d2048/f65536/h16/b8/s512 ................... 0.88-0.90 (stable over
+         3 reruns: 0.889/0.895/0.884; b4 at this width measured
+         0.88-0.99 but its ~15ms steps swing too much through the
+         tunnel to headline; d4096 at f32768 measured 0.85, s1024 at
+         this width 0.82)
+      d2048/f131072/h16/b8/s512 (this config) .... 0.91-0.92 (three
+         back-to-back reruns: 0.917/0.910/0.916 — the ~87ms steps are
+         long enough that tunnel noise stops mattering)
 
     Component ablations at this config (fwd+bwd, ms/step): attention chain
     ~4 (stock pallas flash kernel measured 3.5x slower than the XLA chain
@@ -239,8 +247,8 @@ def bench_config() -> BurninConfig:
     custom-vjp backward in softmax_xent), gelu/rms/SGD-update ~0 (XLA
     fuses them into neighbouring ops). FLOPs are XLA cost-analysis of the
     no-remat step (see timed_steps)."""
-    return BurninConfig(vocab=8192, d_model=2048, d_ff=32768,
-                        n_heads=16, seq=512, batch=16)
+    return BurninConfig(vocab=8192, d_model=2048, d_ff=131072,
+                        n_heads=16, seq=512, batch=8)
 
 
 def make_mesh(shape: Tuple[int, int], devices=None) -> Mesh:
