@@ -28,6 +28,11 @@ const std::vector<AcceleratorType>& Catalogue() {
       {"v5p-16", "v5p", 4, 2, 2, 95, {4}, {{4, {2, 2}}}, 2, 1, 1, 2},
       {"v5p-32", "v5p", 4, 2, 2, 95, {4}, {{4, {2, 2}}}, 4, 1, 1, 4},
       {"v4-16", "v4", 4, 2, 2, 32, {4}, {{4, {2, 2}}}, 2, 1, 1, 2},
+      // larger slices: v5e tiles x then y; v5p-64 is the first shape
+      // tiling hosts along ALL THREE axes (2x2 groups -> the 4x4x2 torus)
+      {"v5e-64", "v5e", 8, 2, 4, 16, {8}, {{8, {2, 4}}}, 8, 4, 2, 1},
+      {"v6e-32", "v6e", 8, 2, 4, 32, {8}, {{8, {2, 4}}}, 4, 2, 2, 1},
+      {"v5p-64", "v5p", 4, 2, 2, 95, {4}, {{4, {2, 2}}}, 8, 2, 2, 2},
   };
   return kTypes;
 }

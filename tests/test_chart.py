@@ -5,6 +5,7 @@ flags, and the default render reproduces the canonical manifests byte-equal.
 Rendering goes through tpu_cluster.render.gotmpl (the helm-template analog);
 CI additionally runs real `helm lint` + `helm template` on the chart."""
 
+import json
 import os
 import sys
 
@@ -172,3 +173,25 @@ def test_go_trim_semantics():
     assert gotmpl.render("x: {{ .Values.n }}!", {"n": 4}) == "x: 4!"
     assert gotmpl.render("{{ .Values.b }}", {"b": True}) == "true"
     assert gotmpl.render("{{/* note */}}ok", {}) == "ok"
+
+
+def test_values_schema_validates_defaults_and_rejects_typos():
+    """helm validates user values against values.schema.json at
+    lint/install — the chart's defense against `--set devicPlugin...`
+    typos. The defaults must validate; a misspelled switch must not."""
+    jsonschema = pytest.importorskip("jsonschema")
+    with open(os.path.join(CHART, "values.schema.json")) as f:
+        schema = json.load(f)
+    with open(os.path.join(CHART, "values.yaml")) as f:
+        values = yaml.safe_load(f)
+    jsonschema.validate(values, schema)
+
+    with pytest.raises(jsonschema.ValidationError):
+        jsonschema.validate({**values, "devicPlugin": {"enabled": True}},
+                            schema)
+    with pytest.raises(jsonschema.ValidationError):
+        jsonschema.validate({**values, "accelerator": "v99-8"}, schema)
+    # every catalogue type is an allowed accelerator value
+    from tpu_cluster import topology
+    assert set(schema["properties"]["accelerator"]["enum"]) == \
+        set(topology.ACCELERATOR_TYPES)

@@ -169,3 +169,18 @@ def test_cli_render_multihost_mismatch_clean_error(capsys):
     assert rc == 2
     err = capsys.readouterr().err
     assert "2-host slice" in err and "got 3" in err
+
+
+def test_multihost_jobs_v5e64_eight_hosts():
+    """v5e-64 (the 8x8 grid = 8 hosts of 2x4) renders 8-worker Indexed
+    sets, each pod taking its host's whole 8-chip group."""
+    spec = specmod.default_spec()
+    spec.tpu.accelerator = "v5e-64"
+    objs = jobs.render_validation_jobs(spec)
+    job = next(o for o in objs
+               if o["kind"] == "Job"
+               and o["metadata"]["name"] == "tpu-psum-multihost")
+    assert job["spec"]["completionMode"] == "Indexed"
+    assert job["spec"]["completions"] == 8
+    container = job["spec"]["template"]["spec"]["containers"][0]
+    assert container["resources"]["limits"]["google.com/tpu"] == "8"

@@ -698,3 +698,23 @@ def test_exporter_not_wedged_by_drip_feed_client(native_build, tmp_path):
             t.join(timeout=5)
         proc.terminate()
         proc.wait(timeout=10)
+
+
+def test_allocate_v5p64_three_axis_host_bounds(native_build, tmp_path):
+    """v5p-64 tiles hosts along ALL THREE torus axes (8 hosts of flat 2x2
+    chips -> the 4x4x2 torus): TPU_HOST_BOUNDS carries "2,2,2" — no axis
+    is degenerate, so any x/y/z ordering bug in the bounds math shows."""
+    from tpu_cluster.plugin_api.client import DevicePluginClient
+    proc, sock = start_tpud(native_build, tmp_path, "--fake-devices=4",
+                            "--no-register", "--accelerator=v5p-64")
+    c = DevicePluginClient(sock)
+    try:
+        resp = c.allocate([f"tpu-{i}" for i in range(4)])
+        envs = resp.container_responses[0].envs
+        assert envs["TPU_HOST_BOUNDS"] == "2,2,2"
+        assert envs["TPU_CHIPS_PER_HOST_BOUNDS"] == "2,2,1"
+        assert envs["TPU_ACCELERATOR_TYPE"] == "v5p-64"
+    finally:
+        c.close()
+        proc.terminate()
+        proc.wait(timeout=5)

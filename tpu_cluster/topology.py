@@ -182,6 +182,34 @@ V6E_16 = _register(AcceleratorType(
     num_hosts=2, host_bounds=(2, 1, 1),
 ))
 
+# Larger slices: v5e hosts tile x then y (v5e-64 is the 8x8 grid = 8 hosts
+# of 2x4); v5p-64 is the first catalogue shape tiling hosts along ALL
+# THREE torus axes (8 hosts of flat 2x2 chips -> the 4x4x2 torus,
+# TPU_HOST_BOUNDS "2,2,2").
+V5E_64 = _register(AcceleratorType(
+    name="v5e-64", generation="v5e", chips_per_host=8, topology=(2, 4),
+    hbm_gib_per_chip=16, aligned_sizes=(8,),
+    sub_mesh_shapes={8: (2, 4)},
+    peak_bf16_tflops=197.0,
+    num_hosts=8, host_bounds=(4, 2, 1),
+))
+
+V6E_32 = _register(AcceleratorType(
+    name="v6e-32", generation="v6e", chips_per_host=8, topology=(2, 4),
+    hbm_gib_per_chip=32, aligned_sizes=(8,),
+    sub_mesh_shapes={8: (2, 4)},
+    peak_bf16_tflops=918.0,
+    num_hosts=4, host_bounds=(2, 2, 1),
+))
+
+V5P_64 = _register(AcceleratorType(
+    name="v5p-64", generation="v5p", chips_per_host=4, topology=(2, 2),
+    hbm_gib_per_chip=95, aligned_sizes=(4,),
+    sub_mesh_shapes={4: (2, 2)},
+    peak_bf16_tflops=459.0,
+    num_hosts=8, host_bounds=(2, 2, 2),
+))
+
 
 # JAX device_kind strings -> catalogue generation. The tunneled runtime
 # reports e.g. "TPU v5 lite" (observed) — this is how code holding only a
