@@ -52,6 +52,7 @@ struct Options {
   std::string ca_file;
   std::string bundle_dir = "/etc/tpu-operator/bundle";
   std::string policy;        // TpuStackPolicy name; "" = no policy gating
+  int policy_poll_ms = 2000; // CR-change probe cadence inside the sleep
   int interval_s = 15;
   int stage_timeout_s = 600;
   int poll_ms = 1000;
@@ -365,7 +366,41 @@ class Operator {
       }
       sleep_ms = static_cast<int>(
           sleep_ms * (0.9 + 0.2 * (rand() / double(RAND_MAX))));
-      Sleep(sleep_ms);
+      SleepWatchingPolicy(sleep_ms);
+    }
+  }
+
+  // Sleep up to ms, probing the TpuStackPolicy's metadata.generation every
+  // policy_poll_ms: a day-2 toggle reconciles within seconds instead of
+  // waiting out the interval (or a post-failure backoff). The probe is one
+  // cheap GET; errors fall back to the normal cadence — a flapping
+  // apiserver must not turn the watch into a retry storm.
+  void SleepWatchingPolicy(int ms) {
+    if (opt_.policy.empty() || opt_.policy_poll_ms <= 0) {
+      Sleep(ms);
+      return;
+    }
+    int left = ms;
+    while (left > 0 && !g_stop) {
+      int chunk = std::min(left, opt_.policy_poll_ms);
+      Sleep(chunk);
+      left -= chunk;
+      if (left <= 0 || g_stop) break;
+      kubeclient::Response get = kubeclient::Call(cfg_, "GET", PolicyPath());
+      if (!get.ok()) {
+        if (get.status == 404 && !policy_missing_) break;  // CR deleted
+        continue;
+      }
+      minijson::ValuePtr cr = minijson::Parse(get.body);
+      if (!cr) continue;
+      double gen = cr->PathNumber("metadata.generation", 0);
+      if (policy_missing_ || gen != policy_generation_) {
+        fprintf(stderr,
+                "tpu-operator: policy %s changed (generation %.0f -> %.0f); "
+                "reconciling now\n",
+                opt_.policy.c_str(), policy_generation_, gen);
+        break;
+      }
     }
   }
 
@@ -747,6 +782,10 @@ int main(int argc, char** argv) {
     if (FlagVal(a, "--ca-file", &opt.ca_file)) continue;
     if (FlagVal(a, "--bundle-dir", &opt.bundle_dir)) continue;
     if (FlagVal(a, "--policy", &opt.policy)) continue;
+    if (FlagVal(a, "--policy-poll-ms", &sval)) {
+      opt.policy_poll_ms = atoi(sval.c_str());
+      continue;
+    }
     if (FlagVal(a, "--interval", &sval)) { opt.interval_s = atoi(sval.c_str()); continue; }
     if (FlagVal(a, "--stage-timeout", &sval)) { opt.stage_timeout_s = atoi(sval.c_str()); continue; }
     if (FlagVal(a, "--poll-ms", &sval)) { opt.poll_ms = atoi(sval.c_str()); continue; }
@@ -764,8 +803,8 @@ int main(int argc, char** argv) {
             "tpu-operator: unknown flag %s\n"
             "usage: tpu-operator [--apiserver=URL] [--token-file=F] "
             "[--ca-file=F]\n"
-            "  [--bundle-dir=DIR] [--policy=NAME] [--interval=SECS] "
-            "[--stage-timeout=SECS]\n"
+            "  [--bundle-dir=DIR] [--policy=NAME] [--policy-poll-ms=MS]\n"
+            "  [--interval=SECS] [--stage-timeout=SECS]\n"
             "  [--poll-ms=MS] [--status-port=PORT] [--once]\n"
             "  [--allow-empty-daemonsets] [--insecure-skip-tls-verify]\n",
             a);

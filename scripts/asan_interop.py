@@ -93,18 +93,33 @@ def converge_operator(build: str) -> None:
 
     bundle = tempfile.mkdtemp()
     operator_bundle.write_bundle(specmod.default_spec(), bundle)
-    with FakeApiServer(auto_ready=True) as api:
-        proc = subprocess.run(
-            [os.path.join(build, "tpu-operator"),
-             f"--apiserver={api.url}", f"--bundle-dir={bundle}", "--once",
-             "--poll-ms=20", "--stage-timeout=10", "--status-port=0"],
-            capture_output=True, text=True, timeout=120)
-    check_clean("tpu-operator", proc.stderr)
-    if proc.returncode != 0:
-        print(f"tpu-operator --once failed rc={proc.returncode}:\n"
-              f"{proc.stderr[-2000:]}", file=sys.stderr)
-        raise SystemExit(1)
-    print("tpu-operator --once: clean, converged")
+    policy_path = "/apis/tpu-stack.dev/v1alpha1/tpustackpolicies/default"
+    cr = operator_bundle.policy(specmod.default_spec())
+    cr["metadata"]["generation"] = 1
+    with FakeApiServer(auto_ready=True, store={policy_path: cr}) as api:
+        # two passes under the sanitizers: converge, then a policy toggle
+        # (delete + status write-back paths)
+        for generation, enabled in ((1, True), (2, False)):
+            api.store[policy_path]["spec"]["operands"]["metricsExporter"] \
+                = {"enabled": enabled}
+            api.store[policy_path]["metadata"]["generation"] = generation
+            proc = subprocess.run(
+                [os.path.join(build, "tpu-operator"),
+                 f"--apiserver={api.url}", f"--bundle-dir={bundle}",
+                 "--policy=default", "--once",
+                 "--poll-ms=20", "--stage-timeout=10", "--status-port=0"],
+                capture_output=True, text=True, timeout=120)
+            check_clean("tpu-operator", proc.stderr)
+            if proc.returncode != 0:
+                print(f"tpu-operator --once failed rc={proc.returncode}:\n"
+                      f"{proc.stderr[-2000:]}", file=sys.stderr)
+                raise SystemExit(1)
+        status = api.get(policy_path).get("status", {})
+        if status.get("operands", {}).get("metricsExporter", {}) \
+                .get("enabled") is not False:
+            print("policy toggle not reflected in CR status", file=sys.stderr)
+            raise SystemExit(1)
+    print("tpu-operator --once x2 (policy toggle): clean, converged")
 
 
 def hammer_exporter(build: str) -> None:

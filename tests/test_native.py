@@ -718,3 +718,55 @@ def test_allocate_v5p64_three_axis_host_bounds(native_build, tmp_path):
         c.close()
         proc.terminate()
         proc.wait(timeout=5)
+
+
+def test_tpud_survives_hostile_socket_clients(native_build, tmp_path):
+    """Garbage bytes on the plugin's unix socket (a confused prober, a
+    half-dead kubelet, port-scanner noise) must not take the daemon down
+    or wedge it: a real gRPC client works before, during, and after."""
+    import socket as socketmod
+
+    from tpu_cluster.plugin_api.client import DevicePluginClient
+
+    proc, sock = start_tpud(native_build, tmp_path, "--fake-devices=8",
+                            "--no-register")
+    try:
+        c = DevicePluginClient(sock)
+        assert len(next(c.list_and_watch()).devices) == 8
+        c.close()
+
+        payloads = [
+            b"\x00" * 512,                      # nulls
+            b"GET / HTTP/1.1\r\nHost: x\r\n\r\n",  # HTTP/1.1 to an h2 port
+            b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n" + b"\xff" * 256,  # bad frame
+            bytes(range(256)) * 4,              # every byte value
+        ]
+        for payload in payloads:
+            with socketmod.socket(socketmod.AF_UNIX,
+                                  socketmod.SOCK_STREAM) as s:
+                s.settimeout(2)
+                s.connect(sock)
+                s.sendall(payload)
+                try:  # server may RST or reply (GOAWAY) — both fine
+                    s.recv(4096)
+                except OSError:
+                    pass
+            assert proc.poll() is None, "tpud died on hostile bytes"
+
+        # an abruptly-abandoned half-open connection must not wedge the
+        # poll loop either
+        s = socketmod.socket(socketmod.AF_UNIX, socketmod.SOCK_STREAM)
+        s.connect(sock)
+        s.sendall(b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n")  # preface, then silence
+
+        c = DevicePluginClient(sock)
+        assert len(next(c.list_and_watch()).devices) == 8
+        resp = c.allocate([f"tpu-{i}" for i in range(8)])
+        assert resp.container_responses[0].envs["TPU_ACCELERATOR_TYPE"] \
+            == "v5e-8"
+        c.close()
+        s.close()
+        assert proc.poll() is None
+    finally:
+        proc.terminate()
+        proc.wait(timeout=5)

@@ -726,3 +726,34 @@ def test_policy_status_honest_on_failed_pass(native_build, bundle_dir):
         assert st["phase"] == "Progressing"
         assert st["observedGeneration"] == 2
         assert st["operands"]["metricsExporter"]["enabled"] is False
+
+
+def test_policy_toggle_reconciled_within_poll_window(native_build,
+                                                     bundle_dir):
+    """A live CR edit must not wait out the reconcile interval: the sleep
+    probes the policy's generation (--policy-poll-ms) and cuts itself
+    short, so a day-2 toggle lands within seconds even with a long
+    --interval."""
+    with FakeApiServer(auto_ready=True,
+                       store={POLICY_PATH: seeded_policy()}) as api:
+        op = start_operator(
+            native_build, f"--apiserver={api.url}",
+            f"--bundle-dir={bundle_dir}", "--policy=default",
+            "--interval=120", "--policy-poll-ms=100", "--poll-ms=20",
+            "--stage-timeout=10", "--status-port=0")
+        try:
+            exporter_ds = f"{DS}/tpu-metrics-exporter"
+            assert wait_until(lambda: api.get(exporter_ds) is not None)
+            # the operator is now asleep for ~120s; edit the CR
+            api.store[POLICY_PATH]["spec"]["operands"]["metricsExporter"] \
+                = {"enabled": False}
+            api.store[POLICY_PATH]["metadata"]["generation"] = 2
+            # well under the 120s interval: the generation probe fires
+            assert wait_until(lambda: api.get(exporter_ds) is None,
+                              timeout=20), \
+                "toggle was not reconciled within the poll window"
+            st = api.get(POLICY_PATH)["status"]
+            assert st["observedGeneration"] == 2
+        finally:
+            op.send_signal(signal.SIGTERM)
+            op.wait(timeout=10)
