@@ -30,6 +30,29 @@ const std::map<std::string, std::string>& Plurals() {
   return *m;
 }
 
+// kind -> apiVersion for every kind in Plurals(). A kind present in one
+// table but not the other is a maintenance bug, caught by the selftest.
+const std::map<std::string, std::string>& ApiVersions() {
+  static const auto* m = new std::map<std::string, std::string>{
+      {"Namespace", "v1"},
+      {"ConfigMap", "v1"},
+      {"Secret", "v1"},
+      {"Service", "v1"},
+      {"ServiceAccount", "v1"},
+      {"Pod", "v1"},
+      {"Event", "v1"},
+      {"DaemonSet", "apps/v1"},
+      {"Deployment", "apps/v1"},
+      {"StatefulSet", "apps/v1"},
+      {"Job", "batch/v1"},
+      {"ClusterRole", "rbac.authorization.k8s.io/v1"},
+      {"ClusterRoleBinding", "rbac.authorization.k8s.io/v1"},
+      {"Role", "rbac.authorization.k8s.io/v1"},
+      {"RoleBinding", "rbac.authorization.k8s.io/v1"},
+  };
+  return *m;
+}
+
 }  // namespace
 
 bool IsClusterScoped(const std::string& kind) {
@@ -65,6 +88,27 @@ std::string ObjectPath(const minijson::Value& obj, std::string* err) {
     return "";
   }
   return coll + "/" + name;
+}
+
+std::vector<std::string> SweepCollections(const std::string& ns) {
+  std::vector<std::string> out;
+  for (const auto& kv : Plurals()) {
+    const std::string& kind = kv.first;
+    // kinds a bundle never carries the operand label on: the Namespace
+    // itself, Events, and Pods (labels sit on controllers, not their pods)
+    if (kind == "Namespace" || kind == "Event" || kind == "Pod") continue;
+    auto av = ApiVersions().find(kind);
+    if (av == ApiVersions().end()) continue;  // selftest pins full coverage
+    std::string prefix = av->second.find('/') == std::string::npos
+                             ? "/api/" + av->second
+                             : "/apis/" + av->second;
+    if (IsClusterScoped(kind)) {
+      out.push_back(prefix + "/" + kv.second);
+    } else if (!ns.empty()) {
+      out.push_back(prefix + "/namespaces/" + ns + "/" + kv.second);
+    }
+  }
+  return out;
 }
 
 bool IsReady(const minijson::Value& obj) {

@@ -6,6 +6,7 @@
 #define TPU_NATIVE_OPERATOR_KUBEAPI_H_
 
 #include <string>
+#include <vector>
 
 #include "minijson.h"
 
@@ -28,6 +29,13 @@ bool IsReady(const minijson::Value& obj);
 
 // True for kinds with no namespace segment (Namespace, ClusterRole, ...).
 bool IsClusterScoped(const std::string& kind);
+
+// Collection paths of every kind the operator can manage (the Plurals
+// table), for the stale-object prune sweep — derived from the same table
+// as path construction so the two cannot drift. Excludes kinds a bundle
+// never labels (Namespace, Event, Pod). Namespaced collections are
+// omitted when ns is empty.
+std::vector<std::string> SweepCollections(const std::string& ns);
 
 }  // namespace kubeapi
 

@@ -33,6 +33,7 @@
 
 #include <algorithm>
 #include <map>
+#include <set>
 #include <string>
 #include <vector>
 
@@ -328,7 +329,43 @@ class Operator {
       }
       i = stage_end;
     }
+    if (!g_stop) PruneStaleOperandObjects();
     return !g_stop;
+  }
+
+  // Garbage-collect operand objects a re-rendered bundle no longer
+  // contains. The operand label marks exactly the bundle-managed set, so a
+  // labeled live object absent from the bundle was dropped by an upgrade —
+  // without this sweep it would leak forever (apply/patch only ever adds).
+  // Runs only after a fully-converged pass; policy-disabled objects are
+  // still IN the bundle, so the policy gate (not this sweep) owns them.
+  void PruneStaleOperandObjects() {
+    std::string ns, err;
+    std::set<std::string> keep;
+    for (const auto& bo : bundle_) {
+      if (ns.empty()) ns = bo.obj->PathString("metadata.namespace");
+      std::string coll = kubeapi::CollectionPath(*bo.obj, &err);
+      if (!coll.empty())
+        keep.insert(coll + "/" + bo.obj->PathString("metadata.name"));
+    }
+    for (const auto& coll : kubeapi::SweepCollections(ns)) {
+      kubeclient::Response list = kubeclient::Call(
+          cfg_, "GET", coll + "?labelSelector=" + kOperandLabel);
+      if (!list.ok()) continue;  // 404: nothing of this kind exists
+      minijson::ValuePtr doc = minijson::Parse(list.body);
+      minijson::ValuePtr items = doc ? doc->Get("items") : nullptr;
+      if (!items || !items->is_array()) continue;
+      for (const auto& item : items->elements()) {
+        std::string name = item->PathString("metadata.name");
+        if (name.empty() || keep.count(coll + "/" + name)) continue;
+        kubeclient::Response del =
+            kubeclient::Call(cfg_, "DELETE", coll + "/" + name);
+        fprintf(stderr,
+                "tpu-operator: pruned stale operand object %s/%s (no "
+                "longer in bundle)%s\n", coll.c_str(), name.c_str(),
+                del.ok() || del.status == 404 ? "" : " [delete failed]");
+      }
+    }
   }
 
   void RunForever() {

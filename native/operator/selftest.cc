@@ -95,6 +95,28 @@ static void TestPaths() {
   CHECK(kubeapi::CollectionPath(*bogus, &err).empty() && !err.empty());
 }
 
+static void TestSweepCollections() {
+  // Every managed kind (Plurals) except the never-labeled three must be
+  // swept — a kind added to one table but not the other is the drift this
+  // pin exists to catch. Count: 15 kinds - Namespace/Event/Pod = 12.
+  auto colls = kubeapi::SweepCollections("tpu-system");
+  CHECK(colls.size() == 12);
+  auto has = [&](const char* want) {
+    for (const auto& c : colls)
+      if (c == want) return true;
+    return false;
+  };
+  CHECK(has("/apis/apps/v1/namespaces/tpu-system/daemonsets"));
+  CHECK(has("/apis/apps/v1/namespaces/tpu-system/statefulsets"));
+  CHECK(has("/api/v1/namespaces/tpu-system/secrets"));
+  CHECK(has("/apis/batch/v1/namespaces/tpu-system/jobs"));
+  CHECK(has("/apis/rbac.authorization.k8s.io/v1/clusterroles"));
+  CHECK(has("/apis/rbac.authorization.k8s.io/v1/namespaces/tpu-system/"
+            "roles"));
+  // empty namespace: only the cluster-scoped collections remain
+  CHECK(kubeapi::SweepCollections("").size() == 2);
+}
+
 static void TestReadiness() {
   CHECK(!kubeapi::IsReady(*Obj(
       "{\"kind\": \"DaemonSet\", \"status\": {}}")));
@@ -158,6 +180,7 @@ int main() {
   TestJsonRoundtrip();
   TestJsonErrors();
   TestPaths();
+  TestSweepCollections();
   TestReadiness();
   if (g_failures) {
     fprintf(stderr, "operator_selftest: %d FAILURES\n", g_failures);

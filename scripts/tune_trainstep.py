@@ -73,6 +73,11 @@ VARIANTS = {
     "ff128k_b4": replace(BASE, d_ff=131072, batch=4),
     "ff128k_b8": replace(BASE, d_ff=131072, batch=8),
     "ff64k_s1k_b4": replace(BASE, d_ff=65536, seq=1024, batch=4),
+    # past the f131072 winner: twice the width again, and more tokens at
+    # the winning width
+    "ff128k_b16": replace(BASE, d_ff=131072, batch=8 * 2),
+    "ff256k_b4": replace(BASE, d_ff=262144, batch=4),
+    "ff256k_b8": replace(BASE, d_ff=262144, batch=8),
 }
 
 

@@ -65,6 +65,25 @@ def ready_status(obj: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     return None
 
 
+def _filter_selector(items, query: str):
+    """Apply a ?labelSelector= from a collection GET: exact `k=v` matches
+    and bare-key existence (`k`), comma-separated."""
+    from urllib.parse import parse_qs
+
+    sel = parse_qs(query).get("labelSelector", [""])[0]
+    if not sel:
+        return items
+    terms = [t.split("=", 1) if "=" in t else [t, None]
+             for t in sel.split(",") if t]
+    out = []
+    for obj in items:
+        labels = obj.get("metadata", {}).get("labels", {})
+        if all(labels.get(k) == v if v is not None else k in labels
+               for k, v in terms):
+            out.append(obj)
+    return out
+
+
 def make_self_signed(tmp_dir) -> Tuple[str, str]:
     """Generate a 127.0.0.1 self-signed cert+key pair for TLS-mode tests."""
     import subprocess
@@ -135,11 +154,24 @@ class FakeApiServer:
 
             def do_GET(self):
                 self._record()
+                path, _, query = self.path.partition("?")
                 with fake._lock:
-                    obj = fake.store.get(self.path)
-                    if self.path in fake.ghost_get_404:
+                    obj = fake.store.get(path)
+                    if path in fake.ghost_get_404:
                         obj = None  # stale read: stored but reported absent
-                        fake.ghost_get_404.discard(self.path)
+                        fake.ghost_get_404.discard(path)
+                    if obj is None:
+                        # collection GET: list stored objects one level
+                        # under the path, honoring ?labelSelector=k=v (the
+                        # operator's prune sweep uses this)
+                        prefix = path.rstrip("/") + "/"
+                        items = [o for p, o in fake.store.items()
+                                 if p.startswith(prefix)
+                                 and "/" not in p[len(prefix):]]
+                        if items or any(p.startswith(prefix)
+                                        for p in fake.store):
+                            obj = {"kind": "List",
+                                   "items": _filter_selector(items, query)}
                 if obj is None:
                     self._reply(404, {"kind": "Status", "code": 404})
                 else:

@@ -752,8 +752,50 @@ def test_policy_toggle_reconciled_within_poll_window(native_build,
             assert wait_until(lambda: api.get(exporter_ds) is None,
                               timeout=20), \
                 "toggle was not reconciled within the poll window"
-            st = api.get(POLICY_PATH)["status"]
-            assert st["observedGeneration"] == 2
+            # the DS deletion lands mid-pass; the status write-back comes
+            # after the stage gate + prune sweep — wait for it too
+            assert wait_until(
+                lambda: (api.get(POLICY_PATH).get("status") or {})
+                .get("observedGeneration") == 2, timeout=20)
         finally:
             op.send_signal(signal.SIGTERM)
             op.wait(timeout=10)
+
+
+def test_upgrade_prunes_objects_dropped_from_bundle(native_build,
+                                                    bundle_dir):
+    """A re-rendered bundle that DROPS an object must garbage-collect the
+    live one (apply/patch only ever adds): the operand label marks the
+    bundle-managed set, so the post-convergence sweep deletes labeled
+    objects no longer in the bundle — and nothing else."""
+    with FakeApiServer(auto_ready=True) as api:
+        base = [f"--apiserver={api.url}", f"--bundle-dir={bundle_dir}",
+                "--once", "--poll-ms=20", "--stage-timeout=10",
+                "--status-port=0"]
+        p1 = run_operator(native_build, *base)
+        assert p1.returncode == 0, p1.stderr
+        nse = f"{DS}/tpu-node-status-exporter"
+        svc = f"/api/v1/namespaces/{NS}/services/tpu-metrics-exporter"
+        assert api.get(nse) is not None and api.get(svc) is not None
+
+        # the upgrade: node-status-exporter leaves the rendered bundle
+        dropped = [f for f in os.listdir(bundle_dir)
+                   if "node-status-exporter" in f]
+        assert dropped
+        for f in dropped:
+            os.remove(os.path.join(bundle_dir, f))
+        p2 = run_operator(native_build, *base)
+        assert p2.returncode == 0, p2.stderr
+        assert "pruned stale operand object" in p2.stderr
+        assert api.get(nse) is None, "dropped object was not pruned"
+        # everything still in the bundle survives the sweep
+        assert api.get(svc) is not None
+        assert api.get(f"{DS}/tpu-device-plugin") is not None
+        # un-labeled bystanders in the namespace are never touched
+        bystander = f"/api/v1/namespaces/{NS}/services/user-svc"
+        api.store[bystander] = {"apiVersion": "v1", "kind": "Service",
+                                "metadata": {"name": "user-svc",
+                                             "namespace": NS}}
+        p3 = run_operator(native_build, *base)
+        assert p3.returncode == 0, p3.stderr
+        assert api.get(bystander) is not None

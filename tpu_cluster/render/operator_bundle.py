@@ -194,9 +194,9 @@ def rbac(spec: ClusterSpec) -> List[Dict[str, Any]]:
                      "labels": dict(meta["labels"])},
         "rules": [
             {"apiGroups": ["", "apps", "batch"],
-             "resources": ["namespaces", "configmaps", "services",
+             "resources": ["namespaces", "configmaps", "secrets", "services",
                            "serviceaccounts", "daemonsets", "deployments",
-                           "jobs", "pods"],
+                           "statefulsets", "jobs", "pods"],
              "verbs": ["get", "list", "watch", "create", "patch", "delete"]},
             # The bundle's feature-discovery stage contains its own
             # ClusterRole/Binding, so the operator must manage RBAC objects...

@@ -240,6 +240,11 @@ def bench_config() -> BurninConfig:
       d2048/f131072/h16/b8/s512 (this config) .... 0.91-0.92 (three
          back-to-back reruns: 0.917/0.910/0.916 — the ~87ms steps are
          long enough that tunnel noise stops mattering)
+      d2048/f262144 probes ....................... 0.933 (b8) / 0.944 (b4)
+         — the widen direction keeps paying past this config, but at a
+         128x FFN:model ratio the step is a matmul benchmark wearing a
+         transformer costume; the bench stays at the 64x shape and the
+         raw-matmul MFU (0.98) already documents the pure-compute peak
 
     Component ablations at this config (fwd+bwd, ms/step): attention chain
     ~4 (stock pallas flash kernel measured 3.5x slower than the XLA chain
