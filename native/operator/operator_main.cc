@@ -28,6 +28,7 @@
 #include <stdlib.h>
 #include <string.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
 #include <time.h>
 #include <unistd.h>
 
@@ -403,26 +404,60 @@ class Operator {
       }
       sleep_ms = static_cast<int>(
           sleep_ms * (0.9 + 0.2 * (rand() / double(RAND_MAX))));
-      SleepWatchingPolicy(sleep_ms);
+      SleepWatchingInputs(sleep_ms);
     }
   }
 
-  // Sleep up to ms, probing the TpuStackPolicy's metadata.generation every
-  // policy_poll_ms: a day-2 toggle reconciles within seconds instead of
-  // waiting out the interval (or a post-failure backoff). The probe is one
-  // cheap GET; errors fall back to the normal cadence — a flapping
-  // apiserver must not turn the watch into a retry storm.
-  void SleepWatchingPolicy(int ms) {
-    if (opt_.policy.empty() || opt_.policy_poll_ms <= 0) {
+  // Fingerprint of the bundle dir (names + sizes + mtimes): kubelet
+  // rewrites the mounted ConfigMap atomically, so any re-render moves it.
+  std::string BundleFingerprint() const {
+    DIR* d = opendir(opt_.bundle_dir.c_str());
+    if (!d) return "";
+    std::vector<std::string> parts;
+    struct dirent* ent;
+    while ((ent = readdir(d)) != nullptr) {
+      std::string name = ent->d_name;
+      if (name == "." || name == "..") continue;
+      struct stat st;
+      std::string full = opt_.bundle_dir + "/" + name;
+      if (stat(full.c_str(), &st) != 0) continue;
+      parts.push_back(name + ":" + std::to_string(st.st_size) + ":" +
+                      std::to_string(st.st_mtime));
+    }
+    closedir(d);
+    std::sort(parts.begin(), parts.end());
+    std::string out;
+    for (const auto& p : parts) out += p + "\n";
+    return out;
+  }
+
+  // Sleep up to ms, probing for input changes every policy_poll_ms so a
+  // day-2 edit reconciles within seconds instead of waiting out the
+  // interval (or a post-failure backoff):
+  //  - the TpuStackPolicy's metadata.generation (one cheap GET; errors
+  //    fall back to the normal cadence — a flapping apiserver must not
+  //    turn the watch into a retry storm),
+  //  - the bundle dir's fingerprint (local stats; a re-rendered ConfigMap
+  //    rolls out as soon as kubelet projects it).
+  void SleepWatchingInputs(int ms) {
+    if (opt_.policy_poll_ms <= 0) {
       Sleep(ms);
       return;
     }
+    std::string bundle_fp = BundleFingerprint();
     int left = ms;
     while (left > 0 && !g_stop) {
       int chunk = std::min(left, opt_.policy_poll_ms);
       Sleep(chunk);
       left -= chunk;
       if (left <= 0 || g_stop) break;
+      std::string fp = BundleFingerprint();
+      if (!fp.empty() && fp != bundle_fp) {
+        fprintf(stderr,
+                "tpu-operator: bundle changed on disk; reconciling now\n");
+        break;
+      }
+      if (opt_.policy.empty()) continue;
       kubeclient::Response get = kubeclient::Call(cfg_, "GET", PolicyPath());
       if (!get.ok()) {
         if (get.status == 404 && !policy_missing_) break;  // CR deleted

@@ -799,3 +799,37 @@ def test_upgrade_prunes_objects_dropped_from_bundle(native_build,
         p3 = run_operator(native_build, *base)
         assert p3.returncode == 0, p3.stderr
         assert api.get(bystander) is not None
+
+
+def test_bundle_edit_reconciled_within_poll_window(native_build, bundle_dir):
+    """A re-rendered bundle (kubelet projecting an updated ConfigMap) must
+    roll out within the input-probe window, not wait out a long interval:
+    the sleep fingerprints the bundle dir and cuts itself short."""
+    with FakeApiServer(auto_ready=True) as api:
+        op = start_operator(
+            native_build, f"--apiserver={api.url}",
+            f"--bundle-dir={bundle_dir}", "--interval=120",
+            "--policy-poll-ms=100", "--poll-ms=20", "--stage-timeout=10",
+            "--status-port=0")
+        try:
+            ds = f"{DS}/tpu-device-plugin"
+            assert wait_until(lambda: api.get(ds) is not None)
+            # the operator sleeps ~120s; ship a new image via the bundle
+            path = os.path.join(bundle_dir,
+                                [f for f in os.listdir(bundle_dir)
+                                 if "device-plugin" in f][0])
+            doc = json.loads(open(path).read())
+            doc["spec"]["template"]["spec"]["containers"][0]["image"] = \
+                "tpu-stack:v9"
+            with open(path, "w") as f:
+                f.write(json.dumps(doc))
+
+            def image():
+                live = api.get(ds)
+                return (live or {}).get("spec", {}).get("template", {}) \
+                    .get("spec", {}).get("containers", [{}])[0].get("image")
+            assert wait_until(lambda: image() == "tpu-stack:v9", timeout=20), \
+                "bundle edit was not reconciled within the poll window"
+        finally:
+            op.send_signal(signal.SIGTERM)
+            op.wait(timeout=10)
