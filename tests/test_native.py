@@ -316,6 +316,7 @@ def test_tpu_info_runtime_metrics(native_build, tmp_path):
     pydev.make_fake_tree(str(tmp_path), 2)
     mf = tmp_path / "metrics.prom"
     mf.write_text('tpu_duty_cycle_percent{chip="0"} 37.5\n'
+                  'tpu_tensorcore_utilization_percent{chip="0"} 81.6\n'
                   'tpu_hbm_used_bytes{chip="1"} 1073741824\n')
     out = subprocess.run(
         [binpath(native_build, "tpu-info"), f"--devfs-root={tmp_path}",
@@ -323,7 +324,9 @@ def test_tpu_info_runtime_metrics(native_build, tmp_path):
         check=True, capture_output=True, text=True)
     doc = json.loads(out.stdout)
     assert doc["chips"][0]["duty_cycle_percent"] == 37.5
+    assert doc["chips"][0]["tensorcore_utilization_percent"] == 81.6
     assert doc["chips"][1]["hbm_used_bytes"] == 1073741824
+    assert "tensorcore_utilization_percent" not in doc["chips"][1]
 
 
 # ---------------------------------------------------------------- exporter
