@@ -69,6 +69,7 @@ struct Options {
 // belong to, and the live TpuStackPolicy CR decides which operands run.
 // Must match tpu_cluster/render/operator_bundle.py.
 const char kOperandLabel[] = "tpu-stack.dev/operand";
+const char kDefaultEnabledAnnotation[] = "tpu-stack.dev/default-enabled";
 const char kPolicyPathPrefix[] =
     "/apis/tpu-stack.dev/v1alpha1/tpustackpolicies/";
 
@@ -76,6 +77,9 @@ struct BundleObject {
   std::string file;
   std::string stage;
   std::string operand;  // kOperandLabel value; "" = not operand-gated
+  // install-time intent (kDefaultEnabledAnnotation): what gating falls
+  // back to when no policy CR is available
+  bool default_enabled = true;
   minijson::ValuePtr obj;
   // reconcile state (refreshed every pass)
   bool applied = false;
@@ -129,6 +133,11 @@ bool LoadBundle(const std::string& dir, std::vector<BundleObject>* out,
     minijson::ValuePtr labels = meta ? meta->Get("labels") : nullptr;
     minijson::ValuePtr operand = labels ? labels->Get(kOperandLabel) : nullptr;
     if (operand && operand->is_string()) bo.operand = operand->as_string();
+    minijson::ValuePtr anns = meta ? meta->Get("annotations") : nullptr;
+    minijson::ValuePtr dflt =
+        anns ? anns->Get(kDefaultEnabledAnnotation) : nullptr;
+    if (dflt && dflt->is_string() && dflt->as_string() == "false")
+      bo.default_enabled = false;
     out->push_back(std::move(bo));
   }
   return true;
@@ -238,6 +247,10 @@ class Operator {
       : opt_(opt), cfg_(std::move(cfg)) {}
 
   bool LoadOrReloadBundle() {
+    // Baseline the fingerprint BEFORE reading the bundle: a re-render
+    // landing mid-pass then differs from the baseline and triggers an
+    // immediate next pass instead of being absorbed silently.
+    pass_bundle_fp_ = BundleFingerprint();
     std::string err;
     if (!LoadBundle(opt_.bundle_dir, &bundle_, &err)) {
       fprintf(stderr, "tpu-operator: %s\n", err.c_str());
@@ -277,7 +290,8 @@ class Operator {
       // analog — `--set metricsExporter.enabled=false` rolls the operand
       // out of the cluster, reference README.md:104-110)
       for (size_t j = i; j < stage_end; ++j) {
-        if (!OperandEnabled(bundle_[j].operand)) {
+        if (!OperandEnabled(bundle_[j].operand,
+                            bundle_[j].default_enabled)) {
           if (!DeleteDisabled(&bundle_[j])) {
             fprintf(stderr,
                     "tpu-operator: stage %s: delete disabled %s failed: %s\n",
@@ -341,6 +355,13 @@ class Operator {
   // Runs only after a fully-converged pass; policy-disabled objects are
   // still IN the bundle, so the policy gate (not this sweep) owns them.
   void PruneStaleOperandObjects() {
+    // Stale objects can only appear when the bundle's content changed:
+    // sweep on the first converged pass and after any bundle change, not
+    // on every steady-state pass (12 LISTs/pass across a fleet is pure
+    // apiserver load otherwise).
+    if (!last_pruned_fp_.empty() && last_pruned_fp_ == pass_bundle_fp_)
+      return;
+    bool all_ok = true;
     std::string ns, err;
     std::set<std::string> keep;
     for (const auto& bo : bundle_) {
@@ -361,12 +382,16 @@ class Operator {
         if (name.empty() || keep.count(coll + "/" + name)) continue;
         kubeclient::Response del =
             kubeclient::Call(cfg_, "DELETE", coll + "/" + name);
+        bool deleted = del.ok() || del.status == 404;
+        if (!deleted) all_ok = false;
         fprintf(stderr,
                 "tpu-operator: pruned stale operand object %s/%s (no "
                 "longer in bundle)%s\n", coll.c_str(), name.c_str(),
-                del.ok() || del.status == 404 ? "" : " [delete failed]");
+                deleted ? "" : " [delete failed]");
       }
     }
+    // a failed delete keeps the sweep armed for the next pass
+    if (all_ok) last_pruned_fp_ = pass_bundle_fp_;
   }
 
   void RunForever() {
@@ -377,6 +402,8 @@ class Operator {
       // (a stale snapshot would merge-PATCH the upgrade away as "drift").
       std::vector<BundleObject> fresh;
       std::string err;
+      pass_bundle_fp_ = BundleFingerprint();  // before the read, see
+                                              // LoadOrReloadBundle
       if (LoadBundle(opt_.bundle_dir, &fresh, &err)) {
         bundle_ = std::move(fresh);
       } else {
@@ -444,7 +471,10 @@ class Operator {
       Sleep(ms);
       return;
     }
-    std::string bundle_fp = BundleFingerprint();
+    // Baseline = the fingerprint captured at PASS START (not now): a
+    // re-render that landed mid-pass wasn't reconciled by the pass that
+    // just finished and must cut this sleep short immediately.
+    const std::string& bundle_fp = pass_bundle_fp_;
     int left = ms;
     while (left > 0 && !g_stop) {
       int chunk = std::min(left, opt_.policy_poll_ms);
@@ -595,14 +625,21 @@ class Operator {
     }
   }
 
-  bool OperandEnabled(const std::string& operand) const {
+  // Gating: the live policy wins; without one (CR deleted, no --policy,
+  // or an operand key the CR doesn't mention) the object's install-time
+  // default applies — fail-open reverts to the installed state and never
+  // deploys a spec-disabled operand.
+  bool OperandEnabled(const std::string& operand,
+                      bool default_enabled) const {
     if (operand.empty()) return true;  // un-gated (the namespace itself)
     auto it = policy_enabled_.find(operand);
-    return it == policy_enabled_.end() ? true : it->second;
+    return it == policy_enabled_.end() ? default_enabled : it->second;
   }
 
   // Remove a policy-disabled operand object from the cluster. Idempotent:
-  // already-absent is success; only an actual removal is logged.
+  // already-absent is success. Probes with a GET first so the steady state
+  // (object long gone) costs a read, not a DELETE landing in the audit log
+  // every pass; only an actual removal is logged.
   bool DeleteDisabled(BundleObject* bo) {
     bo->disabled = true;
     std::string err;
@@ -611,14 +648,19 @@ class Operator {
       bo->error = err;
       return false;
     }
+    kubeclient::Response get = kubeclient::Call(cfg_, "GET", obj_path);
+    if (get.status == 404) return true;
+    if (!get.ok()) {
+      bo->error = "GET " + obj_path + " -> " + std::to_string(get.status) +
+                  " " + (get.status ? get.body.substr(0, 160) : get.error);
+      return false;
+    }
     kubeclient::Response del = kubeclient::Call(cfg_, "DELETE", obj_path);
-    if (del.ok()) {
-      fprintf(stderr, "tpu-operator: operand %s disabled by policy %s: "
-              "deleted %s\n", bo->operand.c_str(), opt_.policy.c_str(),
-              bo->file.c_str());
+    if (del.ok() || del.status == 404) {
+      fprintf(stderr, "tpu-operator: operand %s disabled by policy: "
+              "deleted %s\n", bo->operand.c_str(), bo->file.c_str());
       return true;
     }
-    if (del.status == 404) return true;
     bo->error = "DELETE " + obj_path + " -> " + std::to_string(del.status) +
                 " " + (del.status ? del.body.substr(0, 160) : del.error);
     return false;
@@ -630,7 +672,8 @@ class Operator {
   void WritePolicyStatus(bool pass_ok) {
     if (opt_.policy.empty() || !policy_seen_ || policy_missing_) return;
     using minijson::Value;
-    struct Agg { int total = 0, applied = 0, ready = 0; };
+    struct Agg { int total = 0, applied = 0, ready = 0;
+                 bool default_enabled = true; };
     std::map<std::string, Agg> per;
     int want = 0, have = 0;
     for (const auto& bo : bundle_) {
@@ -639,10 +682,11 @@ class Operator {
       ++a.total;
       a.applied += bo.applied;
       a.ready += bo.ready;
+      a.default_enabled = bo.default_enabled;
       // "enabled" reports the FETCHED policy, not this pass's deletion
       // progress — a pass that fails before reaching a disabled operand's
       // stage must not report the toggle as un-honored
-      if (OperandEnabled(bo.operand)) {
+      if (OperandEnabled(bo.operand, bo.default_enabled)) {
         ++want;
         have += bo.ready;
       }
@@ -650,7 +694,7 @@ class Operator {
     auto ops = Value::MakeObject();
     for (const auto& kv : per) {
       const Agg& a = kv.second;
-      bool enabled = OperandEnabled(kv.first);
+      bool enabled = OperandEnabled(kv.first, kv.second.default_enabled);
       auto o = Value::MakeObject();
       o->Set("enabled", std::make_shared<Value>(enabled));
       o->Set("applied", std::make_shared<Value>(a.applied == a.total));
@@ -826,6 +870,9 @@ class Operator {
   int passes_ = 0;
   int event_seq_ = 0;
   bool healthy_ = false;
+  // bundle-change tracking (input probe + prune gating)
+  std::string pass_bundle_fp_;   // fingerprint at the current pass's start
+  std::string last_pruned_fp_;   // fingerprint the last prune sweep covered
   // policy state (see FetchPolicy for the fail-open/stale semantics)
   std::map<std::string, bool> policy_enabled_;
   double policy_generation_ = 0;

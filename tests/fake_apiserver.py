@@ -45,6 +45,16 @@ def merge_patch(target: Any, patch: Any) -> Any:
 # bump generation exactly like the workload kinds.
 GENERATION_KINDS = ("DaemonSet", "Deployment", "TpuStackPolicy")
 
+# Path segments treated as collections for list-style GETs (mirrors the
+# plurals the clients construct paths from). A GET whose last segment is
+# anything else is an object GET and 404s when absent.
+COLLECTION_SEGMENTS = frozenset({
+    "namespaces", "configmaps", "secrets", "services", "serviceaccounts",
+    "pods", "events", "daemonsets", "deployments", "statefulsets", "jobs",
+    "clusterroles", "clusterrolebindings", "roles", "rolebindings",
+    "customresourcedefinitions", "tpustackpolicies", "nodes",
+})
+
 
 def ready_status(obj: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     kind = obj.get("kind")
@@ -160,18 +170,20 @@ class FakeApiServer:
                     if path in fake.ghost_get_404:
                         obj = None  # stale read: stored but reported absent
                         fake.ghost_get_404.discard(path)
-                    if obj is None:
+                    if obj is None and \
+                            path.rsplit("/", 1)[-1] in COLLECTION_SEGMENTS:
                         # collection GET: list stored objects one level
                         # under the path, honoring ?labelSelector=k=v (the
-                        # operator's prune sweep uses this)
+                        # operator's prune sweep uses this). Gated on known
+                        # plural segments so a GET of an absent OBJECT
+                        # (e.g. a parent whose seeded "<path>/status" key
+                        # exists) still 404s like a real apiserver.
                         prefix = path.rstrip("/") + "/"
                         items = [o for p, o in fake.store.items()
                                  if p.startswith(prefix)
                                  and "/" not in p[len(prefix):]]
-                        if items or any(p.startswith(prefix)
-                                        for p in fake.store):
-                            obj = {"kind": "List",
-                                   "items": _filter_selector(items, query)}
+                        obj = {"kind": "List",
+                               "items": _filter_selector(items, query)}
                 if obj is None:
                     self._reply(404, {"kind": "Status", "code": 404})
                 else:

@@ -833,3 +833,34 @@ def test_bundle_edit_reconciled_within_poll_window(native_build, bundle_dir):
         finally:
             op.send_signal(signal.SIGTERM)
             op.wait(timeout=10)
+
+
+def test_fail_open_respects_install_time_defaults(native_build, tmp_path):
+    """A deleted CR — or an operator running without --policy at all — must
+    NOT deploy operands the spec disabled at install time: bundle objects
+    carry the default-enabled annotation and gating falls back to it
+    (fail-open means 'revert to the installed state', not 'everything
+    on'). A live CR still wins over the install default."""
+    d = tmp_path / "b"
+    d.mkdir()
+    spec = specmod.load("tpu: {operands: {metricsExporter: false}}")
+    operator_bundle.write_bundle(spec, str(d))
+    with FakeApiServer(auto_ready=True) as api:
+        for args in (("--policy=default",), ()):
+            proc = run_operator(
+                native_build, f"--apiserver={api.url}",
+                f"--bundle-dir={d}", *args, "--once", "--poll-ms=20",
+                "--stage-timeout=10", "--status-port=0")
+            assert proc.returncode == 0, (args, proc.stderr)
+            assert api.get(f"{DS}/tpu-metrics-exporter") is None, args
+            assert api.get(f"{DS}/tpu-device-plugin") is not None, args
+
+        # day-2 re-enable through a live CR overrides the install default
+        cr = seeded_policy()
+        api.store[POLICY_PATH] = cr
+        proc = run_operator(
+            native_build, f"--apiserver={api.url}",
+            f"--bundle-dir={d}", "--policy=default", "--once",
+            "--poll-ms=20", "--stage-timeout=10", "--status-port=0")
+        assert proc.returncode == 0, proc.stderr
+        assert api.get(f"{DS}/tpu-metrics-exporter") is not None

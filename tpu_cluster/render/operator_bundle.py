@@ -38,6 +38,11 @@ POLICY_KIND = "TpuStackPolicy"
 POLICY_PLURAL = "tpustackpolicies"
 POLICY_NAME = "default"
 OPERAND_LABEL = f"{POLICY_GROUP}/operand"
+# Install-time intent, carried on each operand object: when the CR is
+# absent (deleted, or an operator running without --policy), gating falls
+# back to THIS — fail-open must revert to the installed state, not deploy
+# operands the spec never enabled.
+DEFAULT_ENABLED_ANNOTATION = f"{POLICY_GROUP}/default-enabled"
 
 
 def _fname(stage: str, obj: Dict[str, Any]) -> str:
@@ -74,9 +79,14 @@ def bundle_files(spec: ClusterSpec) -> Dict[str, Dict[str, Any]]:
     for stage, objs in stages:
         for operand, obj in objs:
             if operand is not None:
-                labels = obj.setdefault("metadata", {}).setdefault(
-                    "labels", {})
-                labels[OPERAND_LABEL] = operand
+                meta = obj.setdefault("metadata", {})
+                meta.setdefault("labels", {})[OPERAND_LABEL] = operand
+                if not spec.tpu.operand(operand).enabled:
+                    # annotate install-time intent so CR-less gating does
+                    # NOT deploy a spec-disabled operand (fail-open means
+                    # "revert to installed state", not "everything on")
+                    meta.setdefault("annotations", {})[
+                        DEFAULT_ENABLED_ANNOTATION] = "false"
             out[_fname(stage, obj)] = obj
     return out
 
