@@ -370,3 +370,53 @@ def test_operator_install_kubectl_fails_if_crd_never_established(spec):
         kubeapply.apply_groups_kubectl(
             operator_bundle.operator_install_groups(spec), wait=False,
             runner=failing_established)
+
+
+def test_cli_delete_removes_everything_reverse_order(spec):
+    """helm uninstall analog: `tpuctl delete` removes the rendered set in
+    reverse apply order — workloads before RBAC, the namespace last —
+    and is idempotent (absent objects don't fail it)."""
+    with FakeApiServer(auto_ready=True) as api:
+        assert run_cli("apply", "--apiserver", api.url, "--poll", "0.05",
+                       "--stage-timeout", "20").returncode == 0
+        assert api.paths("daemonsets/")
+        proc = run_cli("delete", "--apiserver", api.url)
+        assert proc.returncode == 0, proc.stderr
+        leftovers = [p for p in api.paths("")
+                     if "tpu" in p and "/events/" not in p]
+        assert not leftovers, leftovers
+        deletes = [p for m, p in api.log if m == "DELETE"]
+        assert deletes[-1].endswith("/namespaces/tpu-system")
+        # a second delete is a clean no-op
+        assert run_cli("delete", "--apiserver", api.url).returncode == 0
+
+
+def test_cli_delete_operator_set(spec):
+    with FakeApiServer(auto_ready=True) as api:
+        assert run_cli("apply", "--apiserver", api.url, "--operator",
+                       "--poll", "0.05",
+                       "--stage-timeout", "20").returncode == 0
+        assert run_cli("delete", "--apiserver", api.url,
+                       "--operator").returncode == 0
+        assert api.get("/apis/tpu-stack.dev/v1alpha1/tpustackpolicies/"
+                       "default") is None
+        assert api.get("/apis/apiextensions.k8s.io/v1/"
+                       "customresourcedefinitions/"
+                       "tpustackpolicies.tpu-stack.dev") is None
+
+
+def test_delete_groups_kubectl_reverse_and_ignore_not_found(spec):
+    calls = []
+
+    def fake_kubectl(argv, input_text=None):
+        calls.append((list(argv), input_text))
+        return 0, "ok", ""
+
+    kubeapply.delete_groups_kubectl(manifests.rollout_groups(spec),
+                                    runner=fake_kubectl)
+    assert calls
+    assert all(c[0][:3] == ["kubectl", "delete", "--ignore-not-found"]
+               for c in calls)
+    # the namespace rides the LAST invocation (reverse apply order)
+    assert "kind: Namespace" in calls[-1][1]
+    assert "kind: Namespace" not in calls[0][1]

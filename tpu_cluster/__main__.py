@@ -8,6 +8,8 @@ with rendered artifacts and an ordered, readiness-gated apply:
            validation Jobs, operator install, operator bundle
   apply    rollout against the apiserver, gating each group on readiness
            (--operator deploys the in-cluster controller instead)
+  delete   remove everything a spec renders, reverse order
+           (helm uninstall analog, reference README.md kind-script flow)
   verify   the executable acceptance runbook (BASELINE configs)
   triage   the executable troubleshooting runbook
 """
@@ -78,33 +80,49 @@ def cmd_render(args) -> int:
     return 0
 
 
-def cmd_apply(args) -> int:
+def _rest_client(args):
+    """Client for --apiserver mode, or None for the kubectl backend."""
+    if not args.apiserver:
+        return None
+    token = ""
+    if args.token_file:
+        with open(args.token_file, encoding="utf-8") as f:
+            token = f.read().strip()
+    return kubeapply.Client(
+        args.apiserver, token=token, ca_file=args.ca_file,
+        insecure_skip_tls_verify=args.insecure_skip_tls_verify)
+
+
+def _kubectl_mode_flags_ok(args, cmd: str) -> bool:
+    if args.token_file or args.ca_file:
+        print(f"{cmd}: --token-file/--ca-file need --apiserver "
+              "(the kubectl backend authenticates via kubeconfig)",
+              file=sys.stderr)
+        return False
+    return True
+
+
+def _spec_groups(args):
     spec = _load_spec(args.spec)
     if args.operator:
         # two waves: the TpuStackPolicy CR must trail its CRD's
         # establishment (see operator_bundle.operator_install_groups)
-        groups = operator_bundle.operator_install_groups(spec)
-    else:
-        groups = manifests.rollout_groups(spec)
+        return operator_bundle.operator_install_groups(spec)
+    return manifests.rollout_groups(spec)
+
+
+def cmd_apply(args) -> int:
+    groups = _spec_groups(args)
     try:
-        if args.apiserver:
-            token = ""
-            if args.token_file:
-                with open(args.token_file, encoding="utf-8") as f:
-                    token = f.read().strip()
-            client = kubeapply.Client(
-                args.apiserver, token=token, ca_file=args.ca_file,
-                insecure_skip_tls_verify=args.insecure_skip_tls_verify)
+        client = _rest_client(args)
+        if client is not None:
             kubeapply.apply_groups(
                 client, groups, wait=args.wait,
                 stage_timeout=args.stage_timeout, poll=args.poll,
                 allow_empty_daemonsets=args.allow_empty_daemonsets,
                 log=lambda msg: print(msg))
         else:
-            if args.token_file or args.ca_file:
-                print("apply: --token-file/--ca-file need --apiserver "
-                      "(the kubectl backend authenticates via kubeconfig)",
-                      file=sys.stderr)
+            if not _kubectl_mode_flags_ok(args, "apply"):
                 return 2
             if args.poll != 1.0:
                 print("apply: note: --poll has no effect on the kubectl "
@@ -120,6 +138,25 @@ def cmd_apply(args) -> int:
         print(f"apply failed: {exc}", file=sys.stderr)
         return 1
     print("apply: converged" if args.wait else "apply: submitted")
+    return 0
+
+
+def cmd_delete(args) -> int:
+    groups = _spec_groups(args)
+    try:
+        client = _rest_client(args)
+        if client is not None:
+            kubeapply.delete_groups(client, groups,
+                                    log=lambda msg: print(msg))
+        else:
+            if not _kubectl_mode_flags_ok(args, "delete"):
+                return 2
+            kubeapply.delete_groups_kubectl(groups,
+                                            log=lambda msg: print(msg))
+    except kubeapply.ApplyError as exc:
+        print(f"delete failed: {exc}", file=sys.stderr)
+        return 1
+    print("delete: done")
     return 0
 
 
@@ -163,6 +200,21 @@ def build_parser() -> argparse.ArgumentParser:
         formatter_class=argparse.RawDescriptionHelpFormatter)
     sub = ap.add_subparsers(dest="command", required=True)
 
+    # apiserver-connection flags shared by apply/delete (the _rest_client /
+    # _kubectl_mode_flags_ok pair consumes them identically)
+    conn = argparse.ArgumentParser(add_help=False)
+    conn.add_argument("--spec", default="")
+    conn.add_argument("--apiserver", default="",
+                      help="apiserver base URL (kubectl proxy: "
+                           "http://127.0.0.1:8001, or https://<host>:6443); "
+                           "omit to use kubectl from PATH")
+    conn.add_argument("--token-file", default="")
+    conn.add_argument("--ca-file", default=None)
+    conn.add_argument("--insecure-skip-tls-verify", action="store_true",
+                      help="allow https to an apiserver without CA "
+                           "verification (DANGEROUS: exposes the bearer "
+                           "token to MITM)")
+
     p = sub.add_parser("render", help="render artifacts from a cluster-spec")
     p.add_argument("--spec", default="", help="cluster-spec YAML path "
                                               "(default: built-in defaults)")
@@ -175,17 +227,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser(
         "apply", help="ordered, readiness-gated rollout "
-                      "(helm install --wait analog)")
-    p.add_argument("--spec", default="")
-    p.add_argument("--apiserver", default="",
-                   help="apiserver base URL (kubectl proxy: "
-                        "http://127.0.0.1:8001, or https://<host>:6443); "
-                        "omit to use kubectl from PATH")
-    p.add_argument("--token-file", default="")
-    p.add_argument("--ca-file", default=None)
-    p.add_argument("--insecure-skip-tls-verify", action="store_true",
-                   help="allow https to an apiserver without CA verification "
-                        "(DANGEROUS: exposes the bearer token to MITM)")
+                      "(helm install --wait analog)", parents=[conn])
     p.add_argument("--operator", action="store_true",
                    help="install the in-cluster tpu-operator instead of "
                         "applying operands directly")
@@ -196,6 +238,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--allow-empty-daemonsets", action="store_true",
                    help="treat DaemonSets with no matching nodes as ready")
     p.set_defaults(fn=cmd_apply)
+
+    p = sub.add_parser(
+        "delete", help="remove everything a spec renders, reverse order "
+                       "(helm uninstall analog)", parents=[conn])
+    p.add_argument("--operator", action="store_true",
+                   help="remove the operator install set (CRD, policy CR, "
+                        "bundle, controller) instead of the operands")
+    p.set_defaults(fn=cmd_delete)
 
     p = sub.add_parser("verify", help="run the acceptance runbook")
     p.add_argument("--spec", default="")

@@ -201,6 +201,10 @@ class Client:
             raise ApplyError(f"PATCH {path}: {code} {resp}")
         return "patched"
 
+    def delete(self, path: str) -> Tuple[int, Any]:
+        """DELETE one object; (status, parsed body)."""
+        return self._request("DELETE", path)
+
     def wait_crd_established(self, name: str, timeout: float,
                              poll: float = 1.0) -> None:
         """Block until a just-applied CRD reports Established — the window
@@ -366,6 +370,61 @@ def apply_groups_kubectl(groups: Sequence[Sequence[Dict[str, Any]]],
                         f"readiness gate failed: DaemonSet/{name} pods "
                         f"regressed after rollout ({ready}/{desired} ready)")
         log(f"group {i + 1}/{len(groups)} ready")
+    return result
+
+
+def delete_groups(client: Client,
+                  groups: Sequence[Sequence[Dict[str, Any]]],
+                  log=lambda msg: None) -> GroupResult:
+    """`helm uninstall` analog for the REST backend: delete everything the
+    groups render, in REVERSE order (workloads before the RBAC they run
+    under, the namespace last). Absent objects are fine — uninstall is
+    idempotent."""
+    result = GroupResult()
+    for group in reversed(list(groups)):
+        for obj in reversed(list(group)):
+            path = object_path(obj)
+            code, resp = client.delete(path)
+            name = f"{obj['kind']}/{obj['metadata']['name']}"
+            if code in (200, 202):
+                result.actions.append(f"deleted {name}")
+                log(f"deleted {name}")
+            elif code == 404:
+                result.actions.append(f"absent {name}")
+            elif code == 409:
+                # re-run while a previous delete is still in flight: a
+                # Terminating namespace answers 409 until its contents are
+                # gone — that IS the uninstall proceeding, not a failure
+                result.actions.append(f"terminating {name}")
+                log(f"terminating {name} (deletion already in progress)")
+            else:
+                raise ApplyError(f"DELETE {path}: {code} {resp}")
+    return result
+
+
+def delete_groups_kubectl(groups: Sequence[Sequence[Dict[str, Any]]],
+                          runner=None,
+                          log=lambda msg: None) -> GroupResult:
+    """The kubectl twin of :func:`delete_groups`: one reverse-ordered
+    `kubectl delete --ignore-not-found` per group, last group first."""
+    import yaml
+
+    if runner is None:
+        def runner(argv, input_text=None):
+            return kubectl_runner(argv, input_text, timeout=900)
+
+    result = GroupResult()
+    for group in reversed(list(groups)):
+        docs = list(reversed(list(group)))
+        text = yaml.dump_all(docs, sort_keys=False)
+        rc, out, err = runner(
+            ["kubectl", "delete", "--ignore-not-found", "-f", "-"], text)
+        if rc != 0:
+            raise ApplyError(f"kubectl delete: {(out + err)[-400:]}")
+        for obj in docs:
+            name = f"{obj['kind']}/{obj['metadata']['name']}"
+            result.actions.append(f"deleted {name}")
+            log(f"deleted {name}")
     return result
 
 
