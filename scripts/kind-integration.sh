@@ -160,4 +160,18 @@ for i in $(seq 1 60); do
 done
 kubectl -n tpu-system rollout status ds/tpu-metrics-exporter --timeout=120s
 echo "policy re-enable OK: exporter recreated by the operator"
+
+echo "--- teardown (helm uninstall analog, reverse order, idempotent)"
+PYTHONPATH="$REPO" python3 -m tpu_cluster delete --spec "$SPEC" --operator
+PYTHONPATH="$REPO" python3 -m tpu_cluster delete --spec "$SPEC"
+for i in $(seq 1 60); do
+  kubectl -n tpu-system get ds tpu-device-plugin >/dev/null 2>&1 || break
+  sleep 2
+done
+if kubectl -n tpu-system get ds tpu-device-plugin >/dev/null 2>&1; then
+  echo "FAIL: device-plugin DaemonSet survived tpuctl delete"; exit 1
+fi
+# re-running against the (possibly Terminating) leftovers must be clean
+PYTHONPATH="$REPO" python3 -m tpu_cluster delete --spec "$SPEC"
+echo "teardown OK"
 echo "PASS: kind integration complete"
