@@ -50,15 +50,21 @@ def measure_tflops() -> dict:
     from tpu_cluster.workloads import smoke
 
     dim, lo_iters, hi_iters, reps = 4096, 200, 2000, 3
-    # Best-of-N per point: the tunnel's dispatch+sync constant varies tens
-    # of ms run-to-run, which the subtraction would otherwise inherit; the
-    # minimum is the run with the least interference (standard timing
-    # practice), and both raw minima are reported so the noise floor of the
-    # delta is visible to the reader.
-    lo = min((smoke.matmul(dim, dim, dim, iters=lo_iters)
-              for _ in range(reps)), key=lambda r: r["seconds"])
-    hi = min((smoke.matmul(dim, dim, dim, iters=hi_iters)
-              for _ in range(reps)), key=lambda r: r["seconds"])
+    # Median over PAIRED reps: each rep times the short and long runs
+    # back-to-back and the delta is taken within the pair (the tunnel's
+    # dispatch+sync constant is correlated within a pair, so it cancels
+    # cleanly); the median pair damps noise in BOTH directions. The
+    # previous independent best-of-per-point let a lucky long-run constant
+    # meet an unlucky short-run one, biasing the delta low — observed as
+    # MFU readings a few percent ABOVE the hardware peak, a measurement
+    # artifact, not a faster chip.
+    pairs = []
+    for _ in range(reps):
+        lo_r = smoke.matmul(dim, dim, dim, iters=lo_iters)
+        hi_r = smoke.matmul(dim, dim, dim, iters=hi_iters)
+        pairs.append((lo_r, hi_r))
+    pairs.sort(key=lambda p: p[1]["seconds"] - p[0]["seconds"])
+    lo, hi = pairs[len(pairs) // 2]
     flops_per_iter = 2.0 * hi["m"] * hi["k"] * hi["n"]
     dt = hi["seconds"] - lo["seconds"]
     out = {

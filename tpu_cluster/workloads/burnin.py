@@ -353,7 +353,7 @@ def timed_steps(mesh: Mesh, cfg: BurninConfig, steps: int = 20,
         cost = cost[0] if cost else {}
     flops_per_step = float((cost or {}).get("flops", 0.0))
 
-    def timed(n: int) -> float:
+    def compiled_scan(n: int):
         def multi(params, batch):
             def body(p, _):
                 p, loss = train_step(p, batch, cfg)
@@ -366,19 +366,28 @@ def timed_steps(mesh: Mesh, cfg: BurninConfig, steps: int = 20,
         jitted = jax.jit(multi, out_shardings=(
             param_shardings, NamedSharding(mesh, P(None))))
         float(jitted(params, batch)[1][-1])  # compile + warm-up
-        best = None
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            with runtime_metrics.device_busy():  # duty-cycle producer
-                losses = jitted(params, batch)[1]
-                float(losses[-1])  # the true sync (see docstring)
-            dt = time.perf_counter() - t0
-            # tensorcore-utilization producer: these FLOPs have synced
-            runtime_metrics.add_flops(flops_per_step * n)
-            best = dt if best is None else min(best, dt)
-        return best
+        return jitted
 
-    lo, hi = timed(steps), timed(3 * steps)
+    def run_once(jitted, n: int) -> float:
+        t0 = time.perf_counter()
+        with runtime_metrics.device_busy():  # duty-cycle producer
+            losses = jitted(params, batch)[1]
+            float(losses[-1])  # the true sync (see docstring)
+        elapsed = time.perf_counter() - t0
+        # tensorcore-utilization producer: these FLOPs have synced
+        runtime_metrics.add_flops(flops_per_step * n)
+        return elapsed
+
+    # Median over PAIRED reps (same estimator as bench.measure_tflops):
+    # the tunnel's fetch constant is correlated within a back-to-back
+    # pair, and the median damps noise in both directions — independent
+    # best-of-per-point can bias the delta low enough to read above peak.
+    j_lo, j_hi = compiled_scan(steps), compiled_scan(3 * steps)
+    pairs = []
+    for _ in range(reps):
+        pairs.append((run_once(j_lo, steps), run_once(j_hi, 3 * steps)))
+    pairs.sort(key=lambda p: p[1] - p[0])
+    lo, hi = pairs[len(pairs) // 2]
     dt = hi - lo
     extra_steps = 2 * steps
     if dt <= 1e-4:  # degenerate delta; fall back to the raw long point
