@@ -487,7 +487,11 @@ class Operator {
                 "tpu-operator: bundle changed on disk; reconciling now\n");
         break;
       }
-      if (opt_.policy.empty()) continue;
+      // The policy probe is a remote GET: skip it during a failure backoff
+      // (the apiserver is likely the thing that's down — a fleet of
+      // operators polling it at 2s would undo the backoff). The bundle
+      // probe above is local stats and stays live regardless.
+      if (opt_.policy.empty() || !healthy_) continue;
       kubeclient::Response get = kubeclient::Call(cfg_, "GET", PolicyPath());
       if (!get.ok()) {
         if (get.status == 404 && !policy_missing_) break;  // CR deleted
