@@ -137,10 +137,18 @@ def forward(params: Dict[str, Any], tokens: jnp.ndarray,
         ).transpose(0, 2, 1, 3).reshape(y.shape)
     else:
         def attn_block(q, k, v):
-            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d_head)
-            mask = jnp.tril(jnp.ones((q.shape[1], q.shape[1]), bool))
-            logits = jnp.where(mask, logits.astype(jnp.float32), -1e30)
-            attn = jax.nn.softmax(logits, axis=-1).astype(jnp.bfloat16)
+            # f32 scores straight off the MXU (preferred_element_type) and
+            # an ADDITIVE causal mask: vs the earlier bf16-matmul ->
+            # astype(f32) -> where(mask) chain this skips one full
+            # [B,H,S,S] bf16 write + f32 rewrite of the largest activation
+            # (measured +0.011/+0.006 MFU at the standard shape's
+            # h32/h16 on a real v5e chip, round-4 probe).
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                                preferred_element_type=jnp.float32,
+                                ) / np.sqrt(d_head)
+            mask = jnp.triu(
+                jnp.full((q.shape[1], q.shape[1]), -1e30, jnp.float32), k=1)
+            attn = jax.nn.softmax(logits + mask, axis=-1).astype(jnp.bfloat16)
             return jnp.einsum("bhqk,bkhd->bqhd", attn, v)
 
         if cfg.remat == "attn":
@@ -256,6 +264,39 @@ def bench_config() -> BurninConfig:
                         n_heads=16, seq=512, batch=8)
 
 
+def standard_config() -> BurninConfig:
+    """Standard-geometry transformer shape for the honest headline: 4x
+    FFN:model ratio, vs bench_config's 64x wide shape whose step is
+    matmul-dominated by construction. bench.py reports BOTH —
+    ``train_step.standard`` (this) and ``train_step.wide`` (bench_config)
+    — so the artifact of record shows what a realistic block sustains
+    next to the compute-ceiling shape (round-3 verdict: the wide shape's
+    0.89-0.91 must not stand in for realistic geometry).
+
+    d4096/f16384/h16 (d_head 256) is GPT-J-6B's exact block geometry.
+    Round-4 ablation sweep at this d/f (real v5e chip, steps=40, median
+    of per-pair deltas, MFU vs the 197 TFLOP/s catalogue peak), all with
+    the f32-accum additive-mask attention now in ``forward``:
+
+      h16 (this config) ........ 0.817  (0.811 before the attention fix)
+      h32 (LLaMA-7B heads) ..... 0.783  (0.772 before) — doubling the
+         head count doubles the [B,H,S,S] softmax bandwidth at fixed
+         FLOPs; that ~3ms/step is the whole gap
+      h8 ....................... 0.836  — keeps paying, but d_head 512
+         is no longer standard geometry; not used
+      b16 ...................... 0.755  (activation HBM pressure)
+      remat="attn" ............. 0.794  (recompute loses to XLA's saved-
+         residual schedule at S=512, same as the wide-shape sweep)
+      attention="flash" ........ 0.735  (stock Pallas kernel does not
+         amortise at S=512; its win case is long-seq)
+
+    The measured ceiling for honest 4x geometry on this chip is ~0.82-
+    0.84; the bench headline stays at the GPT-J shape rather than
+    chasing the h8 reading."""
+    return BurninConfig(vocab=8192, d_model=4096, d_ff=16384,
+                        n_heads=16, seq=512, batch=8)
+
+
 def make_mesh(shape: Tuple[int, int], devices=None) -> Mesh:
     devices = devices if devices is not None else jax.devices()
     dp, tp = shape
@@ -316,7 +357,7 @@ def make_sharded_step(mesh: Mesh, cfg: BurninConfig):
 
 
 def timed_steps(mesh: Mesh, cfg: BurninConfig, steps: int = 20,
-                reps: int = 3) -> Dict[str, Any]:
+                reps: int = 5) -> Dict[str, Any]:
     """Training-step throughput with tunneled-backend-safe timing.
 
     Measurement rules learned the hard way on the tunneled TPU backend:
@@ -378,29 +419,44 @@ def timed_steps(mesh: Mesh, cfg: BurninConfig, steps: int = 20,
         runtime_metrics.add_flops(flops_per_step * n)
         return elapsed
 
-    # Median over PAIRED reps (same estimator as bench.measure_tflops):
-    # the tunnel's fetch constant is correlated within a back-to-back
-    # pair, and the median damps noise in both directions — independent
-    # best-of-per-point can bias the delta low enough to read above peak.
+    # Per-pair two-point deltas, median over reps — the SAME estimator
+    # implementation as bench.measure_tflops (workloads.timing), so a fix
+    # there is a fix here: the round-3 above-peak artifact came from two
+    # drifted copies of this logic. The tunnel's fetch constant is
+    # correlated within a back-to-back pair so each pair's own delta
+    # cancels it; the published spread makes residual noise visible.
+    from . import timing
+
     j_lo, j_hi = compiled_scan(steps), compiled_scan(3 * steps)
+    extra_steps = 2 * steps
     pairs = []
     for _ in range(reps):
-        pairs.append((run_once(j_lo, steps), run_once(j_hi, 3 * steps)))
-    pairs.sort(key=lambda p: p[1] - p[0])
-    lo, hi = pairs[len(pairs) // 2]
-    dt = hi - lo
-    extra_steps = 2 * steps
-    if dt <= 1e-4:  # degenerate delta; fall back to the raw long point
-        dt, extra_steps = hi, 3 * steps
-    tflops = flops_per_step * extra_steps / dt / 1e12 if flops_per_step else 0.0
-    return {
-        "steps": steps, "seconds": dt,
-        "points": [{"steps": steps, "seconds": round(lo, 4)},
-                   {"steps": 3 * steps, "seconds": round(hi, 4)}],
+        lo = run_once(j_lo, steps)
+        hi = run_once(j_hi, 3 * steps)
+        pairs.append((lo, hi))
+    est = timing.paired_two_point(
+        pairs, flops_per_step * extra_steps, flops_per_step * 3 * steps)
+    timed_span = est["delta_s"]
+    # tokens/s over the span the rate was computed on: the delta's extra
+    # steps normally, the full long run in the degenerate fallback.
+    span_steps = extra_steps if "spread" in est else 3 * steps
+    out: Dict[str, Any] = {
+        "steps": steps,
+        "seconds": timed_span,
         "flops_per_step": flops_per_step,
-        "tflops": tflops,
-        "tokens_per_s": cfg.batch * cfg.seq * extra_steps / dt,
+        "estimator": est["estimator"],
+        "reps": reps,
+        "points": [{"steps": steps, "seconds": round(est["lo_s"], 4)},
+                   {"steps": 3 * steps, "seconds": round(est["hi_s"], 4)}],
+        "tflops": est["tflops"] if flops_per_step else 0.0,
+        "tokens_per_s": (cfg.batch * cfg.seq * span_steps / timed_span
+                         if timed_span > 0 else 0.0),
     }
+    if "spread" in est:
+        out["tflops_spread"] = est["spread"]
+    if "note" in est:
+        out["note"] = est["note"]
+    return out
 
 
 def run(mesh_shape: Tuple[int, int] = None, steps: int = 5,

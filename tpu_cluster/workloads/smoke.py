@@ -70,15 +70,20 @@ def vector_add(n: int = 1 << 20) -> Dict[str, Any]:
     return {"check": "vector_add", "n": n, "ok": ok}
 
 
-def matmul(m: int = 4096, k: int = 4096, n: int = 4096,
-           dtype=jnp.bfloat16, iters: int = 10) -> Dict[str, Any]:
-    """bf16 matmul smoke + throughput: keeps the MXU busy with one large
-    static-shape contraction (SURVEY's idiomatic-TPU rule: big, batched,
-    bfloat16). The ``iters`` timed steps run INSIDE one compiled computation
-    (lax.scan with a data-dependent carry, so XLA cannot CSE them away) —
-    per-step Python dispatch would dominate the sub-millisecond matmul and
-    measure the host/tunnel, not the MXU. Requires k == n (the carry is fed
-    back through the same rhs each step)."""
+def matmul_chain(m: int, k: int, n: int, dtype, iters: int):
+    """Compiled chained-carry matmul for timing reuse.
+
+    The ``iters`` timed steps run INSIDE one compiled computation (lax.scan
+    with a data-dependent carry, so XLA cannot CSE them away) — per-step
+    Python dispatch would dominate the sub-millisecond matmul and measure
+    the host/tunnel, not the MXU. Requires k == n (the carry is fed back
+    through the same rhs each step).
+
+    Returns ``(run, flops)``: ``run()`` executes one timed pass (marking the
+    duty-cycle producer region, reporting FLOPs after the sync) and returns
+    ``(seconds, out)``; ``flops`` is the pass's total FLOP count. Compile
+    once, time many — callers doing paired reps (bench.measure_tflops) must
+    not pay a fresh XLA compile per rep."""
     if k != n:
         raise ValueError(f"chained-carry benchmark needs k == n, got "
                          f"k={k} n={n}")
@@ -98,19 +103,33 @@ def matmul(m: int = 4096, k: int = 4096, n: int = 4096,
     from . import runtime_metrics
 
     chain(a, b).block_until_ready()  # compile
-    t0 = time.perf_counter()
-    with runtime_metrics.device_busy():  # duty-cycle producer region
-        out = chain(a, b)
-        out.block_until_ready()
-        # On the tunneled backend block_until_ready has been observed
-        # returning before execution for some output kinds (burnin.timed_steps
-        # docstring); a one-element fetch is the guaranteed sync. Its
-        # roundtrip is a constant, cancelled by callers using the two-point
-        # delta (bench.py).
-        np.asarray(out[:1, :1])
-    dt = time.perf_counter() - t0
     flops = 2.0 * m * k * n * iters
-    runtime_metrics.add_flops(flops)  # tensorcore-utilization producer
+
+    def run():
+        t0 = time.perf_counter()
+        with runtime_metrics.device_busy():  # duty-cycle producer region
+            out = chain(a, b)
+            out.block_until_ready()
+            # On the tunneled backend block_until_ready has been observed
+            # returning before execution for some output kinds
+            # (burnin.timed_steps docstring); a one-element fetch is the
+            # guaranteed sync. Its roundtrip is a constant, cancelled by
+            # callers using the two-point delta (bench.py).
+            np.asarray(out[:1, :1])
+        dt = time.perf_counter() - t0
+        runtime_metrics.add_flops(flops)  # tensorcore-utilization producer
+        return dt, out
+
+    return run, flops
+
+
+def matmul(m: int = 4096, k: int = 4096, n: int = 4096,
+           dtype=jnp.bfloat16, iters: int = 10) -> Dict[str, Any]:
+    """bf16 matmul smoke + throughput: keeps the MXU busy with one large
+    static-shape contraction (SURVEY's idiomatic-TPU rule: big, batched,
+    bfloat16). Timing methodology lives in :func:`matmul_chain`."""
+    run, flops = matmul_chain(m, k, n, dtype, iters)
+    dt, out = run()
     finite = bool(jnp.isfinite(out.astype(jnp.float32)).all())
     return {
         "check": "matmul", "m": m, "k": k, "n": n, "dtype": str(dtype.__name__
