@@ -1,0 +1,171 @@
+"""Render the README performance table from the driver bench artifact.
+
+Round-3 verdict: the README's performance numbers were the builder's local
+reruns and disagreed with the driver-captured artifact in both directions.
+This script makes the table mechanically derived from the ARTIFACT OF
+RECORD — the newest ``BENCH_r*.json`` the driver wrote — so a number can
+appear in the README only by appearing in the artifact first.
+
+Usage:
+  python scripts/bench_table.py            # print the table for the newest artifact
+  python scripts/bench_table.py --update   # rewrite README.md between the markers
+  python scripts/bench_table.py --check    # exit 1 if README is out of sync (CI)
+
+An MFU above 1.0 in the artifact is rendered with an explicit
+measurement-defect flag rather than hidden: above-peak readings are
+estimator artifacts by definition and the table must say so.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+README = os.path.join(REPO, "README.md")
+BEGIN = "<!-- bench-table:begin (scripts/bench_table.py --update) -->"
+END = "<!-- bench-table:end -->"
+
+
+def newest_artifact() -> str:
+    paths = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+    if not paths:
+        raise SystemExit("no BENCH_r*.json artifact found")
+    return paths[-1]
+
+
+def load(path: str) -> dict:
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    # driver wrapper: the bench line itself is under "parsed"
+    return doc.get("parsed", doc)
+
+
+def _mfu_cell(mfu) -> str:
+    if mfu is None:
+        return ""
+    cell = f"**{mfu:.3f} MFU**"
+    if mfu > 1.0:
+        cell += (" ⚠ above physical peak = measurement defect "
+                 "(two-point estimator; rebuilt in round 4 with per-pair "
+                 "delta medians + published spread)")
+    return cell
+
+
+def _spread_cell(entry: dict) -> str:
+    spread = entry.get("tflops_spread")
+    if not spread:
+        return ""
+    return (f"spread {spread['min']}/{spread['median']}/{spread['max']} "
+            f"TFLOP/s over {spread['n']} paired reps")
+
+
+def render(doc: dict, name: str) -> str:
+    rows = []
+    value, mfu = doc.get("value"), doc.get("mfu")
+    notes = [f"{doc.get('vs_baseline')}x the reference accelerator's peak "
+             "(Tesla T4, 65 TFLOP/s fp16)"]
+    sp = _spread_cell({"tflops_spread": doc.get("measure_tflops_spread")})
+    if sp:
+        notes.append(sp)
+    rows.append(("bf16 matmul (1 chip)",
+                 f"{value} TFLOP/s = {_mfu_cell(mfu)}",
+                 "; ".join(n for n in notes if n)))
+    ts = doc.get("train_step") or {}
+    if "tflops" in ts:  # r03 flat schema: single unlabeled shape
+        rows.append(("Transformer train step (fwd+bwd+update)",
+                     f"{ts['tflops']} TFLOP/s = {_mfu_cell(ts.get('mfu'))}",
+                     f"{ts.get('tokens_per_s')} tokens/s; shape per "
+                     "burnin.bench_config() of that round"))
+    else:  # r04+ schema: named shapes
+        for shape in ("standard", "wide"):
+            entry = ts.get(shape)
+            if not entry:
+                continue
+            if "error" in entry:
+                rows.append((f"Train step, {shape} ({entry.get('config')})",
+                             "error", entry["error"]))
+                continue
+            notes = [f"{entry.get('tokens_per_s')} tokens/s",
+                     _spread_cell(entry)]
+            rows.append((f"Train step, {shape} ({entry.get('config')})",
+                         f"{entry['tflops']} TFLOP/s = "
+                         f"{_mfu_cell(entry.get('mfu'))}",
+                         "; ".join(n for n in notes if n)))
+    val = doc.get("validate") or {}
+    if "wall_s" in val:
+        rows.append(("Acceptance matrix wall-clock", f"{val['wall_s']} s",
+                     "device-query / vector-add / matmul / psum on hardware "
+                     "(the reference's pasted verification outputs, "
+                     "executed)"))
+    scrape = doc.get("metrics_scrape") or {}
+    if scrape.get("ok"):
+        vals = []
+        if "duty_cycle_percent" in scrape:
+            vals.append(f"duty {scrape['duty_cycle_percent']}%")
+        if "tensorcore_utilization_percent" in scrape:
+            vals.append(
+                f"tensorcore {scrape['tensorcore_utilization_percent']}%")
+        if "hbm_used_bytes" in scrape:
+            vals.append(f"HBM used {scrape['hbm_used_bytes']} B")
+        rows.append(("Metrics scrape (end-to-end)",
+                     ", ".join(vals) or "ok",
+                     "workload producer → exporter relay → HTTP scrape "
+                     f"(hbm_source={scrape.get('hbm_source', '?')})"))
+    lines = [
+        f"Every number below is quoted verbatim from `{name}` — the "
+        "driver-captured artifact of record — by `scripts/bench_table.py` "
+        "(`--check` runs in the test suite). Local reruns never edit this "
+        "table.",
+        "",
+        "| Metric | Value | Notes |",
+        "|---|---|---|",
+    ]
+    for metric, value, note in rows:
+        lines.append(f"| {metric} | {value} | {note} |")
+    return "\n".join(lines)
+
+
+def table_block() -> str:
+    path = newest_artifact()
+    return f"{BEGIN}\n{render(load(path), os.path.basename(path))}\n{END}"
+
+
+def readme_sub(text: str, block: str):
+    pattern = re.compile(re.escape(BEGIN) + r".*?" + re.escape(END),
+                         re.DOTALL)
+    if not pattern.search(text):
+        return None
+    return pattern.sub(lambda _: block, text)
+
+
+def main(argv) -> int:
+    block = table_block()
+    if "--update" in argv or "--check" in argv:
+        with open(README, encoding="utf-8") as f:
+            text = f.read()
+        new = readme_sub(text, block)
+        if new is None:
+            print("README.md markers not found", file=sys.stderr)
+            return 1
+        if "--check" in argv:
+            if new != text:
+                print("README bench table out of sync with the newest "
+                      "BENCH_r*.json; run scripts/bench_table.py --update",
+                      file=sys.stderr)
+                return 1
+            print("bench table in sync")
+            return 0
+        with open(README, "w", encoding="utf-8") as f:
+            f.write(new)
+        print("README updated")
+        return 0
+    print(block)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

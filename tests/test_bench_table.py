@@ -1,0 +1,52 @@
+"""README performance table must be mechanically derived from the newest
+driver BENCH_r*.json artifact (round-3 verdict: the hand-maintained table
+disagreed with the artifact of record in both directions)."""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import bench_table  # noqa: E402
+
+
+def test_readme_table_in_sync_with_newest_artifact():
+    block = bench_table.table_block()
+    with open(bench_table.README, encoding="utf-8") as f:
+        text = f.read()
+    assert bench_table.BEGIN in text and bench_table.END in text
+    assert block in text, (
+        "README bench table out of sync — run scripts/bench_table.py "
+        "--update")
+
+
+def test_above_peak_mfu_is_flagged_as_defect():
+    doc = {"value": 201.0, "mfu": 1.022, "vs_baseline": 3.1}
+    out = bench_table.render(doc, "BENCH_x.json")
+    assert "measurement defect" in out
+
+
+def test_r04_schema_renders_both_shapes_with_spread():
+    doc = {
+        "value": 193.0, "mfu": 0.98, "vs_baseline": 2.97,
+        "measure_tflops_spread": {"min": 189.0, "median": 193.0,
+                                  "max": 292.0, "n": 7},
+        "train_step": {
+            "standard": {"config": "d4096 f16384 h16 s512 b8 (4x FFN)",
+                         "tflops": 160.0, "mfu": 0.813,
+                         "tokens_per_s": 111000,
+                         "tflops_spread": {"min": 159.0, "median": 160.0,
+                                           "max": 162.0, "n": 5}},
+            "wide": {"config": "d2048 f131072 h16 s512 b8 (64x FFN)",
+                     "tflops": 180.0, "mfu": 0.917, "tokens_per_s": 52000},
+        },
+        "validate": {"wall_s": 20.0},
+        "metrics_scrape": {"ok": True, "duty_cycle_percent": 50.0,
+                           "hbm_source": "live_arrays"},
+    }
+    out = bench_table.render(doc, "BENCH_x.json")
+    assert "standard" in out and "wide" in out
+    assert "4x FFN" in out and "64x FFN" in out
+    assert "spread 159.0/160.0/162.0" in out
+    assert "measurement defect" not in out
