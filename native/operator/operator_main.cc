@@ -69,6 +69,7 @@ struct Options {
 // belong to, and the live TpuStackPolicy CR decides which operands run.
 // Must match tpu_cluster/render/operator_bundle.py.
 const char kOperandLabel[] = "tpu-stack.dev/operand";
+const char kInstanceLabel[] = "tpu-stack.dev/instance";
 const char kDefaultEnabledAnnotation[] = "tpu-stack.dev/default-enabled";
 const char kPolicyPathPrefix[] =
     "/apis/tpu-stack.dev/v1alpha1/tpustackpolicies/";
@@ -370,6 +371,16 @@ class Operator {
       if (!coll.empty())
         keep.insert(coll + "/" + bo.obj->PathString("metadata.name"));
     }
+    // The list stays broad (operand label only) but deletion is scoped
+    // to THIS install client-side: cluster-scoped collections
+    // (ClusterRoles etc.) are listed cluster-wide, and deleting on the
+    // operand label alone would garbage-collect a second tpu-stack
+    // install's objects. An object whose instance label (stamped by the
+    // bundle renderer, value = install namespace) names ANOTHER install
+    // is skipped; one with NO instance label is a pre-instance-label
+    // legacy object this sweep must still be able to prune — a
+    // selector-side requirement would orphan those forever (dropped
+    // objects are never re-applied, so they never gain the label).
     for (const auto& coll : kubeapi::SweepCollections(ns)) {
       kubeclient::Response list = kubeclient::Call(
           cfg_, "GET", coll + "?labelSelector=" + kOperandLabel);
@@ -380,6 +391,12 @@ class Operator {
       for (const auto& item : items->elements()) {
         std::string name = item->PathString("metadata.name");
         if (name.empty() || keep.count(coll + "/" + name)) continue;
+        // label key contains dots — walk explicitly, no dotted path
+        minijson::ValuePtr imeta = item->Get("metadata");
+        minijson::ValuePtr ilabels = imeta ? imeta->Get("labels") : nullptr;
+        minijson::ValuePtr inst =
+            ilabels ? ilabels->Get(kInstanceLabel) : nullptr;
+        if (inst && inst->is_string() && inst->as_string() != ns) continue;
         kubeclient::Response del =
             kubeclient::Call(cfg_, "DELETE", coll + "/" + name);
         bool deleted = del.ok() || del.status == 404;

@@ -152,7 +152,13 @@ int main(int argc, char** argv) {
       if (c.hbm_used >= 0) printf(", \"hbm_used_bytes\": %.0f", c.hbm_used);
       printf("}");
     }
-    printf("], \"chip_count\": %zu}\n", chips.size());
+    // The duty-cycle producer is one measurement per OWNING PROCESS,
+    // attributed to every chip that process holds (libtpu exposes no
+    // per-chip counter daemon to ask) — scope declared so a reader can't
+    // mistake identical per-chip values for independent measurements
+    // (docs/DELTAS.md §5).
+    printf("], \"chip_count\": %zu, \"duty_cycle_scope\": \"process\"}\n",
+           chips.size());
     return chips.empty() ? 1 : 0;
   }
 

@@ -2,6 +2,7 @@
 apiserver, pinned to the same readiness semantics as the C++ operator."""
 
 import json
+import re
 import subprocess
 import sys
 import threading
@@ -420,3 +421,48 @@ def test_delete_groups_kubectl_reverse_and_ignore_not_found(spec):
     # the namespace rides the LAST invocation (reverse apply order)
     assert "kind: Namespace" in calls[-1][1]
     assert "kind: Namespace" not in calls[0][1]
+
+
+def test_delete_kubectl_idempotent_after_crd_gone(spec):
+    """Round-3 advisor finding: re-running `tpuctl delete --operator` after
+    the TpuStackPolicy CRD is gone must not fail — RESTMapper 'no matches
+    for kind' is not covered by --ignore-not-found, so CR docs go in their
+    own kubectl invocation with that error tolerated."""
+    from tpu_cluster.render import operator_bundle
+
+    groups = operator_bundle.operator_install_groups(spec)
+    calls = []
+
+    def is_cr_doc(text):
+        # doc-level kind (column 0), not the CRD's nested spec.names.kind
+        return re.search(r"^kind: TpuStackPolicy$", text, re.M) is not None
+
+    def fake_kubectl(argv, input_text=None):
+        calls.append(input_text)
+        if is_cr_doc(input_text):
+            return 1, "", ('error: unable to recognize "STDIN": no matches '
+                           'for kind "TpuStackPolicy" in version '
+                           '"tpu-stack.dev/v1alpha1"')
+        return 0, "ok", ""
+
+    result = kubeapply.delete_groups_kubectl(groups, runner=fake_kubectl)
+    # the CR rode alone, its no-matches failure was absorbed as absent,
+    # and everything else still got deleted
+    cr_calls = [c for c in calls if is_cr_doc(c)]
+    assert len(cr_calls) == 1
+    assert "kind: ConfigMap" not in cr_calls[0]  # CRs ride alone
+    assert any(a.startswith("absent TpuStackPolicy") for a in result.actions)
+    assert any(a.startswith("deleted CustomResourceDefinition")
+               for a in result.actions)
+
+
+def test_delete_kubectl_other_errors_still_raise(spec):
+    from tpu_cluster.render import operator_bundle
+
+    groups = operator_bundle.operator_install_groups(spec)
+
+    def fake_kubectl(argv, input_text=None):
+        return 1, "", "error: connection refused"
+
+    with pytest.raises(kubeapply.ApplyError):
+        kubeapply.delete_groups_kubectl(groups, runner=fake_kubectl)

@@ -67,16 +67,58 @@ def test_default_render_equals_canonical_manifests():
     assert docs == want
 
 
+def _strip_true_intent(doc):
+    """Remove default-enabled:"true" annotations from bundle-CM entries —
+    the helm render carries install-time intent explicitly per operand
+    (values-tracked), where the tpuctl render omits the annotation for
+    enabled operands; "true" and absent are equivalent to the operator."""
+    if doc.get("kind") != "ConfigMap" or "data" not in doc:
+        return doc
+    doc = json.loads(json.dumps(doc))
+    for fname, text in list(doc["data"].items()):
+        entry = json.loads(text)
+        anns = (entry.get("metadata") or {}).get("annotations") or {}
+        if anns.get(operator_bundle.DEFAULT_ENABLED_ANNOTATION) == "true":
+            del anns[operator_bundle.DEFAULT_ENABLED_ANNOTATION]
+            if not anns:
+                del entry["metadata"]["annotations"]
+            doc["data"][fname] = json.dumps(entry, indent=2)
+    return doc
+
+
 def test_operator_enabled_renders_bundle_install():
     docs = gotmpl.render_chart(CHART, {"operator": {"enabled": True}})
     base = kindnames(mf.render_objects(specmod.default_spec()))
-    extra = [d for d in docs if kindnames([d]) - base]
+    extra = [_strip_true_intent(d) for d in docs if kindnames([d]) - base]
     # the CRD is NOT in templates/ — Helm installs crds/ before templates,
     # which is the establishment gate for the TpuStackPolicy CR
     want = [o for o in
             operator_bundle.operator_install(specmod.default_spec())[1:]
             if o["kind"] != "CustomResourceDefinition"]
     assert extra == want
+
+
+def test_helm_disabled_operand_carries_false_intent_in_bundle():
+    """Round-3 advisor finding: a helm-disabled operand must carry
+    default-enabled="false" inside the bundle ConfigMap, so an operator
+    whose TpuStackPolicy CR is deleted fails open to the INSTALLED state
+    instead of deploying what the user disabled."""
+    docs = gotmpl.render_chart(
+        CHART, {"operator": {"enabled": True},
+                "devicePlugin": {"enabled": False}})
+    cm = next(d for d in docs if d.get("kind") == "ConfigMap"
+              and d["metadata"]["name"] == "tpu-operator-bundle")
+    intents = {}
+    for text in cm["data"].values():
+        entry = json.loads(text)
+        meta = entry.get("metadata") or {}
+        operand = (meta.get("labels") or {}).get(
+            operator_bundle.OPERAND_LABEL)
+        if operand:
+            intents[operand] = (meta.get("annotations") or {}).get(
+                operator_bundle.DEFAULT_ENABLED_ANNOTATION)
+    assert intents["devicePlugin"] == "false"
+    assert intents["libtpuPrep"] == "true"
 
 
 def test_chart_ships_crd_in_crds_dir():

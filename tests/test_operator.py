@@ -796,9 +796,38 @@ def test_upgrade_prunes_objects_dropped_from_bundle(native_build,
         api.store[bystander] = {"apiVersion": "v1", "kind": "Service",
                                 "metadata": {"name": "user-svc",
                                              "namespace": NS}}
+        # a SECOND tpu-stack install's cluster-scoped object carries the
+        # operand label but a different instance identity — the
+        # cluster-wide sweep must not garbage-collect it (round-3 advisor
+        # finding: the operand label alone matched across installs)
+        other = ("/apis/rbac.authorization.k8s.io/v1/clusterroles/"
+                 "other-install-tfd")
+        api.store[other] = {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRole",
+            "metadata": {"name": "other-install-tfd",
+                         "labels": {"tpu-stack.dev/operand":
+                                    "featureDiscovery",
+                                    "tpu-stack.dev/instance": "other-ns"}}}
+        # ...while a pre-instance-label LEGACY object (operand label only,
+        # dropped from the bundle before the label existed) must still be
+        # prunable — it will never be re-applied, so it can never gain
+        # the instance label
+        legacy = ("/apis/rbac.authorization.k8s.io/v1/clusterroles/"
+                  "tpu-legacy-dropped")
+        api.store[legacy] = {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRole",
+            "metadata": {"name": "tpu-legacy-dropped",
+                         "labels": {"tpu-stack.dev/operand":
+                                    "featureDiscovery"}}}
         p3 = run_operator(native_build, *base)
         assert p3.returncode == 0, p3.stderr
         assert api.get(bystander) is not None
+        assert api.get(other) is not None, \
+            "pruned a different install's cluster-scoped object"
+        assert api.get(legacy) is None, \
+            "legacy object without instance label was orphaned"
 
 
 def test_bundle_edit_reconciled_within_poll_window(native_build, bundle_dir):

@@ -382,6 +382,42 @@ def test_policy_check_ready_stale_and_degraded(spec):
     assert not res.ok and "Progressing" in res.detail
 
 
+def test_policy_check_fresh_cr_without_status_gets_grace(spec):
+    """Round-3 advisor finding: right after `apply --operator` the CR
+    exists before the first status write-back; a YOUNG status-less CR is a
+    pending first reconcile (pass with note), an OLD one is a dead
+    operator (fail)."""
+    import time as timemod
+
+    runner = CannedRunner(healthy=True)
+    runner.responses["get crd tpustackpolicies.tpu-stack.dev"] = {
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": "tpustackpolicies.tpu-stack.dev"}}
+    key = "get tpustackpolicies.tpu-stack.dev default"
+
+    def cr_with_age(seconds):
+        ts = timemod.strftime("%Y-%m-%dT%H:%M:%SZ",
+                              timemod.gmtime(timemod.time() - seconds))
+        return {"kind": "TpuStackPolicy",
+                "metadata": {"name": "default", "generation": 1,
+                             "creationTimestamp": ts}}
+
+    runner.responses[key] = cr_with_age(5)
+    res = verify.check_policy(runner, spec)
+    assert res.ok and "first reconcile pending" in res.detail
+
+    runner.responses[key] = cr_with_age(600)
+    res = verify.check_policy(runner, spec)
+    assert not res.ok and "operator not running" in res.detail
+
+    # no creationTimestamp at all (hand-made CR): benefit of the doubt
+    runner.responses[key] = {"kind": "TpuStackPolicy",
+                             "metadata": {"name": "default",
+                                          "generation": 1}}
+    res = verify.check_policy(runner, spec)
+    assert res.ok
+
+
 def test_triage_reports_policy_disabled_operands(spec):
     """'Where did my exporter go?' — when the TpuStackPolicy toggled it
     off, triage says so with the exact re-enable command."""

@@ -402,29 +402,67 @@ def delete_groups(client: Client,
     return result
 
 
+def _crd_kinds(groups: Sequence[Sequence[Dict[str, Any]]]):
+    """(apiGroup, kind) pairs defined by CRDs inside ``groups`` — the docs
+    whose resource type vanishes with the CRD."""
+    kinds = set()
+    for group in groups:
+        for obj in group:
+            if obj.get("kind") == "CustomResourceDefinition":
+                spec = obj.get("spec") or {}
+                kinds.add((spec.get("group"),
+                           (spec.get("names") or {}).get("kind")))
+    return kinds
+
+
 def delete_groups_kubectl(groups: Sequence[Sequence[Dict[str, Any]]],
                           runner=None,
                           log=lambda msg: None) -> GroupResult:
     """The kubectl twin of :func:`delete_groups`: one reverse-ordered
-    `kubectl delete --ignore-not-found` per group, last group first."""
+    `kubectl delete --ignore-not-found` per group, last group first.
+
+    Custom-resource docs (kinds a CRD in this bundle defines) go in their
+    OWN kubectl invocation with RESTMapper no-matches errors tolerated:
+    after the CRD is gone — a re-run of `tpuctl delete`, or the CRD's own
+    deletion earlier in this reverse pass — `--ignore-not-found` does NOT
+    cover "no matches for kind", and uninstall must stay idempotent (the
+    REST backend already treats this as absent)."""
     import yaml
 
     if runner is None:
         def runner(argv, input_text=None):
             return kubectl_runner(argv, input_text, timeout=900)
 
+    crd_kinds = _crd_kinds(groups)
     result = GroupResult()
     for group in reversed(list(groups)):
         docs = list(reversed(list(group)))
-        text = yaml.dump_all(docs, sort_keys=False)
-        rc, out, err = runner(
-            ["kubectl", "delete", "--ignore-not-found", "-f", "-"], text)
-        if rc != 0:
-            raise ApplyError(f"kubectl delete: {(out + err)[-400:]}")
-        for obj in docs:
-            name = f"{obj['kind']}/{obj['metadata']['name']}"
-            result.actions.append(f"deleted {name}")
-            log(f"deleted {name}")
+        crs = [d for d in docs
+               if (d.get("apiVersion", "").split("/")[0],
+                   d.get("kind")) in crd_kinds]
+        rest = [d for d in docs if d not in crs]
+        for batch, tolerate_no_match in ((crs, True), (rest, False)):
+            if not batch:
+                continue
+            text = yaml.dump_all(batch, sort_keys=False)
+            rc, out, err = runner(
+                ["kubectl", "delete", "--ignore-not-found", "-f", "-"], text)
+            if rc != 0:
+                blob = out + err
+                no_match = ("no matches for kind" in blob
+                            or "doesn't have a resource type" in blob
+                            or "the server doesn't have a resource" in blob)
+                if not (tolerate_no_match and no_match):
+                    raise ApplyError(f"kubectl delete: {blob[-400:]}")
+                for obj in batch:
+                    name = f"{obj['kind']}/{obj['metadata']['name']}"
+                    result.actions.append(f"absent {name} (CRD gone)")
+                    log(f"absent {name} (its CRD is already gone)")
+                continue
+            for obj in batch:
+                name = f"{obj['kind']}/{obj['metadata']['name']}"
+                result.actions.append(f"deleted {name}")
+                log(f"deleted {name}")
     return result
 
 

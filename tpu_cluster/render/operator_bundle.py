@@ -43,6 +43,12 @@ OPERAND_LABEL = f"{POLICY_GROUP}/operand"
 # back to THIS — fail-open must revert to the installed state, not deploy
 # operands the spec never enabled.
 DEFAULT_ENABLED_ANNOTATION = f"{POLICY_GROUP}/default-enabled"
+# Install identity, stamped on every operand object: the operator's GC
+# prune sweeps cluster-scoped collections cluster-WIDE by label, and the
+# operand label alone would let one install's operator garbage-collect a
+# second install's differently-named ClusterRoles/ClusterRoleBindings.
+# The namespace is the install identity (one tpu-stack per namespace).
+INSTANCE_LABEL = f"{POLICY_GROUP}/instance"
 
 
 def _fname(stage: str, obj: Dict[str, Any]) -> str:
@@ -81,6 +87,7 @@ def bundle_files(spec: ClusterSpec) -> Dict[str, Dict[str, Any]]:
             if operand is not None:
                 meta = obj.setdefault("metadata", {})
                 meta.setdefault("labels", {})[OPERAND_LABEL] = operand
+                meta["labels"][INSTANCE_LABEL] = spec.tpu.namespace
                 if not spec.tpu.operand(operand).enabled:
                     # annotate install-time intent so CR-less gating does
                     # NOT deploy a spec-disabled operand (fail-open means

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import json
 import subprocess
+import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -363,6 +364,29 @@ def policy_disabled_operands(cr) -> List[str]:
                   if not op.get("enabled"))
 
 
+# Seconds a TpuStackPolicy CR may exist without ANY status before its
+# absence counts as "operator not reconciling". The operator's probe
+# cadence is 2s and image pull tops out around a minute; the rest of the
+# window absorbs client-vs-apiserver clock skew — the age is computed
+# against the LOCAL clock (kubectl exposes no server time), so a client
+# running a few minutes fast must not turn a healthy fresh install red.
+POLICY_STATUS_GRACE_S = 300
+
+
+def _cr_age_seconds(cr) -> Optional[float]:
+    """Age from metadata.creationTimestamp (RFC3339 UTC); None if absent
+    or unparseable."""
+    ts = (cr.get("metadata") or {}).get("creationTimestamp")
+    if not ts:
+        return None
+    try:
+        import calendar
+        parsed = time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ")
+        return max(0.0, time.time() - calendar.timegm(parsed))
+    except (ValueError, TypeError):
+        return None
+
+
 def check_policy(runner: Runner, spec: ClusterSpec) -> CheckResult:
     """TpuStackPolicy health (operator mode's ClusterPolicy analog): the
     controller's status must be current (observedGeneration == generation)
@@ -383,6 +407,23 @@ def check_policy(runner: Runner, spec: ClusterSpec) -> CheckResult:
     st = cr.get("status") or {}
     gen = cr.get("metadata", {}).get("generation")
     observed = st.get("observedGeneration")
+    if not st:
+        # Freshly-installed operator: the CR exists before the first status
+        # write-back lands. A young CR with NO status at all is a pending
+        # first reconcile, not a stale one — `tpuctl verify` right after a
+        # healthy `apply --operator` must not be transiently red.
+        age = _cr_age_seconds(cr)
+        if age is None or age < POLICY_STATUS_GRACE_S:
+            return CheckResult(
+                "policy", True,
+                f"status not yet written (CR age "
+                f"{'unknown' if age is None else round(age)}s < "
+                f"{POLICY_STATUS_GRACE_S}s grace) — operator's first "
+                "reconcile pending")
+        return CheckResult(
+            "policy", False,
+            f"no status {round(age)}s after CR creation "
+            "(operator not running?)")
     if gen is not None and observed != gen:
         return CheckResult("policy", False,
                            f"status stale: observedGeneration={observed} "
