@@ -158,7 +158,9 @@ def metrics_scrape_roundtrip(platform: str) -> dict:
                 s.bind(("127.0.0.1", 0))
                 port = s.getsockname()[1]
             proc = subprocess.Popen(
-                [exporter, f"--port={port}", f"--metrics-file={metrics_file}"],
+                [exporter, f"--port={port}", f"--metrics-file={metrics_file}",
+                 # hermetic: don't union in a stray host metrics.d
+                 f"--metrics-dir={os.path.join(tmp, 'metrics.d')}"],
                 stderr=subprocess.PIPE)
             try:
                 for _ in range(50):

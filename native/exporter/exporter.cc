@@ -17,6 +17,7 @@
 // request is answered and closed. Single poll loop, no threads.
 
 #include <arpa/inet.h>
+#include <dirent.h>
 #include <errno.h>
 #include <fcntl.h>
 #include <netinet/in.h>
@@ -30,8 +31,11 @@
 #include <time.h>
 #include <unistd.h>
 
+#include <algorithm>
+#include <map>
 #include <sstream>
 #include <string>
+#include <utility>
 #include <vector>
 
 #include "../common/devenum.h"
@@ -48,7 +52,9 @@ struct Options {
   std::string device_glob = "/dev/accel*";
   std::string devfs_root;
   std::string accelerator = "v5e-8";
-  std::string metrics_file = "/run/tpu/metrics.prom";
+  std::string metrics_file = "/run/tpu/metrics.prom";  // legacy single file
+  std::string metrics_dir = "/run/tpu/metrics.d";      // multi-writer drop-dir
+  int stale_after_s = 300;   // skip source files older than this
   std::string libtpu_path;   // --status-mode check
   std::string plugin_socket; // --status-mode check
   int expect_chips = -1;     // default: accelerator's chips_per_host
@@ -69,47 +75,144 @@ std::vector<std::pair<int, std::string>> DiscoverChips(const Options& opt) {
   return chips;
 }
 
-// Relay validated lines from the runtime-metrics textfile: only tpu_-prefixed
-// metric lines and comments pass through (prevents a hostile writer from
-// injecting arbitrary series). Relay size is bounded — the writer shares the
-// node but not the exporter's memory budget; a runaway file must not balloon
-// every scrape response — with the truncation surfaced as its own gauge so
-// scrapers can alert instead of silently missing series.
-constexpr size_t kRelayLimitBytes = 1 << 20;  // 1 MiB
+// Relay validated lines from the runtime-metrics textfiles: only
+// tpu_-prefixed metric lines and comments pass through (prevents a hostile
+// writer from injecting arbitrary series). Relay size is bounded — the
+// writers share the node but not the exporter's memory budget; a runaway
+// file must not balloon every scrape response — with the truncation
+// surfaced as its own gauge so scrapers can alert instead of silently
+// missing series.
+//
+// Sources are the UNION of the legacy --metrics-file and every *.prom in
+// the --metrics-dir drop-dir (node-exporter textfile-collector pattern):
+// one file per writer, so two concurrent workloads on a node publish side
+// by side instead of clobbering each other. Files older than
+// --stale-after are evicted from the relay (a finished Job's gauges must
+// not haunt scrapes forever), and a series duplicated across writers
+// (e.g. both publish chip 0's HBM) resolves NEWEST-file-wins.
+constexpr size_t kRelayLimitBytes = 1 << 20;  // 1 MiB across all sources
 
-std::string RelayRuntimeMetrics(const std::string& file) {
-  FILE* f = fopen(file.c_str(), "r");
-  if (!f) return "";
-  std::string s, cur;
-  char chunk[1024];
+struct RelayAccum {
+  std::vector<std::string> order;            // key emission order
+  std::map<std::string, std::string> lines;  // key -> full line (no \n)
+  size_t bytes = 0;
   bool truncated = false;
+  int files = 0;
+  int stale = 0;
+};
+
+void RelayLine(const std::string& line, RelayAccum* acc) {
+  if (line.empty()) return;
+  if (!(line[0] == '#' || line.compare(0, 4, "tpu_") == 0)) return;
+  // Comments dedup on the whole line (identical HELP/TYPE from several
+  // writers emit once); samples dedup on name+labels so a later (newer)
+  // file's value REPLACES an earlier one for the same series.
+  std::string key = line;
+  if (line[0] != '#') {
+    size_t sp = line.find_last_of(' ');
+    if (sp != std::string::npos) key = line.substr(0, sp);
+  }
+  auto it = acc->lines.find(key);
+  if (it != acc->lines.end()) {
+    acc->bytes += line.size() - it->second.size();
+    it->second = line;
+    return;
+  }
+  if (acc->bytes + line.size() > kRelayLimitBytes) {
+    acc->truncated = true;
+    return;
+  }
+  acc->order.push_back(key);
+  acc->lines.emplace(std::move(key), line);
+  acc->bytes += line.size();
+}
+
+void RelayFile(const std::string& file, RelayAccum* acc) {
+  FILE* f = fopen(file.c_str(), "r");
+  if (!f) return;
+  ++acc->files;
+  std::string cur;
+  char chunk[1024];
   // Lines are accumulated whole before the filter/emit decision, so a
   // line longer than the chunk buffer is relayed (or dropped) WHOLE — a
   // continuation chunk can neither masquerade as a fresh series nor leave
   // an unterminated fragment — and the truncation break discards any
   // partial line rather than emitting it. Consumption is measured with
   // ftell, not strlen: embedded NUL bytes (crashed writer, sparse file)
-  // must not defeat the read bound.
+  // must not defeat the per-file read bound.
   while (fgets(chunk, sizeof(chunk), f)) {
     cur += chunk;
     long consumed = ftell(f);
     if (consumed < 0 || static_cast<size_t>(consumed) > kRelayLimitBytes) {
-      truncated = true;
+      acc->truncated = true;
       break;
     }
     if (!cur.empty() && cur.back() == '\n') {
-      if (cur[0] == '#' || cur.compare(0, 4, "tpu_") == 0) s += cur;
+      cur.pop_back();
+      RelayLine(cur, acc);
       cur.clear();
+      if (acc->truncated) break;
     }
   }
   // trailing line without a final newline: relay it if it passes
-  if (!truncated && !cur.empty() &&
-      (cur[0] == '#' || cur.compare(0, 4, "tpu_") == 0))
-    s += cur;
+  if (!acc->truncated && !cur.empty()) RelayLine(cur, acc);
   fclose(f);
-  if (!s.empty() && s.back() != '\n') s += "\n";
-  if (truncated)
-    s += "# HELP tpu_relay_truncated runtime-metrics file exceeded the relay "
+}
+
+std::string RelayRuntimeMetrics(const Options& opt) {
+  // Candidate sources with mtimes; relayed oldest-first so the newest
+  // file's duplicates win the per-series dedup. Nanosecond mtimes:
+  // concurrent writers routinely land in the same second, and a
+  // second-granularity tie would hand the win to readdir order.
+  std::vector<std::pair<int64_t, std::string>> sources;
+  time_t now = time(nullptr);
+  RelayAccum acc;
+  auto consider = [&](const std::string& path) {
+    struct stat sb;
+    if (stat(path.c_str(), &sb) != 0 || !S_ISREG(sb.st_mode)) return;
+    if (opt.stale_after_s > 0 && now - sb.st_mtime > opt.stale_after_s) {
+      ++acc.stale;
+      return;
+    }
+    int64_t ns = static_cast<int64_t>(sb.st_mtim.tv_sec) * 1000000000 +
+                 sb.st_mtim.tv_nsec;
+    sources.push_back({ns, path});
+  };
+  if (!opt.metrics_file.empty()) consider(opt.metrics_file);
+  if (!opt.metrics_dir.empty()) {
+    if (DIR* d = opendir(opt.metrics_dir.c_str())) {
+      struct dirent* ent;
+      while ((ent = readdir(d)) != nullptr) {
+        std::string name = ent->d_name;
+        if (name.size() > 5 &&
+            name.compare(name.size() - 5, 5, ".prom") == 0)
+          consider(opt.metrics_dir + "/" + name);
+      }
+      closedir(d);
+    }
+  }
+  std::stable_sort(sources.begin(), sources.end(),
+                   [](const auto& a, const auto& b) {
+                     return a.first < b.first;
+                   });
+  for (const auto& [mtime, path] : sources) {
+    (void)mtime;
+    RelayFile(path, &acc);
+    if (acc.truncated) break;
+  }
+  if (acc.files == 0 && acc.stale == 0) return "";
+  std::string s;
+  for (const auto& key : acc.order) s += acc.lines[key] + "\n";
+  s += "# HELP tpu_relay_files runtime-metrics source files relayed into "
+       "this scrape\n"
+       "# TYPE tpu_relay_files gauge\n"
+       "tpu_relay_files " + std::to_string(acc.files) + "\n" +
+       "# HELP tpu_relay_stale_files source files skipped as stale "
+       "(writer gone)\n"
+       "# TYPE tpu_relay_stale_files gauge\n"
+       "tpu_relay_stale_files " + std::to_string(acc.stale) + "\n";
+  if (acc.truncated)
+    s += "# HELP tpu_relay_truncated runtime-metrics relay exceeded its "
          "limit; series beyond it were dropped\n"
          "# TYPE tpu_relay_truncated gauge\n"
          "tpu_relay_truncated 1\n";
@@ -173,7 +276,7 @@ std::string RenderMetrics(const Options& opt,
       os << "tpu_hbm_capacity_bytes{chip=\"" << idx << "\"} "
          << (int64_t(acc->hbm_gib_per_chip) << 30) << "\n";
   }
-  os << RelayRuntimeMetrics(opt.metrics_file);
+  os << RelayRuntimeMetrics(opt);
   if (opt.status_mode) {
     StatusChecks st = RunChecks(opt, acc);
     os << "# HELP tpu_stack_check TPU stack health checks (1 = ok)\n"
@@ -234,6 +337,8 @@ int main(int argc, char** argv) {
     else if ((v = val("--devfs-root"))) opt.devfs_root = v;
     else if ((v = val("--accelerator"))) opt.accelerator = v;
     else if ((v = val("--metrics-file"))) opt.metrics_file = v;
+    else if ((v = val("--metrics-dir"))) opt.metrics_dir = v;
+    else if ((v = val("--stale-after"))) opt.stale_after_s = atoi(v);
     else if ((v = val("--libtpu-path"))) opt.libtpu_path = v;
     else if ((v = val("--plugin-socket"))) opt.plugin_socket = v;
     else if ((v = val("--expect-chips"))) opt.expect_chips = atoi(v);
@@ -244,6 +349,7 @@ int main(int argc, char** argv) {
       fprintf(stderr,
               "usage: tpu-metrics-exporter [--port=9400] [--device-glob=G]\n"
               "  [--devfs-root=D] [--accelerator=T] [--metrics-file=F]\n"
+              "  [--metrics-dir=D] [--stale-after=SECONDS]\n"
               "  [--status-mode --libtpu-path=P --plugin-socket=S\n"
               "   --expect-chips=N] [--fake-devices=N] [--once]\n");
       return 2;

@@ -7,17 +7,23 @@
 // written by the workload/libtpu side), and prints a table, one line
 // (--oneline, used by the libtpu-prep readiness probe), or JSON (--json).
 //
-// Runtime metrics interface: a Prometheus-style textfile (default
-// /run/tpu/metrics.prom) with lines like
+// Runtime metrics interface: Prometheus-style textfiles with lines like
 //   tpu_duty_cycle_percent{chip="0"} 37.5
 //   tpu_hbm_used_bytes{chip="0"} 1073741824
-// The same file feeds tpu-metrics-exporter; see docs/DELTAS.md.
+// Workloads publish per-writer files into the /run/tpu/metrics.d drop-dir
+// (legacy single /run/tpu/metrics.prom also read); non-stale files merge
+// oldest-first so the newest writer's value wins per chip — the same
+// union the tpu-metrics-exporter relays; see docs/DELTAS.md §5.
 
+#include <dirent.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
+#include <sys/stat.h>
+#include <time.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <map>
 #include <string>
 #include <vector>
@@ -64,7 +70,7 @@ std::vector<Chip> Discover(const std::string& device_glob,
   return chips;
 }
 
-// Parses `name{chip="N"} value` lines for the two metrics we display.
+// Parses `name{chip="N"} value` lines for the metrics we display.
 void MergeRuntimeMetrics(const std::string& file, std::vector<Chip>* chips) {
   FILE* f = fopen(file.c_str(), "r");
   if (!f) return;
@@ -90,6 +96,44 @@ void MergeRuntimeMetrics(const std::string& file, std::vector<Chip>* chips) {
   fclose(f);
 }
 
+// Merge the legacy file plus every non-stale *.prom in the drop-dir,
+// oldest-first (nanosecond mtimes) so the NEWEST writer's value wins per
+// chip — the same union/eviction rules as the exporter's relay.
+void MergeAllRuntimeMetrics(const std::string& file, const std::string& dir,
+                            int stale_after_s, std::vector<Chip>* chips) {
+  std::vector<std::pair<int64_t, std::string>> sources;
+  time_t now = time(nullptr);
+  auto consider = [&](const std::string& path) {
+    struct stat sb;
+    if (stat(path.c_str(), &sb) != 0 || !S_ISREG(sb.st_mode)) return;
+    if (stale_after_s > 0 && now - sb.st_mtime > stale_after_s) return;
+    int64_t ns = static_cast<int64_t>(sb.st_mtim.tv_sec) * 1000000000 +
+                 sb.st_mtim.tv_nsec;
+    sources.push_back({ns, path});
+  };
+  if (!file.empty()) consider(file);
+  if (!dir.empty()) {
+    if (DIR* d = opendir(dir.c_str())) {
+      struct dirent* ent;
+      while ((ent = readdir(d)) != nullptr) {
+        std::string name = ent->d_name;
+        if (name.size() > 5 &&
+            name.compare(name.size() - 5, 5, ".prom") == 0)
+          consider(dir + "/" + name);
+      }
+      closedir(d);
+    }
+  }
+  std::stable_sort(sources.begin(), sources.end(),
+                   [](const auto& a, const auto& b) {
+                     return a.first < b.first;
+                   });
+  for (const auto& [mtime, path] : sources) {
+    (void)mtime;
+    MergeRuntimeMetrics(path, chips);
+  }
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
@@ -97,6 +141,8 @@ int main(int argc, char** argv) {
   std::string devfs_root;
   std::string accelerator = "v5e-8";
   std::string metrics_file = "/run/tpu/metrics.prom";
+  std::string metrics_dir = "/run/tpu/metrics.d";
+  int stale_after_s = 300;
   int fake = -1;
   bool json = false, oneline = false;
 
@@ -112,13 +158,16 @@ int main(int argc, char** argv) {
     else if ((v = val("--devfs-root"))) devfs_root = v;
     else if ((v = val("--accelerator"))) accelerator = v;
     else if ((v = val("--metrics-file"))) metrics_file = v;
+    else if ((v = val("--metrics-dir"))) metrics_dir = v;
+    else if ((v = val("--stale-after"))) stale_after_s = atoi(v);
     else if ((v = val("--fake-devices"))) fake = atoi(v);
     else if (a == "--json") json = true;
     else if (a == "--oneline") oneline = true;
     else {
       fprintf(stderr,
               "usage: tpu-info [--device-glob=G] [--devfs-root=D] "
-              "[--accelerator=T] [--metrics-file=F] [--fake-devices=N] "
+              "[--accelerator=T] [--metrics-file=F] [--metrics-dir=D] "
+              "[--stale-after=S] [--fake-devices=N] "
               "[--json|--oneline]\n");
       return 2;
     }
@@ -126,7 +175,7 @@ int main(int argc, char** argv) {
 
   const tpud::AcceleratorType* acc = tpud::FindAccelerator(accelerator);
   auto chips = Discover(device_glob, devfs_root, fake);
-  MergeRuntimeMetrics(metrics_file, &chips);
+  MergeAllRuntimeMetrics(metrics_file, metrics_dir, stale_after_s, &chips);
 
   if (oneline) {
     printf("tpu-info: %zu chip(s) [%s %s]\n", chips.size(),

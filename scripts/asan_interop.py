@@ -138,6 +138,7 @@ def hammer_exporter(build: str) -> None:
     proc = subprocess.Popen(
         [os.path.join(build, "tpu-metrics-exporter"), f"--port={port}",
          "--fake-devices=8", "--status-mode", f"--metrics-file={metrics}",
+         f"--metrics-dir={os.path.dirname(metrics)}/no-metrics.d",
          "--libtpu-path=/nonexistent", "--expect-chips=8"],
         stderr=subprocess.PIPE, text=True)
     try:

@@ -217,60 +217,109 @@ def stage_metrics(t: Transcript, tmp: str) -> None:
     from tpu_cluster.workloads import runtime_metrics
 
     t.h2("Stage 4 — metrics exporter scrape (BASELINE config 4)")
-    metrics_file = os.path.join(tmp, "metrics.prom")
+    # multi-writer drop-dir (node-exporter textfile-collector pattern):
+    # this process publishes its per-writer file; a second file stands in
+    # for another pod's concurrent writer. The exporter relays the UNION.
+    mdir = os.path.join(tmp, "metrics.d")
+    os.makedirs(mdir, exist_ok=True)
+    metrics_file = os.path.join(mdir, f"{runtime_metrics.writer_id()}.prom")
     os.environ.setdefault("TPU_ACCELERATOR_TYPE", "v5e-8")
-    with runtime_metrics.duty_cycle_window(), \
-            runtime_metrics.tensorcore_window():
-        from tpu_cluster.workloads import smoke
-        smoke.matmul(256, 256, 256, iters=2)  # duty + FLOPs producer
-        runtime_metrics.write(metrics_file)
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-    proc = subprocess.Popen(
-        [binpath("tpu-metrics-exporter"), f"--port={port}",
-         "--fake-devices=8", f"--metrics-file={metrics_file}"],
-        stderr=subprocess.PIPE)
-    body = ""
+    # short trailing window so the idle-decay behavior is demonstrable in
+    # seconds (default 60s; same code path)
+    os.environ["TPU_METRICS_WINDOW_S"] = "2"
     try:
-        for _ in range(50):
+        with runtime_metrics.duty_cycle_window(), \
+                runtime_metrics.tensorcore_window():
+            from tpu_cluster.workloads import smoke
+            smoke.matmul(256, 256, 256, iters=2)  # duty + FLOPs producer
+            runtime_metrics.write(metrics_file)
+            with open(os.path.join(mdir, "other-pod-7.prom"), "w") as f:
+                f.write('tpu_hbm_used_bytes{chip="7"} 424242\n')
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                port = s.getsockname()[1]
+            proc = subprocess.Popen(
+                [binpath("tpu-metrics-exporter"), f"--port={port}",
+                 "--fake-devices=8", f"--metrics-dir={mdir}",
+                 "--metrics-file=/nonexistent"],
+                stderr=subprocess.PIPE)
+            body = ""
             try:
-                with urllib.request.urlopen(
-                        f"http://127.0.0.1:{port}/metrics", timeout=2) as r:
-                    body = r.read().decode()
-                break
-            except OSError:
-                time.sleep(0.1)
+                for _ in range(50):
+                    try:
+                        with urllib.request.urlopen(
+                                f"http://127.0.0.1:{port}/metrics",
+                                timeout=2) as r:
+                            body = r.read().decode()
+                        break
+                    except OSError:
+                        time.sleep(0.1)
+            finally:
+                proc.terminate()
+                proc.wait(timeout=10)
+            shown = [ln for ln in body.splitlines()
+                     if ln.startswith(("tpu_chips", "tpu_duty",
+                                       "tpu_tensorcore", "tpu_process",
+                                       "tpu_hbm_used", "tpu_relay_files"))]
+            t.emit(f"GET /metrics mid-run -> {len(body)} bytes; "
+                   "selected gauges:")
+            t.code("\n".join(shown))
+            t.check("tpu_chips_total 8" in body,
+                    "exporter's own census gauge served over HTTP")
+            duty_vals = [float(ln.rsplit(" ", 1)[1])
+                         for ln in body.splitlines()
+                         if ln.startswith("tpu_duty_cycle_percent{")]
+            # > 0 mid-run, == 0 after idle (checked below): the CONTRAST is
+            # the window-semantics proof; an absolute floor would be
+            # machine-speed dependent (busy is a few ms of CPU matmul)
+            t.check(bool(duty_vals) and duty_vals[0] > 0,
+                    "duty-cycle gauge carries a measured recent-activity "
+                    f"value mid-run ({duty_vals[0] if duty_vals else '?'}%, "
+                    "trailing-window rate, not a diluted lifetime average)")
+            t.check("tpu_tensorcore_utilization_percent{" in body,
+                    "workload-produced tensorcore-utilization gauge relayed "
+                    "end-to-end")
+            t.check('tpu_hbm_used_bytes{chip="7"} 424242' in body
+                    and "tpu_relay_files 2" in body,
+                    "ONE scrape carries BOTH concurrent writers' gauges "
+                    "(metrics.d union; no last-writer-wins clobbering)")
+            # the nvidia-smi-analog probe renders the same produced
+            # metrics — probed MID-RUN, while the trailing window still
+            # holds the activity
+            from tpu_cluster.discovery import devices as pydev
+            tree = os.path.join(tmp, "devfs")
+            pydev.make_fake_tree(tree, 8)
+            probe = subprocess.run(
+                [binpath("tpu-info"), f"--devfs-root={tree}",
+                 f"--metrics-file={metrics_file}", "--json"],
+                capture_output=True, text=True, timeout=30)
+            doc = json.loads(probe.stdout) if probe.returncode == 0 else {}
+            duty = (doc.get("chips") or [{}])[0].get("duty_cycle_percent")
+            scope = doc.get("duty_cycle_scope")
+            t.emit(f"\n`tpu-info --json` chip 0: duty_cycle_percent={duty} "
+                   f"(duty_cycle_scope={scope})")
+            t.check(probe.returncode == 0 and isinstance(duty, (int, float))
+                    and duty > 0 and scope == "process",
+                    "tpu-info renders the measured duty cycle (nvidia-smi "
+                    "util% analog) and declares its process scope")
+            # idle decay: wait out the trailing window, republish, rescrape
+            time.sleep(2.5)
+            runtime_metrics.write(metrics_file)
+            once = subprocess.run(
+                [binpath("tpu-metrics-exporter"), "--once",
+                 f"--metrics-dir={mdir}", "--metrics-file=/nonexistent",
+                 "--fake-devices=8"],
+                capture_output=True, text=True, timeout=30)
+            idle_lines = [ln for ln in once.stdout.splitlines()
+                          if ln.startswith("tpu_duty_cycle_percent{")]
+            idle_vals = [float(ln.rsplit(" ", 1)[1]) for ln in idle_lines]
+            t.emit("\nAfter 2.5s idle (window 2s), the same gauge:")
+            t.code("\n".join(idle_lines[:2]))
+            t.check(bool(idle_vals) and idle_vals[0] == 0.0,
+                    "after idle the gauge reads 0.0 — the window slid past "
+                    "the activity (never a tiny diluted average)")
     finally:
-        proc.terminate()
-        proc.wait(timeout=10)
-    shown = [ln for ln in body.splitlines()
-             if ln.startswith(("tpu_chips", "tpu_duty", "tpu_tensorcore",
-                               "tpu_process"))]
-    t.emit(f"GET /metrics -> {len(body)} bytes; selected gauges:")
-    t.code("\n".join(shown))
-    t.check("tpu_chips_total 8" in body,
-            "exporter's own census gauge served over HTTP")
-    t.check("tpu_duty_cycle_percent{" in body,
-            "workload-produced duty-cycle gauge relayed end-to-end")
-    t.check("tpu_tensorcore_utilization_percent{" in body,
-            "workload-produced tensorcore-utilization gauge relayed "
-            "end-to-end")
-    # the nvidia-smi-analog probe renders the same produced metrics
-    from tpu_cluster.discovery import devices as pydev
-    tree = os.path.join(tmp, "devfs")
-    pydev.make_fake_tree(tree, 8)
-    probe = subprocess.run(
-        [binpath("tpu-info"), f"--devfs-root={tree}",
-         f"--metrics-file={metrics_file}", "--json"],
-        capture_output=True, text=True, timeout=30)
-    doc = json.loads(probe.stdout) if probe.returncode == 0 else {}
-    duty = (doc.get("chips") or [{}])[0].get("duty_cycle_percent")
-    t.emit(f"\n`tpu-info --json` chip 0: duty_cycle_percent={duty}")
-    t.check(probe.returncode == 0 and isinstance(duty, (int, float))
-            and duty > 0,
-            "tpu-info renders the measured duty cycle (nvidia-smi util% "
-            "analog)")
+        os.environ.pop("TPU_METRICS_WINDOW_S", None)
 
 
 def main() -> int:

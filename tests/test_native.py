@@ -320,7 +320,8 @@ def test_tpu_info_runtime_metrics(native_build, tmp_path):
                   'tpu_hbm_used_bytes{chip="1"} 1073741824\n')
     out = subprocess.run(
         [binpath(native_build, "tpu-info"), f"--devfs-root={tmp_path}",
-         f"--metrics-file={mf}", "--json"],
+         f"--metrics-file={mf}",
+         f"--metrics-dir={tmp_path}/no-metrics.d", "--json"],
         check=True, capture_output=True, text=True)
     doc = json.loads(out.stdout)
     assert doc["chips"][0]["duty_cycle_percent"] == 37.5
@@ -369,7 +370,8 @@ def test_exporter_scrape(native_build, tmp_path):
     port = _free_port()
     proc = subprocess.Popen(
         [binpath(native_build, "tpu-metrics-exporter"), f"--port={port}",
-         f"--devfs-root={tmp_path}", f"--metrics-file={mf}"],
+         f"--devfs-root={tmp_path}", f"--metrics-file={mf}",
+         f"--metrics-dir={tmp_path}/no-metrics.d"],
         stderr=subprocess.PIPE)
     try:
         body = _wait_ready(port, proc)

@@ -42,7 +42,15 @@ def _job(spec: ClusterSpec, name: str, args: List[str], chips: int,
                 "limits": {resource: str(chips)},
                 "requests": {resource: str(chips)},
             },
+            # writable runtime-metrics hostPath: the Job publishes its
+            # per-writer gauges into /run/tpu/metrics.d for the exporter's
+            # union relay (the exporter mounts the same path read-only)
+            "volumeMounts": [{"name": "runtime-metrics",
+                              "mountPath": "/run/tpu"}],
         }],
+        "volumes": [{"name": "runtime-metrics",
+                     "hostPath": {"path": "/run/tpu",
+                                  "type": "DirectoryOrCreate"}}],
     }
     return {
         "apiVersion": "batch/v1",
