@@ -1,0 +1,74 @@
+// promsources — shared discovery of runtime-metrics textfile sources.
+//
+// One implementation of "which writer files feed this read, in what
+// order" for every consumer (tpu-metrics-exporter's relay, tpu-info's
+// merge): the legacy single --metrics-file plus every *.prom in the
+// metrics.d drop-dir, files stale past stale_after_s evicted, survivors
+// ordered oldest-first by NANOSECOND mtime so a consumer applying them in
+// order gives the newest writer the last word. Two binaries re-implementing
+// this would drift on eviction/ordering rules and report different unions
+// for the same node.
+
+#ifndef TPU_NATIVE_COMMON_PROMSOURCES_H_
+#define TPU_NATIVE_COMMON_PROMSOURCES_H_
+
+#include <dirent.h>
+#include <sys/stat.h>
+#include <time.h>
+
+#include <algorithm>
+#include <string>
+#include <vector>
+
+namespace promsources {
+
+struct Source {
+  int64_t mtime_ns;
+  std::string path;
+  std::string stem;  // filename without .prom — the writer identity
+};
+
+// stale_count (nullable) receives the number of evicted files.
+inline std::vector<Source> Collect(const std::string& file,
+                                   const std::string& dir,
+                                   int stale_after_s,
+                                   int* stale_count) {
+  std::vector<Source> out;
+  time_t now = time(nullptr);
+  int stale = 0;
+  auto consider = [&](const std::string& path, const std::string& stem) {
+    struct stat sb;
+    if (stat(path.c_str(), &sb) != 0 || !S_ISREG(sb.st_mode)) return;
+    if (stale_after_s > 0 && now - sb.st_mtime > stale_after_s) {
+      ++stale;
+      return;
+    }
+    int64_t ns = static_cast<int64_t>(sb.st_mtim.tv_sec) * 1000000000 +
+                 sb.st_mtim.tv_nsec;
+    out.push_back({ns, path, stem});
+  };
+  // the legacy single file carries no writer identity (empty stem)
+  if (!file.empty()) consider(file, "");
+  if (!dir.empty()) {
+    if (DIR* d = opendir(dir.c_str())) {
+      struct dirent* ent;
+      while ((ent = readdir(d)) != nullptr) {
+        std::string name = ent->d_name;
+        if (name.size() > 5 &&
+            name.compare(name.size() - 5, 5, ".prom") == 0)
+          consider(dir + "/" + name, name.substr(0, name.size() - 5));
+      }
+      closedir(d);
+    }
+  }
+  std::stable_sort(out.begin(), out.end(),
+                   [](const Source& a, const Source& b) {
+                     return a.mtime_ns < b.mtime_ns;
+                   });
+  if (stale_count) *stale_count = stale;
+  return out;
+}
+
+}  // namespace promsources
+
+#endif  // TPU_NATIVE_COMMON_PROMSOURCES_H_

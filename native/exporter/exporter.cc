@@ -39,6 +39,7 @@
 #include <vector>
 
 #include "../common/devenum.h"
+#include "../common/promsources.h"
 #include "../common/httpread.h"
 #include "../plugin/topology.h"
 
@@ -101,9 +102,26 @@ struct RelayAccum {
   int stale = 0;
 };
 
-void RelayLine(const std::string& line, RelayAccum* acc) {
-  if (line.empty()) return;
-  if (!(line[0] == '#' || line.compare(0, 4, "tpu_") == 0)) return;
+void RelayLine(const std::string& raw, const std::string& writer,
+               RelayAccum* acc) {
+  if (raw.empty()) return;
+  if (!(raw[0] == '#' || raw.compare(0, 4, "tpu_") == 0)) return;
+  // Unlabeled samples are PROCESS-scoped (tpu_process_devices, the
+  // timestamp, tpu_hbm_source): from the multi-writer drop-dir they get a
+  // writer label, otherwise two concurrent pods' values would collide on
+  // the dedup key and silently reduce to the newest writer's number (and
+  // emitting both without labels would be duplicate series — invalid
+  // Prometheus). Labeled (per-chip) series stay as-is: chip ids are
+  // node-scoped, so newest-wins per chip is the right resolution.
+  std::string line = raw;
+  if (!writer.empty() && raw[0] != '#') {
+    size_t sp = raw.find_last_of(' ');
+    if (sp != std::string::npos &&
+        raw.find('{') == std::string::npos) {
+      line = raw.substr(0, sp) + "{writer=\"" + writer + "\"}" +
+             raw.substr(sp);
+    }
+  }
   // Comments dedup on the whole line (identical HELP/TYPE from several
   // writers emit once); samples dedup on name+labels so a later (newer)
   // file's value REPLACES an earlier one for the same series.
@@ -127,7 +145,8 @@ void RelayLine(const std::string& line, RelayAccum* acc) {
   acc->bytes += line.size();
 }
 
-void RelayFile(const std::string& file, RelayAccum* acc) {
+void RelayFile(const std::string& file, const std::string& writer,
+               RelayAccum* acc) {
   FILE* f = fopen(file.c_str(), "r");
   if (!f) return;
   ++acc->files;
@@ -149,55 +168,27 @@ void RelayFile(const std::string& file, RelayAccum* acc) {
     }
     if (!cur.empty() && cur.back() == '\n') {
       cur.pop_back();
-      RelayLine(cur, acc);
+      RelayLine(cur, writer, acc);
       cur.clear();
       if (acc->truncated) break;
     }
   }
   // trailing line without a final newline: relay it if it passes
-  if (!acc->truncated && !cur.empty()) RelayLine(cur, acc);
+  if (!acc->truncated && !cur.empty()) RelayLine(cur, writer, acc);
   fclose(f);
 }
 
 std::string RelayRuntimeMetrics(const Options& opt) {
-  // Candidate sources with mtimes; relayed oldest-first so the newest
-  // file's duplicates win the per-series dedup. Nanosecond mtimes:
-  // concurrent writers routinely land in the same second, and a
-  // second-granularity tie would hand the win to readdir order.
-  std::vector<std::pair<int64_t, std::string>> sources;
-  time_t now = time(nullptr);
+  // Sources relayed oldest-first so the newest file's duplicates win the
+  // per-series dedup (shared discovery with tpu-info — promsources.h;
+  // nanosecond mtimes because concurrent writers routinely land in the
+  // same second, and a second-granularity tie would hand the win to
+  // readdir order).
   RelayAccum acc;
-  auto consider = [&](const std::string& path) {
-    struct stat sb;
-    if (stat(path.c_str(), &sb) != 0 || !S_ISREG(sb.st_mode)) return;
-    if (opt.stale_after_s > 0 && now - sb.st_mtime > opt.stale_after_s) {
-      ++acc.stale;
-      return;
-    }
-    int64_t ns = static_cast<int64_t>(sb.st_mtim.tv_sec) * 1000000000 +
-                 sb.st_mtim.tv_nsec;
-    sources.push_back({ns, path});
-  };
-  if (!opt.metrics_file.empty()) consider(opt.metrics_file);
-  if (!opt.metrics_dir.empty()) {
-    if (DIR* d = opendir(opt.metrics_dir.c_str())) {
-      struct dirent* ent;
-      while ((ent = readdir(d)) != nullptr) {
-        std::string name = ent->d_name;
-        if (name.size() > 5 &&
-            name.compare(name.size() - 5, 5, ".prom") == 0)
-          consider(opt.metrics_dir + "/" + name);
-      }
-      closedir(d);
-    }
-  }
-  std::stable_sort(sources.begin(), sources.end(),
-                   [](const auto& a, const auto& b) {
-                     return a.first < b.first;
-                   });
-  for (const auto& [mtime, path] : sources) {
-    (void)mtime;
-    RelayFile(path, &acc);
+  std::vector<promsources::Source> sources = promsources::Collect(
+      opt.metrics_file, opt.metrics_dir, opt.stale_after_s, &acc.stale);
+  for (const auto& src : sources) {
+    RelayFile(src.path, src.stem, &acc);
     if (acc.truncated) break;
   }
   if (acc.files == 0 && acc.stale == 0) return "";

@@ -59,6 +59,9 @@ struct Options {
   int stage_timeout_s = 600;
   int poll_ms = 1000;
   int status_port = 9402;    // 0 = disabled
+  bool leader_elect = false; // coordination.k8s.io Lease election
+  int lease_duration_s = 30;
+  std::string lease_name = "tpu-operator";
   bool once = false;
   bool allow_empty_daemonsets = false;
   bool insecure_skip_tls_verify = false;
@@ -245,7 +248,11 @@ std::string NowRfc3339() {
 class Operator {
  public:
   Operator(const Options& opt, kubeclient::Config cfg)
-      : opt_(opt), cfg_(std::move(cfg)) {}
+      : opt_(opt), cfg_(std::move(cfg)) {
+    char host[256] = "host";
+    gethostname(host, sizeof(host) - 1);
+    identity_ = std::string(host) + "-" + std::to_string(getpid());
+  }
 
   bool LoadOrReloadBundle() {
     // Baseline the fingerprint BEFORE reading the bundle: a re-render
@@ -411,9 +418,235 @@ class Operator {
     if (all_ok) last_pruned_fp_ = pass_bundle_fp_;
   }
 
+  // ---- Leader election (coordination.k8s.io/v1 Lease) ----------------
+  // Upstream gpu-operator ships controller-runtime leader election; two
+  // replicas of tpu-operator without it would fight (duplicate Events,
+  // racing PATCHes, double GC-prune). The standby loops on the lease and
+  // reconciles NOTHING until the holder's lease expires.
+
+  std::string InstallNamespace() const {
+    for (const auto& bo : bundle_) {
+      std::string ns = bo.obj->PathString("metadata.namespace");
+      if (!ns.empty()) return ns;
+    }
+    return "default";
+  }
+
+  static std::string NowRfc3339Micro() {
+    struct timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    struct tm tm;
+    gmtime_r(&ts.tv_sec, &tm);
+    char buf[64];
+    snprintf(buf, sizeof(buf), "%04d-%02d-%02dT%02d:%02d:%02d.%06ldZ",
+             tm.tm_year + 1900, tm.tm_mon + 1, tm.tm_mday, tm.tm_hour,
+             tm.tm_min, tm.tm_sec, ts.tv_nsec / 1000);
+    return buf;
+  }
+
+  static time_t ParseRfc3339(const std::string& s) {
+    struct tm tm = {};
+    int y, mo, d, h, mi, se;
+    if (sscanf(s.c_str(), "%d-%d-%dT%d:%d:%d", &y, &mo, &d, &h, &mi, &se)
+        != 6)
+      return 0;
+    tm.tm_year = y - 1900;
+    tm.tm_mon = mo - 1;
+    tm.tm_mday = d;
+    tm.tm_hour = h;
+    tm.tm_min = mi;
+    tm.tm_sec = se;
+    return timegm(&tm);
+  }
+
+  std::string LeaseCollection() const {
+    return "/apis/coordination.k8s.io/v1/namespaces/" + InstallNamespace() +
+           "/leases";
+  }
+
+  // Returns whether this instance holds the lease after the call. Safe on
+  // real apiservers: updates go through PUT of the GET'd object (carrying
+  // its resourceVersion), so a racing standby loses with a 409 instead of
+  // silently co-leading. Sets lease_error_ when the lease state could not
+  // be determined or written for NON-contention reasons (RBAC denial,
+  // missing namespace, unreachable apiserver) — callers surface that as
+  // unhealthy instead of a silent forever-standby.
+  //
+  // Expiry is judged by the LOCAL observation clock, never the holder's
+  // wall-clock renewTime (client-go leaderelection semantics): a takeover
+  // happens only after THIS instance has watched the lease stay unchanged
+  // for a full leaseDurationSeconds. Inter-node clock skew therefore
+  // cannot make a standby steal a live lease. Consequence: a fresh
+  // --once run cannot take over a crashed holder's lease (it has no
+  // observation history) — looping instances can, which is what replicas
+  // do in production.
+  bool TryAcquireLease() {
+    std::string path = LeaseCollection() + "/" + opt_.lease_name;
+    kubeclient::Response r = kubeclient::Call(cfg_, "GET", path);
+    time_t now = time(nullptr);
+    if (r.status == 404) {
+      std::string body =
+          "{\"apiVersion\": \"coordination.k8s.io/v1\", \"kind\": \"Lease\","
+          " \"metadata\": {\"name\": \"" + opt_.lease_name +
+          "\", \"namespace\": \"" + InstallNamespace() + "\"},"
+          " \"spec\": {\"holderIdentity\": \"" + identity_ +
+          "\", \"leaseDurationSeconds\": " +
+          std::to_string(opt_.lease_duration_s) +
+          ", \"acquireTime\": \"" + NowRfc3339Micro() +
+          "\", \"renewTime\": \"" + NowRfc3339Micro() +
+          "\", \"leaseTransitions\": 0}}";
+      kubeclient::Response c =
+          kubeclient::Call(cfg_, "POST", LeaseCollection(), body);
+      if (c.ok()) {
+        lease_error_ = false;
+        SetLeader(true, "acquired (new lease)");
+        last_renew_ = now;
+      } else if (c.status == 409) {
+        lease_error_ = false;
+        SetLeader(false, "lost create race");
+      } else {
+        // 403 = missing coordination.k8s.io RBAC; 404 = the install
+        // namespace does not exist yet (in-cluster it always does — the
+        // operator pod runs inside it; an external `tpu-operator
+        // --leader-elect` against a fresh cluster must create it first,
+        // e.g. via `tpuctl apply`). Either way this is a configuration
+        // failure, not contention: say so and report unhealthy rather
+        // than spinning as a silent healthy standby.
+        lease_error_ = true;
+        fprintf(stderr,
+                "tpu-operator: LEASE CREATE FAILED (HTTP %d%s): check "
+                "coordination.k8s.io/leases RBAC and that namespace %s "
+                "exists; refusing to reconcile without the lease\n",
+                c.status, c.status == 0 ? " transport" : "",
+                InstallNamespace().c_str());
+        SetLeader(false, "lease create failed (config error)");
+      }
+      return leader_;
+    }
+    if (!r.ok()) {
+      // Transport trouble: keep acting as leader only inside the lease we
+      // already hold (another instance cannot have taken it before our
+      // renewTime + duration passes); past that, step down.
+      lease_error_ = true;
+      if (leader_ && now - last_renew_ < opt_.lease_duration_s) return true;
+      SetLeader(false, "apiserver unreachable, lease unverifiable");
+      return false;
+    }
+    lease_error_ = false;
+    minijson::ValuePtr doc = minijson::Parse(r.body);
+    minijson::ValuePtr spec = doc ? doc->Get("spec") : nullptr;
+    std::string holder =
+        spec && spec->Get("holderIdentity")
+            ? spec->Get("holderIdentity")->as_string() : "";
+    std::string renew_str = spec && spec->Get("renewTime")
+                                ? spec->Get("renewTime")->as_string() : "";
+    double dur = opt_.lease_duration_s;
+    if (spec && spec->Get("leaseDurationSeconds"))
+      dur = spec->Get("leaseDurationSeconds")->as_number();
+    bool mine = holder == identity_;
+    bool expired;
+    if (holder.empty()) {
+      expired = true;  // gracefully released
+    } else if (!mine) {
+      // Local observation clock: (re)start the expiry timer whenever the
+      // lease CHANGES under us; only a lease we have seen frozen for a
+      // full duration is dead. Never compare the holder's wall-clock
+      // renewTime against ours.
+      std::string key = holder + "|" + renew_str;
+      if (key != observed_lease_) {
+        observed_lease_ = key;
+        observed_at_ = now;
+      }
+      expired = now - observed_at_ > static_cast<time_t>(dur);
+    } else {
+      expired = true;  // our own lease: renew regardless
+    }
+    if (!mine && !expired) {
+      SetLeader(false, ("standby; lease held by " + holder).c_str());
+      return false;
+    }
+    if (!spec) return leader_;  // malformed lease: keep current role
+    spec->Set("holderIdentity",
+              std::make_shared<minijson::Value>(identity_));
+    spec->Set("renewTime",
+              std::make_shared<minijson::Value>(NowRfc3339Micro()));
+    spec->Set("leaseDurationSeconds",
+              std::make_shared<minijson::Value>(
+                  static_cast<double>(opt_.lease_duration_s)));
+    if (!mine) {
+      spec->Set("acquireTime",
+                std::make_shared<minijson::Value>(NowRfc3339Micro()));
+      double transitions =
+          spec->Get("leaseTransitions")
+              ? spec->Get("leaseTransitions")->as_number() : 0;
+      spec->Set("leaseTransitions",
+                std::make_shared<minijson::Value>(transitions + 1));
+    }
+    kubeclient::Response u = kubeclient::Call(cfg_, "PUT", path,
+                                              doc->Dump());
+    if (u.ok()) {
+      if (!mine)
+        SetLeader(true, ("took over expired lease from " +
+                         (holder.empty() ? "<none>" : holder)).c_str());
+      else if (!leader_)
+        SetLeader(true, "re-acquired own lease");
+      leader_ = true;
+      last_renew_ = now;
+    } else if (leader_ && now - last_renew_ >= opt_.lease_duration_s) {
+      SetLeader(false, "renew failed past lease duration");
+    } else if (!mine) {
+      SetLeader(false, "lost takeover race");
+    }
+    return leader_;
+  }
+
+  bool lease_error() const { return lease_error_; }
+
+  // Graceful release on clean shutdown (controller-runtime's
+  // ReleaseOnCancel analog): an empty holderIdentity lets the next
+  // instance take over immediately instead of waiting out the lease.
+  // A crashed leader never gets here — that is what expiry is for.
+  void ReleaseLease() {
+    if (!opt_.leader_elect || !leader_) return;
+    std::string path = LeaseCollection() + "/" + opt_.lease_name;
+    kubeclient::Response r = kubeclient::Call(cfg_, "GET", path);
+    if (!r.ok()) return;
+    minijson::ValuePtr doc = minijson::Parse(r.body);
+    minijson::ValuePtr spec = doc ? doc->Get("spec") : nullptr;
+    if (!spec || !spec->Get("holderIdentity") ||
+        spec->Get("holderIdentity")->as_string() != identity_)
+      return;  // not ours anymore; nothing to release
+    spec->Set("holderIdentity", std::make_shared<minijson::Value>(
+                                    std::string("")));
+    spec->Set("renewTime",
+              std::make_shared<minijson::Value>(NowRfc3339Micro()));
+    if (kubeclient::Call(cfg_, "PUT", path, doc->Dump()).ok())
+      fprintf(stderr, "tpu-operator: released lease on shutdown\n");
+    leader_ = false;
+  }
+
+  void SetLeader(bool lead, const char* why) {
+    if (lead != leader_)
+      fprintf(stderr, "tpu-operator: leader-election [%s]: %s -> %s\n",
+              identity_.c_str(), why, lead ? "LEADER" : "standby");
+    leader_ = lead;
+  }
+
+  bool leader() const { return leader_; }
+
   void RunForever() {
     int failures = 0;
     while (!g_stop) {
+      if (opt_.leader_elect && !TryAcquireLease()) {
+        // Standby is inert: no bundle reload, no reconcile, no Events —
+        // it only watches the lease. Watching a healthy holder IS its
+        // job; failing to even determine the lease state (RBAC, missing
+        // namespace, transport) is not, and must page someone.
+        healthy_ = !lease_error_;
+        SleepWatchingInputs(
+            std::max(1000, opt_.lease_duration_s * 1000 / 3));
+        continue;
+      }
       // The bundle is a mounted ConfigMap that kubelet live-updates; reload
       // each pass so a re-rendered bundle rolls out without a pod restart
       // (a stale snapshot would merge-PATCH the upgrade away as "drift").
@@ -448,6 +681,11 @@ class Operator {
       }
       sleep_ms = static_cast<int>(
           sleep_ms * (0.9 + 0.2 * (rand() / double(RAND_MAX))));
+      // A leader must renew well inside the lease duration, whatever the
+      // reconcile interval says.
+      if (opt_.leader_elect)
+        sleep_ms = std::min(sleep_ms,
+                            std::max(1000, opt_.lease_duration_s * 1000 / 3));
       SleepWatchingInputs(sleep_ms);
     }
   }
@@ -545,6 +783,11 @@ class Operator {
       arr->Append(o);
     }
     root->Set("objects", arr);
+    if (opt_.leader_elect) {
+      root->Set("role", std::make_shared<minijson::Value>(
+                            std::string(leader_ ? "leader" : "standby")));
+      root->Set("identity", std::make_shared<minijson::Value>(identity_));
+    }
     if (!opt_.policy.empty()) {
       auto p = minijson::Value::MakeObject();
       p->Set("name", std::make_shared<minijson::Value>(opt_.policy));
@@ -578,7 +821,11 @@ class Operator {
              "tpu_operator_policy_generation %.0f\n",
              bundle_.size(), applied, ready, disabled, passes_,
              healthy_ ? 1 : 0, policy_generation_);
-    return buf;
+    std::string out = buf;
+    if (opt_.leader_elect)
+      out += "# TYPE tpu_operator_leader gauge\n"
+             "tpu_operator_leader " + std::to_string(leader_ ? 1 : 0) + "\n";
+    return out;
   }
 
   bool healthy() const { return healthy_; }
@@ -899,6 +1146,13 @@ class Operator {
   double policy_generation_ = 0;
   bool policy_seen_ = false;
   bool policy_missing_ = false;
+  // leader election
+  std::string identity_;
+  bool leader_ = false;
+  bool lease_error_ = false;
+  time_t last_renew_ = 0;
+  std::string observed_lease_;   // holder|renewTime last seen on a
+  time_t observed_at_ = 0;       // foreign lease, and when WE saw it
 };
 
 bool FlagVal(const char* arg, const char* name, std::string* out) {
@@ -931,6 +1185,12 @@ int main(int argc, char** argv) {
     if (FlagVal(a, "--poll-ms", &sval)) { opt.poll_ms = atoi(sval.c_str()); continue; }
     if (FlagVal(a, "--status-port", &sval)) { opt.status_port = atoi(sval.c_str()); continue; }
     if (strcmp(a, "--once") == 0) { opt.once = true; continue; }
+    if (strcmp(a, "--leader-elect") == 0) { opt.leader_elect = true; continue; }
+    if (FlagVal(a, "--lease-duration", &sval)) {
+      opt.lease_duration_s = atoi(sval.c_str());
+      continue;
+    }
+    if (FlagVal(a, "--lease-name", &sval)) { opt.lease_name = sval; continue; }
     if (strcmp(a, "--allow-empty-daemonsets") == 0) {
       opt.allow_empty_daemonsets = true;
       continue;
@@ -946,6 +1206,7 @@ int main(int argc, char** argv) {
             "  [--bundle-dir=DIR] [--policy=NAME] [--policy-poll-ms=MS]\n"
             "  [--interval=SECS] [--stage-timeout=SECS]\n"
             "  [--poll-ms=MS] [--status-port=PORT] [--once]\n"
+            "  [--leader-elect] [--lease-duration=SECS] [--lease-name=N]\n"
             "  [--allow-empty-daemonsets] [--insecure-skip-tls-verify]\n",
             a);
     return 2;
@@ -985,11 +1246,22 @@ int main(int argc, char** argv) {
           opt.bundle_dir.c_str(), opt.status_port);
 
   if (opt.once) {
+    if (opt.leader_elect && !op.TryAcquireLease()) {
+      if (op.lease_error()) return 1;  // config error, already logged
+      // Inert standby: distinct exit code so scripts can tell "another
+      // instance holds the lease" from a failed reconcile.
+      fprintf(stderr, "tpu-operator: standby (lease held elsewhere); "
+              "--once exits without reconciling\n");
+      printf("%s", op.StatusJson().c_str());
+      return 3;
+    }
     bool ok = op.ReconcilePass();
     op.set_healthy(ok);
     printf("%s", op.StatusJson().c_str());
+    op.ReleaseLease();
     return ok ? 0 : 1;
   }
   op.RunForever();
+  op.ReleaseLease();
   return 0;
 }

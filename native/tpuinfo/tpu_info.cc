@@ -29,6 +29,7 @@
 #include <vector>
 
 #include "../common/devenum.h"
+#include "../common/promsources.h"
 #include "../plugin/topology.h"
 
 namespace {
@@ -97,41 +98,14 @@ void MergeRuntimeMetrics(const std::string& file, std::vector<Chip>* chips) {
 }
 
 // Merge the legacy file plus every non-stale *.prom in the drop-dir,
-// oldest-first (nanosecond mtimes) so the NEWEST writer's value wins per
-// chip — the same union/eviction rules as the exporter's relay.
+// oldest-first so the NEWEST writer's value wins per chip — the same
+// union/eviction/ordering as the exporter's relay, via the SHARED source
+// discovery (native/common/promsources.h).
 void MergeAllRuntimeMetrics(const std::string& file, const std::string& dir,
                             int stale_after_s, std::vector<Chip>* chips) {
-  std::vector<std::pair<int64_t, std::string>> sources;
-  time_t now = time(nullptr);
-  auto consider = [&](const std::string& path) {
-    struct stat sb;
-    if (stat(path.c_str(), &sb) != 0 || !S_ISREG(sb.st_mode)) return;
-    if (stale_after_s > 0 && now - sb.st_mtime > stale_after_s) return;
-    int64_t ns = static_cast<int64_t>(sb.st_mtim.tv_sec) * 1000000000 +
-                 sb.st_mtim.tv_nsec;
-    sources.push_back({ns, path});
-  };
-  if (!file.empty()) consider(file);
-  if (!dir.empty()) {
-    if (DIR* d = opendir(dir.c_str())) {
-      struct dirent* ent;
-      while ((ent = readdir(d)) != nullptr) {
-        std::string name = ent->d_name;
-        if (name.size() > 5 &&
-            name.compare(name.size() - 5, 5, ".prom") == 0)
-          consider(dir + "/" + name);
-      }
-      closedir(d);
-    }
-  }
-  std::stable_sort(sources.begin(), sources.end(),
-                   [](const auto& a, const auto& b) {
-                     return a.first < b.first;
-                   });
-  for (const auto& [mtime, path] : sources) {
-    (void)mtime;
-    MergeRuntimeMetrics(path, chips);
-  }
+  for (const auto& src :
+       promsources::Collect(file, dir, stale_after_s, nullptr))
+    MergeRuntimeMetrics(src.path, chips);
 }
 
 }  // namespace

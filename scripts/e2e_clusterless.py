@@ -86,6 +86,7 @@ def stage_operator(t: Transcript, api, bundle_dir: str) -> None:
         return subprocess.run(
             [binpath("tpu-operator"), f"--apiserver={api.url}",
              f"--bundle-dir={bundle_dir}", "--policy=default", "--once",
+             "--leader-elect",  # same args as the rendered Deployment
              "--poll-ms=20", "--stage-timeout=30", "--status-port=0"],
             capture_output=True, text=True, timeout=120)
 
@@ -291,7 +292,9 @@ def stage_metrics(t: Transcript, tmp: str) -> None:
             pydev.make_fake_tree(tree, 8)
             probe = subprocess.run(
                 [binpath("tpu-info"), f"--devfs-root={tree}",
-                 f"--metrics-file={metrics_file}", "--json"],
+                 f"--metrics-file={metrics_file}",
+                 f"--metrics-dir={mdir}",  # hermetic: never the host's
+                 "--json"],
                 capture_output=True, text=True, timeout=30)
             doc = json.loads(probe.stdout) if probe.returncode == 0 else {}
             duty = (doc.get("chips") or [{}])[0].get("duty_cycle_percent")

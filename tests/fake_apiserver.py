@@ -52,7 +52,7 @@ COLLECTION_SEGMENTS = frozenset({
     "namespaces", "configmaps", "secrets", "services", "serviceaccounts",
     "pods", "events", "daemonsets", "deployments", "statefulsets", "jobs",
     "clusterroles", "clusterrolebindings", "roles", "rolebindings",
-    "customresourcedefinitions", "tpustackpolicies", "nodes",
+    "customresourcedefinitions", "tpustackpolicies", "nodes", "leases",
 })
 
 

@@ -143,6 +143,9 @@ def test_exporter_relays_union_of_concurrent_writers(native_build, tmp_path):
     assert 'tpu_hbm_used_bytes{chip="4"} 222' in proc.stdout
     assert "tpu_relay_files 2" in proc.stdout
     assert "tpu_relay_stale_files 0" in proc.stdout
+    # PROCESS-scoped (unlabeled) series get a writer label in the union:
+    # two pods' tpu_process_devices must not collide into one series
+    assert 'tpu_process_devices{writer="podA-12"} 4' in proc.stdout
 
 
 def test_exporter_evicts_stale_writer_files(native_build, tmp_path):
@@ -162,7 +165,7 @@ def test_exporter_evicts_stale_writer_files(native_build, tmp_path):
          f"--metrics-dir={mdir}", "--metrics-file=/nonexistent",
          "--stale-after=300", "--fake-devices=2", "--accelerator=v5e-8"],
         capture_output=True, text=True, check=True)
-    assert "tpu_live_gauge 1" in proc.stdout
+    assert 'tpu_live_gauge{writer="live-1"} 1' in proc.stdout
     assert "tpu_dead_gauge" not in proc.stdout
     assert "tpu_relay_files 1" in proc.stdout
     assert "tpu_relay_stale_files 1" in proc.stdout
@@ -188,7 +191,7 @@ def test_exporter_duplicate_series_newest_file_wins(native_build, tmp_path):
         capture_output=True, text=True, check=True)
     assert 'tpu_duty_cycle_percent{chip="0"} 99' in proc.stdout
     assert 'tpu_duty_cycle_percent{chip="0"} 11' not in proc.stdout
-    assert "tpu_only_in_older 5" in proc.stdout
+    assert 'tpu_only_in_older{writer="older"} 5' in proc.stdout
 
 
 def test_writer_resolves_drop_dir_path(tmp_path, monkeypatch):

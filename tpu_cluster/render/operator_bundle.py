@@ -236,6 +236,11 @@ def rbac(spec: ClusterSpec) -> List[Dict[str, Any]]:
             {"apiGroups": [POLICY_GROUP],
              "resources": [POLICY_PLURAL, f"{POLICY_PLURAL}/status"],
              "verbs": ["get", "list", "watch", "patch"]},
+            # Leader election: a second replica stands by on this Lease
+            # until the holder dies (upstream gpu-operator parity).
+            {"apiGroups": ["coordination.k8s.io"],
+             "resources": ["leases"],
+             "verbs": ["get", "create", "update"]},
         ],
     }
     binding = {
@@ -284,6 +289,9 @@ def deployment(spec: ClusterSpec) -> Dict[str, Any]:
                         "args": [f"--bundle-dir={BUNDLE_MOUNT}",
                                  f"--status-port={STATUS_PORT}",
                                  f"--policy={POLICY_NAME}",
+                                 # a second replica is inert until the
+                                 # holder's Lease expires
+                                 "--leader-elect",
                                  "--allow-empty-daemonsets"],
                         "ports": [{"name": "status",
                                    "containerPort": STATUS_PORT}],

@@ -355,11 +355,16 @@ def collect_lines(now: Optional[float] = None) -> List[str]:
     ]
     duty = _active_sampler.percent() if _active_sampler else None
     if duty is not None:
+        # HELP text carries NO writer-specific values (like the window
+        # length): two writers with different TPU_METRICS_WINDOW_S must
+        # dedup to ONE HELP line in the exporter's union, or strict
+        # Prometheus parsers reject the scrape for duplicate HELP. The
+        # actual window rides its own gauge below.
         lines += [
             "# HELP tpu_duty_cycle_percent fraction of wall-time the owning "
             "workload had device execution in flight, over the trailing "
-            f"{_window_s():g}s window (process-scoped: one value, every "
-            "local chip)",
+            "window published as tpu_metrics_window_seconds "
+            "(process-scoped: one value, every local chip)",
             "# TYPE tpu_duty_cycle_percent gauge",
         ]
         for d in devices:
@@ -375,7 +380,8 @@ def collect_lines(now: Optional[float] = None) -> List[str]:
         lines += [
             "# HELP tpu_tensorcore_utilization_percent achieved model "
             "FLOP rate vs the per-chip bf16 peak (MFU, as a percentage) "
-            f"over the trailing {_window_s():g}s window",
+            "over the trailing window published as "
+            "tpu_metrics_window_seconds",
             "# TYPE tpu_tensorcore_utilization_percent gauge",
         ]
         for d in devices:
@@ -388,6 +394,10 @@ def collect_lines(now: Optional[float] = None) -> List[str]:
         "# HELP tpu_process_devices local devices owned by the writer",
         "# TYPE tpu_process_devices gauge",
         f"tpu_process_devices {len(devices)}",
+        "# HELP tpu_metrics_window_seconds trailing window the duty/"
+        "tensorcore gauges are computed over",
+        "# TYPE tpu_metrics_window_seconds gauge",
+        f"tpu_metrics_window_seconds {_window_s():g}",
         "# TYPE tpu_runtime_metrics_timestamp_seconds gauge",
         f"tpu_runtime_metrics_timestamp_seconds "
         f"{int(now if now is not None else time.time())}",
