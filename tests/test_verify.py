@@ -434,3 +434,22 @@ def test_triage_reports_policy_disabled_operands(spec):
     # no CR (non-operator installs): no policy section, no failure
     text = triage.run_triage(spec, CannedRunner(healthy=True)).text()
     assert "disabled by TpuStackPolicy" not in text
+
+
+def test_triage_shows_operator_lease_holder(spec):
+    """HA installs: 'why is this operator pod idle' is answered by the
+    Lease — triage shows the holder; absent Lease shows nothing."""
+    runner = CannedRunner(healthy=True)
+    runner.responses["get lease -n tpu-system tpu-operator"] = {
+        "kind": "Lease",
+        "metadata": {"name": "tpu-operator", "namespace": "tpu-system"},
+        "spec": {"holderIdentity": "tpu-operator-abc12-7",
+                 "renewTime": "2026-07-30T12:00:00.000000Z",
+                 "leaseDurationSeconds": 30, "leaseTransitions": 2}}
+    text = triage.run_triage(spec, runner).text()
+    assert "operator leader election" in text
+    assert "tpu-operator-abc12-7" in text
+    assert "standbys by design" in text
+
+    text = triage.run_triage(spec, CannedRunner(healthy=True)).text()
+    assert "operator leader election" not in text

@@ -115,6 +115,27 @@ def run_triage(spec: ClusterSpec,
                           "\":{\"enabled\":true}}}}')"
                           for n in disabled))
 
+    # 2d. operator leader election: with >1 replica, "why is this
+    # operator pod idle?" is usually "it is the standby" — show the Lease
+    # holder so the answer is one read away. Absent Lease = leader
+    # election not in use (single-replica default); ignore fetch errors.
+    from .verify import _kubectl_json
+    lease = _kubectl_json(runner, ["get", "lease", "-n", ns, "tpu-operator",
+                                   "--ignore-not-found"])
+    if lease:
+        lease_spec = lease.get("spec", {})
+        holder = lease_spec.get("holderIdentity") or "(released)"
+        report.add(
+            "operator leader election",
+            f"lease holder: {holder}\n"
+            f"renewed: {lease_spec.get('renewTime', '?')} "
+            f"(duration {lease_spec.get('leaseDurationSeconds', '?')}s, "
+            f"transitions "
+            f"{lease_spec.get('leaseTransitions', 0)})\n"
+            "other replicas are standbys by design; a stale renewTime "
+            "with a wedged stack means the holder is stuck — delete "
+            "its pod to force a handoff")
+
     # 3. per-node health from the node-status-exporter (the automated
     # version of "confirm the instance really has a GPU", README.md:187)
     if spec.tpu.operand("nodeStatusExporter").enabled:
