@@ -287,8 +287,12 @@ def standard_config() -> BurninConfig:
       b16 ...................... 0.755  (activation HBM pressure)
       remat="attn" ............. 0.794  (recompute loses to XLA's saved-
          residual schedule at S=512, same as the wide-shape sweep)
+      remat="dots" ............. 0.749  (same story, bigger loss)
       attention="flash" ........ 0.735  (stock Pallas kernel does not
          amortise at S=512; its win case is long-seq)
+      fused [d,3d] QKV matmul .. 0.813  (within run-to-run noise of the
+         three separate projections — XLA already schedules them well;
+         not adopted, no measured win for the extra param plumbing)
 
     The measured ceiling for honest 4x geometry on this chip is ~0.82-
     0.84; the bench headline stays at the GPT-J shape rather than
