@@ -158,3 +158,16 @@ def test_timed_steps_measures_train_throughput():
     assert ts["tflops"] >= 0
     assert [p["steps"] for p in ts["points"]] == [2, 6]
     assert ts["tokens_per_s"] > 0
+
+
+def test_bf16_master_params_train():
+    """param_dtype="bf16" (pure-bf16 weights/grads/update, the bench's
+    labeled standard_bf16_params entry) must still converge: precision of
+    STORAGE changes, the f32 loss arithmetic does not."""
+    from dataclasses import replace
+
+    from tpu_cluster.workloads import burnin
+
+    r = burnin.run(steps=4, cfg=replace(burnin.BurninConfig(),
+                                        param_dtype="bf16"))
+    assert r["ok"], r
