@@ -12,7 +12,10 @@
 #ifndef TPU_NATIVE_COMMON_PROMSOURCES_H_
 #define TPU_NATIVE_COMMON_PROMSOURCES_H_
 
+#include <ctype.h>
 #include <dirent.h>
+#include <stdint.h>
+#include <stdio.h>
 #include <sys/stat.h>
 #include <time.h>
 
@@ -25,18 +28,58 @@ namespace promsources {
 struct Source {
   int64_t mtime_ns;
   std::string path;
-  std::string stem;  // filename without .prom — the writer identity
+  std::string stem;  // sanitized filename stem — the writer identity
 };
 
-// stale_count (nullable) receives the number of evicted files.
+// Writers name their own files on a shared hostPath; the stem becomes a
+// Prometheus label VALUE, so it is restricted to label-safe characters —
+// a quote/backslash/newline in a hostile filename must not break (or
+// smuggle series into) the scrape text. When sanitization CHANGES the
+// stem, a short hash of the raw bytes is appended so two distinct raw
+// names cannot collapse onto one writer label ("train job" vs
+// "train_job" impersonation — the cross-writer isolation the label
+// exists for).
+inline std::string SanitizeStem(const std::string& raw) {
+  std::string out;
+  bool changed = false;
+  for (char c : raw) {
+    bool ok = isalnum(static_cast<unsigned char>(c)) || c == '_' ||
+              c == '-' || c == '.';
+    out += ok ? c : '_';
+    changed |= !ok;
+  }
+  if (changed) {
+    uint32_t h = 2166136261u;  // FNV-1a of the raw bytes
+    for (char c : raw) {
+      h ^= static_cast<unsigned char>(c);
+      h *= 16777619u;
+    }
+    char buf[12];
+    snprintf(buf, sizeof(buf), "-%08x", h);
+    out += buf;
+  }
+  return out;
+}
+
+// A runaway writer (or an attack) dropping thousands of files must not
+// blow up every scrape: the newest kMaxSources drop-dir files win (they
+// carry the live values under newest-wins dedup) and only those are
+// OPENED/READ; the overflow is reported via dropped_count. Note the
+// residual cost: enumerating mtimes still stat()s every *.prom in the
+// dir — the cap bounds reads, not directory enumeration.
+constexpr size_t kMaxSources = 256;
+
+// stale_count / dropped_count (nullable) receive eviction/overflow counts.
 inline std::vector<Source> Collect(const std::string& file,
                                    const std::string& dir,
                                    int stale_after_s,
-                                   int* stale_count) {
+                                   int* stale_count,
+                                   int* dropped_count = nullptr) {
   std::vector<Source> out;
   time_t now = time(nullptr);
   int stale = 0;
-  auto consider = [&](const std::string& path, const std::string& stem) {
+  auto consider = [&](const std::string& path, const std::string& stem,
+                      bool sanitize) {
     struct stat sb;
     if (stat(path.c_str(), &sb) != 0 || !S_ISREG(sb.st_mode)) return;
     if (stale_after_s > 0 && now - sb.st_mtime > stale_after_s) {
@@ -45,10 +88,8 @@ inline std::vector<Source> Collect(const std::string& file,
     }
     int64_t ns = static_cast<int64_t>(sb.st_mtim.tv_sec) * 1000000000 +
                  sb.st_mtim.tv_nsec;
-    out.push_back({ns, path, stem});
+    out.push_back({ns, path, sanitize ? SanitizeStem(stem) : stem});
   };
-  // the legacy single file carries no writer identity (empty stem)
-  if (!file.empty()) consider(file, "");
   if (!dir.empty()) {
     if (DIR* d = opendir(dir.c_str())) {
       struct dirent* ent;
@@ -56,7 +97,8 @@ inline std::vector<Source> Collect(const std::string& file,
         std::string name = ent->d_name;
         if (name.size() > 5 &&
             name.compare(name.size() - 5, 5, ".prom") == 0)
-          consider(dir + "/" + name, name.substr(0, name.size() - 5));
+          consider(dir + "/" + name, name.substr(0, name.size() - 5),
+                   true);
       }
       closedir(d);
     }
@@ -65,7 +107,26 @@ inline std::vector<Source> Collect(const std::string& file,
                    [](const Source& a, const Source& b) {
                      return a.mtime_ns < b.mtime_ns;
                    });
+  int dropped = 0;
+  if (out.size() > kMaxSources) {
+    dropped = static_cast<int>(out.size() - kMaxSources);
+    out.erase(out.begin(), out.end() - kMaxSources);  // keep newest
+  }
+  // The explicitly configured legacy file (empty stem = no writer label)
+  // is EXEMPT from the cap: a drop-dir flood must not be able to evict
+  // the operator-configured source's series from the scrape. Added after
+  // the cap, re-sorted so newest-wins ordering still holds.
+  if (!file.empty()) {
+    size_t before = out.size();
+    consider(file, "", false);
+    if (out.size() > before)
+      std::stable_sort(out.begin(), out.end(),
+                       [](const Source& a, const Source& b) {
+                         return a.mtime_ns < b.mtime_ns;
+                       });
+  }
   if (stale_count) *stale_count = stale;
+  if (dropped_count) *dropped_count = dropped;
   return out;
 }
 

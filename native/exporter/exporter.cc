@@ -100,6 +100,7 @@ struct RelayAccum {
   bool truncated = false;
   int files = 0;
   int stale = 0;
+  int dropped = 0;  // sources beyond the promsources cap
 };
 
 void RelayLine(const std::string& raw, const std::string& writer,
@@ -186,7 +187,8 @@ std::string RelayRuntimeMetrics(const Options& opt) {
   // readdir order).
   RelayAccum acc;
   std::vector<promsources::Source> sources = promsources::Collect(
-      opt.metrics_file, opt.metrics_dir, opt.stale_after_s, &acc.stale);
+      opt.metrics_file, opt.metrics_dir, opt.stale_after_s, &acc.stale,
+      &acc.dropped);
   for (const auto& src : sources) {
     RelayFile(src.path, src.stem, &acc);
     if (acc.truncated) break;
@@ -202,6 +204,12 @@ std::string RelayRuntimeMetrics(const Options& opt) {
        "(writer gone)\n"
        "# TYPE tpu_relay_stale_files gauge\n"
        "tpu_relay_stale_files " + std::to_string(acc.stale) + "\n";
+  // unconditional like the stale gauge: a clean 0 after a flood clears
+  // must be distinguishable from the metric not existing
+  s += "# HELP tpu_relay_dropped_sources source files beyond the "
+       "per-scrape cap (newest kept)\n"
+       "# TYPE tpu_relay_dropped_sources gauge\n"
+       "tpu_relay_dropped_sources " + std::to_string(acc.dropped) + "\n";
   if (acc.truncated)
     s += "# HELP tpu_relay_truncated runtime-metrics relay exceeded its "
          "limit; series beyond it were dropped\n"

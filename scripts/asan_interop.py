@@ -132,13 +132,24 @@ def hammer_exporter(build: str) -> None:
     sock.bind(("127.0.0.1", 0))
     port = sock.getsockname()[1]
     sock.close()
-    metrics = os.path.join(tempfile.mkdtemp(), "metrics.prom")
+    tmp = tempfile.mkdtemp()
+    metrics = os.path.join(tmp, "metrics.prom")
     with open(metrics, "w", encoding="utf-8") as f:
         f.write("tpu_custom_gauge 7\nevil 666\n")
+    # hostile multi-writer drop-dir under the sanitizers: evil filename
+    # (label-injection attempt), NUL/garbage content, long unterminated
+    # line, empty file
+    mdir = os.path.join(tmp, "metrics.d")
+    os.makedirs(mdir, exist_ok=True)
+    with open(os.path.join(mdir, 'ev"il\\x.prom'), "w") as f:
+        f.write('tpu_h{chip="0"} 1\n')
+    with open(os.path.join(mdir, "garbage.prom"), "wb") as f:
+        f.write(b"\x00\x01tpu_\xffbad\n" + b"g" * 5000 + b"\ntpu_ok 2")
+    open(os.path.join(mdir, "empty.prom"), "w").close()
     proc = subprocess.Popen(
         [os.path.join(build, "tpu-metrics-exporter"), f"--port={port}",
          "--fake-devices=8", "--status-mode", f"--metrics-file={metrics}",
-         f"--metrics-dir={os.path.dirname(metrics)}/no-metrics.d",
+         f"--metrics-dir={mdir}",
          "--libtpu-path=/nonexistent", "--expect-chips=8"],
         stderr=subprocess.PIPE, text=True)
     try:

@@ -194,6 +194,82 @@ def test_exporter_duplicate_series_newest_file_wins(native_build, tmp_path):
     assert 'tpu_only_in_older{writer="older"} 5' in proc.stdout
 
 
+def _fnv1a(raw: bytes) -> int:
+    h = 2166136261
+    for b in raw:
+        h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+    return h
+
+
+def test_exporter_sanitizes_hostile_writer_filenames(native_build, tmp_path):
+    """The writer filename stem becomes a Prometheus label VALUE: quotes/
+    backslashes in a hostile filename must not break the scrape text or
+    smuggle label syntax — and since sanitization is lossy, a changed stem
+    gets a raw-bytes hash suffix so 'train job' cannot impersonate
+    'train_job'."""
+    mdir = tmp_path / "metrics.d"
+    mdir.mkdir()
+    evil = 'evil"},x="'
+    (mdir / f"{evil}.prom").write_text("tpu_evil_gauge 1\n")
+    (mdir / "train_job.prom").write_text("tpu_tj 1\n")
+    (mdir / "train job.prom").write_text("tpu_tj2 1\n")
+    proc = subprocess.run(
+        [binpath(native_build, "tpu-metrics-exporter"), "--once",
+         f"--metrics-dir={mdir}", "--metrics-file=/nonexistent",
+         "--fake-devices=2", "--accelerator=v5e-8"],
+        capture_output=True, text=True, check=True)
+    want = f'tpu_evil_gauge{{writer="evil___x__-{_fnv1a(evil.encode()):08x}"}} 1'
+    assert want in proc.stdout
+    # the clean stem stays clean; the colliding-after-sanitize stem is
+    # disambiguated by its hash
+    assert 'tpu_tj{writer="train_job"} 1' in proc.stdout
+    assert ('tpu_tj2{writer="train_job-'
+            f'{_fnv1a(b"train job"):08x}"}} 1') in proc.stdout
+
+
+def test_exporter_caps_source_file_count(native_build, tmp_path):
+    """A runaway writer dropping hundreds of files must not turn a scrape
+    into unbounded reads: newest 256 win, overflow surfaced as a gauge."""
+    mdir = tmp_path / "metrics.d"
+    mdir.mkdir()
+    for i in range(300):
+        f = mdir / f"w{i:04d}.prom"
+        f.write_text(f"tpu_w{i:04d} 1\n")
+        old = time.time() - 3 + i / 100.0  # strictly increasing mtimes
+        os.utime(f, (old, old))
+    proc = subprocess.run(
+        [binpath(native_build, "tpu-metrics-exporter"), "--once",
+         f"--metrics-dir={mdir}", "--metrics-file=/nonexistent",
+         "--fake-devices=2", "--accelerator=v5e-8"],
+        capture_output=True, text=True, check=True)
+    assert "tpu_relay_dropped_sources 44" in proc.stdout
+    assert "tpu_relay_files 256" in proc.stdout
+    assert "tpu_w0299" in proc.stdout      # newest kept
+    assert "tpu_w0000" not in proc.stdout  # oldest dropped
+
+
+def test_source_cap_cannot_evict_the_configured_legacy_file(native_build,
+                                                            tmp_path):
+    """A drop-dir flood must not push the operator-configured
+    --metrics-file out of the scrape: the legacy source is exempt from
+    the per-scrape cap."""
+    legacy = tmp_path / "metrics.prom"
+    legacy.write_text("tpu_legacy_gauge 7\n")
+    old = time.time() - 200  # older than every flood file, within stale
+    os.utime(legacy, (old, old))
+    mdir = tmp_path / "metrics.d"
+    mdir.mkdir()
+    for i in range(300):
+        (mdir / f"w{i:04d}.prom").write_text(f"tpu_w{i:04d} 1\n")
+    proc = subprocess.run(
+        [binpath(native_build, "tpu-metrics-exporter"), "--once",
+         f"--metrics-dir={mdir}", f"--metrics-file={legacy}",
+         "--fake-devices=2", "--accelerator=v5e-8"],
+        capture_output=True, text=True, check=True)
+    assert "tpu_legacy_gauge 7" in proc.stdout
+    assert "tpu_relay_dropped_sources 44" in proc.stdout
+
+
 def test_writer_resolves_drop_dir_path(tmp_path, monkeypatch):
     """resolved_path prefers a per-writer file under metrics.d (created on
     demand beneath the exporter hostPath); TPU_METRICS_FILE still wins for
