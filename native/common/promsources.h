@@ -48,6 +48,17 @@ inline std::string SanitizeStem(const std::string& raw) {
     out += ok ? c : '_';
     changed |= !ok;
   }
+  // The hashed form "<stem>-<8 hex>" must be UNREACHABLE from clean
+  // input: a clean filename that already ends in -xxxxxxxx could
+  // otherwise be chosen byte-identical to another writer's hashed label
+  // (impersonation through the front door). Force-hash that shape too.
+  if (!changed && out.size() > 9 && out[out.size() - 9] == '-') {
+    bool hexish = true;
+    for (size_t i = out.size() - 8; i < out.size(); ++i)
+      hexish &= isxdigit(static_cast<unsigned char>(out[i])) &&
+                !isupper(static_cast<unsigned char>(out[i]));
+    changed = hexish;
+  }
   if (changed) {
     uint32_t h = 2166136261u;  // FNV-1a of the raw bytes
     for (char c : raw) {

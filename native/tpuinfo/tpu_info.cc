@@ -185,25 +185,27 @@ int main(int argc, char** argv) {
     return chips.empty() ? 1 : 0;
   }
 
-  // Table mode — the human-facing nvidia-smi analog.
-  printf("+---------------------------------------------------------------+\n");
-  printf("| tpu-info          accelerator: %-8s  topology: %-6s      |\n",
+  // Table mode — the human-facing nvidia-smi analog. duty%/tc% are
+  // trailing-window, process-scoped rates (docs/DELTAS.md §5).
+  printf("+-----------------------------------------------------------------------+\n");
+  printf("| tpu-info          accelerator: %-8s  topology: %-6s             |\n",
          acc ? acc->name.c_str() : accelerator.c_str(),
          acc ? acc->LabelTopology().c_str() : "?");
-  printf("|---------------------------------------------------------------|\n");
-  printf("| chip | device        | present | numa | duty%% | HBM used      |\n");
-  printf("|------+---------------+---------+------+-------+---------------|\n");
+  printf("|-----------------------------------------------------------------------|\n");
+  printf("| chip | device        | present | numa | duty%% |  tc%%  | HBM used      |\n");
+  printf("|------+---------------+---------+------+-------+-------+---------------|\n");
   for (const Chip& c : chips) {
-    char duty[16] = "   - ", hbm[24] = "      -      ";
+    char duty[16] = "   - ", tc[16] = "   - ", hbm[24] = "      -      ";
     if (c.duty_cycle >= 0) snprintf(duty, sizeof(duty), "%5.1f", c.duty_cycle);
+    if (c.tc_util >= 0) snprintf(tc, sizeof(tc), "%5.1f", c.tc_util);
     if (c.hbm_used >= 0)
-      snprintf(hbm, sizeof(hbm), "%10.0f MiB", c.hbm_used / (1024.0 * 1024));
-    printf("| %4d | %-13s | %-7s | %4d | %s | %s |\n", c.index,
-           c.path.c_str(), c.present ? "yes" : "no", c.numa, duty, hbm);
+      snprintf(hbm, sizeof(hbm), "%9.0f MiB", c.hbm_used / (1024.0 * 1024));
+    printf("| %4d | %-13s | %-7s | %4d | %s | %s | %s |\n", c.index,
+           c.path.c_str(), c.present ? "yes" : "no", c.numa, duty, tc, hbm);
   }
   if (chips.empty())
-    printf("|      no TPU device nodes found (%-28s) |\n",
+    printf("|      no TPU device nodes found (%-36s) |\n",
            device_glob.c_str());
-  printf("+---------------------------------------------------------------+\n");
+  printf("+-----------------------------------------------------------------------+\n");
   return chips.empty() ? 1 : 0;
 }

@@ -227,6 +227,30 @@ def test_exporter_sanitizes_hostile_writer_filenames(native_build, tmp_path):
             f'{_fnv1a(b"train job"):08x}"}} 1') in proc.stdout
 
 
+def test_hashed_label_form_unreachable_from_clean_filenames(native_build,
+                                                            tmp_path):
+    """An attacker must not be able to NAME a file so its clean stem
+    equals another writer's hashed label: clean stems already shaped like
+    '<x>-<8 hex>' are force-hashed again."""
+    mdir = tmp_path / "metrics.d"
+    mdir.mkdir()
+    victim_label = f"train_job-{_fnv1a(b'train job'):08x}"
+    (mdir / "train job.prom").write_text("tpu_v 1\n")
+    attacker = mdir / f"{victim_label}.prom"
+    attacker.write_text("tpu_v 666\n")
+    future = time.time() + 5  # attacker is newer
+    os.utime(attacker, (future, future))
+    proc = subprocess.run(
+        [binpath(native_build, "tpu-metrics-exporter"), "--once",
+         f"--metrics-dir={mdir}", "--metrics-file=/nonexistent",
+         "--fake-devices=2", "--accelerator=v5e-8"],
+        capture_output=True, text=True, check=True)
+    assert f'tpu_v{{writer="{victim_label}"}} 1' in proc.stdout  # victim's
+    expect_attacker = (f"{victim_label}-"
+                       f"{_fnv1a(victim_label.encode()):08x}")
+    assert f'tpu_v{{writer="{expect_attacker}"}} 666' in proc.stdout
+
+
 def test_exporter_caps_source_file_count(native_build, tmp_path):
     """A runaway writer dropping hundreds of files must not turn a scrape
     into unbounded reads: newest 256 win, overflow surfaced as a gauge."""
