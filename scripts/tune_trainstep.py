@@ -29,6 +29,9 @@ BASE = replace(burnin.bench_config(), d_ff=32768, batch=16)
 VARIANTS = {
     "base": BASE,
     "bench": burnin.bench_config(),
+    "standard": burnin.standard_config(),
+    "standard_bf16p": replace(burnin.standard_config(),
+                              param_dtype="bf16"),
     "dots": replace(BASE, remat="dots"),
     "b32": replace(BASE, batch=32),
     "b32_dots": replace(BASE, batch=32, remat="dots"),
