@@ -299,6 +299,12 @@ def standard_config() -> BurninConfig:
       fused [d,3d] QKV matmul .. 0.813  (within run-to-run noise of the
          three separate projections — XLA already schedules them well;
          not adopted, no measured win for the extra param plumbing)
+      vocab 16384 / 32768 ...... 0.788 / 0.765  (the f32 [B,S,V] logits
+         + fused-CE bandwidth grows faster than the LM-head matmul
+         gain. The bench keeps vocab 8192 — the "GPT-J geometry" claim
+         is about the BLOCK (d/f/h/d_head), not the vocab, and this
+         line records what a production-size vocab costs so the choice
+         is transparent, not flattering.)
       param_dtype="bf16" ....... 0.847-0.848  (pure-bf16 masters halve
          the per-step parameter HBM traffic; ~350M params x f32 read +
          grad write + update rw is ~4GB/step at this shape. Reported as
