@@ -14,7 +14,7 @@ from dataclasses import dataclass, field
 from typing import List
 
 from .spec import ClusterSpec
-from .verify import OPERAND_PODS, Runner, subprocess_runner
+from .verify import Runner, subprocess_runner
 
 
 @dataclass
