@@ -330,6 +330,44 @@ def test_tpu_info_runtime_metrics(native_build, tmp_path):
     assert "tensorcore_utilization_percent" not in doc["chips"][1]
 
 
+def test_tpu_info_merges_metrics_drop_dir(native_build, tmp_path):
+    """tpu-info reads the same metrics.d union the exporter relays: all
+    writers' per-chip gauges merge, stale writers are evicted, and a
+    duplicated chip resolves newest-file-wins (round-4 review finding —
+    the probe previously read only the legacy file while workloads
+    publish into the drop-dir)."""
+    import os
+    import time as timemod
+
+    from tpu_cluster.discovery import devices as pydev
+    pydev.make_fake_tree(str(tmp_path), 4)
+    mdir = tmp_path / "metrics.d"
+    mdir.mkdir()
+    older = mdir / "job-a.prom"
+    older.write_text('tpu_duty_cycle_percent{chip="0"} 11\n'
+                     'tpu_hbm_used_bytes{chip="1"} 4096\n')
+    old = timemod.time() - 60
+    os.utime(older, (old, old))
+    (mdir / "job-b.prom").write_text(
+        'tpu_duty_cycle_percent{chip="0"} 99\n'
+        'tpu_hbm_used_bytes{chip="2"} 8192\n')
+    dead = mdir / "dead.prom"
+    dead.write_text('tpu_duty_cycle_percent{chip="3"} 50\n')
+    ancient = timemod.time() - 3600
+    os.utime(dead, (ancient, ancient))
+    out = subprocess.run(
+        [binpath(native_build, "tpu-info"), f"--devfs-root={tmp_path}",
+         "--metrics-file=/nonexistent", f"--metrics-dir={mdir}",
+         "--stale-after=300", "--json"],
+        check=True, capture_output=True, text=True)
+    doc = json.loads(out.stdout)
+    chips = {c["index"]: c for c in doc["chips"]}
+    assert chips[0]["duty_cycle_percent"] == 99      # newest writer wins
+    assert chips[1]["hbm_used_bytes"] == 4096        # older writer's chip
+    assert chips[2]["hbm_used_bytes"] == 8192        # union across writers
+    assert "duty_cycle_percent" not in chips[3]      # stale file evicted
+
+
 # ---------------------------------------------------------------- exporter
 
 
