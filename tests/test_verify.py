@@ -417,6 +417,14 @@ def test_policy_check_fresh_cr_without_status_gets_grace(spec):
     res = verify.check_policy(runner, spec)
     assert res.ok
 
+    # malformed timestamp parses to None -> same benefit of the doubt
+    runner.responses[key] = {"kind": "TpuStackPolicy",
+                             "metadata": {"name": "default",
+                                          "generation": 1,
+                                          "creationTimestamp": "not-a-ts"}}
+    res = verify.check_policy(runner, spec)
+    assert res.ok and "grace" in res.detail
+
 
 def test_triage_reports_policy_disabled_operands(spec):
     """'Where did my exporter go?' — when the TpuStackPolicy toggled it
