@@ -23,6 +23,13 @@ Also folded into the line (driver artifacts for the judge):
 
 Prints exactly ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+
+The driver records only the LAST ~2000 bytes of output and parses the
+final line — round 4's enriched ~3.4 kB line overflowed that window and
+the round's artifact came back unparseable (BENCH_r04 ``parsed: null``).
+The stdout line is therefore a COMPACT doc (everything the README table
+renders, audit detail trimmed) with a hard size guard; the full document
+is written to the ``bench_detail.json`` sidecar for local audit.
 """
 
 from __future__ import annotations
@@ -39,6 +46,71 @@ import urllib.request
 REPO = os.path.dirname(os.path.abspath(__file__))
 
 T4_FP16_PEAK_TFLOPS = 65.0
+
+# The driver captures the last 2000 bytes of output; the final line must fit
+# with margin or the round ships no machine-readable artifact (round 4 did
+# exactly that). scripts/bench_table.py can recover a front-truncated tail,
+# but that is the fallback, not the plan.
+TAIL_BUDGET = 1900
+DETAIL_SIDECAR = "bench_detail.json"
+
+
+def compact_line(doc: dict) -> str:
+    """Compact stdout rendering of the bench doc, guaranteed under
+    ``TAIL_BUDGET`` by staged shrinking that never touches the headline
+    numbers. Audit-only detail (raw timing points, per-shape estimator
+    strings, the full gauge list) lives in the sidecar; the compact doc
+    keeps every field scripts/bench_table.py renders into the README
+    when it fits, and any stage that has to drop a rendered field
+    records itself in ``compacted`` so the artifact says the sidecar
+    holds more."""
+    doc = json.loads(json.dumps(doc))  # deep copy; doc must stay intact
+    doc.pop("measure_points", None)
+    for entry in (doc.get("train_step") or {}).values():
+        entry.pop("points", None)
+        entry.pop("estimator", None)  # identical to measure_estimator
+    scrape = doc.get("metrics_scrape") or {}
+    gauges = scrape.pop("gauges", None)
+    if gauges is not None:
+        scrape["gauges_n"] = len(gauges)
+
+    # every shrink stage that drops a rendered field records itself, so
+    # the artifact always says when the sidecar holds more than the line
+    dropped = []
+
+    def dump() -> str:
+        if dropped:
+            doc["compacted"] = "; ".join(dropped) + " (see the sidecar)"
+        return json.dumps(doc, separators=(",", ":"))
+
+    line = dump()
+    if len(line) > TAIL_BUDGET:
+        doc.pop("vocab_note", None)
+        doc.pop("measure_spread_note", None)
+        dropped.append("notes dropped")
+        line = dump()
+    if len(line) > TAIL_BUDGET:
+        for entry in (doc.get("train_step") or {}).values():
+            entry.pop("tflops_spread", None)
+            entry.pop("spread_note", None)
+        dropped.append("per-shape spreads dropped")
+        line = dump()
+    if len(line) > TAIL_BUDGET:
+        # e.g. every shape errored with a 300-char repr each
+        for entry in (doc.get("train_step") or {}).values():
+            if "error" in entry:
+                entry["error"] = entry["error"][:80]
+        dropped.append("error text truncated")
+        line = dump()
+    if len(line) > TAIL_BUDGET:
+        # last resort: the guarantee beats completeness — keep only the
+        # headline scalars (all small, bounded keys), point at the sidecar
+        doc = {k: doc[k] for k in
+               ("metric", "value", "unit", "vs_baseline", "platform",
+                "devices", "peak_bf16_tflops", "mfu", "detail") if k in doc}
+        dropped = ["doc exceeded the driver window"]
+        line = dump()
+    return line
 
 
 def measure_tflops() -> dict:
@@ -314,8 +386,11 @@ def main() -> int:
                      dc_replace(burnin.standard_config(),
                                 param_dtype="bf16"), 40),
                     ("wide", burnin.bench_config(), 20)):
-                geom = (f"d{cfg.d_model} f{cfg.d_ff} h{cfg.n_heads} "
-                        f"s{cfg.seq} b{cfg.batch} "
+                # the vocab belongs in the one string a reader sees: the
+                # v8192 choice costs/earns real MFU vs production vocabs
+                # (round-4 verdict; the trade-off note travels below)
+                geom = (f"v{cfg.vocab} d{cfg.d_model} f{cfg.d_ff} "
+                        f"h{cfg.n_heads} s{cfg.seq} b{cfg.batch} "
                         f"({cfg.d_ff // cfg.d_model}x FFN, "
                         f"{cfg.param_dtype} master)")
                 try:
@@ -341,6 +416,16 @@ def main() -> int:
                 except Exception as exc:  # noqa: BLE001 — keep the line
                     doc["train_step"][name] = {"config": geom,
                                                "error": repr(exc)[:300]}
+            # measured cost of a production-size vocab at the standard
+            # shape — in the artifact so the README table can surface it
+            # next to the v8192 rows; the numbers live in ONE place
+            # (burnin.STANDARD_VOCAB_MFU, next to the ledger they cite)
+            doc["vocab_note"] = (
+                "standard shapes bench vocab 8192; measured production-"
+                "vocab cost: "
+                + " / ".join(f"v{v} {m}" for v, m in
+                             sorted(burnin.STANDARD_VOCAB_MFU.items()))
+                + " MFU (burnin.standard_config ledger)")
         # Scrape last, inside the window, holding a known-size device
         # allocation so the live-array HBM accounting (runtime_metrics
         # degradation ladder) has a real value to report even on runtimes
@@ -353,7 +438,14 @@ def main() -> int:
             anchor.block_until_ready()
         doc["metrics_scrape"] = metrics_scrape_roundtrip(platform)
         del anchor
-    print(json.dumps(doc))
+    try:  # full document for local audit; stdout stays compact
+        with open(os.path.join(REPO, DETAIL_SIDECAR), "w",
+                  encoding="utf-8") as f:
+            json.dump(doc, f, indent=1)
+        doc["detail"] = DETAIL_SIDECAR
+    except OSError:
+        pass
+    print(compact_line(doc))
     return 0
 
 

@@ -30,6 +30,10 @@ import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402  — the ONE copy of the T4 baseline constant
+
 README = os.path.join(REPO, "README.md")
 BEGIN = "<!-- bench-table:begin (scripts/bench_table.py --update) -->"
 END = "<!-- bench-table:end -->"
@@ -42,11 +46,83 @@ def newest_artifact() -> str:
     return paths[-1]
 
 
+def recover_from_tail(tail: str):
+    """Best-effort recovery of the bench doc from a driver tail whose final
+    line was too long to capture whole (``parsed: null`` + front-truncated
+    ``tail`` — the exact state of BENCH_r04.json). Returns a doc or None.
+
+    Two attempts, in order:
+    1. a complete final line somewhere in the tail (driver parse missed it);
+    2. the longest suffix of the tail that is a valid object body after some
+       top-level ``, "`` boundary — re-opened with ``{``. This recovers every
+       key from the truncation point onward; leading fields (``value``,
+       ``vs_baseline``) are resynthesised from the recovered ``mfu`` x
+       catalogue peak, and the render labels the row as recovered.
+    """
+    text = tail.strip()
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(doc, dict) and "metric" in doc:
+                return doc
+    # both separator styles: r03/r04 printed ', "' (default json.dumps),
+    # round 5+ prints compact ',"' — the recovery must read what bench.py
+    # actually emits, not only the legacy spacing
+    for m in re.finditer(r',\s*"', text):
+        try:
+            doc = json.loads("{" + text[m.end() - 1:])
+        except ValueError:
+            continue
+        if not isinstance(doc, dict) or not any(
+                k in doc for k in ("mfu", "train_step", "metrics_scrape",
+                                   "measure_tflops_spread")):
+            # parses but isn't bench-shaped (e.g. a stray error dict echoed
+            # in the tail) — rendering it would make a garbage table pass
+            # the CI render step; keep scanning / fail clean instead
+            continue
+        doc["recovered_from_tail"] = True
+        # the tail may happen to OPEN on a complete sub-object whose key was
+        # cut (r04: the validate doc) — reattach it if unambiguous
+        if "validate" not in doc and text.startswith("{"):
+            try:
+                head, _ = json.JSONDecoder().raw_decode(text)
+            except ValueError:
+                head = None
+            if (isinstance(head, dict) and "wall_s" in head
+                    and "device_query_devices" in head):
+                doc["validate"] = head
+        # resynthesise the truncated-away headline fields from what survived
+        peak, mfu = doc.get("peak_bf16_tflops"), doc.get("mfu")
+        if "value" not in doc and peak and mfu is not None:
+            spread = doc.get("measure_tflops_spread") or {}
+            doc["value"] = spread.get("median", round(mfu * peak, 2))
+        if "vs_baseline" not in doc and doc.get("value"):
+            doc["vs_baseline"] = round(
+                doc["value"] / bench.T4_FP16_PEAK_TFLOPS, 3)
+        return doc
+    return None
+
+
 def load(path: str) -> dict:
     with open(path, encoding="utf-8") as f:
         doc = json.load(f)
+    if "parsed" not in doc and "tail" not in doc:
+        return doc  # bare bench doc, no driver wrapper
     # driver wrapper: the bench line itself is under "parsed"
-    return doc.get("parsed", doc)
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict):
+        return parsed
+    recovered = recover_from_tail(doc.get("tail") or "")
+    if recovered is None:
+        raise SystemExit(
+            f"{os.path.basename(path)}: the driver could not parse the "
+            "bench line (parsed: null) and its tail is not recoverable — "
+            "rerun `python bench.py` or point at an older BENCH_r*.json")
+    return recovered
 
 
 def _mfu_cell(mfu) -> str:
@@ -64,15 +140,19 @@ def _spread_cell(entry: dict) -> str:
     spread = entry.get("tflops_spread")
     if not spread:
         return ""
-    return (f"spread {spread['min']}/{spread['median']}/{spread['max']} "
+    cell = (f"spread {spread['min']}/{spread['median']}/{spread['max']} "
             f"TFLOP/s over {spread['n']} paired reps")
+    if spread.get("rejected"):
+        cell += (f", {spread['rejected']} stall-biased pair"
+                 f"{'s' if spread['rejected'] != 1 else ''} rejected")
+    return cell
 
 
 def render(doc: dict, name: str) -> str:
     rows = []
     value, mfu = doc.get("value"), doc.get("mfu")
     notes = [f"{doc.get('vs_baseline')}x the reference accelerator's peak "
-             "(Tesla T4, 65 TFLOP/s fp16)"]
+             f"(Tesla T4, {bench.T4_FP16_PEAK_TFLOPS:g} TFLOP/s fp16)"]
     sp = _spread_cell({"tflops_spread": doc.get("measure_tflops_spread")})
     if sp:
         notes.append(sp)
@@ -125,11 +205,26 @@ def render(doc: dict, name: str) -> str:
         "(the test suite verifies the table is a verbatim render of the "
         "artifact it cites). Local reruns never edit this table.",
         "",
+    ]
+    if doc.get("recovered_from_tail"):
+        lines += [
+            "That artifact's final line overflowed the driver's capture "
+            "window (`parsed: null`); the numbers below were recovered "
+            "from its front-truncated `tail` by "
+            "`bench_table.recover_from_tail` — everything from the "
+            "truncation point onward is verbatim, and the headline "
+            "TFLOP/s is the recovered spread median (the leading fields "
+            "were the part cut off).",
+            "",
+        ]
+    lines += [
         "| Metric | Value | Notes |",
         "|---|---|---|",
     ]
     for metric, value, note in rows:
         lines.append(f"| {metric} | {value} | {note} |")
+    if doc.get("vocab_note"):
+        lines += ["", f"Vocab trade-off: {doc['vocab_note']}."]
     return "\n".join(lines)
 
 

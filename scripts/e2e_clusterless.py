@@ -41,6 +41,18 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.join(REPO, "tests"))
 
+# Pin the JAX environment BEFORE anything imports jax: run unpinned on the
+# TPU-attached bench host this script picks up the tunneled real-TPU
+# platform and the duty-cycle checks measure the wrong device (round-4
+# verdict: 2/18 checks failed there, all 18 passed with the env pinned).
+# Identical discipline to tests/conftest.py and __graft_entry__ — one
+# shared recipe (tpu_cluster.virtualmesh) that forces JAX_PLATFORMS=cpu,
+# --xla_force_host_platform_device_count=8, and clears
+# PALLAS_AXON_POOL_IPS, so the transcript is reproducible on ANY host.
+from tpu_cluster.virtualmesh import force_virtual_cpu_mesh  # noqa: E402
+
+force_virtual_cpu_mesh(8)
+
 NODE = "e2e-node-0"
 
 
@@ -224,7 +236,11 @@ def stage_metrics(t: Transcript, tmp: str) -> None:
     mdir = os.path.join(tmp, "metrics.d")
     os.makedirs(mdir, exist_ok=True)
     metrics_file = os.path.join(mdir, f"{runtime_metrics.writer_id()}.prom")
-    os.environ.setdefault("TPU_ACCELERATOR_TYPE", "v5e-8")
+    # explicit, not setdefault: the bench host's sitecustomize injects its
+    # own TPU_ACCELERATOR_TYPE (observed: "v5litepod-4") and a leaked value
+    # would change which catalogue entry prices the tensorcore gauge —
+    # the transcript must not depend on ambient env
+    os.environ["TPU_ACCELERATOR_TYPE"] = "v5e-8"
     # short trailing window so the idle-decay behavior is demonstrable in
     # seconds (default 60s; same code path)
     os.environ["TPU_METRICS_WINDOW_S"] = "2"
@@ -344,6 +360,14 @@ def main() -> int:
            "fakes because this environment has no container tooling — the "
            "docker+kind composition of the same seams is "
            "`scripts/kind-integration.sh`.")
+    t.emit()
+    t.emit("JAX environment pinned at script start (so the run is "
+           "reproducible on any host, including one with a tunneled real "
+           "TPU attached): `JAX_PLATFORMS=cpu`, "
+           "`--xla_force_host_platform_device_count=8`, "
+           "`PALLAS_AXON_POOL_IPS` cleared — via "
+           "`tpu_cluster.virtualmesh.force_virtual_cpu_mesh(8)`, the same "
+           "recipe `tests/conftest.py` and `__graft_entry__` use.")
 
     with tempfile.TemporaryDirectory() as tmp:
         bundle_dir = os.path.join(tmp, "bundle")

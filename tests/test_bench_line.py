@@ -1,0 +1,176 @@
+"""bench.py's stdout contract: the driver records only the final ~2000
+bytes of output and parses the last line. Round 4's enriched ~3.4 kB line
+overflowed that window and the round's artifact of record came back
+``parsed: null`` — these tests pin the compact-line budget and the
+tail-recovery fallback that unblocked consuming that artifact."""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import bench  # noqa: E402
+import bench_table  # noqa: E402
+
+
+def full_doc() -> dict:
+    """A doc shaped like the round-4 FULL output (the one that overflowed),
+    with the round-5 additions: vocab in config strings, vocab_note,
+    spread.rejected."""
+    spread = {"min": 188.86, "median": 194.4, "max": 201.22, "n": 6,
+              "rejected": 1}
+
+    def entry(cfg, tflops, mfu, toks):
+        return {
+            "config": cfg, "tflops": tflops, "mfu": mfu,
+            "tokens_per_s": toks,
+            "points": [{"steps": 40, "seconds": 1.5853},
+                       {"steps": 120, "seconds": 4.5261}],
+            "tflops_spread": dict(spread),
+            "estimator": "median_of_per_pair_two_point_deltas",
+            "spread_note": "spread max above peak = a tunnel-stalled lo "
+                           "run shrank that pair's delta; the median "
+                           "rejects it",
+        }
+
+    return {
+        "metric": "bf16_matmul_tflops_1chip", "value": 194.4,
+        "unit": "TFLOP/s", "vs_baseline": 2.991, "platform": "tpu",
+        "devices": 1,
+        "measure_points": [{"iters": 1000, "seconds": 1.0129},
+                           {"iters": 4000, "seconds": 3.2276}],
+        "validate": {"ok": True, "device_query_devices": 1,
+                     "vector_add_ok": True, "matmul_ok": True,
+                     "psum_ok": True, "psum_devices": 1, "wall_s": 13.954},
+        "measure_estimator": "median_of_per_pair_two_point_deltas",
+        "measure_reps": 7,
+        "measure_tflops_spread": dict(spread),
+        "peak_bf16_tflops": 197.0, "mfu": 0.987,
+        "measure_spread_note": "spread max above peak = a tunnel-stalled "
+                               "lo run shrank that pair's delta; the "
+                               "median rejects it",
+        "train_step": {
+            "standard": entry("v8192 d4096 f16384 h16 s512 b8 (4x FFN, "
+                              "f32 master)", 159.99, 0.812, 111427),
+            "standard_bf16_params": entry(
+                "v8192 d4096 f16384 h16 s512 b8 (4x FFN, bf16 master)",
+                164.89, 0.837, 114852),
+            "wide": entry("v8192 d2048 f131072 h16 s512 b8 (64x FFN, "
+                          "f32 master)", 180.77, 0.918, 52535),
+        },
+        "vocab_note": "standard shapes bench vocab 8192; measured "
+                      "production-vocab cost: v16384 0.788 / v32768 "
+                      "0.765 MFU (burnin.standard_config ledger)",
+        "metrics_scrape": {
+            "ok": True,
+            "gauges": ["tpu_chips_expected", "tpu_chips_total",
+                       "tpu_duty_cycle_percent", "tpu_hbm_limit_bytes",
+                       "tpu_hbm_source", "tpu_hbm_used_bytes",
+                       "tpu_metrics_window_seconds", "tpu_process_devices",
+                       "tpu_relay_dropped_sources", "tpu_relay_files",
+                       "tpu_relay_stale_files",
+                       "tpu_runtime_metrics_timestamp_seconds",
+                       "tpu_tensorcore_utilization_percent"],
+            "hbm_source": "live_arrays", "duty_cycle_percent": 54.0,
+            "hbm_used_bytes": 134217728,
+            "tensorcore_utilization_percent": 47.7},
+        "detail": "bench_detail.json",
+    }
+
+
+def test_compact_line_fits_the_driver_window():
+    line = bench.compact_line(full_doc())
+    assert len(line) <= bench.TAIL_BUDGET
+    parsed = json.loads(line)
+    # audit detail moved to the sidecar...
+    assert "measure_points" not in parsed
+    for entry in parsed["train_step"].values():
+        assert "points" not in entry and "estimator" not in entry
+    assert "gauges" not in parsed["metrics_scrape"]
+    assert parsed["metrics_scrape"]["gauges_n"] == 13
+    # ...but everything the README table renders survives
+    assert parsed["mfu"] == 0.987
+    assert parsed["train_step"]["standard"]["tflops_spread"]["rejected"] == 1
+    assert parsed["validate"]["wall_s"] == 13.954
+    assert "vocab_note" in parsed
+
+
+def test_compact_line_render_matches_full_doc_rows():
+    """The README table built from the compact line must carry the same
+    rows/numbers as one built from the full doc."""
+    doc = full_doc()
+    compact = json.loads(bench.compact_line(doc))
+    a = bench_table.render(doc, "X.json")
+    b = bench_table.render(compact, "X.json")
+    for needle in ("0.987 MFU", "0.812 MFU", "0.837 MFU", "0.918 MFU",
+                   "13.954 s", "duty 54.0%", "stall-biased pair rejected",
+                   "Vocab trade-off"):
+        assert needle in a and needle in b
+
+
+def test_oversize_doc_is_staged_down_not_truncated():
+    doc = full_doc()
+    doc["measure_spread_note"] = "x" * 1500  # force the first shrink stage
+    line = bench.compact_line(doc)
+    assert len(line) <= bench.TAIL_BUDGET
+    assert json.loads(line)["mfu"] == 0.987  # headline never dropped
+
+
+def test_recover_from_tail_on_the_real_r04_artifact():
+    """BENCH_r04.json is the motivating case: parsed null, tail starts
+    mid-line at the validate object. Recovery must be deterministic — the
+    committed README table is a render of this load."""
+    doc = bench_table.load(os.path.join(REPO, "BENCH_r04.json"))
+    assert doc["recovered_from_tail"] is True
+    assert doc["mfu"] == 0.987
+    assert doc["value"] == 194.4  # spread median, not mfu*peak rounding
+    assert doc["validate"]["wall_s"] == 13.954  # reattached head object
+    assert set(doc["train_step"]) == {"standard", "standard_bf16_params",
+                                      "wide"}
+
+
+def test_recover_from_tail_handles_compact_separators():
+    """Round 5+ prints compact (',' ':') separators. If a future line
+    still overflowed the driver window, recovery must find the ',"key":'
+    boundaries — not only the legacy spaced format r03/r04 printed."""
+    line = bench.compact_line(full_doc())
+    tail = line[len(line) // 3:]  # front-truncated mid-line, like a real tail
+    doc = bench_table.recover_from_tail(tail)
+    assert doc is not None and doc["recovered_from_tail"] is True
+    assert doc["metrics_scrape"]["duty_cycle_percent"] == 54.0
+
+
+def test_all_shapes_erroring_still_fits_the_window():
+    """Worst realistic case: every train-step shape raises and carries a
+    300-char repr. The guarantee ('under TAIL_BUDGET') must hold anyway —
+    round 4 shipped parsed:null precisely because no final guard existed."""
+    doc = full_doc()
+    doc["train_step"] = {
+        name: {"config": e["config"], "error": "E" * 300}
+        for name, e in doc["train_step"].items()}
+    line = bench.compact_line(doc)
+    assert len(line) <= bench.TAIL_BUDGET
+    assert json.loads(line)["mfu"] == 0.987
+
+
+def test_pathological_doc_falls_back_to_headline_scalars():
+    doc = full_doc()
+    doc["validate"]["error"] = "x" * 4000  # nothing stageable can absorb this
+    line = bench.compact_line(doc)
+    assert len(line) <= bench.TAIL_BUDGET
+    parsed = json.loads(line)
+    assert parsed["mfu"] == 0.987 and "compacted" in parsed
+
+
+def test_unrecoverable_artifact_exits_clean(tmp_path):
+    p = tmp_path / "BENCH_r99.json"
+    p.write_text(json.dumps({"n": 99, "cmd": "python bench.py", "rc": 1,
+                             "tail": "Traceback (most recent call last)",
+                             "parsed": None}))
+    with pytest.raises(SystemExit) as exc:
+        bench_table.load(str(p))
+    assert "not recoverable" in str(exc.value)  # message, not a traceback

@@ -24,6 +24,18 @@ def test_unknown_type():
         topology.get("v99-1")
 
 
+def test_gce_accelerator_type_aliases():
+    """A real TPU VM's metadata spells the type "v5litepod-4" (observed on
+    the bench host's injected TPU_ACCELERATOR_TYPE); the catalogue must
+    resolve the GCE spelling, not only its own."""
+    assert topology.get("v5litepod-4") is topology.get("v5e-4")
+    assert topology.get("v5litepod-8") is topology.get("v5e-8")
+    assert topology.canonical_name("v5p-8") == "v5p-8"  # pass-through
+    assert topology.canonical_name("weird") == "weird"
+    with pytest.raises(KeyError):
+        topology.get("v5litepod-3")  # alias never invents sizes
+
+
 def test_chip_coords_row_major():
     acc = topology.get("v5e-8")
     assert topology.chip_coords(acc) == [

@@ -234,12 +234,34 @@ def from_device_kind(kind: str) -> Optional["AcceleratorType"]:
     return None
 
 
+# GCE accelerator-type spellings -> catalogue generation prefix. A real TPU
+# VM's metadata (and the TPU_ACCELERATOR_TYPE env a provisioner injects)
+# says "v5litepod-4", not "v5e-4" — observed live on this project's bench
+# host, where the unaliased lookup silently missed and the tensorcore gauge
+# lost its catalogue peak.
+_GCE_GENERATION_ALIASES = {"v5litepod": "v5e", "v6litepod": "v6e"}
+
+
+def canonical_name(name: str) -> str:
+    """Catalogue spelling for an accelerator-type string, folding the GCE
+    aliases ("v5litepod-8" -> "v5e-8"). Unknown shapes pass through."""
+    gen, sep, size = name.partition("-")
+    if sep and gen in _GCE_GENERATION_ALIASES:
+        return f"{_GCE_GENERATION_ALIASES[gen]}-{size}"
+    return name
+
+
 def get(name: str) -> AcceleratorType:
+    canonical = canonical_name(name)
     try:
-        return ACCELERATOR_TYPES[name]
+        return ACCELERATOR_TYPES[canonical]
     except KeyError:
+        # the error must name the string the CALLER passed — they grep
+        # their config for that, not for the folded alias
+        alias = f" (alias of {canonical!r})" if canonical != name else ""
         raise KeyError(
-            f"unknown accelerator type {name!r}; known: {sorted(ACCELERATOR_TYPES)}"
+            f"unknown accelerator type {name!r}{alias}; "
+            f"known: {sorted(ACCELERATOR_TYPES)}"
         ) from None
 
 

@@ -270,6 +270,13 @@ def bench_config() -> BurninConfig:
                         n_heads=16, seq=512, batch=8)
 
 
+# Measured MFU at standard_config's geometry with production-size vocabs
+# (real v5e chip, round-4 sweep — the full ledger is standard_config's
+# docstring). bench.py publishes these in the artifact's vocab_note so the
+# v8192 choice is transparent; ONE copy here, composed there.
+STANDARD_VOCAB_MFU = {16384: 0.788, 32768: 0.765}
+
+
 def standard_config() -> BurninConfig:
     """Standard-geometry transformer shape for the honest headline: 4x
     FFN:model ratio, vs bench_config's 64x wide shape whose step is

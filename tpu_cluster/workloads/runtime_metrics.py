@@ -299,7 +299,8 @@ def _resolve_accelerator(devices):
     device plugin's Allocate injects wins, else the JAX device_kind."""
     from .. import topology
 
-    acc_env = os.environ.get("TPU_ACCELERATOR_TYPE", "")
+    acc_env = topology.canonical_name(os.environ.get(
+        "TPU_ACCELERATOR_TYPE", ""))
     if acc_env in topology.ACCELERATOR_TYPES:
         return topology.get(acc_env)
     if devices:

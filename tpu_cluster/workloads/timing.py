@@ -11,30 +11,71 @@ long ("hi") run back-to-back; the dispatch/fetch constant of the tunneled
 backend is correlated within such a pair, so the pair's OWN delta cancels
 it. The published rate is the MEDIAN of the per-pair delta rates, with the
 min/median/max spread alongside so residual noise is visible in the
-artifact instead of silently picked from.
+artifact instead of silently picked from. Round 5 adds stall-pair
+rejection: ~1 in 7 pairs through the tunnel carries a one-sided stall
+(extra time in one run only), which the per-pair delta does NOT cancel —
+pairs whose delta is an outlier against the median delta are rejected and
+the count is published in the spread so the outlier rate stays visible.
 """
 
 from __future__ import annotations
 
+import statistics
 from typing import Any, Dict, List, Tuple
 
 ESTIMATOR = "median_of_per_pair_two_point_deltas"
 
 
+def _reject_stalled(pairs: List[Tuple[float, float]], floor: float,
+                    tol_frac: float, tol_abs: float,
+                    ) -> Tuple[List[Tuple[float, float]], int]:
+    """Drop pairs whose DELTA is an outlier against the median delta.
+
+    The published statistic is the per-pair delta rate, so the delta is
+    the right thing to test: a one-sided stall in the lo run shrinks the
+    delta and the rate reads HIGH (the round-4 artifact's 254 TFLOP/s
+    max vs a 197 peak); a stalled hi run grows it and reads LOW (the
+    bf16-params 138 vs 165 min). A pair where BOTH runs are slower by a
+    correlated amount (tunnel constant drifting mid-session) has an
+    unchanged delta and survives — that correlated overhead cancelling
+    is the whole design of the pairing, so per-position absolute times
+    must not be the test. ``tol`` as a fraction of the median delta
+    directly bounds the published spread: keeping |delta - median| <=
+    0.1*median keeps every surviving rate within ~11% of the median's."""
+    if len(pairs) < 3:
+        return pairs, 0
+    deltas = [hi - lo for lo, hi in pairs]
+    delta_med = statistics.median(deltas)
+    if delta_med <= floor:
+        return pairs, 0
+    tol = max(tol_frac * delta_med, tol_abs)
+    kept = [p for p, d in zip(pairs, deltas) if abs(d - delta_med) <= tol]
+    if not kept:  # bimodal deltas (even n): nothing is more trustworthy
+        return pairs, 0
+    return kept, len(pairs) - len(kept)
+
+
 def paired_two_point(pairs: List[Tuple[float, float]], extra_flops: float,
                      long_flops: float, floor: float = 1e-3,
+                     stall_tol_frac: float = 0.10,
+                     stall_tol_abs: float = 0.05,
                      ) -> Dict[str, Any]:
     """Median per-pair two-point delta rate over ``pairs``.
 
     ``pairs``: ``(lo_seconds, hi_seconds)`` per rep. ``extra_flops``: FLOPs
     the hi run executes beyond the lo run (the delta's numerator).
     ``long_flops``: FLOPs of the hi run alone, used only by the degenerate
-    fallback. Returns ``tflops``, the median pair's raw ``lo_s``/``hi_s``
-    (for audit), a ``spread`` dict when >=1 pair cleared the noise
-    ``floor``, and a ``note`` when none did.
+    fallback. Stall-biased pairs (see ``_reject_stalled``) are rejected
+    before the median; the count is published as ``spread["rejected"]`` so
+    the artifact tracks the outlier rate instead of hiding it. Returns
+    ``tflops``, the median pair's raw ``lo_s``/``hi_s`` (for audit), a
+    ``spread`` dict when >=1 surviving pair cleared the noise ``floor``,
+    and a ``note`` when none did.
     """
+    kept, rejected = _reject_stalled(pairs, floor, stall_tol_frac,
+                                     stall_tol_abs)
     rated = []
-    for lo_s, hi_s in pairs:
+    for lo_s, hi_s in kept:
         dt = hi_s - lo_s
         if dt > floor:
             rated.append((extra_flops / dt / 1e12, lo_s, hi_s))
@@ -50,7 +91,8 @@ def paired_two_point(pairs: List[Tuple[float, float]], extra_flops: float,
             "spread": {"min": round(rated[0][0], 2),
                        "median": round(rate, 2),
                        "max": round(rated[-1][0], 2),
-                       "n": len(rated)},
+                       "n": len(rated),
+                       "rejected": rejected},
         }
     # Every delta was below the noise floor — the runs are noise-dominated
     # by definition, so report the raw long-run rate from the MEDIAN hi
