@@ -74,34 +74,43 @@ def compact_line(doc: dict) -> str:
     if gauges is not None:
         scrape["gauges_n"] = len(gauges)
 
-    # every shrink stage that drops a rendered field records itself, so
-    # the artifact always says when the sidecar holds more than the line
+    # every shrink stage that drops a rendered field records itself (only
+    # when it actually removed something — the audit note must be as
+    # truthful as the data), so the artifact says when the sidecar holds
+    # more than the line
     dropped = []
 
     def dump() -> str:
         if dropped:
-            doc["compacted"] = "; ".join(dropped) + " (see the sidecar)"
+            where = " (see the sidecar)" if doc.get("detail") else ""
+            doc["compacted"] = "; ".join(dropped) + where
         return json.dumps(doc, separators=(",", ":"))
 
     line = dump()
     if len(line) > TAIL_BUDGET:
-        doc.pop("vocab_note", None)
-        doc.pop("measure_spread_note", None)
-        dropped.append("notes dropped")
-        line = dump()
+        removed = [doc.pop(k, None) for k in ("vocab_note",
+                                              "measure_spread_note")]
+        if any(r is not None for r in removed):
+            dropped.append("notes dropped")
+            line = dump()
     if len(line) > TAIL_BUDGET:
+        hit = False
         for entry in (doc.get("train_step") or {}).values():
-            entry.pop("tflops_spread", None)
-            entry.pop("spread_note", None)
-        dropped.append("per-shape spreads dropped")
-        line = dump()
+            hit |= entry.pop("tflops_spread", None) is not None
+            hit |= entry.pop("spread_note", None) is not None
+        if hit:
+            dropped.append("per-shape spreads dropped")
+            line = dump()
     if len(line) > TAIL_BUDGET:
         # e.g. every shape errored with a 300-char repr each
+        hit = False
         for entry in (doc.get("train_step") or {}).values():
-            if "error" in entry:
+            if len(entry.get("error", "")) > 80:
                 entry["error"] = entry["error"][:80]
-        dropped.append("error text truncated")
-        line = dump()
+                hit = True
+        if hit:
+            dropped.append("error text truncated")
+            line = dump()
     if len(line) > TAIL_BUDGET:
         # last resort: the guarantee beats completeness — keep only the
         # headline scalars (all small, bounded keys), point at the sidecar

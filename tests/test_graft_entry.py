@@ -42,6 +42,9 @@ def test_dryrun_multichip_bare_subprocess():
     # evidence a real 2-process jax.distributed bootstrap with the global
     # all-reduce spanning both workers' devices.
     assert "processes=2 devices=8" in proc.stdout
+    # the 4-worker variant (v5e-16-shaped: 4 processes x 2 devices) must be
+    # in the driver artifact too, not only the test suite
+    assert "processes=4 devices=8" in proc.stdout
     assert "global_psum=28.0" in proc.stdout
 
 
@@ -78,7 +81,9 @@ def test_dryrun_repeat_and_growth():
         "g.dryrun_multichip(8)\n", timeout=900,
     )
     assert proc.returncode == 0, proc.stderr
-    # each dryrun prints two OK lines now: the single-process sharded step
-    # and the 2-process DCN phase
-    assert proc.stdout.count("OK") == 6
+    # per dryrun: the single-process sharded step, the 2-process DCN phase,
+    # and (on 4-divisible sizes, i.e. all three calls here) the 4-process
+    # variant
+    assert proc.stdout.count("OK") == 9
     assert proc.stdout.count("processes=2") == 3
+    assert proc.stdout.count("processes=4") == 3

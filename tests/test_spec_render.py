@@ -75,6 +75,18 @@ def test_operand_bool_shorthand():
     assert s.tpu.operand("libtpuPrep").enabled
 
 
+def test_spec_canonicalizes_gce_accelerator_spelling():
+    """A spec written with the GCE spelling must validate AND come out
+    canonical: the generated CRD/values-schema enums list catalogue names
+    only, so a locally-valid alias left unfolded would be rejected by the
+    apiserver's enum for the same field."""
+    s = specmod.default_spec()
+    s.tpu.accelerator = "v5litepod-8"
+    s.validate()
+    assert s.tpu.accelerator == "v5e-8"
+    assert s.tpu.accelerator_type.name == "v5e-8"
+
+
 def test_node_prep_renders_reference_phase1():
     """Tier-1 parity with reference README.md:5-36."""
     s = specmod.default_spec()

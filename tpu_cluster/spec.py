@@ -109,6 +109,13 @@ class TpuSpec:
             # the CLI prints a clean `spec error: unknown accelerator ...`
             # line instead of a traceback.
             raise SpecError(exc.args[0]) from None
+        # Fold GCE alias spellings ("v5litepod-8") to the catalogue name
+        # here, at the validation boundary: every rendered artifact
+        # downstream — chart values, the CRD/values-schema enums (built
+        # from the canonical catalogue names only), node labels — then
+        # carries ONE spelling, and a spec that validated locally can
+        # never be rejected by the apiserver's enum for the same field.
+        self.accelerator = topology.canonical_name(self.accelerator)
         for name, op in self.operands.items():
             if name not in self.OPERAND_NAMES:
                 raise SpecError(
