@@ -103,6 +103,39 @@ struct RelayAccum {
   int dropped = 0;  // sources beyond the promsources cap
 };
 
+// End of a sample line's series identity (metric name + label block if
+// any): the value starts after it, and anything after the value is an
+// OPTIONAL Prometheus timestamp. Splitting at the LAST space (the old
+// implementation) misparses a timestamped line both ways: the writer
+// label lands after the value (`tpu_x 5{writer="w"} 169…` — invalid
+// exposition a strict scraper rejects page-wide) and the dedup key
+// absorbs the value, so the same series from two writers never dedups.
+size_t SeriesEnd(const std::string& line) {
+  size_t brace = line.find('{');
+  size_t sp = line.find(' ');
+  if (brace == std::string::npos ||
+      (sp != std::string::npos && sp < brace)) {
+    return sp == std::string::npos ? line.size() : sp;
+  }
+  // Quote-aware scan for the label block's close: '}' is legal INSIDE a
+  // quoted label value (and the drop-dir is hostile-writer territory, see
+  // above) — a raw find('}') would truncate the key mid-label and collide
+  // distinct series, letting one writer clobber another's.
+  bool in_quote = false;
+  for (size_t i = brace + 1; i < line.size(); ++i) {
+    char c = line[i];
+    if (in_quote) {
+      if (c == '\\') ++i;  // escaped char inside a quoted value
+      else if (c == '"') in_quote = false;
+    } else if (c == '"') {
+      in_quote = true;
+    } else if (c == '}') {
+      return i + 1;
+    }
+  }
+  return line.size();
+}
+
 void RelayLine(const std::string& raw, const std::string& writer,
                RelayAccum* acc) {
   if (raw.empty()) return;
@@ -115,22 +148,20 @@ void RelayLine(const std::string& raw, const std::string& writer,
   // Prometheus). Labeled (per-chip) series stay as-is: chip ids are
   // node-scoped, so newest-wins per chip is the right resolution.
   std::string line = raw;
-  if (!writer.empty() && raw[0] != '#') {
-    size_t sp = raw.find_last_of(' ');
-    if (sp != std::string::npos &&
-        raw.find('{') == std::string::npos) {
-      line = raw.substr(0, sp) + "{writer=\"" + writer + "\"}" +
-             raw.substr(sp);
+  if (!writer.empty() && raw[0] != '#' &&
+      raw.find('{') == std::string::npos) {
+    size_t ne = SeriesEnd(raw);  // end of the bare metric name
+    if (ne < raw.size()) {
+      line = raw.substr(0, ne) + "{writer=\"" + writer + "\"}" +
+             raw.substr(ne);
     }
   }
   // Comments dedup on the whole line (identical HELP/TYPE from several
-  // writers emit once); samples dedup on name+labels so a later (newer)
-  // file's value REPLACES an earlier one for the same series.
+  // writers emit once); samples dedup on name+labels — never the value
+  // or a trailing timestamp — so a later (newer) file's value REPLACES
+  // an earlier one for the same series.
   std::string key = line;
-  if (line[0] != '#') {
-    size_t sp = line.find_last_of(' ');
-    if (sp != std::string::npos) key = line.substr(0, sp);
-  }
+  if (line[0] != '#') key = line.substr(0, SeriesEnd(line));
   auto it = acc->lines.find(key);
   if (it != acc->lines.end()) {
     acc->bytes += line.size() - it->second.size();

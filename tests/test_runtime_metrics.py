@@ -148,6 +148,51 @@ def test_exporter_relays_union_of_concurrent_writers(native_build, tmp_path):
     assert 'tpu_process_devices{writer="podA-12"} 4' in proc.stdout
 
 
+def test_exporter_relays_timestamped_lines_intact(native_build, tmp_path):
+    """Prometheus exposition allows an optional timestamp after the value
+    (`name value ts`). The writer label must land at the end of the METRIC
+    NAME, never after the value (`tpu_x 5{writer=…} ts` is invalid
+    exposition strict scrapers reject page-wide), and dedup must key on
+    name+labels so the same series from two writers still resolves
+    newest-wins with timestamps present."""
+    mdir = tmp_path / "metrics.d"
+    mdir.mkdir()
+    older = mdir / "podA-1.prom"
+    older.write_text("tpu_custom_total 5 1699999990\n"
+                     'tpu_hbm_used_bytes{chip="0"} 111 1699999990\n')
+    past = time.time() - 30
+    os.utime(older, (past, past))
+    (mdir / "podB-2.prom").write_text(
+        'tpu_hbm_used_bytes{chip="0"} 222 1699999999\n')
+    proc = subprocess.run(
+        [binpath(native_build, "tpu-metrics-exporter"), "--once",
+         f"--metrics-dir={mdir}", "--metrics-file=/nonexistent",
+         "--fake-devices=2", "--accelerator=v5e-8"],
+        capture_output=True, text=True, check=True)
+    # writer label inserted at the name, value+timestamp intact after it
+    assert 'tpu_custom_total{writer="podA-1"} 5 1699999990' in proc.stdout
+    # same labeled series from two writers: ONE line, newest file's value
+    assert 'tpu_hbm_used_bytes{chip="0"} 222 1699999999' in proc.stdout
+    assert "111" not in proc.stdout
+
+
+def test_exporter_dedup_key_is_quote_aware(native_build, tmp_path):
+    """'}' is legal inside a quoted label value, and the drop-dir is
+    hostile-writer territory: a raw find('}') key scan would truncate both
+    series below to the same key and let one writer clobber the other's."""
+    mdir = tmp_path / "metrics.d"
+    mdir.mkdir()
+    (mdir / "podA-1.prom").write_text('tpu_x{l="a}1"} 5\n')
+    (mdir / "podB-2.prom").write_text('tpu_x{l="a}2"} 7\n')
+    proc = subprocess.run(
+        [binpath(native_build, "tpu-metrics-exporter"), "--once",
+         f"--metrics-dir={mdir}", "--metrics-file=/nonexistent",
+         "--fake-devices=2", "--accelerator=v5e-8"],
+        capture_output=True, text=True, check=True)
+    assert 'tpu_x{l="a}1"} 5' in proc.stdout
+    assert 'tpu_x{l="a}2"} 7' in proc.stdout
+
+
 def test_exporter_evicts_stale_writer_files(native_build, tmp_path):
     """A dead writer's file stops being relayed after --stale-after: its
     gauges must not haunt scrapes forever, and the eviction is surfaced
