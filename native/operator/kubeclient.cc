@@ -266,7 +266,8 @@ Response CurlHttps(const Config& cfg, const std::string& method,
     if (body_fd < 0 || write(body_fd, body.data(), body.size()) !=
                            static_cast<ssize_t>(body.size())) {
       resp.error = "cannot stage request body";
-      if (body_fd >= 0) close(body_fd);
+      // a short write still created the file — unlink it on the way out
+      if (body_fd >= 0) { close(body_fd); unlink(body_path); }
       return resp;
     }
   }
@@ -280,7 +281,8 @@ Response CurlHttps(const Config& cfg, const std::string& method,
     if (hdr_fd < 0 || write(hdr_fd, hdr.data(), hdr.size()) !=
                           static_cast<ssize_t>(hdr.size())) {
       resp.error = "cannot stage auth header";
-      if (hdr_fd >= 0) close(hdr_fd);
+      // never leave a partial Authorization line on disk
+      if (hdr_fd >= 0) { close(hdr_fd); unlink(hdr_path); }
       if (body_fd >= 0) { close(body_fd); unlink(body_path); }
       return resp;
     }
@@ -406,6 +408,222 @@ Response Call(const Config& cfg, const std::string& method,
   if (url.https)
     return CurlHttps(cfg, method, cfg.base_url + path, body, content_type);
   return PlainHttp(cfg, url, method, path, body, content_type);
+}
+
+// ------------------------------------------------------------------ watch
+
+namespace {
+int ElapsedMs(const struct timespec& t0) {
+  struct timespec now;
+  clock_gettime(CLOCK_MONOTONIC, &now);
+  return static_cast<int>((now.tv_sec - t0.tv_sec) * 1000 +
+                          (now.tv_nsec - t0.tv_nsec) / 1000000);
+}
+}  // namespace
+
+WatchStream::~WatchStream() { Close(); }
+
+void WatchStream::Close() {
+  if (fd_ >= 0) {
+    close(fd_);
+    fd_ = -1;
+  }
+  if (pid_ > 0) {
+    kill(pid_, SIGKILL);
+    int st = 0;
+    waitpid(pid_, &st, 0);
+    pid_ = -1;
+  }
+  if (!hdr_file_.empty()) {
+    unlink(hdr_file_.c_str());
+    hdr_file_.clear();
+  }
+  raw_.clear();
+  body_.clear();
+  headers_done_ = false;
+  chunked_ = false;
+  saw_final_chunk_ = false;
+  chunk_left_ = -1;
+}
+
+bool WatchStream::Open(const Config& cfg, const std::string& path_and_query,
+                       int max_seconds, std::string* err) {
+  Close();
+  Url url;
+  if (!ParseUrl(cfg.base_url, &url, err)) return false;
+  if (url.https) {
+    if (cfg.ca_file.empty() && !cfg.insecure_skip_tls_verify) {
+      *err = "refusing unverified https watch: no CA file";
+      return false;
+    }
+    // Token via a 0600 header file, never argv (same rationale as
+    // CurlHttps). The file must outlive exec — curl opens it lazily — so
+    // it is unlinked in Close(), not here.
+    std::vector<std::string> args = {
+        "curl", "-sS", "-N", "--max-time", std::to_string(max_seconds),
+        "-H", "Accept: application/json",
+    };
+    if (!cfg.token.empty()) {
+      char hdr_path[] = "/tmp/tpuop-watch-hdr-XXXXXX";
+      int hdr_fd = mkstemp(hdr_path);
+      if (hdr_fd >= 0) hdr_file_ = hdr_path;  // recorded BEFORE the write
+                                              // so a failed write still
+                                              // gets the file (possibly
+                                              // holding a partial token)
+                                              // unlinked by Close()
+      std::string hdr = "Authorization: Bearer " + cfg.token + "\n";
+      if (hdr_fd < 0 || write(hdr_fd, hdr.data(), hdr.size()) !=
+                            static_cast<ssize_t>(hdr.size())) {
+        *err = "cannot stage auth header";
+        if (hdr_fd >= 0) close(hdr_fd);
+        Close();
+        return false;
+      }
+      close(hdr_fd);
+      args.insert(args.end(), {"-H", std::string("@") + hdr_file_});
+    }
+    if (!cfg.ca_file.empty())
+      args.insert(args.end(), {"--cacert", cfg.ca_file});
+    else
+      args.push_back("-k");
+    args.push_back(cfg.base_url + path_and_query);
+
+    int pipefd[2];
+    if (pipe(pipefd) != 0) {
+      *err = "pipe failed";
+      return false;
+    }
+    pid_ = fork();
+    if (pid_ < 0) {
+      *err = "fork failed";
+      close(pipefd[0]);
+      close(pipefd[1]);
+      pid_ = -1;
+      return false;
+    }
+    if (pid_ == 0) {
+      dup2(pipefd[1], 1);
+      close(pipefd[0]);
+      close(pipefd[1]);
+      std::vector<char*> argv;
+      for (auto& a : args) argv.push_back(const_cast<char*>(a.c_str()));
+      argv.push_back(nullptr);
+      execvp("curl", argv.data());
+      _exit(127);
+    }
+    close(pipefd[1]);
+    fd_ = pipefd[0];
+    headers_done_ = true;  // curl emits the (dechunked) body only
+    return true;
+  }
+
+  fd_ = ConnectTcp(url.host, url.port, cfg.timeout_ms, err);
+  if (fd_ < 0) return false;
+  std::string req = "GET " + url.base_path + path_and_query + " HTTP/1.1\r\n" +
+                    "Host: " + url.host + "\r\n" +
+                    "Connection: close\r\nAccept: application/json\r\n";
+  if (!cfg.token.empty()) req += "Authorization: Bearer " + cfg.token + "\r\n";
+  req += "\r\n";
+  size_t off = 0;
+  while (off < req.size()) {
+    ssize_t n = write(fd_, req.data() + off, req.size() - off);
+    if (n <= 0) {
+      *err = "write failed";
+      Close();
+      return false;
+    }
+    off += n;
+  }
+  return true;
+}
+
+bool WatchStream::Decode() {
+  if (!headers_done_) return true;
+  if (!chunked_) {
+    body_ += raw_;
+    raw_.clear();
+    return true;
+  }
+  size_t pos = 0;
+  while (pos < raw_.size()) {
+    if (chunk_left_ > 0) {
+      size_t take = std::min(static_cast<size_t>(chunk_left_),
+                             raw_.size() - pos);
+      body_.append(raw_, pos, take);
+      pos += take;
+      chunk_left_ -= take;
+      continue;
+    }
+    // need a chunk-size line; an empty line here is the CRLF that trails
+    // a completed chunk body
+    size_t nl = raw_.find("\r\n", pos);
+    if (nl == std::string::npos) break;
+    std::string szline = raw_.substr(pos, nl - pos);
+    pos = nl + 2;
+    if (szline.empty()) continue;
+    char* end = nullptr;
+    long sz = strtol(szline.c_str(), &end, 16);
+    if (end == szline.c_str() || sz < 0) return false;
+    if (sz == 0) {
+      saw_final_chunk_ = true;
+      break;
+    }
+    chunk_left_ = sz;
+  }
+  raw_.erase(0, pos);
+  return true;
+}
+
+WatchStream::Result WatchStream::Next(int wait_ms, std::string* line) {
+  if (fd_ < 0) return kClosed;
+  struct timespec t0;
+  clock_gettime(CLOCK_MONOTONIC, &t0);
+  while (true) {
+    size_t nl;
+    while ((nl = body_.find('\n')) != std::string::npos) {
+      std::string l = body_.substr(0, nl);
+      body_.erase(0, nl + 1);
+      while (!l.empty() && (l.back() == '\r' || l.back() == ' '))
+        l.pop_back();
+      if (!l.empty()) {
+        *line = l;
+        return kEvent;
+      }
+    }
+    if (saw_final_chunk_) return kClosed;
+    // left clamps to 0, not an early return: Next(0) must still drain
+    // data already readable on the transport (the caller's non-blocking
+    // pump pattern), returning kTimeout only when poll says idle.
+    int left = wait_ms - ElapsedMs(t0);
+    if (left < 0) left = 0;
+    struct pollfd pfd = {fd_, POLLIN, 0};
+    int prc = poll(&pfd, 1, left);
+    if (prc == 0) return kTimeout;
+    if (prc < 0) return kError;
+    char buf[8192];
+    ssize_t n = read(fd_, buf, sizeof(buf));
+    if (n < 0) return kError;
+    if (n == 0) return kClosed;
+    raw_.append(buf, n);
+    if (!headers_done_) {
+      size_t he = raw_.find("\r\n\r\n");
+      if (he == std::string::npos) continue;
+      std::string headers = raw_.substr(0, he);
+      raw_.erase(0, he + 4);
+      if (headers.compare(0, 5, "HTTP/") != 0) return kError;
+      size_t lsp = headers.find(' ');
+      size_t lend = headers.find("\r\n");
+      if (lsp == std::string::npos ||
+          (lend != std::string::npos && lsp > lend))
+        return kError;
+      if (atoi(headers.c_str() + lsp + 1) != 200) return kError;
+      for (char& c : headers) c = tolower(c);
+      chunked_ =
+          headers.find("transfer-encoding: chunked") != std::string::npos;
+      headers_done_ = true;
+    }
+    if (!Decode()) return kError;
+  }
 }
 
 }  // namespace kubeclient

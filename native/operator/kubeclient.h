@@ -45,6 +45,48 @@ Response Call(const Config& cfg, const std::string& method,
               const std::string& path, const std::string& body = "",
               const std::string& content_type = "application/json");
 
+// Streaming watch (`?watch=1`): ONE long-lived GET whose response body is a
+// newline-delimited stream of watch-event JSON objects — the
+// controller-runtime model, replacing per-interval GET probes. Same two
+// transports as Call: plain socket for http:// (decodes chunked transfer
+// itself), `curl -sS -N` child for https:// (curl dechunks). Single
+// threaded by design: the caller pumps Next() and owns the cadence.
+class WatchStream {
+ public:
+  enum Result {
+    kEvent,    // *line holds one complete event JSON line
+    kTimeout,  // nothing arrived within wait_ms; stream still open
+    kClosed,   // server ended the stream cleanly (watch timeoutSeconds)
+    kError,    // transport/protocol failure; caller should fall back
+  };
+  WatchStream() = default;
+  ~WatchStream();
+  WatchStream(const WatchStream&) = delete;
+  WatchStream& operator=(const WatchStream&) = delete;
+
+  // path_and_query must already carry `?watch=1&timeoutSeconds=…`;
+  // max_seconds bounds the whole stream (curl --max-time on the https
+  // path). False + *err when the stream cannot be established.
+  bool Open(const Config& cfg, const std::string& path_and_query,
+            int max_seconds, std::string* err);
+  Result Next(int wait_ms, std::string* line);
+  void Close();
+  bool is_open() const { return fd_ >= 0; }
+
+ private:
+  bool Decode();  // raw_ -> body_ (chunked-aware); false on parse error
+
+  int fd_ = -1;
+  pid_t pid_ = -1;          // curl child (https path); -1 = plain socket
+  std::string hdr_file_;    // 0600 auth-header temp file (https path)
+  bool headers_done_ = false;
+  bool chunked_ = false;
+  bool saw_final_chunk_ = false;
+  long chunk_left_ = -1;    // -1 = expecting a chunk-size line
+  std::string raw_;         // undecoded transport bytes
+  std::string body_;        // decoded body not yet split into lines
+};
+
 // Read a whole file, stripping trailing newlines (token files etc.).
 bool ReadFileTrim(const std::string& path, std::string* out);
 

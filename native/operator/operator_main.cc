@@ -55,6 +55,10 @@ struct Options {
   std::string bundle_dir = "/etc/tpu-operator/bundle";
   std::string policy;        // TpuStackPolicy name; "" = no policy gating
   int policy_poll_ms = 2000; // CR-change probe cadence inside the sleep
+                             // (watch fallback; also the bundle-stat and
+                             // watch-pump cadence)
+  bool policy_watch = true;  // event-driven CR watch (?watch=1 stream);
+                             // GET-probe polling remains the fallback
   int interval_s = 15;
   int stage_timeout_s = 600;
   int poll_ms = 1000;
@@ -713,12 +717,110 @@ class Operator {
     return out;
   }
 
-  // Sleep up to ms, probing for input changes every policy_poll_ms so a
-  // day-2 edit reconciles within seconds instead of waiting out the
-  // interval (or a post-failure backoff):
-  //  - the TpuStackPolicy's metadata.generation (one cheap GET; errors
-  //    fall back to the normal cadence — a flapping apiserver must not
-  //    turn the watch into a retry storm),
+  // Event-driven sleep: hold ONE streaming `?watch=1` connection on the
+  // policy CR for the whole interval (the controller-runtime model — zero
+  // GET probes), pumping it every policy_poll_ms so the bundle dir's
+  // LOCAL fingerprint is still checked between waits. Returns true when
+  // the sleep was fully handled (event cut it short, or it ran out);
+  // false = the watch could not be established or died — the caller falls
+  // back to GET-probe polling for the remaining *left_ms.
+  bool SleepOnWatch(int* left_ms, const std::string& bundle_fp) {
+    int secs = (*left_ms + 999) / 1000 + 1;
+    kubeclient::WatchStream ws;
+    std::string err;
+    std::string path = PolicyPath() + "?watch=1&timeoutSeconds=" +
+                       std::to_string(secs);
+    if (!ws.Open(cfg_, path, secs + 30, &err)) {
+      fprintf(stderr,
+              "tpu-operator: watch unavailable (%s); falling back to "
+              "generation polling\n", err.c_str());
+      return false;
+    }
+    int since_bundle_check = 0;
+    while (*left_ms > 0 && !g_stop) {
+      // Drain the watch stream WITHOUT blocking, then hand the actual
+      // wait to Sleep() — the status listener is single-threaded and
+      // only served inside its Pump; blocking in ws.Next for the whole
+      // interval would leave the kubelet's /healthz readiness probe
+      // unanswered (default probe timeout: 1 s).
+      std::string line;
+      kubeclient::WatchStream::Result r = ws.Next(0, &line);
+      switch (r) {
+        case kubeclient::WatchStream::kEvent: {
+          minijson::ValuePtr ev = minijson::Parse(line);
+          if (!ev) continue;
+          std::string type =
+              ev->Get("type") ? ev->Get("type")->as_string() : "";
+          if (type == "ERROR") {
+            // apiserver watch-level error (expired/internal): the stream
+            // is useless but the CR state is UNKNOWN — fall back to the
+            // probe loop rather than reconciling on it (a persistent
+            // error would otherwise bypass --interval as a reconcile hot
+            // loop, since each "successful" pass resets the backoff).
+            fprintf(stderr, "tpu-operator: watch ERROR event; falling "
+                    "back to generation polling\n");
+            return false;
+          }
+          if (type == "DELETED") {
+            if (!policy_missing_) {
+              fprintf(stderr, "tpu-operator: policy %s deleted (watch); "
+                      "reconciling now\n", opt_.policy.c_str());
+              return true;
+            }
+            continue;
+          }
+          double gen = ev->PathNumber("object.metadata.generation", 0);
+          // Generation-filtered, like controller-runtime predicates: the
+          // operator's own status PATCH echoes back as MODIFIED with an
+          // unchanged generation and must not retrigger it.
+          if (policy_missing_ || gen != policy_generation_) {
+            fprintf(stderr,
+                    "tpu-operator: policy %s changed (watch event, "
+                    "generation %.0f -> %.0f); reconciling now\n",
+                    opt_.policy.c_str(), policy_generation_, gen);
+            return true;
+          }
+          continue;
+        }
+        case kubeclient::WatchStream::kTimeout: {
+          // Nothing pending on the stream: serve status/healthz for a
+          // short chunk (also the loop's sleep), and check the local
+          // bundle fingerprint at the probe cadence.
+          int chunk = std::min(*left_ms,
+                               std::min(opt_.policy_poll_ms, 100));
+          Sleep(chunk);
+          *left_ms -= chunk;
+          since_bundle_check += chunk;
+          if (since_bundle_check >= opt_.policy_poll_ms) {
+            since_bundle_check = 0;
+            std::string fp = BundleFingerprint();
+            if (!fp.empty() && fp != bundle_fp) {
+              fprintf(stderr,
+                      "tpu-operator: bundle changed on disk; reconciling "
+                      "now\n");
+              return true;
+            }
+          }
+          continue;
+        }
+        case kubeclient::WatchStream::kClosed:
+        case kubeclient::WatchStream::kError:
+          // server ended the stream early or transport broke: the
+          // remaining sleep falls back to the probe loop
+          return false;
+      }
+    }
+    return true;
+  }
+
+  // Sleep up to ms, reacting to input changes so a day-2 edit reconciles
+  // within seconds instead of waiting out the interval (or a post-failure
+  // backoff):
+  //  - the TpuStackPolicy CR, via a streaming watch when available (the
+  //    upstream operator is controller-runtime, i.e. watch-driven), with
+  //    a metadata.generation GET probe every policy_poll_ms as fallback
+  //    (errors fall back to the normal cadence — a flapping apiserver
+  //    must not turn the watch into a retry storm),
   //  - the bundle dir's fingerprint (local stats; a re-rendered ConfigMap
   //    rolls out as soon as kubelet projects it).
   void SleepWatchingInputs(int ms) {
@@ -731,6 +833,12 @@ class Operator {
     // just finished and must cut this sleep short immediately.
     const std::string& bundle_fp = pass_bundle_fp_;
     int left = ms;
+    // The watch is gated like the remote probe below: never during a
+    // failure backoff (the apiserver is likely the thing that is down).
+    if (opt_.policy_watch && !opt_.policy.empty() && healthy_) {
+      if (SleepOnWatch(&left, bundle_fp)) return;
+      if (left <= 0 || g_stop) return;
+    }
     while (left > 0 && !g_stop) {
       int chunk = std::min(left, opt_.policy_poll_ms);
       Sleep(chunk);
@@ -1199,11 +1307,17 @@ int main(int argc, char** argv) {
       opt.insecure_skip_tls_verify = true;
       continue;
     }
+    if (strcmp(a, "--no-policy-watch") == 0) {
+      opt.policy_watch = false;  // GET-probe polling only (debug escape
+                                 // hatch; the watch self-falls-back anyway)
+      continue;
+    }
     fprintf(stderr,
             "tpu-operator: unknown flag %s\n"
             "usage: tpu-operator [--apiserver=URL] [--token-file=F] "
             "[--ca-file=F]\n"
             "  [--bundle-dir=DIR] [--policy=NAME] [--policy-poll-ms=MS]\n"
+            "  [--no-policy-watch]\n"
             "  [--interval=SECS] [--stage-timeout=SECS]\n"
             "  [--poll-ms=MS] [--status-port=PORT] [--once]\n"
             "  [--leader-elect] [--lease-duration=SECS] [--lease-name=N]\n"

@@ -21,8 +21,10 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs
 
 
 def merge_patch(target: Any, patch: Any) -> Any:
@@ -135,6 +137,15 @@ class FakeApiServer:
         self.created: List[str] = []          # stored object paths, in order
         self.headers_seen: List[Dict[str, str]] = []
         self._lock = threading.Lock()
+        # watch support (?watch=1): every mutation through the HTTP
+        # handlers (or the touch() test hook) bumps _rev and records the
+        # touched path; watchers block on the condition and stream events
+        # for paths under their watch. The changes list is bounded — a
+        # watcher always re-reads the CURRENT object, so dropped history
+        # only loses intermediate states, like a real compacted etcd.
+        self._changed = threading.Condition(self._lock)
+        self._rev = 0
+        self._changes: List[Tuple[int, str]] = []  # (rev, path)
 
         fake = self
 
@@ -162,9 +173,61 @@ class FakeApiServer:
                     fake.log.append((self.command, self.path))
                     fake.headers_seen.append(dict(self.headers))
 
+            def _serve_watch(self, path: str, q: Dict[str, list]):
+                """`?watch=1` long-poll: stream newline-delimited watch
+                events for mutations at/under ``path`` until timeoutSeconds
+                elapses, then end the stream cleanly (the apiserver watch
+                -window model). Connection: close + no Content-Length —
+                the client reads lines until EOF."""
+                try:
+                    timeout_s = float(q.get("timeoutSeconds", ["30"])[0])
+                except ValueError:
+                    timeout_s = 30.0
+                deadline = time.monotonic() + max(0.0, min(timeout_s, 300.0))
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                self.close_connection = True
+                with fake._lock:
+                    last_rev = fake._rev
+                try:
+                    while True:
+                        with fake._changed:
+                            while fake._rev == last_rev:
+                                remaining = deadline - time.monotonic()
+                                if remaining <= 0:
+                                    return  # clean end of the watch window
+                                fake._changed.wait(min(remaining, 1.0))
+                            touched = [p for r, p in fake._changes
+                                       if r > last_rev
+                                       and (p == path
+                                            or p.startswith(path + "/"))]
+                            last_rev = fake._rev
+                            events = [(p, json.loads(json.dumps(
+                                           fake.store[p]))
+                                       if p in fake.store else None)
+                                      for p in touched]
+                        for p, obj in events:
+                            if obj is None:
+                                ev = {"type": "DELETED",
+                                      "object": {"metadata": {
+                                          "name": p.rsplit("/", 1)[-1]}}}
+                            else:
+                                ev = {"type": "MODIFIED", "object": obj}
+                            self.wfile.write(
+                                (json.dumps(ev) + "\n").encode())
+                            self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # watcher went away; nothing to clean up
+
             def do_GET(self):
                 self._record()
                 path, _, query = self.path.partition("?")
+                q = parse_qs(query)
+                if q.get("watch", ["0"])[0] in ("1", "true"):
+                    self._serve_watch(path, q)
+                    return
                 with fake._lock:
                     obj = fake.store.get(path)
                     if path in fake.ghost_get_404:
@@ -233,6 +296,7 @@ class FakeApiServer:
                             obj["status"] = st
                     fake.store[path] = obj
                     fake.created.append(path)
+                    fake._note_change(path)
                 self._reply(201, obj)
 
             def do_PUT(self):
@@ -241,6 +305,7 @@ class FakeApiServer:
                 with fake._lock:
                     existed = self.path in fake.store
                     fake.store[self.path] = obj
+                    fake._note_change(self.path)
                 self._reply(200 if existed else 201, obj)
 
             def do_PATCH(self):
@@ -261,6 +326,7 @@ class FakeApiServer:
                             st = (patch or {}).get("status")
                             parent["status"] = merge_patch(
                                 parent.get("status"), st)
+                            fake._note_change(parent_path)
                     if parent is None:
                         self._reply(404, {"kind": "Status", "code": 404})
                     else:
@@ -290,12 +356,15 @@ class FakeApiServer:
                         if st:
                             merged["status"] = st
                     fake.store[self.path] = merged
+                    fake._note_change(self.path)
                 self._reply(200, merged)
 
             def do_DELETE(self):
                 self._record()
                 with fake._lock:
                     gone = fake.store.pop(self.path, None)
+                    if gone is not None:
+                        fake._note_change(self.path)
                 self._reply(200 if gone is not None else 404, {})
 
         self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
@@ -330,6 +399,22 @@ class FakeApiServer:
     def __exit__(self, *exc):
         self.stop()
 
+    # ------------------------------------------------------------- watch
+
+    def _note_change(self, path: str) -> None:
+        """Record a mutation for watchers. Caller must hold self._lock."""
+        self._rev += 1
+        self._changes.append((self._rev, path))
+        del self._changes[:-1000]  # bounded; watchers re-read current state
+        self._changed.notify_all()
+
+    def touch(self, path: str) -> None:
+        """Wake watchers after a DIRECT store mutation (tests that edit
+        ``api.store[...]`` in place bypass the HTTP handlers and their
+        notifications)."""
+        with self._lock:
+            self._note_change(path)
+
     # ------------------------------------------------------------- test hooks
 
     def paths(self, kind_suffix: str = "") -> List[str]:
@@ -356,10 +441,12 @@ class FakeApiServer:
                 if obj.get("kind") == "DaemonSet":
                     st["desiredNumberScheduled"] = 2
             obj["status"] = st
+            self._note_change(path)
 
     def delete(self, path: str):
         with self._lock:
-            self.store.pop(path, None)
+            if self.store.pop(path, None) is not None:
+                self._note_change(path)
 
     def creation_order(self) -> List[str]:
         with self._lock:
