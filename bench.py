@@ -88,16 +88,19 @@ def compact_line(doc: dict) -> str:
 
     line = dump()
     if len(line) > TAIL_BUDGET:
+        # prose notes go first — the numeric spreads are the audit trail
         removed = [doc.pop(k, None) for k in ("vocab_note",
                                               "measure_spread_note")]
-        if any(r is not None for r in removed):
+        hit = any(r is not None for r in removed)
+        for entry in (doc.get("train_step") or {}).values():
+            hit |= entry.pop("spread_note", None) is not None
+        if hit:
             dropped.append("notes dropped")
             line = dump()
     if len(line) > TAIL_BUDGET:
         hit = False
         for entry in (doc.get("train_step") or {}).values():
             hit |= entry.pop("tflops_spread", None) is not None
-            hit |= entry.pop("spread_note", None) is not None
         if hit:
             dropped.append("per-shape spreads dropped")
             line = dump()
@@ -394,6 +397,15 @@ def main() -> int:
                     ("standard_bf16_params",
                      dc_replace(burnin.standard_config(),
                                 param_dtype="bf16"), 40),
+                    # the full-bf16-STORAGE config (masters + the
+                    # [B,H,S,S] softmax scores; accumulation stays f32 on
+                    # the MXU): the round-5 softmax-bandwidth sweep's
+                    # winner, the first standard-geometry config past
+                    # 0.85 on this chip (standard_config's ledger)
+                    ("standard_bf16",
+                     dc_replace(burnin.standard_config(),
+                                param_dtype="bf16",
+                                score_dtype="bf16"), 40),
                     ("wide", burnin.bench_config(), 20)):
                 # the vocab belongs in the one string a reader sees: the
                 # v8192 choice costs/earns real MFU vs production vocabs
@@ -401,7 +413,9 @@ def main() -> int:
                 geom = (f"v{cfg.vocab} d{cfg.d_model} f{cfg.d_ff} "
                         f"h{cfg.n_heads} s{cfg.seq} b{cfg.batch} "
                         f"({cfg.d_ff // cfg.d_model}x FFN, "
-                        f"{cfg.param_dtype} master)")
+                        f"{cfg.param_dtype} master"
+                        + (", bf16 scores" if cfg.score_dtype == "bf16"
+                           else "") + ")")
                 try:
                     ts = burnin.timed_steps(mesh, cfg, steps=steps)
                     entry = {

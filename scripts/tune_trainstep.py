@@ -32,6 +32,22 @@ VARIANTS = {
     "standard": burnin.standard_config(),
     "standard_bf16p": replace(burnin.standard_config(),
                               param_dtype="bf16"),
+    # round-5 softmax-bandwidth probes (the ledger localises the f32-master
+    # gap to [B,H,S,S] softmax HBM traffic):
+    "standard_bf16score": replace(burnin.standard_config(),
+                                  score_dtype="bf16"),
+    "standard_bf16score_bf16p": replace(burnin.standard_config(),
+                                        score_dtype="bf16",
+                                        param_dtype="bf16"),
+    "standard_chunked": replace(burnin.standard_config(),
+                                attention="chunked"),
+    "standard_chunked_b64": replace(burnin.standard_config(),
+                                    attention="chunked", attn_block=64),
+    "standard_chunked_b256": replace(burnin.standard_config(),
+                                     attention="chunked", attn_block=256),
+    "standard_chunked_bf16p": replace(burnin.standard_config(),
+                                      attention="chunked",
+                                      param_dtype="bf16"),
     "dots": replace(BASE, remat="dots"),
     "b32": replace(BASE, batch=32),
     "b32_dots": replace(BASE, batch=32, remat="dots"),
