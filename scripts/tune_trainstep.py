@@ -48,6 +48,28 @@ VARIANTS = {
     "standard_chunked_bf16p": replace(burnin.standard_config(),
                                       attention="chunked",
                                       param_dtype="bf16"),
+    # long-sequence probes (same 4096 tokens/step as standard): the
+    # chunked/flash knobs' claimed win case is where the [B,H,S,S] matrix
+    # grows quadratically — measure it instead of asserting it
+    "ls2k": replace(burnin.standard_config(), seq=2048, batch=2),
+    "ls2k_chunked": replace(burnin.standard_config(), seq=2048, batch=2,
+                            attention="chunked", attn_block=256),
+    "ls2k_flash": replace(burnin.standard_config(), seq=2048, batch=2,
+                          attention="flash"),
+    "ls8k_chunked": replace(burnin.standard_config(), seq=8192, batch=1,
+                            attention="chunked", attn_block=512),
+    "ls8k_flash": replace(burnin.standard_config(), seq=8192, batch=1,
+                          attention="flash"),
+    "ls8k": replace(burnin.standard_config(), seq=8192, batch=1),
+    "ls4k": replace(burnin.standard_config(), seq=4096, batch=1),
+    "ls4k_flash": replace(burnin.standard_config(), seq=4096, batch=1,
+                          attention="flash"),
+    "ls8k_chunked_b256": replace(burnin.standard_config(), seq=8192,
+                                 batch=1, attention="chunked",
+                                 attn_block=256),
+    "ls8k_flash_dots": replace(burnin.standard_config(), seq=8192,
+                               batch=1, attention="flash",
+                               remat="dots"),
     "dots": replace(BASE, remat="dots"),
     "b32": replace(BASE, batch=32),
     "b32_dots": replace(BASE, batch=32, remat="dots"),

@@ -151,11 +151,18 @@ def test_unknown_attention_knobs_are_rejected():
     for bad in (dc_replace(cfg, attention="chunk"),
                 dc_replace(cfg, attention="Chunked"),
                 dc_replace(cfg, score_dtype="fp32"),
-                # bf16 scores are honored on the xla path ONLY; a silent
+                dc_replace(cfg, param_dtype="fp16"),
+                # these knobs are honored on the xla path ONLY; a silent
                 # no-op elsewhere would mislabel the measured config
-                dc_replace(cfg, attention="chunked", score_dtype="bf16")):
+                dc_replace(cfg, attention="chunked", score_dtype="bf16"),
+                dc_replace(cfg, attention="chunked", remat="attn"),
+                # chunked needs seq divisible by the KV block
+                dc_replace(cfg, attention="chunked", attn_block=3)):
         with pytest.raises(ValueError):
             burnin.forward(params, tokens, bad)
+    with pytest.raises(ValueError):
+        burnin.init_params(dc_replace(cfg, param_dtype="fp16"),
+                           jax.random.PRNGKey(0))
 
 
 def test_fused_xent_matches_autodiff():

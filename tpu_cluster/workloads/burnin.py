@@ -45,8 +45,9 @@ class BurninConfig:
     #           there, see bench_config)
     #   "attn"  recompute only the attention block (its [B,H,S,S] tensors
     #           are the largest saves; the flash-attention trade without
-    #           the kernel). Applies to the "xla" attention path only — the
-    #           flash kernel already rematerialises internally.
+    #           the kernel). "xla" attention path only — forward() REJECTS
+    #           it with flash/chunked (they rematerialise internally; a
+    #           silent no-op would mislabel a measured config).
     #   "dots"  save only matmul outputs (jax.checkpoint
     #           dots_with_no_batch_dims_saveable)
     #   "full"  save nothing, recompute the whole fwd pass
@@ -87,6 +88,10 @@ class BurninConfig:
 
 
 def init_params(cfg: BurninConfig, key) -> Dict[str, Any]:
+    if cfg.param_dtype not in ("f32", "bf16"):  # same guard as forward():
+        # a typo'd dtype silently minting f32 masters would publish an
+        # f32 measurement under a bf16-labeled entry
+        raise ValueError(f"unknown param_dtype={cfg.param_dtype!r}")
     ks = jax.random.split(key, 8)
     d, f, h = cfg.d_model, cfg.d_ff, cfg.n_heads
     dtype = jnp.bfloat16 if cfg.param_dtype == "bf16" else jnp.float32
@@ -131,8 +136,9 @@ def _chunked_attention(q, k, v, d_head: int, block: int) -> jnp.ndarray:
     tested in test_workloads)."""
     scale = 1.0 / np.sqrt(d_head)
     b, s, h, d = q.shape
+    if s % block != 0:
+        raise ValueError(f"seq {s} not divisible by attn_block {block}")
     nb = s // block
-    assert s % block == 0, (s, block)
     # scan carries: running max m [B,S,H,1], denom l [B,S,H,1], out o (f32)
     kb = jnp.moveaxis(k.reshape(b, nb, block, h, d), 1, 0)
     vb = jnp.moveaxis(v.reshape(b, nb, block, h, d), 1, 0)
@@ -182,11 +188,22 @@ def forward(params: Dict[str, Any], tokens: jnp.ndarray,
                          "expected xla|flash|chunked")
     if cfg.score_dtype not in ("f32", "bf16"):
         raise ValueError(f"unknown score_dtype={cfg.score_dtype!r}")
+    if cfg.param_dtype not in ("f32", "bf16"):
+        raise ValueError(f"unknown param_dtype={cfg.param_dtype!r}")
     if cfg.score_dtype == "bf16" and cfg.attention != "xla":
         raise ValueError(
             "score_dtype='bf16' applies to the 'xla' attention path only "
             "(flash/chunked manage score storage internally); a silent "
             "no-op here would mislabel the measured config")
+    if cfg.remat == "attn" and cfg.attention != "xla":
+        raise ValueError(
+            "remat='attn' checkpoints the 'xla' attention block only "
+            "(flash/chunked rematerialise internally); a silent no-op "
+            "here would mislabel the measured config")
+    if cfg.attention == "chunked" and cfg.seq % cfg.attn_block != 0:
+        raise ValueError(
+            f"attention='chunked' needs seq ({cfg.seq}) divisible by "
+            f"attn_block ({cfg.attn_block})")
     x = params["embed"][tokens].astype(jnp.bfloat16)       # [B, S, D]
     h = cfg.n_heads
     d_head = cfg.d_model // h
@@ -413,6 +430,21 @@ def standard_config() -> BurninConfig:
          sequentialisation + per-block [B,S,H,block] tiles cost more
          than the avoided full-matrix round trips; the win case
          remains long sequences, where the S^2 matrix stops fitting.
+
+    Long-sequence crossover (round 5, same-session, steps=10, constant
+    4096 tokens/step so the rows compare):
+
+      s2048/b2:  xla 0.736   chunked 0.602   flash 0.640
+      s4096/b1:  xla 0.624                   flash 0.526
+      s8192/b1:  xla 0.134                   flash 0.402  (3.0x)
+         (+ remat="dots" on flash: 0.349 — a regression even here)
+
+    The materialised [B,H,S,S] path wins through s4096; at s8192 its
+    4.3 GB f32 score matrix thrashes HBM and the Pallas flash kernel
+    is 3x faster — long-context shapes should set attention="flash".
+    The hand-chunked XLA recurrence failed to COMPILE at s8192 through
+    the tunnel's remote compiler (HTTP 500 at block 256 and 512) —
+    recorded, not benched.
 
     The measured ceiling for honest 4x geometry with f32 MASTERS on
     this chip is ~0.82 (best: bf16 scores, 0.818); the 0.85+ readings
