@@ -832,6 +832,56 @@ def test_watch_event_triggers_reconcile_without_polling(native_build,
         assert "watch event" in op.stderr.read()
 
 
+def test_fake_apiserver_watch_stream_semantics():
+    """Direct coverage of the fake's `?watch=1` long-poll (the operator
+    test only exercises MODIFIED on an exact path): DELETED events,
+    collection-prefix matching, and the clean timeoutSeconds end."""
+    import http.client
+
+    with FakeApiServer(auto_ready=True,
+                       store={POLICY_PATH: seeded_policy()}) as api:
+        host = api.url[len("http://"):]
+        conn = http.client.HTTPConnection(host, timeout=10)
+        conn.request("GET", "/apis/tpu-stack.dev/v1alpha1/tpustackpolicies"
+                            "?watch=1&timeoutSeconds=8")
+        resp = conn.getresponse()
+        assert resp.status == 200
+
+        # The long-poll runs on the ThreadingHTTPServer's handler thread,
+        # so mutations can interleave from THIS thread deterministically:
+        # mutate, then read the event, so the watcher can never coalesce
+        # the PATCH with a later DELETE (which would re-read the
+        # post-DELETE store and emit two DELETEDs).
+        body = json.dumps({"spec": {"operands": {
+            "metricsExporter": {"enabled": False}}}}).encode()
+        req = urllib.request.Request(
+            api.url + POLICY_PATH, data=body,
+            headers={"Content-Type": "application/merge-patch+json"},
+            method="PATCH")
+        urllib.request.urlopen(req).read()
+        ev1 = json.loads(resp.readline())
+        assert ev1["type"] == "MODIFIED"
+        assert ev1["object"]["metadata"]["generation"] == 2
+
+        req = urllib.request.Request(api.url + POLICY_PATH,
+                                     method="DELETE")
+        urllib.request.urlopen(req).read()
+        ev2 = json.loads(resp.readline())
+        assert ev2["type"] == "DELETED"
+        assert ev2["object"]["metadata"]["name"] == "default"
+        conn.close()
+
+        # a watch on an UNRELATED path must see neither event: only the
+        # clean timeout end (empty body) — run after the mutations above
+        conn2 = http.client.HTTPConnection(host, timeout=10)
+        conn2.request("GET", "/api/v1/nodes/nope?watch=1&timeoutSeconds=1")
+        r2 = conn2.getresponse()
+        assert r2.status == 200
+        api.touch("/api/v1/nodes/other")  # different path: filtered out
+        assert r2.read() == b""  # stream ends at timeoutSeconds, no events
+        conn2.close()
+
+
 def test_upgrade_prunes_objects_dropped_from_bundle(native_build,
                                                     bundle_dir):
     """A re-rendered bundle that DROPS an object must garbage-collect the
