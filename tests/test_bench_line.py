@@ -172,6 +172,14 @@ def test_pathological_doc_falls_back_to_headline_scalars():
     assert parsed["mfu"] == 0.987 and "compacted" in parsed
 
 
+def test_wrapper_with_parsed_dict_loads_directly():
+    """The healthy-driver case (r03, and r05+ by construction): the
+    wrapper's parsed dict is returned as-is, no recovery involved."""
+    doc = bench_table.load(os.path.join(REPO, "BENCH_r03.json"))
+    assert doc.get("metric") == "bf16_matmul_tflops_1chip"
+    assert "recovered_from_tail" not in doc
+
+
 def test_unrecoverable_artifact_exits_clean(tmp_path):
     p = tmp_path / "BENCH_r99.json"
     p.write_text(json.dumps({"n": 99, "cmd": "python bench.py", "rc": 1,
