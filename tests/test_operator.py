@@ -766,25 +766,43 @@ def test_policy_toggle_reconciled_within_poll_window(native_build,
             op.wait(timeout=10)
 
 
+@pytest.mark.parametrize("transport", ["http", "https"])
 def test_watch_event_triggers_reconcile_without_polling(native_build,
-                                                        bundle_dir):
+                                                        bundle_dir,
+                                                        tmp_path,
+                                                        transport):
     """The upstream gpu-operator is controller-runtime, i.e. watch-driven
     (reference README.md:101-110; round-4 verdict missing #3): our
     operator holds ONE streaming `?watch=1` connection on the CR for the
     whole sleep. Proof shape: a silent interval shows ZERO generation GET
     probes, then a CR edit through the apiserver cuts the sleep short via
-    the watch event."""
+    the watch event. Parametrized over BOTH WatchStream transports: the
+    plain socket (http) and the production in-cluster path — a streaming
+    `curl -sS -N` child with CA verification and the bearer token via a
+    header file (https)."""
     import socket
+    import ssl
+
+    from fake_apiserver import make_self_signed
+
+    tls, extra, ctx = None, [], None
+    if transport == "https":
+        cert, key = make_self_signed(tmp_path)
+        tok = tmp_path / "token"
+        tok.write_text("https-sekrit\n")
+        tls = (str(cert), str(key))
+        extra = [f"--token-file={tok}", f"--ca-file={cert}"]
+        ctx = ssl.create_default_context(cafile=str(cert))
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         status_port = s.getsockname()[1]
-    with FakeApiServer(auto_ready=True,
+    with FakeApiServer(auto_ready=True, tls=tls,
                        store={POLICY_PATH: seeded_policy()}) as api:
         op = start_operator(
             native_build, f"--apiserver={api.url}",
-            f"--bundle-dir={bundle_dir}", "--policy=default",
+            f"--bundle-dir={bundle_dir}", "--policy=default", *extra,
             "--interval=120", "--policy-poll-ms=100", "--poll-ms=20",
-            "--stage-timeout=10", f"--status-port={status_port}")
+            "--stage-timeout=20", f"--status-port={status_port}")
         try:
             exporter_ds = f"{DS}/tpu-metrics-exporter"
             assert wait_until(lambda: api.get(exporter_ds) is not None)
@@ -816,7 +834,7 @@ def test_watch_event_triggers_reconcile_without_polling(native_build,
                 api.url + POLICY_PATH, data=body,
                 headers={"Content-Type": "application/merge-patch+json"},
                 method="PATCH")
-            with urllib.request.urlopen(req) as r:
+            with urllib.request.urlopen(req, context=ctx) as r:
                 assert r.status == 200
             assert wait_until(lambda: api.get(exporter_ds) is None,
                               timeout=20), \
@@ -826,7 +844,13 @@ def test_watch_event_triggers_reconcile_without_polling(native_build,
                 .get("observedGeneration") == 2, timeout=20)
         finally:
             op.send_signal(signal.SIGTERM)
-            op.wait(timeout=10)
+            try:
+                op.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                # the https path holds a streaming curl child; a stuck
+                # reap must not mask the real assertion or leak processes
+                op.kill()
+                op.wait(timeout=10)
         # outside the finally: a body-assertion failure must surface as
         # itself, not be masked by this secondary check
         assert "watch event" in op.stderr.read()
