@@ -412,14 +412,12 @@ Response Call(const Config& cfg, const std::string& method,
 
 // ------------------------------------------------------------------ watch
 
-namespace {
 int ElapsedMs(const struct timespec& t0) {
   struct timespec now;
   clock_gettime(CLOCK_MONOTONIC, &now);
   return static_cast<int>((now.tv_sec - t0.tv_sec) * 1000 +
                           (now.tv_nsec - t0.tv_nsec) / 1000000);
 }
-}  // namespace
 
 WatchStream::~WatchStream() { Close(); }
 

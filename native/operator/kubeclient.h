@@ -12,9 +12,16 @@
 #ifndef TPU_NATIVE_OPERATOR_KUBECLIENT_H_
 #define TPU_NATIVE_OPERATOR_KUBECLIENT_H_
 
+#include <time.h>
+
 #include <string>
 
 namespace kubeclient {
+
+// Milliseconds since t0 (CLOCK_MONOTONIC). One shared copy of the
+// timespec arithmetic — WatchStream, the operator's sleep accounting and
+// its status pump all budget waits with it.
+int ElapsedMs(const struct timespec& t0);
 
 struct Response {
   int status = 0;          // HTTP status; 0 = transport failure

@@ -229,10 +229,7 @@ class StatusServer {
           close(cfd);
         }
       }
-      struct timespec now;
-      clock_gettime(CLOCK_MONOTONIC, &now);
-      left = wait_ms - static_cast<int>((now.tv_sec - t0.tv_sec) * 1000 +
-                                        (now.tv_nsec - t0.tv_nsec) / 1000000);
+      left = wait_ms - kubeclient::ElapsedMs(t0);
     } while (left > 0 && !g_stop);
   }
 
@@ -736,8 +733,21 @@ class Operator {
               "generation polling\n", err.c_str());
       return false;
     }
+    // Wall-clock accounting for EVERY branch: a writer flapping the CR's
+    // status at high rate streams kEvent results continuously, and a loop
+    // that only deducts time in the kTimeout branch would spin here past
+    // the interval — for a leader, past the lease renewal deadline
+    // (split-brain by starvation). left is recomputed from the clock.
+    struct timespec sleep_start;
+    clock_gettime(CLOCK_MONOTONIC, &sleep_start);
+    const int budget_ms = *left_ms;
+    auto recompute_left = [&]() {
+      *left_ms = std::max(0, budget_ms - kubeclient::ElapsedMs(sleep_start));
+    };
     int since_bundle_check = 0;
-    while (*left_ms > 0 && !g_stop) {
+    while (!g_stop) {
+      recompute_left();
+      if (*left_ms <= 0) break;
       // Drain the watch stream WITHOUT blocking, then hand the actual
       // wait to Sleep() — the status listener is single-threaded and
       // only served inside its Pump; blocking in ws.Next for the whole
@@ -785,11 +795,11 @@ class Operator {
         case kubeclient::WatchStream::kTimeout: {
           // Nothing pending on the stream: serve status/healthz for a
           // short chunk (also the loop's sleep), and check the local
-          // bundle fingerprint at the probe cadence.
+          // bundle fingerprint at the probe cadence. left_ms itself is
+          // wall-clock-recomputed at the loop top.
           int chunk = std::min(*left_ms,
                                std::min(opt_.policy_poll_ms, 100));
           Sleep(chunk);
-          *left_ms -= chunk;
           since_bundle_check += chunk;
           if (since_bundle_check >= opt_.policy_poll_ms) {
             since_bundle_check = 0;
@@ -807,6 +817,7 @@ class Operator {
         case kubeclient::WatchStream::kError:
           // server ended the stream early or transport broke: the
           // remaining sleep falls back to the probe loop
+          recompute_left();
           return false;
       }
     }
