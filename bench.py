@@ -34,6 +34,10 @@ is written to the ``bench_detail.json`` sidecar for local audit.
 
 from __future__ import annotations
 
+# Module scope must stay STDLIB-ONLY: scripts/bench_table.py imports this
+# module for the T4 baseline constant on CI runners that have no
+# accelerator stack at all. jax (and everything heavy) imports lazily
+# inside the measuring functions — keep it that way.
 import json
 import os
 import socket

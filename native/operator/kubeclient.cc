@@ -457,8 +457,17 @@ bool WatchStream::Open(const Config& cfg, const std::string& path_and_query,
     // Token via a 0600 header file, never argv (same rationale as
     // CurlHttps). The file must outlive exec — curl opens it lazily — so
     // it is unlinked in Close(), not here.
+    //
+    // --fail: a non-2xx watch response (403 RBAC denial, 410 Gone) makes
+    // curl exit without emitting the apiserver's kind:Status error body.
+    // Without it those bodies stream out of this fd as "event" lines, and
+    // the consumer reconciles on each one — a hot loop that bypasses
+    // --interval for as long as the denial persists. With it the stream
+    // just hits EOF (kClosed) and the caller falls back to generation
+    // polling at its normal cadence.
     std::vector<std::string> args = {
-        "curl", "-sS", "-N", "--max-time", std::to_string(max_seconds),
+        "curl", "-sS", "-N", "--fail", "--max-time",
+        std::to_string(max_seconds),
         "-H", "Accept: application/json",
     };
     if (!cfg.token.empty()) {

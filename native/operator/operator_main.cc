@@ -745,6 +745,14 @@ class Operator {
       *left_ms = std::max(0, budget_ms - kubeclient::ElapsedMs(sleep_start));
     };
     int since_bundle_check = 0;
+    // Consecutive-kEvent cap: a saturating stream (or a misbehaving proxy
+    // echoing garbage lines) keeps Next(0) returning kEvent, so the loop
+    // would never reach the kTimeout branch where the status listener is
+    // pumped — and the kubelet's /healthz probe (1 s timeout) would go
+    // unanswered. Every kMaxEventDrain events the listener gets a
+    // zero-length Pump before draining continues.
+    constexpr int kMaxEventDrain = 64;
+    int events_since_pump = 0;
     while (!g_stop) {
       recompute_left();
       if (*left_ms <= 0) break;
@@ -757,6 +765,10 @@ class Operator {
       kubeclient::WatchStream::Result r = ws.Next(0, &line);
       switch (r) {
         case kubeclient::WatchStream::kEvent: {
+          if (++events_since_pump >= kMaxEventDrain) {
+            events_since_pump = 0;
+            Sleep(0);  // answer pending /healthz before draining more
+          }
           minijson::ValuePtr ev = minijson::Parse(line);
           if (!ev) continue;
           std::string type =
@@ -778,6 +790,19 @@ class Operator {
               return true;
             }
             continue;
+          }
+          minijson::ValuePtr obj = ev->Get("object");
+          if (!obj || !obj->Get("metadata")) {
+            // Not a watch event at all: an apiserver error body (kind:
+            // Status from a 403/410 response) streamed through the https
+            // transport line-by-line. Reconciling on it would reset the
+            // backoff each pass — a hot loop bypassing --interval for as
+            // long as the error persists. The stream is junk; fall back
+            // to generation polling for the remaining interval.
+            fprintf(stderr, "tpu-operator: watch line without "
+                    "object.metadata (apiserver error body?); falling "
+                    "back to generation polling\n");
+            return false;
           }
           double gen = ev->PathNumber("object.metadata.generation", 0);
           // Generation-filtered, like controller-runtime predicates: the

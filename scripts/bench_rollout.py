@@ -1,0 +1,133 @@
+"""Rollout hot-path microbenchmark: sequential vs pipelined engine.
+
+`bench.py` makes chip MFU visible; this makes the CONTROL-PLANE hot path
+visible the same way — one reproducible JSON line per run, asserted in the
+tier-1 flow (tests/test_pipeline.py) so a regression in the rollout
+engine shows up exactly like a kernel regression would.
+
+The scenario is the full stack a `tpuctl apply --operator` + `tpuctl apply`
+day would drive — the operator install waves plus every operand group —
+against `tests/fake_apiserver.py` with an injected per-request service time
+(default 5 ms, the ballpark of an in-cluster apiserver round trip). Each arm
+does one fresh install and then `--passes` steady-state re-applies (the C++
+operator's reconcile cadence: identical bundle, every interval):
+
+  sequential  one object at a time over fresh per-request sockets
+              (``keep_alive=False, max_inflight=1`` — the seed procedure)
+  pipelined   persistent connections, shared-cache prefetch, tiered
+              concurrent apply, skip-unchanged re-applies, seeded readiness
+              (``keep_alive=True, max_inflight=N``)
+
+Usage:
+  python scripts/bench_rollout.py                 # print the JSON line
+  python scripts/bench_rollout.py --check         # also exit 1 unless
+                                                  # >=3x fewer requests and
+                                                  # >=2x lower wall clock
+  python scripts/bench_rollout.py --latency-ms 5 --passes 3 --max-inflight 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+from fake_apiserver import FakeApiServer  # noqa: E402
+from tpu_cluster import kubeapply  # noqa: E402
+from tpu_cluster import spec as specmod  # noqa: E402
+from tpu_cluster.render import manifests, operator_bundle  # noqa: E402
+
+REQUEST_RATIO_TARGET = 3.0
+SPEEDUP_TARGET = 2.0
+
+
+def full_stack_groups(spec):
+    """Operator install waves followed by every operand group — the whole
+    bundle one cluster bring-up applies."""
+    return (list(operator_bundle.operator_install_groups(spec))
+            + list(manifests.rollout_groups(spec)))
+
+
+def run_arm(name: str, latency_s: float, passes: int,
+            max_inflight: int) -> dict:
+    """One fresh fake apiserver; install + `passes` steady-state re-applies.
+    Returns wall clock, apiserver request count, and per-phase timings."""
+    spec = specmod.default_spec()
+    groups = full_stack_groups(spec)
+    phases = {"apply": 0.0, "crd-establish": 0.0, "ready-wait": 0.0}
+    with FakeApiServer(auto_ready=True, latency_s=latency_s) as api:
+        client = kubeapply.Client(api.url, keep_alive=(max_inflight > 1))
+        t0 = time.monotonic()
+        for _ in range(1 + passes):
+            result = kubeapply.apply_groups(
+                client, groups, wait=True, stage_timeout=60, poll=0.05,
+                max_inflight=max_inflight)
+            for k, v in result.timings.items():
+                phases[k] += v
+        wall = time.monotonic() - t0
+        client.close()
+        requests = len(api.log)
+    return {
+        "arm": name,
+        "wall_s": round(wall, 3),
+        "requests": requests,
+        "phases": {k: round(v, 3) for k, v in phases.items()},
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--latency-ms", type=float, default=5.0,
+                    help="injected per-request service time (default 5)")
+    ap.add_argument("--passes", type=int, default=3,
+                    help="steady-state re-applies after the install "
+                         "(default 3 — the operator reconcile cadence)")
+    ap.add_argument("--max-inflight", type=int, default=8,
+                    help="pipelined arm's worker-pool bound (default 8)")
+    ap.add_argument("--check", action="store_true",
+                    help=f"exit 1 unless requests drop "
+                         f">={REQUEST_RATIO_TARGET:g}x and wall clock drops "
+                         f">={SPEEDUP_TARGET:g}x")
+    args = ap.parse_args(argv)
+
+    latency_s = args.latency_ms / 1000.0
+    seq = run_arm("sequential", latency_s, args.passes, max_inflight=1)
+    pipe = run_arm("pipelined", latency_s, args.passes,
+                   max_inflight=args.max_inflight)
+
+    spec = specmod.default_spec()
+    groups = full_stack_groups(spec)
+    doc = {
+        "bench": "rollout",
+        "latency_ms": args.latency_ms,
+        "groups": len(groups),
+        "objects": sum(len(g) for g in groups),
+        "passes": 1 + args.passes,
+        "max_inflight": args.max_inflight,
+        "sequential": {k: v for k, v in seq.items() if k != "arm"},
+        "pipelined": {k: v for k, v in pipe.items() if k != "arm"},
+        "request_ratio": round(seq["requests"] / max(1, pipe["requests"]), 2),
+        "speedup": round(seq["wall_s"] / max(1e-9, pipe["wall_s"]), 2),
+    }
+    print(json.dumps(doc, separators=(",", ":")))
+
+    if args.check:
+        ok = (doc["request_ratio"] >= REQUEST_RATIO_TARGET
+              and doc["speedup"] >= SPEEDUP_TARGET)
+        if not ok:
+            print(f"bench_rollout: FAIL — request_ratio "
+                  f"{doc['request_ratio']} (target "
+                  f">={REQUEST_RATIO_TARGET:g}) speedup {doc['speedup']} "
+                  f"(target >={SPEEDUP_TARGET:g})", file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

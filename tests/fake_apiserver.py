@@ -125,8 +125,14 @@ class FakeApiServer:
 
     def __init__(self, auto_ready: bool = True, tls=None, port: int = 0,
                  store: Optional[Dict[str, Dict[str, Any]]] = None,
-                 ghost_get_404=(), reject_posts: Optional[Dict[str, int]] = None):
+                 ghost_get_404=(), reject_posts: Optional[Dict[str, int]] = None,
+                 latency_s: float = 0.0):
         self.auto_ready = auto_ready
+        # Injected per-request service time (scripts/bench_rollout.py and
+        # the shared-watcher tests): slept before EVERY handled request, on
+        # that request's own handler thread, so concurrent clients overlap
+        # their waits exactly like round trips to a remote apiserver.
+        self.latency_s = latency_s
         self._tls = tls
         self.store: Dict[str, Dict[str, Any]] = dict(store or {})
         self.ghost_get_404 = set(ghost_get_404)
@@ -169,6 +175,8 @@ class FakeApiServer:
                 self.wfile.write(body)
 
             def _record(self):
+                if fake.latency_s > 0:
+                    time.sleep(fake.latency_s)
                 with fake._lock:
                     fake.log.append((self.command, self.path))
                     fake.headers_seen.append(dict(self.headers))

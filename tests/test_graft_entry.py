@@ -11,7 +11,17 @@ import os
 import subprocess
 import sys
 
+import jax
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# In-process CPU-mesh GROWTH (4 -> 8 after a client exists) requires the
+# jax_num_cpu_devices config (newer JAX): XLA parses XLA_FLAGS once per
+# process, so on older versions a live CPU client can never be rebuilt at a
+# larger size — only fresh processes (which all driver entry points use)
+# can pick a new count.
+GROWTH_SUPPORTED = hasattr(jax.config, "jax_num_cpu_devices")
 
 
 def _bare_env():
@@ -58,7 +68,10 @@ def test_dryrun_restores_process_state():
         "assert os.environ.get('JAX_PLATFORMS') is None, os.environ\n"
         "assert 'xla_force_host_platform' not in"
         " os.environ.get('XLA_FLAGS', ''), os.environ\n"
-        "assert jax.config.jax_num_cpu_devices == -1\n"
+        # (getattr: the config key only exists on newer JAX; on older
+        # versions XLA_FLAGS is the whole mechanism and the env asserts
+        # above already cover the restore)
+        "assert getattr(jax.config, 'jax_num_cpu_devices', -1) == -1\n"
         # NB: len(jax.devices('cpu')) may stay 8 — XLA parses XLA_FLAGS once
         # per process (C++ layer), so the client size itself cannot shrink
         # back; the restored env/config only govern future processes.
@@ -67,12 +80,16 @@ def test_dryrun_restores_process_state():
         "out = jax.jit(fn)(*args)\n"
         "jax.block_until_ready(out)\n"
         "print('post-dryrun platform:',"
-        " list(out.devices())[0].platform)\n"
+        " list(out.devices())[0].platform)\n",
+        timeout=600,  # full dryrun + post-work; same budget as the bare test
     )
     assert proc.returncode == 0, proc.stderr
     assert "post-dryrun platform: cpu" in proc.stdout  # bare env ⇒ cpu default
 
 
+@pytest.mark.skipif(
+    not GROWTH_SUPPORTED,
+    reason="in-process mesh growth needs jax_num_cpu_devices (newer JAX)")
 def test_dryrun_repeat_and_growth():
     proc = _run(
         "import __graft_entry__ as g\n"

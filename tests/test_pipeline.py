@@ -1,0 +1,347 @@
+"""Pipelined rollout engine + snapshot-cached verify tests.
+
+Covers the concurrency surfaces the seed's sequential tests can't: group
+barriers under the worker pool, the shared readiness watcher's one-GET-per-
+collection-per-tick contract, keep-alive transport reuse (and its stale-
+socket retry), skip-unchanged re-applies, ClusterSnapshot parity with the
+per-check canned-runner results, and the bench_rollout JSON line the tier-1
+flow records.
+"""
+
+import json
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from fake_apiserver import FakeApiServer
+from tpu_cluster import kubeapply, spec as specmod, verify
+from tpu_cluster.render import manifests, operator_bundle
+
+NS = "tpu-system"
+DS_COLL = f"/apis/apps/v1/namespaces/{NS}/daemonsets"
+
+
+@pytest.fixture()
+def spec():
+    return specmod.default_spec()
+
+
+def daemonset(name, ns=NS):
+    return {"apiVersion": "apps/v1", "kind": "DaemonSet",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {"template": {"spec": {"image": f"{name}:v1"}}}}
+
+
+# ------------------------------------------------------------ concurrent apply
+
+
+def test_pipelined_tiers_and_group_barriers(spec):
+    """Under the worker pool, dependency order must survive: Namespace/CRD
+    land before RBAC/config inside a group, and NOTHING from group N+1
+    lands before group N converges."""
+    groups = operator_bundle.operator_install_groups(spec)
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        result = kubeapply.apply_groups(client, groups, wait=True,
+                                        stage_timeout=10, poll=0.02,
+                                        max_inflight=8)
+        order = api.creation_order()
+
+        def pos(frag):
+            return next(i for i, p in enumerate(order) if frag in p)
+
+        # tier barrier inside group 0: Namespace + CRD before RBAC
+        for rbac in ("serviceaccounts/tpu-operator",
+                     "clusterroles/tpu-operator",
+                     "clusterrolebindings/tpu-operator"):
+            assert pos("/namespaces/tpu-system") < pos(rbac)
+            assert pos("customresourcedefinitions/") < pos(rbac)
+        # group barrier: every group-0 object before any group-1 object
+        group1_frags = ("tpustackpolicies/", "configmaps/", "deployments/")
+        last_g0 = max(pos(f) for f in ("/namespaces/tpu-system",
+                                       "serviceaccounts/",
+                                       "clusterroles/tpu-operator",
+                                       "clusterrolebindings/",
+                                       "customresourcedefinitions/"))
+        assert last_g0 < min(pos(f) for f in group1_frags)
+        assert len(result.actions) == sum(len(g) for g in groups)
+        assert set(result.timings) == {"apply", "crd-establish",
+                                       "ready-wait"}
+
+
+def test_pipelined_failure_in_group_blocks_next_group(spec):
+    """A 403 on one group-0 object (RBAC denial) must abort the rollout at
+    that group's barrier: no group-1 object may reach the apiserver."""
+    deny = "/apis/rbac.authorization.k8s.io/v1/clusterroles"
+    groups = operator_bundle.operator_install_groups(spec)
+    with FakeApiServer(auto_ready=True, reject_posts={deny: 403}) as api:
+        client = kubeapply.Client(api.url)
+        with pytest.raises(kubeapply.ApplyError, match="group 1"):
+            kubeapply.apply_groups(client, groups, wait=True,
+                                   stage_timeout=10, poll=0.02,
+                                   max_inflight=8)
+        for frag in ("tpustackpolicies/", "configmaps/", "deployments/"):
+            assert not api.paths(frag), f"group-1 object applied: {frag}"
+
+
+def test_pipelined_sequential_parity(spec):
+    """Both engines must converge the same bundle to the same store."""
+    stores = {}
+    for inflight in (1, 8):
+        with FakeApiServer(auto_ready=True) as api:
+            client = kubeapply.Client(api.url)
+            kubeapply.apply_groups(client, manifests.rollout_groups(spec),
+                                   wait=True, stage_timeout=10, poll=0.02,
+                                   max_inflight=inflight)
+            stores[inflight] = set(api.snapshot())
+    assert stores[1] == stores[8]
+
+
+def test_pipelined_reapply_skips_unchanged(spec):
+    """Steady state (the operator's reconcile cadence): a second identical
+    apply must LIST each collection once and PATCH nothing."""
+    groups = operator_bundle.operator_install_groups(spec)
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        kubeapply.apply_groups(client, groups, wait=True, stage_timeout=10,
+                               poll=0.02, max_inflight=8)
+        before = len(api.log)
+        result = kubeapply.apply_groups(client, groups, wait=True,
+                                        stage_timeout=10, poll=0.02,
+                                        max_inflight=8)
+        reapply = api.log[before:]
+        assert all(a.startswith("unchanged") for a in result.actions)
+        assert all(m == "GET" for m, _ in reapply), reapply
+        # one LIST per distinct collection (+ the fresh-install probe);
+        # far fewer round trips than one GET+PATCH per object
+        assert len(reapply) <= len({kubeapply.collection_path(o)
+                                    for g in groups for o in g}) + 1
+        # dead pool threads' connections were reaped, not leaked: at most
+        # the caller thread's own connection survives the two rollouts
+        assert len(client._conns) <= 1
+
+
+def test_patch_noop_tolerates_listed_items_without_kind():
+    """Real apiservers omit per-item kind/apiVersion from LIST responses;
+    that cosmetic gap alone must not defeat skip-unchanged."""
+    desired = {"apiVersion": "v1", "kind": "ConfigMap",
+               "metadata": {"name": "cm"}, "data": {"k": "v"}}
+    live_from_list = {"metadata": {"name": "cm", "uid": "u1"},
+                      "data": {"k": "v"}}
+    assert kubeapply._patch_is_noop(live_from_list, desired)
+    assert not kubeapply._patch_is_noop(
+        dict(live_from_list, data={"k": "OLD"}), desired)
+
+
+# ------------------------------------------------------------ shared watcher
+
+
+def test_shared_watcher_one_get_per_collection_per_tick():
+    """With N DaemonSets pending in one namespace, each readiness tick must
+    cost ONE collection GET, not N object GETs (run with injected latency
+    so overlapping per-object GETs couldn't hide in a fast loop)."""
+    objs = [daemonset(f"ds-{i}") for i in range(4)]
+    with FakeApiServer(auto_ready=False, latency_s=0.002) as api:
+        client = kubeapply.Client(api.url)
+        for obj in objs:
+            client.apply(obj)
+        applied = len(api.log)
+        done = []
+        t = threading.Thread(
+            target=lambda: (client.wait_ready(objs, timeout=10, poll=0.05),
+                            done.append(True)),
+            daemon=True)
+        t.start()
+        time.sleep(0.18)  # let a few ticks run while nothing is ready
+        for obj in objs:
+            api.set_ready(kubeapply.object_path(obj))
+        t.join(timeout=5)
+        assert done
+        waits = api.log[applied:]
+        # every readiness request is the collection LIST — zero per-object
+        assert waits and all(
+            (m, p) == ("GET", DS_COLL) for m, p in waits), waits
+        # shared fan-out: ticks, not ticks x objects — with 4 DaemonSets
+        # pending for ~4-6 ticks, the per-object storm would be 16-24 GETs
+        assert len(waits) <= 12, f"{len(waits)} GETs for ~4-6 ticks"
+
+
+def test_wait_ready_list_denied_falls_back_to_per_object_gets():
+    """RBAC that grants get but not list was enough for the seed's
+    per-object loop — a 403 on the collection LIST must degrade to
+    per-object GETs, not hang until stage_timeout."""
+    objs = [daemonset(f"ds-rbac-{i}") for i in range(2)]
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        for obj in objs:
+            client.apply(obj)
+        real_get = client.get
+
+        def deny_list(path):
+            if path == DS_COLL:
+                return 403, {"kind": "Status", "message": "list denied"}
+            return real_get(path)
+
+        client.get = deny_list
+        before = len(api.log)
+        client.wait_ready(objs, timeout=5, poll=0.02)  # must NOT time out
+        waits = api.log[before:]
+        assert waits, "per-object fallback made no requests"
+        assert all(p != DS_COLL for _, p in waits), waits
+
+
+def test_wait_ready_timeout_names_the_failing_list():
+    """When collection reads keep failing and the deadline passes, the
+    error must say so instead of a bare 'timed out' (the triage hint for
+    a missing list verb)."""
+    obj = daemonset("ds-denied")
+    with FakeApiServer(auto_ready=False, ghost_get_404=()) as api:
+        client = kubeapply.Client(api.url)
+        client.apply(obj)
+
+        def deny_everything(path):
+            return 403, {"kind": "Status", "message": "forbidden"}
+
+        client.get = deny_everything
+        with pytest.raises(kubeapply.ApplyError,
+                           match=r"collection reads failing.*403"):
+            client.wait_ready([obj], timeout=0.1, poll=0.02)
+
+
+def test_wait_ready_seeded_objects_cost_zero_requests():
+    """Objects already proven ready by apply responses / the pipelined
+    cache must not be re-fetched at all."""
+    obj = daemonset("ds-seeded")
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        client.apply(obj)
+        _, live = client.get(kubeapply.object_path(obj))
+        before = len(api.log)
+        client.wait_ready([obj], timeout=5, poll=0.02,
+                          seed={kubeapply.object_path(obj): live})
+        assert len(api.log) == before
+
+
+# ------------------------------------------------------------ transport
+
+
+def test_keepalive_reuses_one_connection_per_thread():
+    with FakeApiServer(auto_ready=True) as api:
+        with kubeapply.Client(api.url) as client:
+            for _ in range(5):
+                code, _ = client.get("/api/v1/namespaces/x")
+                assert code == 404
+            assert len(client._conns) == 1
+
+
+def test_keepalive_retries_stale_socket_after_server_bounce():
+    """A pooled connection whose server restarted must be retried once on a
+    fresh socket, not surfaced as a transport failure."""
+    api = FakeApiServer(auto_ready=True).start()
+    port = int(api.url.rsplit(":", 1)[1])
+    client = kubeapply.Client(api.url)
+    assert client.apply(daemonset("ds-bounce")) == "created"
+    seed = api.snapshot()
+    api.stop()
+    api2 = FakeApiServer(auto_ready=True, port=port, store=seed).start()
+    try:
+        code, live = client.get(kubeapply.object_path(daemonset("ds-bounce")))
+        assert code == 200 and live["metadata"]["name"] == "ds-bounce"
+    finally:
+        client.close()
+        api2.stop()
+
+
+def test_oneshot_transport_still_available():
+    """keep_alive=False is the seed transport — the bench's baseline arm."""
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url, keep_alive=False)
+        assert client.apply(daemonset("ds-oneshot")) == "created"
+        assert client._conns == []
+
+
+# ------------------------------------------------------------ snapshot verify
+
+
+def test_snapshot_verify_parity_with_per_check_results(spec):
+    """run_checks through one ClusterSnapshot must produce byte-identical
+    results to invoking every check directly with its own runner."""
+    from test_verify import CannedRunner
+
+    direct = [verify.CHECKS[n](CannedRunner(healthy=True), spec)
+              for n in verify.CHECKS]
+    snapped = verify.run_checks(list(verify.CHECKS), spec,
+                                CannedRunner(healthy=True))
+    assert [(r.name, r.ok, r.detail) for r in snapped] == \
+        [(r.name, r.ok, r.detail) for r in direct]
+    # and the same on a broken cluster (failure details matter in triage)
+    direct = [verify.CHECKS[n](CannedRunner(healthy=False), spec)
+              for n in verify.CHECKS]
+    snapped = verify.run_checks(list(verify.CHECKS), spec,
+                                CannedRunner(healthy=False))
+    assert [(r.name, r.ok, r.detail) for r in snapped] == \
+        [(r.name, r.ok, r.detail) for r in direct]
+
+
+def test_snapshot_dedupes_shared_fetches(spec):
+    """One `get nodes` must feed smoke + allocatable; one labeled listing
+    must feed labels + conditions — request counts, not just results."""
+    from test_verify import CannedRunner
+
+    runner = CannedRunner(healthy=True)
+    snapshot = verify.ClusterSnapshot(runner)
+    results = verify.run_checks(
+        ["smoke", "operands", "labels", "conditions", "allocatable"],
+        spec, snapshot)
+    assert all(r.ok for r in results)
+    assert snapshot.fetches == len(runner.calls)
+    nodes_gets = [c for c in runner.calls
+                  if c[:3] == ["kubectl", "get", "nodes"] and "-l" not in c]
+    labeled_gets = [c for c in runner.calls
+                    if c[:3] == ["kubectl", "get", "nodes"] and "-l" in c]
+    assert len(nodes_gets) == 1, runner.calls
+    assert len(labeled_gets) == 1, runner.calls
+
+
+def test_snapshot_single_fetch_under_concurrent_askers():
+    calls = []
+
+    def slow_runner(argv):
+        calls.append(argv)
+        time.sleep(0.05)
+        return 0, json.dumps({"items": []})
+
+    snapshot = verify.ClusterSnapshot(slow_runner)
+    threads = [threading.Thread(
+        target=lambda: snapshot(["kubectl", "get", "nodes", "-o", "json"]))
+        for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(calls) == 1 and snapshot.fetches == 1
+
+
+# ------------------------------------------------------------ bench line
+
+
+def test_bench_rollout_json_line_meets_targets():
+    """The tier-1 record of the rollout hot path: the bench must emit one
+    machine-readable line and clear its own >=3x requests / >=2x wall-clock
+    bars at 5 ms injected latency (the --check contract)."""
+    proc = subprocess.run(
+        [sys.executable, "scripts/bench_rollout.py", "--check"],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert doc["bench"] == "rollout"
+    assert doc["request_ratio"] >= 3.0
+    assert doc["speedup"] >= 2.0
+    for arm in ("sequential", "pipelined"):
+        assert set(doc[arm]["phases"]) == {"apply", "crd-establish",
+                                           "ready-wait"}
+    # the recorded line for the round artifacts / triage summary
+    print(f"BENCH_ROLLOUT {json.dumps(doc, separators=(',', ':'))}")

@@ -113,17 +113,38 @@ def _spec_groups(args):
 
 def cmd_apply(args) -> int:
     groups = _spec_groups(args)
+    if args.max_inflight is not None and not args.parallel:
+        print("apply: note: --max-inflight has no effect without "
+              "--parallel", file=sys.stderr)
+    if args.parallel and args.max_inflight is not None \
+            and args.max_inflight < 2:
+        print("apply: --max-inflight must be >= 2 with --parallel (the "
+              "pipelined engine is the concurrent path; drop --parallel "
+              "for the sequential one)", file=sys.stderr)
+        return 2
+    max_inflight = ((8 if args.max_inflight is None else args.max_inflight)
+                    if args.parallel else 1)
     try:
         client = _rest_client(args)
         if client is not None:
-            kubeapply.apply_groups(
-                client, groups, wait=args.wait,
-                stage_timeout=args.stage_timeout, poll=args.poll,
-                allow_empty_daemonsets=args.allow_empty_daemonsets,
-                log=lambda msg: print(msg))
+            try:
+                result = kubeapply.apply_groups(
+                    client, groups, wait=args.wait,
+                    stage_timeout=args.stage_timeout, poll=args.poll,
+                    allow_empty_daemonsets=args.allow_empty_daemonsets,
+                    log=lambda msg: print(msg), max_inflight=max_inflight)
+            finally:
+                client.close()
+            if args.wait:
+                print(f"rollout phases: {result.timings_line()}")
         else:
             if not _kubectl_mode_flags_ok(args, "apply"):
                 return 2
+            if args.parallel:
+                print("apply: note: --parallel has no effect on the kubectl "
+                      "backend (kubectl apply already batches per group); "
+                      "pass --apiserver to use the pipelined engine",
+                      file=sys.stderr)
             if args.poll != 1.0:
                 print("apply: note: --poll has no effect on the kubectl "
                       "backend (kubectl rollout status does its own "
@@ -146,8 +167,11 @@ def cmd_delete(args) -> int:
     try:
         client = _rest_client(args)
         if client is not None:
-            kubeapply.delete_groups(client, groups,
-                                    log=lambda msg: print(msg))
+            try:
+                kubeapply.delete_groups(client, groups,
+                                        log=lambda msg: print(msg))
+            finally:
+                client.close()
         else:
             if not _kubectl_mode_flags_ok(args, "delete"):
                 return 2
@@ -169,8 +193,11 @@ def cmd_verify(args) -> int:
         print(f"--config selected no checks; known: {list(verify.CHECKS)}",
               file=sys.stderr)
         return 2
+    # One snapshot per run: every check reads the same instant of cluster
+    # state, and identical kubectl invocations are fetched once and shared.
+    snapshot = verify.ClusterSnapshot(verify.subprocess_runner)
     try:
-        results = verify.run_checks(names, spec)
+        results = verify.run_checks(names, spec, snapshot)
     except KeyError as exc:
         print(exc, file=sys.stderr)
         return 2
@@ -181,10 +208,13 @@ def cmd_verify(args) -> int:
             "ok": ok,
             "checks": [{"name": r.name, "ok": r.ok, "detail": r.detail}
                        for r in results],
+            "kubectl_calls": snapshot.fetches,
         }))
     else:
         for res in results:
             print(res.line())
+        print(f"(snapshot: {snapshot.fetches} kubectl invocation(s) "
+              f"served {len(results)} check(s))")
     return 0 if ok else 1
 
 
@@ -235,6 +265,13 @@ def build_parser() -> argparse.ArgumentParser:
                    default=True)
     p.add_argument("--stage-timeout", type=float, default=600)
     p.add_argument("--poll", type=float, default=1.0)
+    p.add_argument("--parallel", action="store_true",
+                   help="pipelined rollout engine (REST backend only): "
+                        "shared-cache prefetch, concurrent apply within "
+                        "each dependency group, skip-unchanged re-applies")
+    p.add_argument("--max-inflight", type=int, default=None,
+                   help="worker-pool bound for --parallel "
+                        "(default 8, min 2)")
     p.add_argument("--allow-empty-daemonsets", action="store_true",
                    help="treat DaemonSets with no matching nodes as ready")
     p.set_defaults(fn=cmd_apply)

@@ -8,19 +8,43 @@ continuous reconciler is the native C++ tpu-operator
 the two are pinned to each other by tests/test_apply.py.
 
 Transports: a base URL (``http://127.0.0.1:8001`` from ``kubectl proxy``, or
-the fake apiserver) via urllib, with optional bearer token / CA file for
-direct https apiserver access.
+the fake apiserver) with optional bearer token / CA file for direct https
+apiserver access. By default the client keeps ONE persistent connection per
+thread alive across requests (``keep_alive=True``); ``keep_alive=False``
+falls back to a fresh urllib socket per request (the pre-pipelining
+behavior, kept as the baseline arm of ``scripts/bench_rollout.py``).
+
+Two rollout strategies share the same group semantics (ordered barriers,
+CRD establishment gating, readiness gating):
+
+- sequential (``max_inflight=1``, the default): one object at a time in
+  list order, GET-then-POST/PATCH per object — the original apply
+  procedure.
+- pipelined (``max_inflight>1``): one LIST per collection primes a shared
+  live-object cache (skipping the LISTs entirely on a fresh install, probed
+  via the bundle's Namespace), objects inside a group apply concurrently in
+  dependency tiers, unchanged objects are skipped, and apply responses seed
+  readiness.
+
+BOTH strategies wait for readiness through the shared loop in
+``wait_ready``: one collection GET per tick fans out to every waiting
+object in that collection (this replaced the seed's per-object GET storm
+for all callers, so the credential driving ``apply`` needs the ``list``
+verb on workload collections, which the rendered RBAC grants).
 """
 
 from __future__ import annotations
 
+import http.client
 import json
 import ssl
+import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 # kind -> (api prefix builder, plural, cluster-scoped). Mirrors
 # native/operator/kubeapi.cc Plurals() — a lookup table so unsupported kinds
@@ -111,6 +135,76 @@ def is_ready(obj: Dict[str, Any],
     return True
 
 
+def crd_established(live: Optional[Dict[str, Any]]) -> bool:
+    conditions = ((live or {}).get("status") or {}).get("conditions", [])
+    return any(c.get("type") == "Established" and c.get("status") == "True"
+               for c in conditions)
+
+
+def _seed_ready(live: Optional[Dict[str, Any]], obj: Dict[str, Any],
+                allow_empty_daemonsets: bool) -> bool:
+    """is_ready over a live object that may have come from a LIST (where
+    real apiservers omit per-item ``kind``) — grafted from the manifest."""
+    if live is None:
+        return False
+    if "kind" not in live:
+        live = dict(live, kind=obj.get("kind"))
+    return is_ready(live, allow_empty_daemonsets)
+
+
+def _index_items(listing: Optional[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
+    """{name: item} over a LIST response body (None-tolerant; real
+    apiservers omit per-item ``kind``, which _seed_ready grafts back)."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for item in (listing or {}).get("items") or []:
+        name = (item.get("metadata") or {}).get("name")
+        if name:
+            out[name] = item
+    return out
+
+
+def _merge_patch(target: Any, patch: Any) -> Any:
+    """RFC 7386 JSON merge patch (twin of the fake apiserver's, kept here
+    so the package never imports from tests/)."""
+    if not isinstance(patch, dict):
+        return patch
+    if not isinstance(target, dict):
+        target = {}
+    out = dict(target)
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        else:
+            out[k] = _merge_patch(out.get(k), v)
+    return out
+
+
+def _patch_is_noop(live: Dict[str, Any], desired: Dict[str, Any]) -> bool:
+    """True when merge-patching ``desired`` into ``live`` changes nothing —
+    the pipelined re-apply skips the round trip entirely (the diff-aware
+    half of the informer pattern: the shared cache already proves the
+    object's spec is current). Real apiservers omit per-item ``kind`` /
+    ``apiVersion`` from LIST items while the manifest always carries them —
+    grafted onto the live side first so that cosmetic gap alone can't turn
+    every steady-state re-apply into a PATCH.
+
+    Conservative by design: merge patch (RFC 7386) replaces arrays
+    wholesale, so server-side defaulting INSIDE pod-template containers
+    (imagePullPolicy, terminationMessagePath, ...) makes live != merged for
+    workloads on a real apiserver and the re-apply PATCHes them anyway —
+    correct, just not saved. The skip reliably fires for array-free objects
+    (Namespace, ServiceAccount, ConfigMap, RBAC) everywhere, and for the
+    whole bundle against stores that keep manifests verbatim (the fake
+    apiserver, hence the bench's steady-state numbers). Closing the gap for
+    real clusters needs a last-applied-manifest annotation (kubectl's
+    approach) — not worth the per-object payload until profiles say so."""
+    grafts = {k: desired[k] for k in ("kind", "apiVersion")
+              if k in desired and k not in live}
+    if grafts:
+        live = dict(live, **grafts)
+    return _merge_patch(live, desired) == live
+
+
 @dataclass
 class Client:
     base_url: str
@@ -122,35 +216,148 @@ class Client:
     # credentials to any MITM, so disabling verification must be an explicit
     # opt-in (mirrors the C++ kubeclient and kubectl's flag of the same name).
     insecure_skip_tls_verify: bool = False
+    # Persistent per-thread connection reuse. Off = a fresh urllib socket
+    # per request (the original transport, the bench's sequential arm).
+    keep_alive: bool = True
     _warned_insecure: bool = field(default=False, repr=False, compare=False)
+    _local: Any = field(default=None, repr=False, compare=False)
+    _conns: Any = field(default=None, repr=False, compare=False)
 
-    def _request(self, method: str, path: str,
-                 body: Optional[Dict[str, Any]] = None,
-                 content_type: str = "application/json"):
-        req = urllib.request.Request(self.base_url + path, method=method)
-        req.add_header("Accept", "application/json")
+    def __post_init__(self):
+        self._local = threading.local()
+        self._conns = []  # every connection ever opened, for close()
+        self._conns_lock = threading.Lock()
+
+    # ------------------------------------------------------------ transport
+
+    def _tls_context(self) -> Optional[ssl.SSLContext]:
+        if not self.base_url.startswith("https"):
+            return None
+        if not self.ca_file and not self.insecure_skip_tls_verify:
+            raise ApplyError(
+                f"refusing unverified https to {self.base_url}: no CA "
+                f"file; pass --ca-file or --insecure-skip-tls-verify")
+        ctx = ssl.create_default_context(cafile=self.ca_file)
+        if not self.ca_file:
+            if not self._warned_insecure:
+                self._warned_insecure = True
+                import sys
+                print(f"kubeapply: WARNING: TLS verification DISABLED "
+                      f"for {self.base_url} (insecure-skip-tls-verify)",
+                      file=sys.stderr)
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        return ctx
+
+    def _connection(self) -> http.client.HTTPConnection:
+        """The calling thread's persistent connection (created on demand).
+        One per thread, never shared: http.client connections aren't
+        thread-safe, and the pipelined worker pool drives one thread each."""
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            return conn
+        url = urllib.parse.urlsplit(self.base_url)
+        if url.scheme == "https":
+            conn = http.client.HTTPSConnection(
+                url.hostname, url.port or 443, timeout=self.timeout,
+                context=self._tls_context())
+        else:
+            conn = http.client.HTTPConnection(
+                url.hostname, url.port or 80, timeout=self.timeout)
+        self._local.conn = conn
+        with self._conns_lock:
+            self._conns.append(conn)
+        return conn
+
+    def _drop_connection(self):
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            self._local.conn = None
+            with self._conns_lock:
+                if conn in self._conns:
+                    self._conns.remove(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self):
+        """Close every pooled connection (idempotent)."""
+        with self._conns_lock:
+            conns, self._conns = self._conns, []
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def reap_other_connections(self):
+        """Close every pooled connection EXCEPT the calling thread's.
+        Worker threads die with their executor but their thread-local
+        connections would stay open (and strongly referenced here)
+        forever; the pipelined engine reaps them as each pool winds down
+        so a long-lived Client doesn't leak a socket per worker per
+        rollout."""
+        mine = getattr(self._local, "conn", None)
+        with self._conns_lock:
+            stale = [c for c in self._conns if c is not mine]
+            self._conns = [c for c in self._conns if c is mine]
+        for conn in stale:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def __enter__(self) -> "Client":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def _headers(self, has_body: bool, content_type: str) -> Dict[str, str]:
+        headers = {"Accept": "application/json"}
         if self.token:
-            req.add_header("Authorization", f"Bearer {self.token}")
-        data = None
-        if body is not None:
-            data = json.dumps(body).encode()
-            req.add_header("Content-Type", content_type)
-        ctx = None
-        if self.base_url.startswith("https"):
-            if not self.ca_file and not self.insecure_skip_tls_verify:
-                raise ApplyError(
-                    f"refusing unverified https to {self.base_url}: no CA "
-                    f"file; pass --ca-file or --insecure-skip-tls-verify")
-            ctx = ssl.create_default_context(cafile=self.ca_file)
-            if not self.ca_file:
-                if not self._warned_insecure:
-                    self._warned_insecure = True
-                    import sys
-                    print(f"kubeapply: WARNING: TLS verification DISABLED "
-                          f"for {self.base_url} (insecure-skip-tls-verify)",
-                          file=sys.stderr)
-                ctx.check_hostname = False
-                ctx.verify_mode = ssl.CERT_NONE
+            headers["Authorization"] = f"Bearer {self.token}"
+        if has_body:
+            headers["Content-Type"] = content_type
+        return headers
+
+    def _request_keepalive(self, method: str, path: str,
+                           data: Optional[bytes], content_type: str):
+        """One request over the thread's persistent connection. A stale
+        keep-alive socket (server restarted, idle timeout) surfaces as
+        RemoteDisconnected / reset on the FIRST attempt only — retried once
+        on a fresh connection before reporting a transport failure."""
+        base_path = urllib.parse.urlsplit(self.base_url).path.rstrip("/")
+        for attempt in (0, 1):
+            conn = self._connection()
+            try:
+                conn.request(method, base_path + path, body=data,
+                             headers=self._headers(data is not None,
+                                                   content_type))
+                resp = conn.getresponse()
+                payload = resp.read()  # drains so the connection can reuse
+                try:
+                    parsed = json.loads(payload or b"{}")
+                except ValueError:
+                    parsed = {"message":
+                              payload.decode(errors="replace")[:200]}
+                return resp.status, parsed
+            except (http.client.HTTPException, OSError) as exc:
+                self._drop_connection()
+                if attempt == 0 and isinstance(
+                        exc, (http.client.RemoteDisconnected,
+                              http.client.BadStatusLine,
+                              BrokenPipeError, ConnectionResetError)):
+                    continue  # stale pooled socket: one fresh retry
+                return 0, {"message": f"transport error: {exc}"}
+
+    def _request_oneshot(self, method: str, path: str,
+                         data: Optional[bytes], content_type: str):
+        req = urllib.request.Request(self.base_url + path, method=method)
+        for k, v in self._headers(data is not None, content_type).items():
+            req.add_header(k, v)
+        ctx = self._tls_context()
         try:
             with urllib.request.urlopen(req, data=data, timeout=self.timeout,
                                         context=ctx) as resp:
@@ -168,16 +375,37 @@ class Client:
             # apply() turns it into a clean ApplyError.
             return 0, {"message": f"transport error: {exc}"}
 
+    def _request(self, method: str, path: str,
+                 body: Optional[Dict[str, Any]] = None,
+                 content_type: str = "application/json"):
+        data = json.dumps(body).encode() if body is not None else None
+        if self.keep_alive:
+            return self._request_keepalive(method, path, data, content_type)
+        return self._request_oneshot(method, path, data, content_type)
+
     def get(self, path: str):
         return self._request("GET", path)
+
+    def list_collection(self, path: str) -> Dict[str, Dict[str, Any]]:
+        """LIST one collection -> {name: live object}. 404 is an EMPTY
+        collection, not an error: a CRD-backed collection doesn't exist
+        before its CRD is Established, and the pipelined prefetch must
+        treat that exactly like 'no CRs yet'."""
+        code, resp = self.get(path)
+        if code == 404:
+            return {}
+        if code != 200:
+            raise ApplyError(
+                f"LIST {path}: {code} {(resp or {}).get('message', resp)}")
+        return _index_items(resp)
 
     def apply(self, obj: Dict[str, Any]) -> str:
         """Create-or-patch one object; returns 'created' | 'patched'."""
         path = object_path(obj)
         code, resp = self.get(path)
         if code == 0:
-            raise ApplyError(f"GET {path}: {resp.get('message', 'transport '
-                                                      'failure')}")
+            msg = resp.get("message", "transport failure")
+            raise ApplyError(f"GET {path}: {msg}")
         if code == 404:
             code, resp = self._request("POST", collection_path(obj), obj)
             if code == 409:
@@ -215,11 +443,7 @@ class Client:
         deadline = time.monotonic() + timeout
         while True:
             code, live = self.get(path)
-            conditions = ((live or {}).get("status") or {}).get(
-                "conditions", [])
-            if code == 200 and any(c.get("type") == "Established"
-                                   and c.get("status") == "True"
-                                   for c in conditions):
+            if code == 200 and crd_established(live):
                 return
             if time.monotonic() >= deadline:
                 raise ApplyError(
@@ -228,27 +452,77 @@ class Client:
 
     def wait_ready(self, objs: Sequence[Dict[str, Any]], timeout: float,
                    poll: float = 1.0,
-                   allow_empty_daemonsets: bool = False) -> None:
+                   allow_empty_daemonsets: bool = False,
+                   seed: Optional[Dict[str, Dict[str, Any]]] = None) -> None:
+        """Shared readiness loop: ONE collection GET per tick feeds every
+        waiting object in that collection (replacing the per-object GET
+        storm — with N DaemonSets pending in a namespace, each tick costs 1
+        round trip instead of N). ``seed`` maps ``object_path(obj)`` to the
+        freshest known live object (apply responses / the pipelined cache):
+        objects already proven ready cost zero additional requests."""
         deadline = time.monotonic() + timeout
         pending = [o for o in objs if o.get("kind") in WORKLOAD_KINDS]
+        if seed:
+            pending = [o for o in pending
+                       if not _seed_ready(seed.get(object_path(o)), o,
+                                          allow_empty_daemonsets)]
+        last_list_err: Optional[str] = None
         while pending:
-            still = []
+            # Per-tick: the timeout hint must reflect the FINAL tick's LIST
+            # state, not a transient failure that later recovered.
+            last_list_err = None
+            by_collection: Dict[str, List[Dict[str, Any]]] = {}
             for obj in pending:
-                code, live = self.get(object_path(obj))
-                if code != 200 or not is_ready(live, allow_empty_daemonsets):
-                    still.append(obj)
+                by_collection.setdefault(collection_path(obj),
+                                         []).append(obj)
+            still = []
+            for coll, members in by_collection.items():
+                code, listing = self.get(coll)
+                if code in (200, 404):  # 404 = collection empty (see LIST)
+                    items = _index_items(listing) if code == 200 else {}
+                else:
+                    # LIST denied or failing — e.g. RBAC that grants get
+                    # but not list, which WAS enough for the per-object
+                    # readiness loop this replaced. Fall back to one GET
+                    # per member this tick so such credentials still
+                    # converge, and remember the error for the timeout
+                    # message.
+                    last_list_err = (
+                        f"LIST {coll}: {code} "
+                        f"{(listing or {}).get('message', listing)}")
+                    items = {}
+                    for obj in members:
+                        one_code, live = self.get(object_path(obj))
+                        if one_code == 200:
+                            items[obj["metadata"]["name"]] = live
+                for obj in members:
+                    live = items.get(obj["metadata"]["name"])
+                    if not _seed_ready(live, obj, allow_empty_daemonsets):
+                        still.append(obj)
             pending = still
             if not pending:
                 return
             if time.monotonic() >= deadline:
                 names = [o["metadata"]["name"] for o in pending]
-                raise ApplyError(f"timed out waiting for readiness: {names}")
+                hint = (f" (collection reads failing — "
+                        f"{last_list_err})" if last_list_err else "")
+                raise ApplyError(
+                    f"timed out waiting for readiness: {names}{hint}")
             time.sleep(poll)
 
 
 @dataclass
 class GroupResult:
     actions: List[str] = field(default_factory=list)
+    # Cumulative per-phase wall clock across all groups — the rollout hot
+    # path's triage surface (tpuctl apply prints it; bench_rollout.py
+    # reports it per arm).
+    timings: Dict[str, float] = field(
+        default_factory=lambda: {"apply": 0.0, "crd-establish": 0.0,
+                                 "ready-wait": 0.0})
+
+    def timings_line(self) -> str:
+        return ", ".join(f"{k} {v:.2f}s" for k, v in self.timings.items())
 
 
 def kubectl_runner(argv: Sequence[str], input_text: Optional[str] = None,
@@ -469,24 +743,187 @@ def delete_groups_kubectl(groups: Sequence[Sequence[Dict[str, Any]]],
 def apply_groups(client: Client, groups: Sequence[Sequence[Dict[str, Any]]],
                  wait: bool = True, stage_timeout: float = 600,
                  poll: float = 1.0, allow_empty_daemonsets: bool = False,
-                 log=lambda msg: None) -> GroupResult:
+                 log=lambda msg: None, max_inflight: int = 1) -> GroupResult:
     """Ordered, readiness-gated rollout of manifest groups — the reference's
-    operator behavior (SURVEY.md §3.3) as a one-shot procedure."""
+    operator behavior (SURVEY.md §3.3) as a one-shot procedure.
+
+    ``max_inflight > 1`` selects the pipelined engine: shared-cache
+    prefetch, tiered concurrent apply inside each group, skip-unchanged
+    re-applies, and apply-response-seeded readiness. Groups stay ordered
+    barriers in both modes, and a failing object in group N always blocks
+    group N+1."""
     result = GroupResult()
+    if max_inflight > 1:
+        try:
+            return _apply_groups_pipelined(
+                client, groups, wait, stage_timeout, poll,
+                allow_empty_daemonsets, log, max_inflight, result)
+        finally:
+            # the pool's worker threads are gone; their thread-local
+            # connections must not outlive them in the Client's pool
+            client.reap_other_connections()
     for i, group in enumerate(groups):
+        t0 = time.monotonic()
         for obj in group:
             action = client.apply(obj)
             name = f"{obj['kind']}/{obj['metadata']['name']}"
             result.actions.append(f"{action} {name}")
             log(f"{action} {name}")
+        result.timings["apply"] += time.monotonic() - t0
         # CRD establishment is a correctness gate for the NEXT group's CRs,
         # not a readiness nicety — enforce it even with wait=False.
+        t0 = time.monotonic()
         for obj in group:
             if obj.get("kind") == "CustomResourceDefinition":
                 client.wait_crd_established(obj["metadata"]["name"],
                                             stage_timeout, poll)
+        result.timings["crd-establish"] += time.monotonic() - t0
         if wait:
+            t0 = time.monotonic()
             client.wait_ready(group, stage_timeout, poll,
                               allow_empty_daemonsets)
+            result.timings["ready-wait"] += time.monotonic() - t0
             log(f"group {i + 1}/{len(groups)} ready")
+    return result
+
+
+# Objects other tiers depend on apply first even INSIDE a group: a real
+# apiserver rejects namespaced objects before their Namespace exists and
+# CRs before their CRD — tier barriers keep the pipelined engine safe for
+# groups that carry both (the sequential path gets this from list order).
+_TIER_FIRST = ("Namespace", "CustomResourceDefinition")
+
+
+def _group_tiers(group: Sequence[Dict[str, Any]]):
+    """Split one group into dependency tiers whose members may apply
+    concurrently: (Namespace/CRD) -> (RBAC/config) -> (workloads)."""
+    first = [o for o in group if o.get("kind") in _TIER_FIRST]
+    workloads = [o for o in group if o.get("kind") in WORKLOAD_KINDS]
+    middle = [o for o in group if o not in first and o not in workloads]
+    return [t for t in (first, middle, workloads) if t]
+
+
+def _apply_one_cached(client: Client, obj: Dict[str, Any],
+                      cache: Dict[str, Dict[str, Dict[str, Any]]],
+                      cache_lock: threading.Lock) -> str:
+    """Create-or-patch one object against the shared live-object cache:
+    absent -> POST (409 -> PATCH, the stale-cache window), present and
+    identical -> skip, present and different -> PATCH. The response object
+    refreshes the cache so readiness seeding sees the newest state."""
+    coll = collection_path(obj)
+    path = object_path(obj)
+    name = obj["metadata"]["name"]
+    with cache_lock:
+        live = cache.get(coll, {}).get(name)
+    if live is not None and _patch_is_noop(live, obj):
+        return "unchanged"
+    if live is None:
+        code, resp = client._request("POST", coll, obj)
+        if code in (200, 201, 202):
+            with cache_lock:
+                cache.setdefault(coll, {})[name] = resp
+            return "created"
+        if code != 409:
+            raise ApplyError(f"POST {path}: {code} {resp}")
+        # AlreadyExists despite the cache: created outside this rollout
+        # (or the fresh-install probe skipped the LIST) — patch it.
+    code, resp = client._request("PATCH", path, obj,
+                                 "application/merge-patch+json")
+    if code != 200:
+        raise ApplyError(f"PATCH {path}: {code} {resp}")
+    with cache_lock:
+        cache.setdefault(coll, {})[name] = resp
+    return "patched"
+
+
+def _apply_groups_pipelined(client: Client,
+                            groups: Sequence[Sequence[Dict[str, Any]]],
+                            wait: bool, stage_timeout: float, poll: float,
+                            allow_empty_daemonsets: bool, log,
+                            max_inflight: int,
+                            result: GroupResult) -> GroupResult:
+    """The concurrent engine behind apply_groups(max_inflight>1).
+
+    One LIST per distinct collection primes a shared live-object cache
+    (client-go informer shape) — except on a fresh install, detected by
+    probing the bundle's first Namespace: when that's absent nothing of
+    ours exists, so the prefetch round trips are skipped and stragglers
+    are caught by the POST->409->PATCH fallback."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    cache: Dict[str, Dict[str, Dict[str, Any]]] = {}
+    cache_lock = threading.Lock()
+    all_objs = [o for group in groups for o in group]
+    collections: List[str] = []
+    for obj in all_objs:
+        coll = collection_path(obj)
+        if coll not in collections:
+            collections.append(coll)
+
+    with ThreadPoolExecutor(max_workers=max_inflight) as pool:
+        ns_names = [o["metadata"]["name"] for o in all_objs
+                    if o.get("kind") == "Namespace"]
+        fresh = False
+        if ns_names:
+            code, live = client.get(f"/api/v1/namespaces/{ns_names[0]}")
+            if code == 404:
+                fresh = True
+            elif code == 200:
+                cache["/api/v1/namespaces"] = {ns_names[0]: live}
+        if fresh:
+            for coll in collections:
+                cache.setdefault(coll, {})
+        else:
+            futures = {coll: pool.submit(client.list_collection, coll)
+                       for coll in collections}
+            for coll, fut in futures.items():
+                cache[coll] = {**fut.result(), **cache.get(coll, {})}
+
+        for i, group in enumerate(groups):
+            t0 = time.monotonic()
+            for tier in _group_tiers(group):
+                futures2 = [(obj, pool.submit(_apply_one_cached, client,
+                                              obj, cache, cache_lock))
+                            for obj in tier]
+                errors = []
+                for obj, fut in futures2:
+                    name = f"{obj['kind']}/{obj['metadata']['name']}"
+                    try:
+                        action = fut.result()
+                    except ApplyError as exc:
+                        errors.append(str(exc))
+                        continue
+                    result.actions.append(f"{action} {name}")
+                    log(f"{action} {name}")
+                if errors:
+                    # group barrier: nothing from group N+1 (or a later
+                    # tier) may start after a failure in group N
+                    raise ApplyError(
+                        f"group {i + 1}: {len(errors)} object(s) failed: "
+                        + "; ".join(errors))
+            result.timings["apply"] += time.monotonic() - t0
+
+            t0 = time.monotonic()
+            for obj in group:
+                if obj.get("kind") != "CustomResourceDefinition":
+                    continue
+                name = obj["metadata"]["name"]
+                with cache_lock:
+                    live = cache.get(collection_path(obj), {}).get(name)
+                if not crd_established(live):
+                    client.wait_crd_established(name, stage_timeout, poll)
+            result.timings["crd-establish"] += time.monotonic() - t0
+
+            if wait:
+                t0 = time.monotonic()
+                with cache_lock:
+                    seed = {object_path(o):
+                            cache.get(collection_path(o),
+                                      {}).get(o["metadata"]["name"])
+                            for o in group
+                            if o.get("kind") in WORKLOAD_KINDS}
+                client.wait_ready(group, stage_timeout, poll,
+                                  allow_empty_daemonsets, seed=seed)
+                result.timings["ready-wait"] += time.monotonic() - t0
+                log(f"group {i + 1}/{len(groups)} ready")
     return result

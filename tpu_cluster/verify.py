@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import json
 import subprocess
+import threading
 import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
@@ -21,6 +22,53 @@ from typing import Callable, Dict, List, Optional, Tuple
 from .spec import ClusterSpec
 
 Runner = Callable[[List[str]], Tuple[int, str]]
+
+
+class ClusterSnapshot:
+    """Point-in-time read cache over a Runner — the informer analog for the
+    runbook. Every check used to pay its own kubectl subprocess for data a
+    sibling already fetched (smoke and allocatable each list nodes; labels
+    and conditions each list the labeled subset). Wrapping the runner in a
+    snapshot makes each distinct invocation hit the cluster ONCE per
+    ``run_checks`` call and fan the result out to every check that asks.
+
+    A snapshot IS a Runner (same callable seam), so the checks and the
+    canned test runners compose with it unchanged. It is safe under the
+    concurrent check dispatch in :func:`run_checks`: the first asker of a
+    key becomes its fetcher and later askers park on an Event instead of
+    double-spawning kubectl. Snapshots are single-shot by design — a fresh
+    one per runbook run, never reused across runs (staleness is the point:
+    all checks judge the same instant)."""
+
+    def __init__(self, runner: Runner):
+        self._runner = runner
+        self._lock = threading.Lock()
+        self._done: Dict[tuple, Tuple[int, str]] = {}
+        self._inflight: Dict[tuple, threading.Event] = {}
+        self.fetches = 0  # underlying runner invocations actually made
+
+    def __call__(self, argv: List[str]) -> Tuple[int, str]:
+        key = tuple(argv)
+        while True:
+            with self._lock:
+                if key in self._done:
+                    return self._done[key]
+                event = self._inflight.get(key)
+                if event is None:
+                    self._inflight[key] = threading.Event()
+                    self.fetches += 1
+                    break
+            event.wait()
+        try:
+            result = self._runner(list(argv))
+        except BaseException:
+            with self._lock:
+                self._inflight.pop(key).set()  # waiters retry as fetchers
+            raise
+        with self._lock:
+            self._done[key] = result
+            self._inflight.pop(key).set()
+        return result
 
 OPERAND_PODS = ("tpu-libtpu-prep", "tpu-device-plugin",
                 "tpu-feature-discovery", "tpu-metrics-exporter",
@@ -455,7 +503,18 @@ CHECKS: Dict[str, Callable[[Runner, ClusterSpec], CheckResult]] = {
 
 def run_checks(names: List[str], spec: ClusterSpec,
                runner: Runner = subprocess_runner) -> List[CheckResult]:
+    """Run the named checks against one :class:`ClusterSnapshot` of the
+    runner (pass a snapshot yourself to read its ``fetches`` afterwards).
+    Checks are independent reads, so they dispatch concurrently through
+    the seam — results come back in request order regardless."""
     unknown = [n for n in names if n not in CHECKS]
     if unknown:
         raise KeyError(f"unknown checks {unknown}; known: {list(CHECKS)}")
-    return [CHECKS[n](runner, spec) for n in names]
+    if not isinstance(runner, ClusterSnapshot):
+        runner = ClusterSnapshot(runner)
+    if len(names) == 1:
+        return [CHECKS[names[0]](runner, spec)]
+    from concurrent.futures import ThreadPoolExecutor
+    with ThreadPoolExecutor(max_workers=min(8, len(names))) as pool:
+        futures = [pool.submit(CHECKS[n], runner, spec) for n in names]
+        return [f.result() for f in futures]

@@ -39,7 +39,11 @@ def force_virtual_cpu_mesh(n_devices: int) -> list:
     created; if a backend already exists (e.g. a TPU computation ran first in
     this process) the cached backends are discarded so the client is rebuilt
     at the new size. The count only ever grows — a smaller request reuses the
-    larger existing mesh.
+    larger existing mesh. Growing PAST an existing client's size needs the
+    ``jax_num_cpu_devices`` config (newer JAX): older versions read the count
+    from XLA_FLAGS exactly once per process, so there a live client can never
+    be rebuilt larger and the RuntimeError below fires — fresh processes
+    (every driver entry point) always pick up the new count.
     """
     import jax
 
@@ -53,8 +57,12 @@ def force_virtual_cpu_mesh(n_devices: int) -> list:
     # the request, any count already in XLA_FLAGS, and the current config.
     flags = os.environ.get("XLA_FLAGS", "")
     m = re.search(_COUNT_FLAG + r"=(\d+)", flags)
+    # jax_num_cpu_devices only exists on newer JAX; older versions read the
+    # count exclusively from XLA_FLAGS at CPU-client creation, so on those
+    # the flag (already forced below) is the whole mechanism.
+    have_count_config = hasattr(jax.config, "jax_num_cpu_devices")
     target = max(n_devices, int(m.group(1)) if m else 0,
-                 jax.config.jax_num_cpu_devices)
+                 jax.config.jax_num_cpu_devices if have_count_config else 0)
     want = f"{_COUNT_FLAG}={target}"
     if m:
         flags = re.sub(_COUNT_FLAG + r"=\d+", want, flags)
@@ -73,7 +81,7 @@ def force_virtual_cpu_mesh(n_devices: int) -> list:
     if xla_bridge.backends_are_initialized():
         # jax_num_cpu_devices rejects updates after init; clear first.
         clear_backend_caches()
-    if jax.config.jax_num_cpu_devices < target:
+    if have_count_config and jax.config.jax_num_cpu_devices < target:
         jax.config.update("jax_num_cpu_devices", target)
     jax.config.update("jax_platforms", "cpu")
     devices = jax.devices("cpu")
@@ -104,7 +112,7 @@ def virtual_cpu_mesh(n_devices: int):
 
     saved_env = {k: os.environ.get(k) for k in _ENV_KEYS}
     saved_platforms = jax.config.jax_platforms
-    saved_num_cpu = jax.config.jax_num_cpu_devices
+    saved_num_cpu = getattr(jax.config, "jax_num_cpu_devices", None)
     try:
         yield force_virtual_cpu_mesh(n_devices)
     finally:
@@ -115,7 +123,8 @@ def virtual_cpu_mesh(n_devices: int):
                 os.environ[k] = v
         clear_backend_caches()
         jax.config.update("jax_platforms", saved_platforms)
-        jax.config.update("jax_num_cpu_devices", saved_num_cpu)
+        if saved_num_cpu is not None:
+            jax.config.update("jax_num_cpu_devices", saved_num_cpu)
 
 
 def clear_backend_caches() -> None:
