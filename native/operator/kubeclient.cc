@@ -419,6 +419,16 @@ int ElapsedMs(const struct timespec& t0) {
                           (now.tv_nsec - t0.tv_nsec) / 1000000);
 }
 
+int WatchBackoffMs(int attempt, int base_ms, int cap_ms) {
+  if (base_ms < 1) base_ms = 1;
+  if (cap_ms < 1) cap_ms = 1;
+  if (base_ms > cap_ms) return cap_ms;
+  if (attempt < 1) attempt = 1;
+  long ms = base_ms;
+  for (int i = 1; i < attempt && ms < cap_ms; ++i) ms *= 2;
+  return static_cast<int>(ms < cap_ms ? ms : cap_ms);
+}
+
 WatchStream::~WatchStream() { Close(); }
 
 void WatchStream::Close() {

@@ -23,6 +23,15 @@ namespace kubeclient {
 // its status pump all budget waits with it.
 int ElapsedMs(const struct timespec& t0);
 
+// Capped exponential backoff for watch reconnects: base_ms doubling per
+// consecutive failure (attempt 1 = base_ms, attempt 2 = 2*base_ms, ...),
+// clamped to cap_ms. A persistently kClosed/kError stream — an apiserver
+// rejecting the watch verb, a proxy resetting long-lived GETs — must not
+// tight-loop stream opens (on the https transport each open is a curl
+// spawn). Overflow-safe for any attempt count; attempt < 1 is treated
+// as 1, and degenerate base/cap inputs clamp instead of misbehaving.
+int WatchBackoffMs(int attempt, int base_ms, int cap_ms);
+
 struct Response {
   int status = 0;          // HTTP status; 0 = transport failure
   std::string body;

@@ -34,6 +34,7 @@
 
 #include <algorithm>
 #include <map>
+#include <memory>
 #include <set>
 #include <string>
 #include <vector>
@@ -59,6 +60,9 @@ struct Options {
                              // watch-pump cadence)
   bool policy_watch = true;  // event-driven CR watch (?watch=1 stream);
                              // GET-probe polling remains the fallback
+  bool operand_watch = true; // event-driven drift repair: watch the owned
+                             // workload collections across the sleep; the
+                             // interval pass stays the resync backstop
   int interval_s = 15;
   int stage_timeout_s = 600;
   int poll_ms = 1000;
@@ -95,6 +99,10 @@ struct BundleObject {
   bool disabled = false;  // policy-gated off this pass
   std::string error;
   std::string uid;  // live object's metadata.uid (event correlation)
+  // live object's metadata.generation as last applied/observed: the
+  // drift watch's filter — a MODIFIED event with a different generation
+  // is an external spec edit, an unchanged one is status churn
+  double generation = 0;
 };
 
 bool LoadBundle(const std::string& dir, std::vector<BundleObject>* out,
@@ -714,28 +722,87 @@ class Operator {
     return out;
   }
 
-  // Event-driven sleep: hold ONE streaming `?watch=1` connection on the
-  // policy CR for the whole interval (the controller-runtime model — zero
-  // GET probes), pumping it every policy_poll_ms so the bundle dir's
-  // LOCAL fingerprint is still checked between waits. Returns true when
-  // the sleep was fully handled (event cut it short, or it ran out);
-  // false = the watch could not be established or died — the caller falls
-  // back to GET-probe polling for the remaining *left_ms.
-  bool SleepOnWatch(int* left_ms, const std::string& bundle_fp) {
-    int secs = (*left_ms + 999) / 1000 + 1;
+  // Workload collections this bundle owns — the drift-watch targets. The
+  // operator only watches what it applied: DaemonSets/Deployments carry
+  // generation-tracked specs whose external edits (and deletions) are the
+  // drift this repairs; config/RBAC drift waits for the interval resync.
+  std::vector<std::string> OwnedWorkloadCollections() const {
+    std::vector<std::string> colls;
+    for (const auto& bo : bundle_) {
+      std::string kind = bo.obj->PathString("kind");
+      if (kind != "DaemonSet" && kind != "Deployment") continue;
+      if (bo.disabled) continue;
+      std::string err;
+      std::string coll = kubeapi::CollectionPath(*bo.obj, &err);
+      if (coll.empty()) continue;
+      if (std::find(colls.begin(), colls.end(), coll) == colls.end())
+        colls.push_back(coll);
+    }
+    return colls;
+  }
+
+  // One owned-operand drift watch: a workload collection held open across
+  // the sleep, reopened with capped exponential backoff when it closes
+  // quickly (WatchBackoffMs — a persistently rejecting server must not
+  // tight-loop stream opens, which are curl spawns on https).
+  struct OperandWatchState {
+    std::string coll;
     kubeclient::WatchStream ws;
+    int strikes = 0;             // consecutive quick closes / failed opens
+    struct timespec opened_at;   // quick-close detection
+    struct timespec blocked_at;  // when the current backoff started
+    int backoff_ms = 0;          // 0 = may (re)open immediately
+  };
+
+  // Event-driven sleep: hold streaming `?watch=1` connections for the
+  // whole interval (the controller-runtime model — zero GET probes) on
+  //  - the policy CR (when ``policy_stream``), and
+  //  - every owned workload collection (drift repair: an external spec
+  //    edit or delete of an operand reconciles NOW, not at the next
+  //    interval pass, which remains the resync backstop),
+  // pumping the status listener between waits and checking the bundle
+  // dir's LOCAL fingerprint at the probe cadence. Returns true when the
+  // sleep was fully handled (an event cut it short, or it ran out);
+  // false = the POLICY stream could not be established or died — the
+  // caller falls back to GET-probe polling for the remaining *left_ms.
+  // Operand streams never fail the sleep over: each just backs off and
+  // retries, because the interval pass already backstops drift.
+  bool SleepOnWatches(int* left_ms, const std::string& bundle_fp,
+                      bool policy_stream) {
+    int secs = (*left_ms + 999) / 1000 + 1;
+    kubeclient::WatchStream pws;
     std::string err;
-    std::string path = PolicyPath() + "?watch=1&timeoutSeconds=" +
-                       std::to_string(secs);
-    if (!ws.Open(cfg_, path, secs + 30, &err)) {
-      fprintf(stderr,
-              "tpu-operator: watch unavailable (%s); falling back to "
-              "generation polling\n", err.c_str());
-      return false;
+    if (policy_stream) {
+      std::string path = PolicyPath() + "?watch=1&timeoutSeconds=" +
+                         std::to_string(secs);
+      if (!pws.Open(cfg_, path, secs + 30, &err)) {
+        fprintf(stderr,
+                "tpu-operator: watch unavailable (%s); falling back to "
+                "generation polling\n", err.c_str());
+        return false;
+      }
+    }
+    std::vector<std::unique_ptr<OperandWatchState>> ows;
+    std::map<std::string, double> owned;  // coll/name -> applied generation
+    if (opt_.operand_watch) {
+      for (const auto& coll : OwnedWorkloadCollections()) {
+        auto st = std::make_unique<OperandWatchState>();
+        st->coll = coll;
+        ows.push_back(std::move(st));
+      }
+      for (const auto& bo : bundle_) {
+        std::string kind = bo.obj->PathString("kind");
+        if ((kind != "DaemonSet" && kind != "Deployment") || bo.disabled)
+          continue;
+        std::string coll = kubeapi::CollectionPath(*bo.obj, &err);
+        if (!coll.empty())
+          owned[coll + "/" + bo.obj->PathString("metadata.name")] =
+              bo.generation;
+      }
     }
     // Wall-clock accounting for EVERY branch: a writer flapping the CR's
     // status at high rate streams kEvent results continuously, and a loop
-    // that only deducts time in the kTimeout branch would spin here past
+    // that only deducts time in the idle branch would spin here past
     // the interval — for a leader, past the lease renewal deadline
     // (split-brain by starvation). left is recomputed from the clock.
     struct timespec sleep_start;
@@ -747,106 +814,222 @@ class Operator {
     int since_bundle_check = 0;
     // Consecutive-kEvent cap: a saturating stream (or a misbehaving proxy
     // echoing garbage lines) keeps Next(0) returning kEvent, so the loop
-    // would never reach the kTimeout branch where the status listener is
+    // would never reach the idle branch where the status listener is
     // pumped — and the kubelet's /healthz probe (1 s timeout) would go
     // unanswered. Every kMaxEventDrain events the listener gets a
     // zero-length Pump before draining continues.
     constexpr int kMaxEventDrain = 64;
     int events_since_pump = 0;
+    auto pump_guard = [&]() {
+      if (++events_since_pump >= kMaxEventDrain) {
+        events_since_pump = 0;
+        Sleep(0);  // answer pending /healthz before draining more
+      }
+    };
+    auto back_off = [&](OperandWatchState* ow, bool quick) {
+      ow->strikes = quick ? ow->strikes + 1 : 0;
+      clock_gettime(CLOCK_MONOTONIC, &ow->blocked_at);
+      ow->backoff_ms =
+          ow->strikes == 0
+              ? 0
+              : kubeclient::WatchBackoffMs(ow->strikes, 1000, 30000);
+      ow->ws.Close();
+    };
     while (!g_stop) {
       recompute_left();
       if (*left_ms <= 0) break;
-      // Drain the watch stream WITHOUT blocking, then hand the actual
+      bool idle = true;
+      // Drain the watch streams WITHOUT blocking, then hand the actual
       // wait to Sleep() — the status listener is single-threaded and
-      // only served inside its Pump; blocking in ws.Next for the whole
+      // only served inside its Pump; blocking in Next for the whole
       // interval would leave the kubelet's /healthz readiness probe
       // unanswered (default probe timeout: 1 s).
-      std::string line;
-      kubeclient::WatchStream::Result r = ws.Next(0, &line);
-      switch (r) {
-        case kubeclient::WatchStream::kEvent: {
-          if (++events_since_pump >= kMaxEventDrain) {
-            events_since_pump = 0;
-            Sleep(0);  // answer pending /healthz before draining more
+      if (policy_stream) {
+        std::string line;
+        kubeclient::WatchStream::Result r = pws.Next(0, &line);
+        switch (r) {
+          case kubeclient::WatchStream::kEvent: {
+            idle = false;
+            pump_guard();
+            minijson::ValuePtr ev = minijson::Parse(line);
+            if (!ev) break;
+            std::string type =
+                ev->Get("type") ? ev->Get("type")->as_string() : "";
+            if (type == "ERROR") {
+              // apiserver watch-level error (expired/internal): the stream
+              // is useless but the CR state is UNKNOWN — fall back to the
+              // probe loop rather than reconciling on it (a persistent
+              // error would otherwise bypass --interval as a reconcile hot
+              // loop, since each "successful" pass resets the backoff).
+              fprintf(stderr, "tpu-operator: watch ERROR event; falling "
+                      "back to generation polling\n");
+              return false;
+            }
+            if (type == "DELETED") {
+              if (!policy_missing_) {
+                fprintf(stderr, "tpu-operator: policy %s deleted (watch); "
+                        "reconciling now\n", opt_.policy.c_str());
+                return true;
+              }
+              break;
+            }
+            minijson::ValuePtr obj = ev->Get("object");
+            if (!obj || !obj->Get("metadata")) {
+              // Not a watch event at all: an apiserver error body (kind:
+              // Status from a 403/410 response) streamed through the https
+              // transport line-by-line. Reconciling on it would reset the
+              // backoff each pass — a hot loop bypassing --interval for as
+              // long as the error persists. The stream is junk; fall back
+              // to generation polling for the remaining interval.
+              fprintf(stderr, "tpu-operator: watch line without "
+                      "object.metadata (apiserver error body?); falling "
+                      "back to generation polling\n");
+              return false;
+            }
+            double gen = ev->PathNumber("object.metadata.generation", 0);
+            // Generation-filtered, like controller-runtime predicates: the
+            // operator's own status PATCH echoes back as MODIFIED with an
+            // unchanged generation and must not retrigger it.
+            if (policy_missing_ || gen != policy_generation_) {
+              fprintf(stderr,
+                      "tpu-operator: policy %s changed (watch event, "
+                      "generation %.0f -> %.0f); reconciling now\n",
+                      opt_.policy.c_str(), policy_generation_, gen);
+              return true;
+            }
+            break;
           }
+          case kubeclient::WatchStream::kTimeout:
+            break;  // nothing pending on the CR stream
+          case kubeclient::WatchStream::kClosed:
+          case kubeclient::WatchStream::kError:
+            // server ended the stream early or transport broke: the
+            // remaining sleep falls back to the probe loop
+            recompute_left();
+            return false;
+        }
+      }
+      for (auto& owp : ows) {
+        OperandWatchState& ow = *owp;
+        if (!ow.ws.is_open()) {
+          if (ow.backoff_ms > 0 &&
+              kubeclient::ElapsedMs(ow.blocked_at) < ow.backoff_ms)
+            continue;
+          std::string werr;
+          std::string wpath = ow.coll + "?watch=1&timeoutSeconds=" +
+                              std::to_string(secs);
+          clock_gettime(CLOCK_MONOTONIC, &ow.opened_at);
+          if (!ow.ws.Open(cfg_, wpath, secs + 30, &werr)) {
+            if (ow.strikes == 0)
+              fprintf(stderr,
+                      "tpu-operator: operand watch %s unavailable (%s); "
+                      "retrying with backoff (interval pass remains the "
+                      "drift backstop)\n", ow.coll.c_str(), werr.c_str());
+            back_off(&ow, true);
+            continue;
+          }
+        }
+        // Bounded drain per iteration: a saturating operand stream must
+        // hand control back so the wall clock and the other streams are
+        // still serviced.
+        for (int drained = 0; drained < kMaxEventDrain; ++drained) {
+          std::string line;
+          kubeclient::WatchStream::Result r = ow.ws.Next(0, &line);
+          if (r == kubeclient::WatchStream::kTimeout) break;
+          if (r == kubeclient::WatchStream::kClosed ||
+              r == kubeclient::WatchStream::kError) {
+            // Quick close = the server/proxy is rejecting the watch:
+            // exponential backoff. A stream that lived out its window
+            // reopens at full rate (strike counter resets).
+            back_off(&ow, kubeclient::ElapsedMs(ow.opened_at) < 2000);
+            break;
+          }
+          idle = false;
+          pump_guard();
           minijson::ValuePtr ev = minijson::Parse(line);
           if (!ev) continue;
           std::string type =
               ev->Get("type") ? ev->Get("type")->as_string() : "";
-          if (type == "ERROR") {
-            // apiserver watch-level error (expired/internal): the stream
-            // is useless but the CR state is UNKNOWN — fall back to the
-            // probe loop rather than reconciling on it (a persistent
-            // error would otherwise bypass --interval as a reconcile hot
-            // loop, since each "successful" pass resets the backoff).
-            fprintf(stderr, "tpu-operator: watch ERROR event; falling "
-                    "back to generation polling\n");
-            return false;
-          }
-          if (type == "DELETED") {
-            if (!policy_missing_) {
-              fprintf(stderr, "tpu-operator: policy %s deleted (watch); "
-                      "reconciling now\n", opt_.policy.c_str());
-              return true;
-            }
-            continue;
-          }
           minijson::ValuePtr obj = ev->Get("object");
-          if (!obj || !obj->Get("metadata")) {
-            // Not a watch event at all: an apiserver error body (kind:
-            // Status from a 403/410 response) streamed through the https
-            // transport line-by-line. Reconciling on it would reset the
-            // backoff each pass — a hot loop bypassing --interval for as
-            // long as the error persists. The stream is junk; fall back
-            // to generation polling for the remaining interval.
-            fprintf(stderr, "tpu-operator: watch line without "
-                    "object.metadata (apiserver error body?); falling "
-                    "back to generation polling\n");
-            return false;
+          if (type == "ERROR" || !obj || !obj->Get("metadata")) {
+            // Junk or expired stream (apiserver error body echoed as
+            // lines): drop THIS stream with backoff. Unlike the policy
+            // stream there is no polling to fall back to — the interval
+            // pass already backstops drift.
+            back_off(&ow, true);
+            break;
           }
-          double gen = ev->PathNumber("object.metadata.generation", 0);
-          // Generation-filtered, like controller-runtime predicates: the
-          // operator's own status PATCH echoes back as MODIFIED with an
-          // unchanged generation and must not retrigger it.
-          if (policy_missing_ || gen != policy_generation_) {
+          std::string name = obj->PathString("metadata.name");
+          auto it = owned.find(ow.coll + "/" + name);
+          if (it == owned.end()) continue;  // not an object we applied
+          if (type == "DELETED") {
             fprintf(stderr,
-                    "tpu-operator: policy %s changed (watch event, "
-                    "generation %.0f -> %.0f); reconciling now\n",
-                    opt_.policy.c_str(), policy_generation_, gen);
+                    "tpu-operator: operand drift (%s deleted, watch "
+                    "event); reconciling now\n", name.c_str());
             return true;
           }
-          continue;
-        }
-        case kubeclient::WatchStream::kTimeout: {
-          // Nothing pending on the stream: serve status/healthz for a
-          // short chunk (also the loop's sleep), and check the local
-          // bundle fingerprint at the probe cadence. left_ms itself is
-          // wall-clock-recomputed at the loop top.
-          int chunk = std::min(*left_ms,
-                               std::min(opt_.policy_poll_ms, 100));
-          Sleep(chunk);
-          since_bundle_check += chunk;
-          if (since_bundle_check >= opt_.policy_poll_ms) {
-            since_bundle_check = 0;
-            std::string fp = BundleFingerprint();
-            if (!fp.empty() && fp != bundle_fp) {
-              fprintf(stderr,
-                      "tpu-operator: bundle changed on disk; reconciling "
-                      "now\n");
-              return true;
-            }
+          double gen = ev->PathNumber("object.metadata.generation", 0);
+          // Generation filter: status churn (readiness counts) echoes as
+          // MODIFIED with an unchanged generation — only an external
+          // spec edit moves it.
+          if (gen != it->second) {
+            fprintf(stderr,
+                    "tpu-operator: operand drift (%s generation "
+                    "%.0f -> %.0f, watch event); reconciling now\n",
+                    name.c_str(), it->second, gen);
+            return true;
           }
-          continue;
         }
-        case kubeclient::WatchStream::kClosed:
-        case kubeclient::WatchStream::kError:
-          // server ended the stream early or transport broke: the
-          // remaining sleep falls back to the probe loop
-          recompute_left();
-          return false;
+      }
+      if (!idle) continue;  // events flowed; wall clock rechecked on top
+      // Nothing pending on any stream: serve status/healthz for a short
+      // chunk (also the loop's sleep), and check the local inputs at the
+      // probe cadence. left_ms itself is wall-clock-recomputed at the
+      // loop top.
+      int chunk = std::min(*left_ms, std::min(opt_.policy_poll_ms, 100));
+      Sleep(chunk);
+      since_bundle_check += chunk;
+      if (since_bundle_check >= opt_.policy_poll_ms) {
+        since_bundle_check = 0;
+        std::string fp = BundleFingerprint();
+        if (!fp.empty() && fp != bundle_fp) {
+          fprintf(stderr,
+                  "tpu-operator: bundle changed on disk; reconciling "
+                  "now\n");
+          return true;
+        }
+        // Without a policy stream (--no-policy-watch) the CR's
+        // generation is still probed at the same cadence, so a day-2
+        // toggle cuts an operand-watch sleep short exactly like it cuts
+        // the plain probe loop short.
+        if (!policy_stream && !opt_.policy.empty() &&
+            PolicyProbeSaysReconcile())
+          return true;
       }
     }
     return true;
+  }
+
+  // One generation probe of the policy CR; true = reconcile now (the CR
+  // changed, or was deleted — fail-open must kick in). ONE copy shared by
+  // the probe fallback loop and the operand-watch idle branch so the two
+  // cadences can never diverge. Probe errors (non-404) keep sleeping: a
+  // flapping apiserver must not cut every sleep short.
+  bool PolicyProbeSaysReconcile() {
+    kubeclient::Response get = kubeclient::Call(cfg_, "GET", PolicyPath());
+    if (!get.ok())
+      return get.status == 404 && !policy_missing_;  // CR deleted
+    minijson::ValuePtr cr = minijson::Parse(get.body);
+    if (!cr) return false;
+    double gen = cr->PathNumber("metadata.generation", 0);
+    if (policy_missing_ || gen != policy_generation_) {
+      fprintf(stderr,
+              "tpu-operator: policy %s changed (generation %.0f -> %.0f); "
+              "reconciling now\n",
+              opt_.policy.c_str(), policy_generation_, gen);
+      return true;
+    }
+    return false;
   }
 
   // Sleep up to ms, reacting to input changes so a day-2 edit reconciles
@@ -857,6 +1040,9 @@ class Operator {
   //    a metadata.generation GET probe every policy_poll_ms as fallback
   //    (errors fall back to the normal cadence — a flapping apiserver
   //    must not turn the watch into a retry storm),
+  //  - the owned workload operands, via streaming collection watches, so
+  //    external drift (kubectl delete/edit of a DaemonSet) is repaired on
+  //    the event instead of the next interval pass,
   //  - the bundle dir's fingerprint (local stats; a re-rendered ConfigMap
   //    rolls out as soon as kubelet projects it).
   void SleepWatchingInputs(int ms) {
@@ -869,10 +1055,14 @@ class Operator {
     // just finished and must cut this sleep short immediately.
     const std::string& bundle_fp = pass_bundle_fp_;
     int left = ms;
-    // The watch is gated like the remote probe below: never during a
+    // The watches are gated like the remote probe below: never during a
     // failure backoff (the apiserver is likely the thing that is down).
-    if (opt_.policy_watch && !opt_.policy.empty() && healthy_) {
-      if (SleepOnWatch(&left, bundle_fp)) return;
+    bool policy_stream = opt_.policy_watch && !opt_.policy.empty() &&
+                         healthy_;
+    bool operand_stream = opt_.operand_watch && healthy_ &&
+                          !OwnedWorkloadCollections().empty();
+    if (policy_stream || operand_stream) {
+      if (SleepOnWatches(&left, bundle_fp, policy_stream)) return;
       if (left <= 0 || g_stop) return;
     }
     while (left > 0 && !g_stop) {
@@ -891,21 +1081,7 @@ class Operator {
       // operators polling it at 2s would undo the backoff). The bundle
       // probe above is local stats and stays live regardless.
       if (opt_.policy.empty() || !healthy_) continue;
-      kubeclient::Response get = kubeclient::Call(cfg_, "GET", PolicyPath());
-      if (!get.ok()) {
-        if (get.status == 404 && !policy_missing_) break;  // CR deleted
-        continue;
-      }
-      minijson::ValuePtr cr = minijson::Parse(get.body);
-      if (!cr) continue;
-      double gen = cr->PathNumber("metadata.generation", 0);
-      if (policy_missing_ || gen != policy_generation_) {
-        fprintf(stderr,
-                "tpu-operator: policy %s changed (generation %.0f -> %.0f); "
-                "reconciling now\n",
-                opt_.policy.c_str(), policy_generation_, gen);
-        break;
-      }
+      if (PolicyProbeSaysReconcile()) break;
     }
   }
 
@@ -1189,13 +1365,16 @@ class Operator {
     if (!coll.empty()) kubeclient::Call(cfg_, "POST", coll, ev->Dump());
   }
 
-  // Remember the live object's metadata.uid from an API response body
-  // (event correlation — kubectl describe matches on it).
+  // Remember the live object's metadata.uid (event correlation — kubectl
+  // describe matches on it) and metadata.generation (the drift watch's
+  // change filter) from an API response body.
   void RememberUid(BundleObject* bo, const std::string& body) {
     minijson::ValuePtr live = minijson::Parse(body);
     if (live) {
       std::string uid = live->PathString("metadata.uid");
       if (!uid.empty()) bo->uid = uid;
+      double gen = live->PathNumber("metadata.generation", 0);
+      if (gen > 0) bo->generation = gen;
     }
   }
 
@@ -1246,6 +1425,7 @@ class Operator {
                     (patch.status ? patch.body.substr(0, 160) : patch.error);
         return false;
       }
+      RememberUid(bo, patch.body);  // the PATCH may have bumped generation
     } else {
       bo->error = "GET " + obj_path + " -> " + std::to_string(get.status) +
                   " " + (get.status ? get.body.substr(0, 160) : get.error);
@@ -1267,6 +1447,8 @@ class Operator {
     if (!get.ok()) return false;
     minijson::ValuePtr live = minijson::Parse(get.body);
     if (!live) return false;
+    double gen = live->PathNumber("metadata.generation", 0);
+    if (gen > 0) bo->generation = gen;
     bool ready = kubeapi::IsReady(*live);
     if (!ready && opt_.allow_empty_daemonsets && kind == "DaemonSet" &&
         live->PathNumber("status.desiredNumberScheduled", -1) == 0)
@@ -1348,12 +1530,17 @@ int main(int argc, char** argv) {
                                  // hatch; the watch self-falls-back anyway)
       continue;
     }
+    if (strcmp(a, "--no-operand-watch") == 0) {
+      opt.operand_watch = false;  // interval-pass drift repair only (the
+                                  // bench's poll arm; debug escape hatch)
+      continue;
+    }
     fprintf(stderr,
             "tpu-operator: unknown flag %s\n"
             "usage: tpu-operator [--apiserver=URL] [--token-file=F] "
             "[--ca-file=F]\n"
             "  [--bundle-dir=DIR] [--policy=NAME] [--policy-poll-ms=MS]\n"
-            "  [--no-policy-watch]\n"
+            "  [--no-policy-watch] [--no-operand-watch]\n"
             "  [--interval=SECS] [--stage-timeout=SECS]\n"
             "  [--poll-ms=MS] [--status-port=PORT] [--once]\n"
             "  [--leader-elect] [--lease-duration=SECS] [--lease-name=N]\n"

@@ -1,9 +1,11 @@
-// operator_selftest — unit checks for minijson + kubeapi (no server needed).
+// operator_selftest — unit checks for minijson + kubeapi + the watch
+// reconnect backoff (no server needed).
 
 #include <stdio.h>
 #include <string.h>
 
 #include "kubeapi.h"
+#include "kubeclient.h"
 #include "minijson.h"
 
 static int g_failures = 0;
@@ -176,12 +178,31 @@ static void TestReadiness() {
       " \"updatedReplicas\": 2}}")));
 }
 
+static void TestWatchBackoff() {
+  // Doubling from base, capped: the operand drift-watch reconnect
+  // schedule. A persistently kClosed stream (each https open is a curl
+  // spawn) must climb to the cap, never spin at full rate.
+  CHECK(kubeclient::WatchBackoffMs(1, 1000, 30000) == 1000);
+  CHECK(kubeclient::WatchBackoffMs(2, 1000, 30000) == 2000);
+  CHECK(kubeclient::WatchBackoffMs(3, 1000, 30000) == 4000);
+  CHECK(kubeclient::WatchBackoffMs(6, 1000, 30000) == 30000);  // capped
+  // overflow safety: a day of consecutive failures still returns the cap
+  CHECK(kubeclient::WatchBackoffMs(1000, 1000, 30000) == 30000);
+  // degenerate inputs clamp instead of misbehaving
+  CHECK(kubeclient::WatchBackoffMs(0, 1000, 30000) == 1000);
+  CHECK(kubeclient::WatchBackoffMs(-5, 1000, 30000) == 1000);
+  CHECK(kubeclient::WatchBackoffMs(3, 50000, 30000) == 30000);
+  CHECK(kubeclient::WatchBackoffMs(3, 0, 30000) == 4);
+  CHECK(kubeclient::WatchBackoffMs(3, 1000, 0) == 1);
+}
+
 int main() {
   TestJsonRoundtrip();
   TestJsonErrors();
   TestPaths();
   TestSweepCollections();
   TestReadiness();
+  TestWatchBackoff();
   if (g_failures) {
     fprintf(stderr, "operator_selftest: %d FAILURES\n", g_failures);
     return 1;

@@ -18,11 +18,25 @@ operator's reconcile cadence: identical bundle, every interval):
               concurrent apply, skip-unchanged re-applies, seeded readiness
               (``keep_alive=True, max_inflight=N``)
 
+A second axis (the round-6 streaming-watch work): READINESS LATENCY.
+``readiness`` in the JSON line reports mutation→ready — how long after the
+"cluster" flips a workload Ready the waiter notices — for the poll loop
+(tick-clocked) vs the watch mode (event-clocked, ``tpuctl apply --watch``),
+with request counts: watch readiness costs O(streams) per collection
+(1 LIST + 1 watch) however long the wait runs, while poll costs one LIST
+per tick. When the C++ operator binary is built, ``readiness`` also
+carries drift→repaired — delete an owned DaemonSet through the apiserver
+and time its re-creation — for the operand watch (event-bound) vs
+``--no-operand-watch`` (interval-bound).
+
 Usage:
   python scripts/bench_rollout.py                 # print the JSON line
   python scripts/bench_rollout.py --check         # also exit 1 unless
-                                                  # >=3x fewer requests and
-                                                  # >=2x lower wall clock
+                                                  # >=3x fewer requests,
+                                                  # >=2x lower wall clock,
+                                                  # and watch readiness
+                                                  # beats poll on latency
+                                                  # at O(1) requests
   python scripts/bench_rollout.py --latency-ms 5 --passes 3 --max-inflight 8
 """
 
@@ -32,6 +46,7 @@ import argparse
 import json
 import os
 import sys
+import threading
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -45,6 +60,8 @@ from tpu_cluster.render import manifests, operator_bundle  # noqa: E402
 
 REQUEST_RATIO_TARGET = 3.0
 SPEEDUP_TARGET = 2.0
+READY_POLL_S = 0.2  # the poll arm's tick (production default is 1.0s —
+                    # scaled down so the bench line lands in seconds)
 
 
 def full_stack_groups(spec):
@@ -81,6 +98,127 @@ def run_arm(name: str, latency_s: float, passes: int,
     }
 
 
+def readiness_arm(latency_s: float, watch: bool, objects: int = 4) -> dict:
+    """Mutation→ready: ``objects`` unready DaemonSets in ONE collection, a
+    waiter in its steady state, then the 'cluster' flips them all Ready —
+    measured from the flip to wait_ready's return. The request count is
+    the contract half: watch = 1 LIST + 1 stream regardless of how long
+    the wait ran; poll = one LIST per tick."""
+    objs = [{"apiVersion": "apps/v1", "kind": "DaemonSet",
+             "metadata": {"name": f"bench-ds-{i}", "namespace": "tpu-system"},
+             "spec": {"template": {"spec": {"image": f"img:{i}"}}}}
+            for i in range(objects)]
+    with FakeApiServer(auto_ready=False, latency_s=latency_s) as api:
+        client = kubeapply.Client(api.url)
+        for obj in objs:
+            client.apply(obj)
+        applied = len(api.log)
+        stats: dict = {}
+        flipped = []
+
+        def flip():
+            # Flip right AFTER a readiness round trip lands: for the poll
+            # arm that pins mutation→ready to ~one full tick (the honest
+            # average is half a tick; this measures the deterministic
+            # near-worst case), for the watch arm the flip time is
+            # irrelevant — the event wakes the stream whenever it fires.
+            while len(api.log) < applied + 2:
+                time.sleep(0.005)
+            if not watch:
+                base = len(api.log)
+                while len(api.log) == base:
+                    time.sleep(0.005)
+            time.sleep(2 * latency_s + 0.01)  # let that tick's reply pass
+            flipped.append(time.monotonic())
+            for obj in objs:
+                api.set_ready(kubeapply.object_path(obj))
+
+        t = threading.Thread(target=flip, daemon=True)
+        t.start()
+        client.wait_ready(objs, timeout=30, poll=READY_POLL_S, watch=watch,
+                          stats=stats)
+        latency = time.monotonic() - flipped[0]
+        t.join()
+        client.close()
+        requests = len(api.log) - applied
+    return {"mutation_to_ready_s": round(latency, 4),
+            "requests": requests, "mode": stats["mode"]}
+
+
+def _operator_binary() -> str:
+    """The C++ operator, if a native build tree already has it (conftest /
+    CI build it; this bench never builds — the drift column is reported
+    as null when the binary is absent)."""
+    for build in ("build", "build-asan"):
+        path = os.path.join(REPO, "native", build, "tpu-operator")
+        if os.path.exists(path):
+            return path
+    return ""
+
+
+def drift_arm(latency_s: float, watch: bool):
+    """Drift→repaired through the real C++ operator: delete an owned
+    DaemonSet via the apiserver, time its re-creation. The watch arm runs
+    --interval=120 so repair can ONLY come from the operand watch event;
+    the poll arm runs --no-operand-watch --interval=2 so repair waits for
+    the next interval pass. None when no operator binary is built."""
+    binary = _operator_binary()
+    if not binary:
+        return None
+    import signal
+    import subprocess
+    import tempfile
+    import urllib.request
+
+    ds = "/apis/apps/v1/namespaces/tpu-system/daemonsets/tpu-device-plugin"
+    last = ("/apis/apps/v1/namespaces/tpu-system/daemonsets/"
+            "tpu-node-status-exporter")
+    interval = 120 if watch else 2
+    extra = [] if watch else ["--no-operand-watch"]
+    with tempfile.TemporaryDirectory() as d:
+        operator_bundle.write_bundle(specmod.default_spec(), d)
+        with FakeApiServer(auto_ready=True, latency_s=latency_s) as api:
+            op = subprocess.Popen(
+                [binary, f"--apiserver={api.url}", f"--bundle-dir={d}",
+                 f"--interval={interval}", "--policy-poll-ms=100",
+                 "--poll-ms=20", "--stage-timeout=30", "--status-port=0",
+                 *extra],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            try:
+                def settled():
+                    if api.get(last) is None:
+                        return False
+                    if not watch:
+                        return True
+                    # watch arm: the repair path is the stream — wait for it
+                    return any(m == "GET" and "watch=1" in p
+                               and p.split("?")[0] == ds.rsplit("/", 1)[0]
+                               for m, p in api.log)
+
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline and not settled():
+                    time.sleep(0.02)
+                if not settled():
+                    return {"error": "operator never settled"}
+                req = urllib.request.Request(api.url + ds, method="DELETE")
+                t0 = time.monotonic()
+                urllib.request.urlopen(req).read()
+                while time.monotonic() < deadline and api.get(ds) is None:
+                    time.sleep(0.005)
+                repaired = api.get(ds) is not None
+                latency = time.monotonic() - t0
+            finally:
+                op.send_signal(signal.SIGTERM)
+                try:
+                    op.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    op.kill()
+    if not repaired:
+        return {"error": "drift never repaired"}
+    return {"drift_to_repaired_s": round(latency, 4),
+            "interval_s": interval}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--latency-ms", type=float, default=5.0,
@@ -100,6 +238,8 @@ def main(argv=None) -> int:
     seq = run_arm("sequential", latency_s, args.passes, max_inflight=1)
     pipe = run_arm("pipelined", latency_s, args.passes,
                    max_inflight=args.max_inflight)
+    ready_watch = readiness_arm(latency_s, watch=True)
+    ready_poll = readiness_arm(latency_s, watch=False)
 
     spec = specmod.default_spec()
     groups = full_stack_groups(spec)
@@ -114,6 +254,15 @@ def main(argv=None) -> int:
         "pipelined": {k: v for k, v in pipe.items() if k != "arm"},
         "request_ratio": round(seq["requests"] / max(1, pipe["requests"]), 2),
         "speedup": round(seq["wall_s"] / max(1e-9, pipe["wall_s"]), 2),
+        "readiness": {
+            "poll_interval_s": READY_POLL_S,
+            "watch": ready_watch,
+            "poll": ready_poll,
+            # drift→repaired through the real operator (null when the
+            # native binary isn't built on this host)
+            "drift_watch": drift_arm(latency_s, watch=True),
+            "drift_poll": drift_arm(latency_s, watch=False),
+        },
     }
     print(json.dumps(doc, separators=(",", ":")))
 
@@ -125,6 +274,17 @@ def main(argv=None) -> int:
                   f"{doc['request_ratio']} (target "
                   f">={REQUEST_RATIO_TARGET:g}) speedup {doc['speedup']} "
                   f"(target >={SPEEDUP_TARGET:g})", file=sys.stderr)
+            return 1
+        # watch readiness: event-bound latency (beats the tick-clocked
+        # poll arm) at O(1) requests per collection — one LIST + one
+        # stream, independent of how long the wait ran
+        if not (ready_watch["mutation_to_ready_s"]
+                < ready_poll["mutation_to_ready_s"]
+                and ready_watch["requests"] <= 4
+                and ready_poll["requests"] > ready_watch["requests"]):
+            print(f"bench_rollout: FAIL — readiness watch arm "
+                  f"{ready_watch} did not beat poll arm {ready_poll}",
+                  file=sys.stderr)
             return 1
     return 0
 

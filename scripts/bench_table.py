@@ -152,6 +152,8 @@ def _spread_cell(entry: dict) -> str:
     if spread.get("rejected"):
         cell += (f", {spread['rejected']} stall-biased pair"
                  f"{'s' if spread['rejected'] != 1 else ''} rejected")
+        if spread.get("rejected_cause"):
+            cell += f" ({spread['rejected_cause']})"
     return cell
 
 

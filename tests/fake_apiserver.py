@@ -126,7 +126,9 @@ class FakeApiServer:
     def __init__(self, auto_ready: bool = True, tls=None, port: int = 0,
                  store: Optional[Dict[str, Dict[str, Any]]] = None,
                  ghost_get_404=(), reject_posts: Optional[Dict[str, int]] = None,
-                 latency_s: float = 0.0):
+                 latency_s: float = 0.0,
+                 reject_watch: Optional[Dict[str, int]] = None,
+                 watch_gone_once=()):
         self.auto_ready = auto_ready
         # Injected per-request service time (scripts/bench_rollout.py and
         # the shared-watcher tests): slept before EVERY handled request, on
@@ -139,6 +141,14 @@ class FakeApiServer:
         # exact collection path -> HTTP status: force POST failures (RBAC
         # denial / admission-webhook rejection simulation)
         self.reject_posts: Dict[str, int] = dict(reject_posts or {})
+        # Watch fault hooks (degradation-path tests): ``reject_watch`` maps
+        # a path to an HTTP status its `?watch=1` GET answers with (403 =
+        # RBAC lacking the watch verb); ``watch_gone_once`` lists paths
+        # whose NEXT watch emits an ERROR/410 event and ends — the
+        # compacted-history window a real apiserver reports when the
+        # client's resourceVersion fell off the end of etcd history.
+        self.reject_watch: Dict[str, int] = dict(reject_watch or {})
+        self.watch_gone_once = set(watch_gone_once)
         self.log: List[Tuple[str, str]] = []  # (method, path)
         self.created: List[str] = []          # stored object paths, in order
         self.headers_seen: List[Dict[str, str]] = []
@@ -186,7 +196,20 @@ class FakeApiServer:
                 events for mutations at/under ``path`` until timeoutSeconds
                 elapses, then end the stream cleanly (the apiserver watch
                 -window model). Connection: close + no Content-Length —
-                the client reads lines until EOF."""
+                the client reads lines until EOF.
+
+                ``?resourceVersion=N`` starts the stream from revision N
+                (events with rev > N are replayed), like a watch resumed
+                from a LIST's resourceVersion. An RV older than the
+                retained change history — or a path armed via the
+                ``watch_gone_once`` fault hook — answers with a single
+                ERROR/410 event and ends: the client must re-LIST and
+                re-watch (real apiserver compaction semantics)."""
+                rc = fake.reject_watch.get(path)
+                if rc:
+                    self._reply(rc, {"kind": "Status", "code": rc,
+                                     "reason": "Forbidden"})
+                    return
                 try:
                     timeout_s = float(q.get("timeoutSeconds", ["30"])[0])
                 except ValueError:
@@ -197,8 +220,34 @@ class FakeApiServer:
                 self.send_header("Connection", "close")
                 self.end_headers()
                 self.close_connection = True
+                gone = False
                 with fake._lock:
+                    if path in fake.watch_gone_once:
+                        fake.watch_gone_once.discard(path)
+                        gone = True
                     last_rev = fake._rev
+                    rv_param = q.get("resourceVersion", [""])[0]
+                    if rv_param:
+                        try:
+                            start = int(rv_param)
+                        except ValueError:
+                            start = fake._rev
+                        oldest = (fake._changes[0][0] if fake._changes
+                                  else fake._rev + 1)
+                        if start < oldest - 1 and start < fake._rev:
+                            gone = True  # history compacted past this RV
+                        else:
+                            last_rev = start
+                if gone:
+                    ev = {"type": "ERROR",
+                          "object": {"kind": "Status", "code": 410,
+                                     "reason": "Expired"}}
+                    try:
+                        self.wfile.write((json.dumps(ev) + "\n").encode())
+                        self.wfile.flush()
+                    except (BrokenPipeError, ConnectionResetError):
+                        pass
+                    return
                 try:
                     while True:
                         with fake._changed:
@@ -253,7 +302,11 @@ class FakeApiServer:
                         items = [o for p, o in fake.store.items()
                                  if p.startswith(prefix)
                                  and "/" not in p[len(prefix):]]
+                        # list metadata.resourceVersion: where a client's
+                        # watch resumes from (apiserver LIST semantics)
                         obj = {"kind": "List",
+                               "metadata": {"resourceVersion":
+                                            str(fake._rev)},
                                "items": _filter_selector(items, query)}
                 if obj is None:
                     self._reply(404, {"kind": "Status", "code": 404})
@@ -375,7 +428,16 @@ class FakeApiServer:
                         fake._note_change(self.path)
                 self._reply(200 if gone is not None else 404, {})
 
-        self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        class Server(ThreadingHTTPServer):
+            def handle_error(self, request, client_address):
+                import sys as _sys
+                exc = _sys.exc_info()[1]
+                if isinstance(exc, (BrokenPipeError, ConnectionResetError)):
+                    return  # client went away mid-reply — routine when a
+                            # watcher (or a killed operator) disconnects
+                super().handle_error(request, client_address)
+
+        self._server = Server(("127.0.0.1", port), Handler)
         if tls is not None:
             import ssl
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
@@ -410,8 +472,15 @@ class FakeApiServer:
     # ------------------------------------------------------------- watch
 
     def _note_change(self, path: str) -> None:
-        """Record a mutation for watchers. Caller must hold self._lock."""
+        """Record a mutation for watchers and stamp the object's
+        metadata.resourceVersion (apiserver behavior — clients resume
+        watches from it). Caller must hold self._lock."""
         self._rev += 1
+        obj = self.store.get(path)
+        if isinstance(obj, dict):
+            meta = obj.setdefault("metadata", {})
+            if isinstance(meta, dict):
+                meta["resourceVersion"] = str(self._rev)
         self._changes.append((self._rev, path))
         del self._changes[:-1000]  # bounded; watchers re-read current state
         self._changed.notify_all()

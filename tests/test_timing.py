@@ -35,6 +35,9 @@ def test_stalled_lo_pair_is_rejected_and_counted():
     assert sp["rejected"] == 1
     assert sp["n"] == 4
     assert sp["max"] <= 1.15 * normal_rate  # the stall no longer pollutes
+    # the artifact names the rejection's direction (round-5 verdict: a
+    # rejection firing every run must be diagnosable from the JSON)
+    assert sp["rejected_cause"] == "stall_lo_reads_high"
 
 
 def test_stalled_hi_pair_is_rejected_too():
@@ -49,6 +52,7 @@ def test_stalled_hi_pair_is_rejected_too():
     assert sp["n"] == 4
     normal_rate = extra / 2.0 / 1e12
     assert sp["min"] >= 0.85 * normal_rate
+    assert sp["rejected_cause"] == "stall_hi_reads_low"
 
 
 def test_correlated_slow_pair_survives():
@@ -62,6 +66,7 @@ def test_correlated_slow_pair_survives():
     out = timing.paired_two_point(pairs, extra, 3 * extra)
     assert out["spread"]["rejected"] == 0
     assert out["spread"]["n"] == 5
+    assert "rejected_cause" not in out["spread"]  # nothing to explain
 
 
 def test_fewer_than_three_pairs_skip_rejection():

@@ -132,7 +132,8 @@ def cmd_apply(args) -> int:
                     client, groups, wait=args.wait,
                     stage_timeout=args.stage_timeout, poll=args.poll,
                     allow_empty_daemonsets=args.allow_empty_daemonsets,
-                    log=lambda msg: print(msg), max_inflight=max_inflight)
+                    log=lambda msg: print(msg), max_inflight=max_inflight,
+                    watch_ready=args.watch)
             finally:
                 client.close()
             if args.wait:
@@ -144,6 +145,11 @@ def cmd_apply(args) -> int:
                 print("apply: note: --parallel has no effect on the kubectl "
                       "backend (kubectl apply already batches per group); "
                       "pass --apiserver to use the pipelined engine",
+                      file=sys.stderr)
+            if args.watch:
+                print("apply: note: --watch has no effect on the kubectl "
+                      "backend (kubectl rollout status blocks on its own "
+                      "watch); pass --apiserver for event-driven readiness",
                       file=sys.stderr)
             if args.poll != 1.0:
                 print("apply: note: --poll has no effect on the kubectl "
@@ -272,6 +278,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-inflight", type=int, default=None,
                    help="worker-pool bound for --parallel "
                         "(default 8, min 2)")
+    p.add_argument("--watch", action="store_true",
+                   help="event-driven readiness (REST backend only): one "
+                        "?watch=1 stream per collection instead of a "
+                        "LIST per poll tick; readiness fires on the "
+                        "event, degrading to the poll loop on 410/denied "
+                        "watches")
     p.add_argument("--allow-empty-daemonsets", action="store_true",
                    help="treat DaemonSets with no matching nodes as ready")
     p.set_defaults(fn=cmd_apply)

@@ -31,6 +31,16 @@ BOTH strategies wait for readiness through the shared loop in
 object in that collection (this replaced the seed's per-object GET storm
 for all callers, so the credential driving ``apply`` needs the ``list``
 verb on workload collections, which the rendered RBAC grants).
+
+``wait_ready(watch=True)`` (``tpuctl apply --watch``) upgrades that loop
+to streaming watches: ONE ``?watch=1`` stream per collection, started
+from the initial LIST's resourceVersion, fans every event out to the
+waiting objects — readiness fires on the event, not the next tick, and
+the request count is O(streams) instead of O(ticks). Degradation is
+explicit: 410 Gone / expired-RV re-LISTs and re-watches; a denied or
+failing watch transport falls back to the poll loop above (which itself
+degrades to per-object GETs when LIST is denied), so no credential that
+converged before can stop converging.
 """
 
 from __future__ import annotations
@@ -74,6 +84,15 @@ WORKLOAD_KINDS = ("DaemonSet", "Deployment", "Job")
 
 class ApplyError(RuntimeError):
     pass
+
+
+class _WatchDenied(Exception):
+    """A watch (or its priming LIST) was refused or the transport failed —
+    the caller degrades to the poll loop instead of surfacing an error."""
+
+    def __init__(self, code: int, message: Any = ""):
+        super().__init__(f"{code} {message}".strip())
+        self.code = code
 
 
 def collection_path(obj: Dict[str, Any]) -> str:
@@ -453,19 +472,92 @@ class Client:
     def wait_ready(self, objs: Sequence[Dict[str, Any]], timeout: float,
                    poll: float = 1.0,
                    allow_empty_daemonsets: bool = False,
-                   seed: Optional[Dict[str, Dict[str, Any]]] = None) -> None:
-        """Shared readiness loop: ONE collection GET per tick feeds every
-        waiting object in that collection (replacing the per-object GET
-        storm — with N DaemonSets pending in a namespace, each tick costs 1
-        round trip instead of N). ``seed`` maps ``object_path(obj)`` to the
+                   seed: Optional[Dict[str, Dict[str, Any]]] = None,
+                   watch: bool = False,
+                   stats: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        """Shared readiness loop. ``seed`` maps ``object_path(obj)`` to the
         freshest known live object (apply responses / the pipelined cache):
-        objects already proven ready cost zero additional requests."""
+        objects already proven ready cost zero additional requests.
+
+        Poll mode (default): ONE collection GET per tick feeds every
+        waiting object in that collection (replacing the per-object GET
+        storm — with N DaemonSets pending in a namespace, each tick costs
+        1 round trip instead of N).
+
+        Watch mode (``watch=True``): one LIST per collection resolves
+        already-ready objects and yields the resourceVersion a single
+        ``?watch=1`` stream resumes from; readiness then fires on the
+        event, costing O(streams) requests however long the wait runs.
+        410 Gone re-LISTs and re-watches; a denied/failed watch degrades
+        to the poll loop (whose own LIST-denied fallback still applies).
+
+        Returns ``stats`` — ``{"requests": N, "mode": ...}`` — also
+        updated in place when the caller passes its own dict (the
+        per-phase timing line and bench report it)."""
+        if stats is None:
+            stats = {}
+        stats.setdefault("requests", 0)
+        stats["mode"] = "watch" if watch else "poll"
         deadline = time.monotonic() + timeout
         pending = [o for o in objs if o.get("kind") in WORKLOAD_KINDS]
         if seed:
             pending = [o for o in pending
                        if not _seed_ready(seed.get(object_path(o)), o,
                                           allow_empty_daemonsets)]
+        if not pending:
+            return stats
+        lock = threading.Lock()
+        if not watch:
+            self._poll_ready(pending, deadline, poll,
+                             allow_empty_daemonsets, stats, lock)
+            return stats
+        by_collection: Dict[str, List[Dict[str, Any]]] = {}
+        for obj in pending:
+            by_collection.setdefault(collection_path(obj), []).append(obj)
+        failures: List[str] = []
+
+        def run(coll, members, drop_conn=False):
+            try:
+                self._watch_ready_collection(coll, members, deadline, poll,
+                                             allow_empty_daemonsets, stats,
+                                             lock)
+            except ApplyError as exc:
+                with lock:
+                    failures.append(str(exc))
+            finally:
+                if drop_conn:
+                    # this worker thread is about to die: its thread-local
+                    # keep-alive connection (relist/degrade GETs) must not
+                    # stay open and referenced in the Client's pool
+                    self._drop_connection()
+
+        colls = list(by_collection.items())
+        if len(colls) == 1:
+            run(*colls[0])
+        else:
+            # one stream per collection, concurrently: readiness events
+            # arrive in any order and every collection must converge
+            threads = [threading.Thread(target=run,
+                                        args=(coll, members, True),
+                                        daemon=True)
+                       for coll, members in colls]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        if failures:
+            raise ApplyError("; ".join(sorted(failures)))
+        return stats
+
+    def _poll_ready(self, pending: List[Dict[str, Any]], deadline: float,
+                    poll: float, allow_empty_daemonsets: bool,
+                    stats: Dict[str, Any], lock: threading.Lock) -> None:
+        """The tick loop shared by poll-mode wait_ready and the watch
+        mode's per-collection degradation path."""
+        def bump(n=1):
+            with lock:
+                stats["requests"] += n
+
         last_list_err: Optional[str] = None
         while pending:
             # Per-tick: the timeout hint must reflect the FINAL tick's LIST
@@ -477,6 +569,7 @@ class Client:
                                          []).append(obj)
             still = []
             for coll, members in by_collection.items():
+                bump()
                 code, listing = self.get(coll)
                 if code in (200, 404):  # 404 = collection empty (see LIST)
                     items = _index_items(listing) if code == 200 else {}
@@ -492,6 +585,7 @@ class Client:
                         f"{(listing or {}).get('message', listing)}")
                     items = {}
                     for obj in members:
+                        bump()
                         one_code, live = self.get(object_path(obj))
                         if one_code == 200:
                             items[obj["metadata"]["name"]] = live
@@ -510,6 +604,160 @@ class Client:
                     f"timed out waiting for readiness: {names}{hint}")
             time.sleep(poll)
 
+    def _open_watch(self, coll: str, resource_version: str,
+                    window_s: int) -> Tuple[Any, Any]:
+        """Open a streaming ``?watch=1`` GET on a DEDICATED connection
+        (the stream monopolizes its socket until the server's
+        timeoutSeconds window ends, so it can never share the pooled
+        keep-alive transport). Returns ``(conn, resp)`` on 200; raises
+        :class:`_WatchDenied` on any other status or transport failure."""
+        url = urllib.parse.urlsplit(self.base_url)
+        try:
+            if url.scheme == "https":
+                conn = http.client.HTTPSConnection(
+                    url.hostname, url.port or 443,
+                    timeout=window_s + max(5.0, self.timeout),
+                    context=self._tls_context())
+            else:
+                conn = http.client.HTTPConnection(
+                    url.hostname, url.port or 80,
+                    timeout=window_s + max(5.0, self.timeout))
+            query = f"?watch=1&timeoutSeconds={window_s}"
+            if resource_version:
+                query += f"&resourceVersion={resource_version}"
+            conn.request("GET", url.path.rstrip("/") + coll + query,
+                         headers=self._headers(False, ""))
+            resp = conn.getresponse()
+        except (http.client.HTTPException, OSError) as exc:
+            raise _WatchDenied(0, f"transport error: {exc}")
+        if resp.status != 200:
+            try:
+                body = json.loads(resp.read() or b"{}")
+            except ValueError:
+                body = {}
+            conn.close()
+            raise _WatchDenied(resp.status,
+                               body.get("message", body.get("reason", "")))
+        return conn, resp
+
+    def _watch_ready_collection(self, coll: str,
+                                members: List[Dict[str, Any]],
+                                deadline: float, poll: float,
+                                allow_empty_daemonsets: bool,
+                                stats: Dict[str, Any],
+                                lock: threading.Lock) -> None:
+        """Event-driven readiness for one collection: LIST once, then hold
+        one watch stream from the LIST's resourceVersion until every
+        member is ready. The server's timeoutSeconds window is clamped to
+        the remaining deadline, so a silent stream ends exactly when the
+        wait would time out anyway."""
+        def bump(n=1):
+            with lock:
+                stats["requests"] += n
+
+        def degrade(why: str):
+            with lock:
+                stats["mode"] = "poll-fallback"
+                stats.setdefault("fallbacks", []).append(why)
+            self._poll_ready(list(pending.values()), deadline, poll,
+                             allow_empty_daemonsets, stats, lock)
+
+        pending = {o["metadata"]["name"]: o for o in members}
+
+        def relist() -> str:
+            """LIST, resolve already-ready members, return the RV the
+            watch resumes from ('' when the collection doesn't exist yet
+            or the LIST is denied — the latter degrades)."""
+            bump()
+            code, listing = self.get(coll)
+            if code == 200:
+                items = _index_items(listing)
+                rv = str((listing.get("metadata") or {})
+                         .get("resourceVersion") or "")
+            elif code == 404:
+                items, rv = {}, ""
+            else:
+                raise _WatchDenied(
+                    code, (listing or {}).get("message", listing))
+            for name in list(pending):
+                if _seed_ready(items.get(name), pending[name],
+                               allow_empty_daemonsets):
+                    del pending[name]
+            return rv
+
+        try:
+            rv = relist()
+        except _WatchDenied as exc:
+            return degrade(f"LIST {coll}: {exc}")
+        while pending:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                break
+            window = max(1, min(300, int(left) + 1))
+            try:
+                bump()
+                opened = time.monotonic()
+                conn, resp = self._open_watch(coll, rv, window)
+            except _WatchDenied as exc:
+                # watch verb denied / transport down: the poll loop still
+                # converges on get+list (or per-object get) credentials
+                return degrade(f"watch {coll}: {exc}")
+            fallback = None
+            expired = False
+            try:
+                while pending:
+                    if time.monotonic() >= deadline:
+                        break
+                    try:
+                        raw = resp.readline()
+                    except (http.client.HTTPException, OSError):
+                        break  # stream died; reopen from the last RV
+                    if not raw:
+                        break  # clean end of the watch window
+                    try:
+                        ev = json.loads(raw)
+                    except ValueError:
+                        continue
+                    ev_type = ev.get("type")
+                    obj = ev.get("object") or {}
+                    if ev_type == "ERROR":
+                        if obj.get("code") == 410:
+                            expired = True  # compacted history: re-LIST
+                        else:
+                            fallback = f"watch {coll}: ERROR event {obj}"
+                        break
+                    new_rv = (obj.get("metadata") or {}).get(
+                        "resourceVersion")
+                    if new_rv:
+                        rv = str(new_rv)
+                    if ev_type == "DELETED":
+                        continue  # still pending; it cannot be ready
+                    name = (obj.get("metadata") or {}).get("name")
+                    if name in pending and _seed_ready(
+                            obj, pending[name], allow_empty_daemonsets):
+                        del pending[name]
+            finally:
+                conn.close()  # before any fallback holds the wait
+            if fallback is not None:
+                return degrade(fallback)
+            if expired:
+                # expired RV: re-LIST for fresh state + a resumable RV,
+                # then re-watch on the next loop turn
+                try:
+                    rv = relist()
+                except _WatchDenied as exc:
+                    return degrade(f"LIST {coll}: {exc}")
+            elif pending and time.monotonic() - opened < 1.0:
+                # the stream died almost immediately without resolving
+                # anything (server/proxy resetting long GETs): pace the
+                # reopen at the poll tick — never a tight request loop
+                time.sleep(min(poll, max(0.0, deadline - time.monotonic())))
+        if pending:
+            names = sorted(pending)
+            raise ApplyError(
+                f"timed out waiting for readiness: {names} "
+                f"(watch on {coll})")
+
 
 @dataclass
 class GroupResult:
@@ -520,9 +768,18 @@ class GroupResult:
     timings: Dict[str, float] = field(
         default_factory=lambda: {"apply": 0.0, "crd-establish": 0.0,
                                  "ready-wait": 0.0})
+    # Readiness request accounting across all groups: how many apiserver
+    # round trips the ready-wait phase cost, and which mechanism served it
+    # ("watch", "poll", or "poll-fallback" when a watch degraded).
+    ready_requests: int = 0
+    ready_mode: str = ""
 
     def timings_line(self) -> str:
-        return ", ".join(f"{k} {v:.2f}s" for k, v in self.timings.items())
+        line = ", ".join(f"{k} {v:.2f}s" for k, v in self.timings.items())
+        if self.ready_mode:
+            line += (f" [ready-wait: {self.ready_requests} request(s) "
+                     f"via {self.ready_mode}]")
+        return line
 
 
 def kubectl_runner(argv: Sequence[str], input_text: Optional[str] = None,
@@ -740,24 +997,37 @@ def delete_groups_kubectl(groups: Sequence[Sequence[Dict[str, Any]]],
     return result
 
 
+def _note_ready_stats(result: GroupResult, stats: Dict[str, Any]) -> None:
+    """Fold one wait_ready's stats into the rollout result. A degraded
+    watch anywhere taints the whole rollout's reported mode — the line is
+    a triage surface, and 'watch' must mean watch everywhere."""
+    result.ready_requests += stats.get("requests", 0)
+    mode = stats.get("mode", "")
+    if mode and result.ready_mode != "poll-fallback":
+        result.ready_mode = mode
+
+
 def apply_groups(client: Client, groups: Sequence[Sequence[Dict[str, Any]]],
                  wait: bool = True, stage_timeout: float = 600,
                  poll: float = 1.0, allow_empty_daemonsets: bool = False,
-                 log=lambda msg: None, max_inflight: int = 1) -> GroupResult:
+                 log=lambda msg: None, max_inflight: int = 1,
+                 watch_ready: bool = False) -> GroupResult:
     """Ordered, readiness-gated rollout of manifest groups — the reference's
     operator behavior (SURVEY.md §3.3) as a one-shot procedure.
 
     ``max_inflight > 1`` selects the pipelined engine: shared-cache
     prefetch, tiered concurrent apply inside each group, skip-unchanged
-    re-applies, and apply-response-seeded readiness. Groups stay ordered
-    barriers in both modes, and a failing object in group N always blocks
-    group N+1."""
+    re-applies, and apply-response-seeded readiness. ``watch_ready``
+    selects event-driven readiness (one watch stream per collection; see
+    ``Client.wait_ready``). Groups stay ordered barriers in both modes,
+    and a failing object in group N always blocks group N+1."""
     result = GroupResult()
     if max_inflight > 1:
         try:
             return _apply_groups_pipelined(
                 client, groups, wait, stage_timeout, poll,
-                allow_empty_daemonsets, log, max_inflight, result)
+                allow_empty_daemonsets, log, max_inflight, result,
+                watch_ready)
         finally:
             # the pool's worker threads are gone; their thread-local
             # connections must not outlive them in the Client's pool
@@ -780,9 +1050,11 @@ def apply_groups(client: Client, groups: Sequence[Sequence[Dict[str, Any]]],
         result.timings["crd-establish"] += time.monotonic() - t0
         if wait:
             t0 = time.monotonic()
-            client.wait_ready(group, stage_timeout, poll,
-                              allow_empty_daemonsets)
+            stats = client.wait_ready(group, stage_timeout, poll,
+                                      allow_empty_daemonsets,
+                                      watch=watch_ready)
             result.timings["ready-wait"] += time.monotonic() - t0
+            _note_ready_stats(result, stats)
             log(f"group {i + 1}/{len(groups)} ready")
     return result
 
@@ -841,7 +1113,8 @@ def _apply_groups_pipelined(client: Client,
                             wait: bool, stage_timeout: float, poll: float,
                             allow_empty_daemonsets: bool, log,
                             max_inflight: int,
-                            result: GroupResult) -> GroupResult:
+                            result: GroupResult,
+                            watch_ready: bool = False) -> GroupResult:
     """The concurrent engine behind apply_groups(max_inflight>1).
 
     One LIST per distinct collection primes a shared live-object cache
@@ -922,8 +1195,10 @@ def _apply_groups_pipelined(client: Client,
                                       {}).get(o["metadata"]["name"])
                             for o in group
                             if o.get("kind") in WORKLOAD_KINDS}
-                client.wait_ready(group, stage_timeout, poll,
-                                  allow_empty_daemonsets, seed=seed)
+                stats = client.wait_ready(group, stage_timeout, poll,
+                                          allow_empty_daemonsets, seed=seed,
+                                          watch=watch_ready)
                 result.timings["ready-wait"] += time.monotonic() - t0
+                _note_ready_stats(result, stats)
                 log(f"group {i + 1}/{len(groups)} ready")
     return result

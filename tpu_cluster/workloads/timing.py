@@ -16,6 +16,10 @@ rejection: ~1 in 7 pairs through the tunnel carries a one-sided stall
 (extra time in one run only), which the per-pair delta does NOT cancel —
 pairs whose delta is an outlier against the median delta are rejected and
 the count is published in the spread so the outlier rate stays visible.
+Round 6 diagnoses the every-run rejection as the FIRST measured pair
+(cold post-compile caches; bench.measure_tflops now runs an explicit
+excluded warmup pair) and publishes ``rejected_cause`` — the direction
+each rejected pair would have biased the headline — in the spread.
 """
 
 from __future__ import annotations
@@ -28,8 +32,9 @@ ESTIMATOR = "median_of_per_pair_two_point_deltas"
 
 def _reject_stalled(pairs: List[Tuple[float, float]], floor: float,
                     tol_frac: float, tol_abs: float,
-                    ) -> Tuple[List[Tuple[float, float]], int]:
-    """Drop pairs whose DELTA is an outlier against the median delta.
+                    ) -> Tuple[List[Tuple[float, float]], int, List[str]]:
+    """Drop pairs whose DELTA is an outlier against the median delta,
+    returning ``(kept, rejected_count, causes)``.
 
     The published statistic is the per-pair delta rate, so the delta is
     the right thing to test: a one-sided stall in the lo run shrinks the
@@ -41,18 +46,31 @@ def _reject_stalled(pairs: List[Tuple[float, float]], floor: float,
     is the whole design of the pairing, so per-position absolute times
     must not be the test. ``tol`` as a fraction of the median delta
     directly bounds the published spread: keeping |delta - median| <=
-    0.1*median keeps every surviving rate within ~11% of the median's."""
+    0.1*median keeps every surviving rate within ~11% of the median's.
+
+    ``causes`` names each rejection's direction — ``stall_lo_reads_high``
+    (shrunken delta: the headline would have read high) or
+    ``stall_hi_reads_low`` — published in the spread so the artifact
+    records WHAT kind of outlier the run produced, not just that one
+    existed (round-5 verdict: a rejection that fires every run is a
+    systematic effect someone must be able to diagnose from the JSON)."""
     if len(pairs) < 3:
-        return pairs, 0
+        return pairs, 0, []
     deltas = [hi - lo for lo, hi in pairs]
     delta_med = statistics.median(deltas)
     if delta_med <= floor:
-        return pairs, 0
+        return pairs, 0, []
     tol = max(tol_frac * delta_med, tol_abs)
-    kept = [p for p, d in zip(pairs, deltas) if abs(d - delta_med) <= tol]
+    kept, causes = [], []
+    for p, d in zip(pairs, deltas):
+        if abs(d - delta_med) <= tol:
+            kept.append(p)
+        else:
+            causes.append("stall_lo_reads_high" if d < delta_med
+                          else "stall_hi_reads_low")
     if not kept:  # bimodal deltas (even n): nothing is more trustworthy
-        return pairs, 0
-    return kept, len(pairs) - len(kept)
+        return pairs, 0, []
+    return kept, len(pairs) - len(kept), causes
 
 
 def paired_two_point(pairs: List[Tuple[float, float]], extra_flops: float,
@@ -72,8 +90,8 @@ def paired_two_point(pairs: List[Tuple[float, float]], extra_flops: float,
     ``spread`` dict when >=1 surviving pair cleared the noise ``floor``,
     and a ``note`` when none did.
     """
-    kept, rejected = _reject_stalled(pairs, floor, stall_tol_frac,
-                                     stall_tol_abs)
+    kept, rejected, causes = _reject_stalled(pairs, floor, stall_tol_frac,
+                                             stall_tol_abs)
     rated = []
     for lo_s, hi_s in kept:
         dt = hi_s - lo_s
@@ -82,17 +100,20 @@ def paired_two_point(pairs: List[Tuple[float, float]], extra_flops: float,
     if rated:
         rated.sort()
         rate, lo_s, hi_s = rated[len(rated) // 2]
+        spread = {"min": round(rated[0][0], 2),
+                  "median": round(rate, 2),
+                  "max": round(rated[-1][0], 2),
+                  "n": len(rated),
+                  "rejected": rejected}
+        if causes:
+            spread["rejected_cause"] = ",".join(causes)
         return {
             "estimator": ESTIMATOR,
             "tflops": rate,
             "lo_s": lo_s,
             "hi_s": hi_s,
             "delta_s": hi_s - lo_s,
-            "spread": {"min": round(rated[0][0], 2),
-                       "median": round(rate, 2),
-                       "max": round(rated[-1][0], 2),
-                       "n": len(rated),
-                       "rejected": rejected},
+            "spread": spread,
         }
     # Every delta was below the noise floor — the runs are noise-dominated
     # by definition, so report the raw long-run rate from the MEDIAN hi
