@@ -212,6 +212,7 @@ Response PlainHttp(const Config& cfg, const Url& url,
   // Connection: close => body runs to EOF, but honor chunked encoding from
   // picky servers.
   for (char& c : headers) c = tolower(c);
+  resp.retry_after_ms = ParseRetryAfterMs(headers);
   if (headers.find("transfer-encoding: chunked") != std::string::npos) {
     std::string decoded;
     size_t pos = 0;
@@ -369,7 +370,45 @@ Response CurlHttps(const Config& cfg, const std::string& method,
   return resp;
 }
 
+// One transport round trip, no retries — Call() owns the retry loop.
+Response CallOnce(const Config& cfg, const std::string& method,
+                  const std::string& path, const std::string& body,
+                  const std::string& content_type) {
+  Url url;
+  Response resp;
+  if (!ParseUrl(cfg.base_url, &url, &resp.error)) return resp;
+  if (url.https)
+    return CurlHttps(cfg, method, cfg.base_url + path, body, content_type);
+  return PlainHttp(cfg, url, method, path, body, content_type);
+}
+
 }  // namespace
+
+bool RetryableStatus(int status) {
+  switch (status) {
+    case 0:    // transport failure (refused/reset/timeout/malformed)
+    case 429:  // throttled — the apiserver WANTS a retry (with backoff)
+    case 500:
+    case 502:
+    case 503:
+    case 504:
+      return true;
+    default:
+      return false;  // success, or a terminal 4xx retries cannot fix
+  }
+}
+
+int ParseRetryAfterMs(const std::string& lowered_headers) {
+  size_t pos = lowered_headers.find("retry-after:");
+  if (pos == std::string::npos) return 0;
+  pos += strlen("retry-after:");
+  while (pos < lowered_headers.size() && lowered_headers[pos] == ' ') ++pos;
+  char* end = nullptr;
+  double secs = strtod(lowered_headers.c_str() + pos, &end);
+  if (end == lowered_headers.c_str() + pos || secs < 0) return 0;
+  if (secs > 3600) secs = 3600;  // a buggy/hostile header must not park us
+  return static_cast<int>(secs * 1000);
+}
 
 bool Config::InCluster(Config* out) {
   const char* host = getenv("KUBERNETES_SERVICE_HOST");
@@ -402,12 +441,22 @@ bool Config::InCluster(Config* out) {
 Response Call(const Config& cfg, const std::string& method,
               const std::string& path, const std::string& body,
               const std::string& content_type) {
-  Url url;
   Response resp;
-  if (!ParseUrl(cfg.base_url, &url, &resp.error)) return resp;
-  if (url.https)
-    return CurlHttps(cfg, method, cfg.base_url + path, body, content_type);
-  return PlainHttp(cfg, url, method, path, body, content_type);
+  for (int attempt = 1;; ++attempt) {
+    resp = CallOnce(cfg, method, path, body, content_type);
+    if (!RetryableStatus(resp.status) || attempt >= cfg.max_attempts)
+      return resp;
+    // Config refusals (no CA file for https) report status 0 like a
+    // transport failure but can never succeed on retry — fail now.
+    if (resp.status == 0 && resp.error.rfind("refusing", 0) == 0)
+      return resp;
+    int wait_ms =
+        resp.retry_after_ms > 0
+            ? (resp.retry_after_ms < cfg.retry_cap_ms ? resp.retry_after_ms
+                                                      : cfg.retry_cap_ms)
+            : WatchBackoffMs(attempt, cfg.retry_base_ms, cfg.retry_cap_ms);
+    usleep(static_cast<useconds_t>(wait_ms) * 1000);
+  }
 }
 
 // ------------------------------------------------------------------ watch

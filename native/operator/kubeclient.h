@@ -32,10 +32,25 @@ int ElapsedMs(const struct timespec& t0);
 // as 1, and degenerate base/cap inputs clamp instead of misbehaving.
 int WatchBackoffMs(int attempt, int base_ms, int cap_ms);
 
+// Shared failure taxonomy (the C++ twin of tpu_cluster.kubeapply's
+// RetryPolicy, pinned by operator_selftest): transport status 0 and the
+// throttling/gateway statuses 429/500/502/503/504 are worth retrying;
+// every other status is either success or terminal (409 Conflict is
+// resolved semantically by the apply path — re-GET then re-PATCH — never
+// blindly retried).
+bool RetryableStatus(int status);
+
+// Retry-After from a LOWERCASED header block -> milliseconds (0 = absent
+// or the http-date form, which callers treat as "use computed backoff").
+// Fractional seconds are accepted (test servers use them); clamped to 1h.
+int ParseRetryAfterMs(const std::string& lowered_headers);
+
 struct Response {
   int status = 0;          // HTTP status; 0 = transport failure
   std::string body;
   std::string error;       // transport-level error when status == 0
+  int retry_after_ms = 0;  // server-sent Retry-After (plain-http transport
+                           // only; the curl path reports 0)
   bool ok() const { return status >= 200 && status < 300; }
 };
 
@@ -49,6 +64,15 @@ struct Config {
   // projected CA is unreadable; the CLI path requires the explicit flag.
   bool insecure_skip_tls_verify = false;
   int timeout_ms = 10000;
+  // Capped request retries under RetryableStatus: total tries per Call
+  // (1 = no retries), backed off via WatchBackoffMs(attempt, base, cap) —
+  // the same machinery pacing watch reconnects — unless the server sent
+  // Retry-After. Kept small by design: the operator is single-threaded
+  // and its /healthz is not pumped while a Call sleeps, so the worst-case
+  // added stall is base+2*base (~600 ms at the defaults).
+  int max_attempts = 3;
+  int retry_base_ms = 200;
+  int retry_cap_ms = 2000;
 
   // In-cluster defaults: KUBERNETES_SERVICE_HOST/PORT env + the mounted
   // ServiceAccount token/CA. Returns false when not running in a cluster.
@@ -57,6 +81,9 @@ struct Config {
 
 // method: GET | POST | PUT | PATCH | DELETE. content_type applies when body
 // is non-empty (Kubernetes needs application/merge-patch+json for PATCH).
+// Retries RetryableStatus answers up to cfg.max_attempts (429/5xx blips and
+// transport failures absorb here instead of failing the reconcile pass);
+// the returned Response is the final attempt's.
 Response Call(const Config& cfg, const std::string& method,
               const std::string& path, const std::string& body = "",
               const std::string& content_type = "application/json");

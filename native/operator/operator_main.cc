@@ -185,8 +185,12 @@ class StatusServer {
   }
 
   // Serve pending requests for up to wait_ms — doubles as the loop's sleep.
+  // health_body is what /healthz answers with (the degraded-state surface:
+  // consecutive-failure count + last error when unhealthy, so a flapping
+  // apiserver is visible in the probe output, not silent).
   void Pump(int wait_ms, const std::string& status_json,
-            const std::string& metrics, bool healthy) {
+            const std::string& metrics, bool healthy,
+            const std::string& health_body) {
     if (fd_ < 0) {
       if (wait_ms > 0) usleep(wait_ms * 1000);
       return;
@@ -220,7 +224,7 @@ class StatusServer {
                 body = metrics;
                 ctype = "text/plain; version=0.0.4";
               } else if (strcmp(path, "/healthz") == 0) {
-                body = healthy ? "ok\n" : "reconcile failing\n";
+                body = health_body;
                 ctype = "text/plain";
                 code = healthy ? 200 : 503;
               }
@@ -280,11 +284,28 @@ class Operator {
 
   // One full reconcile pass: fetch the policy, apply + gate stage by stage,
   // report back through the CR's status subresource. Returns true when
-  // every enabled object applied and became ready.
+  // every enabled object applied and became ready. Maintains the
+  // degraded-state counters /healthz and /metrics surface: consecutive
+  // failed passes and the first error of the latest failed one.
   bool ReconcilePass() {
     bool ok = ReconcileObjects();
+    if (ok) {
+      consecutive_failures_ = 0;
+      last_error_.clear();
+    } else {
+      ++consecutive_failures_;
+      last_error_ = FirstError();
+    }
     WritePolicyStatus(ok);
     return ok;
+  }
+
+  // The first per-object error of the pass that just failed — the triage
+  // line /healthz carries (a pass interrupted by SIGTERM has none).
+  std::string FirstError() const {
+    for (const auto& bo : bundle_)
+      if (!bo.error.empty()) return bo.file + ": " + bo.error;
+    return "pass interrupted";
   }
 
   bool ReconcileObjects() {
@@ -349,6 +370,8 @@ class Operator {
                       "tpu-operator: stage %s: %s not ready after %ds\n",
                       stage.c_str(), bundle_[j].file.c_str(),
                       opt_.stage_timeout_s);
+              bundle_[j].error = "not ready after " +
+                                 std::to_string(opt_.stage_timeout_s) + "s";
               EmitEvent("StageTimeout",
                         "stage " + stage + ": not ready after " +
                             std::to_string(opt_.stage_timeout_s) + "s",
@@ -644,7 +667,6 @@ class Operator {
   bool leader() const { return leader_; }
 
   void RunForever() {
-    int failures = 0;
     while (!g_stop) {
       if (opt_.leader_elect && !TryAcquireLease()) {
         // Standby is inert: no bundle reload, no reconcile, no Events —
@@ -671,20 +693,17 @@ class Operator {
       }
       bool ok = ReconcilePass();
       healthy_ = ok;
-      if (ok) {
-        failures = 0;
+      if (ok)
         fprintf(stderr, "tpu-operator: pass %d converged\n", passes_);
-      } else {
-        ++failures;
-      }
       // Failed passes back off exponentially with +/-10% jitter: an
       // apiserver bounce must not be met with a synchronized full-rate
       // retry storm from every operator in the fleet. The 5-min cap only
       // bounds the BACKOFF — a configured interval above it is honored.
+      // (consecutive_failures_ is the same counter /healthz surfaces.)
       int sleep_ms = opt_.interval_s * 1000;
-      if (failures > 0) {
+      if (consecutive_failures_ > 0) {
         int cap_ms = std::max(300 * 1000, sleep_ms);
-        for (int i = 0; i < failures && sleep_ms < cap_ms; ++i)
+        for (int i = 0; i < consecutive_failures_ && sleep_ms < cap_ms; ++i)
           sleep_ms *= 2;
         sleep_ms = std::min(sleep_ms, cap_ms);
       }
@@ -754,6 +773,45 @@ class Operator {
     int backoff_ms = 0;          // 0 = may (re)open immediately
   };
 
+  // One LIST of an owned workload collection against the recorded
+  // per-object generations: the catch-up read that closes the
+  // pass→watch BLIND WINDOW. While a reconcile pass runs, no watch
+  // stream is open, and the streams (re)opened for the next sleep start
+  // at "now" — without this, a delete or spec edit that landed mid-pass
+  // would sleep invisibly until the interval resync (observed as a
+  // multi-second repair gap under chaos). Returns true when an owned
+  // object is missing or carries an unexpected generation, i.e. the
+  // caller must reconcile immediately instead of sleeping. A failing
+  // LIST returns false: the stream + interval resync still cover it.
+  bool OwnedDriftInList(const std::string& coll,
+                        const std::map<std::string, double>& owned) {
+    kubeclient::Response list = kubeclient::Call(cfg_, "GET", coll);
+    if (!list.ok()) return false;
+    minijson::ValuePtr doc = minijson::Parse(list.body);
+    minijson::ValuePtr items = doc ? doc->Get("items") : nullptr;
+    if (!items || !items->is_array()) return false;
+    std::map<std::string, double> live;
+    for (const auto& item : items->elements())
+      live[item->PathString("metadata.name")] =
+          item->PathNumber("metadata.generation", 0);
+    for (const auto& kv : owned) {
+      if (kv.first.rfind(coll + "/", 0) != 0) continue;
+      std::string name = kv.first.substr(coll.size() + 1);
+      auto it = live.find(name);
+      if (it != live.end() && kv.second == 0)
+        continue;  // generation never observed: nothing to compare
+      if (it == live.end() || it->second != kv.second) {
+        fprintf(stderr,
+                "tpu-operator: operand drift (%s %s, catch-up list); "
+                "reconciling now\n", name.c_str(),
+                it == live.end() ? "deleted mid-pass"
+                                 : "generation changed mid-pass");
+        return true;
+      }
+    }
+    return false;
+  }
+
   // Event-driven sleep: hold streaming `?watch=1` connections for the
   // whole interval (the controller-runtime model — zero GET probes) on
   //  - the policy CR (when ``policy_stream``), and
@@ -770,18 +828,7 @@ class Operator {
   bool SleepOnWatches(int* left_ms, const std::string& bundle_fp,
                       bool policy_stream) {
     int secs = (*left_ms + 999) / 1000 + 1;
-    kubeclient::WatchStream pws;
     std::string err;
-    if (policy_stream) {
-      std::string path = PolicyPath() + "?watch=1&timeoutSeconds=" +
-                         std::to_string(secs);
-      if (!pws.Open(cfg_, path, secs + 30, &err)) {
-        fprintf(stderr,
-                "tpu-operator: watch unavailable (%s); falling back to "
-                "generation polling\n", err.c_str());
-        return false;
-      }
-    }
     std::vector<std::unique_ptr<OperandWatchState>> ows;
     std::map<std::string, double> owned;  // coll/name -> applied generation
     if (opt_.operand_watch) {
@@ -798,6 +845,26 @@ class Operator {
         if (!coll.empty())
           owned[coll + "/" + bo.obj->PathString("metadata.name")] =
               bo.generation;
+      }
+    }
+    // Catch-up probes BEFORE opening any stream (so they land outside
+    // the event-driven window the tests pin to zero probes): anything
+    // that drifted while the pass ran — when nothing was watching — is
+    // repaired now instead of at the interval resync. What remains
+    // uncovered is the one-RTT probe→open gap, which the resync
+    // backstops.
+    if (policy_stream && PolicyProbeSaysReconcile()) return true;
+    for (const auto& owp : ows)
+      if (OwnedDriftInList(owp->coll, owned)) return true;
+    kubeclient::WatchStream pws;
+    if (policy_stream) {
+      std::string path = PolicyPath() + "?watch=1&timeoutSeconds=" +
+                         std::to_string(secs);
+      if (!pws.Open(cfg_, path, secs + 30, &err)) {
+        fprintf(stderr,
+                "tpu-operator: watch unavailable (%s); falling back to "
+                "generation polling\n", err.c_str());
+        return false;
       }
     }
     // Wall-clock accounting for EVERY branch: a writer flapping the CR's
@@ -1089,6 +1156,10 @@ class Operator {
     minijson::ValuePtr root = minijson::Value::MakeObject();
     root->Set("passes", std::make_shared<minijson::Value>(double(passes_)));
     root->Set("healthy", std::make_shared<minijson::Value>(healthy_));
+    root->Set("consecutiveFailures", std::make_shared<minijson::Value>(
+                                         double(consecutive_failures_)));
+    if (!last_error_.empty())
+      root->Set("lastError", std::make_shared<minijson::Value>(last_error_));
     auto arr = minijson::Value::MakeArray();
     for (const auto& bo : bundle_) {
       auto o = minijson::Value::MakeObject();
@@ -1126,7 +1197,7 @@ class Operator {
       ready += bo.ready;
       disabled += bo.disabled;
     }
-    char buf[768];
+    char buf[1024];
     snprintf(buf, sizeof(buf),
              "# TYPE tpu_operator_objects gauge\n"
              "tpu_operator_objects{state=\"desired\"} %zu\n"
@@ -1137,10 +1208,12 @@ class Operator {
              "tpu_operator_passes_total %d\n"
              "# TYPE tpu_operator_healthy gauge\n"
              "tpu_operator_healthy %d\n"
+             "# TYPE tpu_operator_consecutive_failures gauge\n"
+             "tpu_operator_consecutive_failures %d\n"
              "# TYPE tpu_operator_policy_generation gauge\n"
              "tpu_operator_policy_generation %.0f\n",
              bundle_.size(), applied, ready, disabled, passes_,
-             healthy_ ? 1 : 0, policy_generation_);
+             healthy_ ? 1 : 0, consecutive_failures_, policy_generation_);
     std::string out = buf;
     if (opt_.leader_elect)
       out += "# TYPE tpu_operator_leader gauge\n"
@@ -1152,6 +1225,25 @@ class Operator {
   void set_healthy(bool h) { healthy_ = h; }
 
  private:
+  // The /healthz body: "ok" when converged; otherwise the degraded-state
+  // detail — how many consecutive passes failed and the latest error — so
+  // a flapping apiserver reads as "reconcile failing: 3 consecutive
+  // failure(s); last: 20-plugin--daemonset.json: POST ... -> 503 ..."
+  // instead of a bare 503 with the story buried in pod logs.
+  std::string HealthBody() const {
+    if (healthy_) return "ok\n";
+    if (lease_error_)
+      return "leader-election lease unverifiable "
+             "(RBAC/namespace/transport)\n";
+    if (consecutive_failures_ == 0) return "not yet converged\n";
+    std::string out = "reconcile failing: " +
+                      std::to_string(consecutive_failures_) +
+                      " consecutive failure(s)";
+    if (!last_error_.empty()) out += "; last: " + last_error_.substr(0, 400);
+    out += "\n";
+    return out;
+  }
+
   void Sleep(int ms) {
     if (!status_.enabled()) {
       // no status listener: plain sleep, skip serializing state every poll
@@ -1159,7 +1251,7 @@ class Operator {
         usleep(std::min(left, 50) * 1000);
       return;
     }
-    status_.Pump(ms, StatusJson(), Metrics(), healthy_);
+    status_.Pump(ms, StatusJson(), Metrics(), healthy_, HealthBody());
   }
 
   // --- TpuStackPolicy (ClusterPolicy analog) ---------------------------
@@ -1464,6 +1556,10 @@ class Operator {
   int passes_ = 0;
   int event_seq_ = 0;
   bool healthy_ = false;
+  // degraded-state surface (/healthz, /status, /metrics): consecutive
+  // failed passes and the first error of the latest failed one
+  int consecutive_failures_ = 0;
+  std::string last_error_;
   // bundle-change tracking (input probe + prune gating)
   std::string pass_bundle_fp_;   // fingerprint at the current pass's start
   std::string last_pruned_fp_;   // fingerprint the last prune sweep covered

@@ -178,6 +178,33 @@ static void TestReadiness() {
       " \"updatedReplicas\": 2}}")));
 }
 
+static void TestRetryClassification() {
+  // The shared failure taxonomy (C++ twin of tpu_cluster.kubeapply's
+  // RetryPolicy — the two tables must never drift): transport status 0
+  // and 429/5xx-gateway statuses retry; everything else is success or
+  // terminal. 409 Conflict is deliberately NOT retryable — the apply path
+  // resolves it semantically (re-GET then re-PATCH).
+  const int retryable[] = {0, 429, 500, 502, 503, 504};
+  for (int s : retryable) CHECK(kubeclient::RetryableStatus(s));
+  const int not_retryable[] = {200, 201, 202, 301, 400, 401, 403,
+                               404,  409, 410, 422, 501};
+  for (int s : not_retryable) CHECK(!kubeclient::RetryableStatus(s));
+
+  // Retry-After parsing (plain-http transport, lowercased header block):
+  // seconds — integer or fractional — to ms; absent, the http-date form,
+  // or garbage parse to 0 (caller falls back to computed backoff); a
+  // hostile/buggy value clamps to an hour.
+  CHECK(kubeclient::ParseRetryAfterMs(
+            "content-type: application/json\r\nretry-after: 2") == 2000);
+  CHECK(kubeclient::ParseRetryAfterMs("retry-after:0.25") == 250);
+  CHECK(kubeclient::ParseRetryAfterMs("retry-after:  7\r\nx: y") == 7000);
+  CHECK(kubeclient::ParseRetryAfterMs("content-type: text/plain") == 0);
+  CHECK(kubeclient::ParseRetryAfterMs(
+            "retry-after: wed, 21 oct 2026 07:28:00 gmt") == 0);
+  CHECK(kubeclient::ParseRetryAfterMs("retry-after: -5") == 0);
+  CHECK(kubeclient::ParseRetryAfterMs("retry-after: 999999") == 3600000);
+}
+
 static void TestWatchBackoff() {
   // Doubling from base, capped: the operand drift-watch reconnect
   // schedule. A persistently kClosed stream (each https open is a curl
@@ -202,6 +229,7 @@ int main() {
   TestPaths();
   TestSweepCollections();
   TestReadiness();
+  TestRetryClassification();
   TestWatchBackoff();
   if (g_failures) {
     fprintf(stderr, "operator_selftest: %d FAILURES\n", g_failures);

@@ -53,7 +53,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.join(REPO, "tests"))
 
-from fake_apiserver import FakeApiServer  # noqa: E402
+from fake_apiserver import FakeApiServer, standard_fault_script  # noqa: E402
 from tpu_cluster import kubeapply  # noqa: E402
 from tpu_cluster import spec as specmod  # noqa: E402
 from tpu_cluster.render import manifests, operator_bundle  # noqa: E402
@@ -62,6 +62,14 @@ REQUEST_RATIO_TARGET = 3.0
 SPEEDUP_TARGET = 2.0
 READY_POLL_S = 0.2  # the poll arm's tick (production default is 1.0s —
                     # scaled down so the bench line lands in seconds)
+# The faults column's chaos timing unit: standard_fault_script(0.03) = a
+# 90 ms 503 burst with Retry-After from t=0 (the install always starts
+# inside it), two dropped connections at 90 ms, one apiserver flap at
+# 150 ms — overlapping the install at the default 5 ms RTT.
+FAULT_UNIT_S = 0.03
+# Retries under faults use a bench-scaled policy: same taxonomy, faster
+# clock (production default is base 0.1s / cap 5s).
+FAULT_RETRY = kubeapply.RetryPolicy(attempts=8, base_s=0.02, cap_s=0.3)
 
 
 def full_stack_groups(spec):
@@ -143,6 +151,28 @@ def readiness_arm(latency_s: float, watch: bool, objects: int = 4) -> dict:
         requests = len(api.log) - applied
     return {"mutation_to_ready_s": round(latency, 4),
             "requests": requests, "mode": stats["mode"]}
+
+
+def faults_arm(latency_s: float, watch: bool, faulted: bool) -> dict:
+    """One fresh full-bundle install, clean vs under the standard fault
+    script (503 burst + connection drops + one watch-invalidating flap),
+    in poll or watch readiness mode. Converging AT ALL is the contract —
+    an ApplyError here fails the bench loudly; wall/request/retry counts
+    quantify what the fault script cost."""
+    spec = specmod.default_spec()
+    groups = full_stack_groups(spec)
+    script = standard_fault_script(FAULT_UNIT_S) if faulted else None
+    with FakeApiServer(auto_ready=True, latency_s=latency_s,
+                       chaos=script) as api:
+        client = kubeapply.Client(api.url, retry=FAULT_RETRY)
+        t0 = time.monotonic()
+        kubeapply.apply_groups(client, groups, wait=True, stage_timeout=60,
+                               poll=0.05, max_inflight=8, watch_ready=watch)
+        wall = time.monotonic() - t0
+        client.close()
+        requests = len(api.log)
+    return {"wall_s": round(wall, 3), "requests": requests,
+            "retries": client.retries, "converged": True}
 
 
 def _operator_binary() -> str:
@@ -240,6 +270,16 @@ def main(argv=None) -> int:
                    max_inflight=args.max_inflight)
     ready_watch = readiness_arm(latency_s, watch=True)
     ready_poll = readiness_arm(latency_s, watch=False)
+    faults = {
+        "script": "503-burst+conn-drops+flap",
+        "unit_s": FAULT_UNIT_S,
+        "watch": {"clean": faults_arm(latency_s, watch=True, faulted=False),
+                  "faulted": faults_arm(latency_s, watch=True,
+                                        faulted=True)},
+        "poll": {"clean": faults_arm(latency_s, watch=False, faulted=False),
+                 "faulted": faults_arm(latency_s, watch=False,
+                                       faulted=True)},
+    }
 
     spec = specmod.default_spec()
     groups = full_stack_groups(spec)
@@ -263,6 +303,10 @@ def main(argv=None) -> int:
             "drift_watch": drift_arm(latency_s, watch=True),
             "drift_poll": drift_arm(latency_s, watch=False),
         },
+        # Robustness column: the full bundle under the standard fault
+        # script vs clean, both readiness modes — wall time, request
+        # count (retries cost requests), retry count.
+        "faults": faults,
     }
     print(json.dumps(doc, separators=(",", ":")))
 
@@ -286,6 +330,17 @@ def main(argv=None) -> int:
                   f"{ready_watch} did not beat poll arm {ready_poll}",
                   file=sys.stderr)
             return 1
+        # fault tolerance: both readiness modes must converge under the
+        # standard fault script, with the retries visible in the request
+        # count (a faulted rollout that made no extra requests means the
+        # script never fired — a silently-degraded gate)
+        for mode in ("watch", "poll"):
+            clean, faulted = faults[mode]["clean"], faults[mode]["faulted"]
+            if not (faulted["converged"] and faulted["retries"] > 0
+                    and faulted["requests"] >= clean["requests"]):
+                print(f"bench_rollout: FAIL — faulted {mode} arm "
+                      f"{faulted} vs clean {clean}", file=sys.stderr)
+                return 1
     return 0
 
 

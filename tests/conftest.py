@@ -14,6 +14,7 @@ from tpu_cluster.virtualmesh import force_virtual_cpu_mesh  # noqa: E402
 
 force_virtual_cpu_mesh(8)
 
+import shutil  # noqa: E402
 import subprocess  # noqa: E402
 
 import pytest  # noqa: E402
@@ -22,10 +23,63 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 NATIVE_DIR = os.path.join(REPO, "native")
 NATIVE_BUILD_DIR = os.path.join(NATIVE_DIR, "build")
 
+# C++ targets a bare g++ can build when the cmake/ninja toolchain is
+# absent (everything except tpud, which needs protoc for the kubelet
+# DevicePlugin proto) — enough for the operator / chaos / discovery /
+# exporter suites to run everywhere. Source lists mirror
+# native/CMakeLists.txt.
+_OPERATOR_CORE = ["operator/kubeapi.cc", "operator/kubeclient.cc",
+                  "operator/minijson.cc"]
+_GXX_TARGETS = {
+    "tpu-operator": ["operator/operator_main.cc"] + _OPERATOR_CORE,
+    "operator_selftest": ["operator/selftest.cc"] + _OPERATOR_CORE,
+    "tpu-tfd": ["discovery/tfd_main.cc", "plugin/topology.cc",
+                "common/devenum.cc"] + _OPERATOR_CORE,
+    "tpu-info": ["tpuinfo/tpu_info.cc", "plugin/topology.cc",
+                 "common/devenum.cc"],
+    "tpu-metrics-exporter": ["exporter/exporter.cc", "plugin/topology.cc",
+                             "common/devenum.cc"],
+    "grpcmin_selftest": ["grpcmin/selftest.cc", "grpcmin/hpack.cc",
+                         "grpcmin/h2.cc", "grpcmin/grpc.cc"],
+}
+_GXX_INCLUDES = ["operator", "common", "grpcmin", "plugin"]
+
+
+def _gxx_fallback_build() -> str:
+    """No cmake/ninja on this host (some driver containers): compile the
+    protobuf-free targets directly with g++ so the operator / chaos /
+    healthz / discovery / exporter suites still exercise REAL binaries.
+    tpud (and anything else needing protoc) is not built here — its tests
+    fail loudly on the missing binary, exactly as before."""
+    import glob
+    os.makedirs(NATIVE_BUILD_DIR, exist_ok=True)
+    incs = [f"-I{os.path.join(NATIVE_DIR, d)}" for d in _GXX_INCLUDES]
+    # headers count toward staleness too — a header-only edit (common for
+    # the operator's Config/taxonomy changes) must trigger a rebuild
+    headers = glob.glob(os.path.join(NATIVE_DIR, "**", "*.h"),
+                        recursive=True)
+    newest_header = max((os.path.getmtime(h) for h in headers), default=0)
+    for name, rel_srcs in _GXX_TARGETS.items():
+        srcs = [os.path.join(NATIVE_DIR, s) for s in rel_srcs]
+        out = os.path.join(NATIVE_BUILD_DIR, name)
+        newest = max(max(os.path.getmtime(s) for s in srcs), newest_header)
+        if os.path.exists(out) and os.path.getmtime(out) >= newest:
+            continue  # cached: sources unchanged since the last build
+        subprocess.run(
+            ["g++", "-std=c++17", "-O1", *incs, "-o", out, *srcs,
+             "-pthread"],
+            check=True, capture_output=True, timeout=600)
+    return NATIVE_BUILD_DIR
+
 
 @pytest.fixture(scope="session")
 def native_build():
-    """Configure+build the native tree once per test session (cached)."""
+    """Configure+build the native tree once per test session (cached).
+    Falls back to a direct g++ build of the operator targets when the
+    cmake/ninja toolchain is unavailable (CI always has it and builds the
+    full tree)."""
+    if shutil.which("cmake") is None or shutil.which("ninja") is None:
+        return _gxx_fallback_build()
     if not os.path.exists(os.path.join(NATIVE_BUILD_DIR, "build.ninja")):
         subprocess.run(
             ["cmake", "-S", NATIVE_DIR, "-B", NATIVE_BUILD_DIR, "-G", "Ninja"],

@@ -20,6 +20,7 @@ simulator; gating tests leave it off and flip readiness by hand via
 from __future__ import annotations
 
 import json
+import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -96,6 +97,132 @@ def _filter_selector(items, query: str):
     return out
 
 
+class ChaosEngine:
+    """Scripted fault injection for the fake apiserver — the promotion of
+    the old ad-hoc ``reject_posts``/``reject_watch`` hooks (which are now
+    translated into chaos faults at construction) into one timed,
+    composable fault machine.
+
+    A script is a list of fault dicts; per request, faults are evaluated
+    in script order and the first active match consumes it:
+
+      {"status": 503, "for": 0.3}                       # every matching
+                                                        # request 503s for
+                                                        # 0.3s from "at"
+      {"status": 429, "count": 3, "retry_after": 0.05}  # next 3 matching
+                                                        # requests 429 with
+                                                        # a Retry-After
+      {"drop": 2}                                       # next 2 matching
+                                                        # connections closed
+                                                        # without any reply
+      {"flap": True, "at": 0.5}                         # apiserver restart:
+                                                        # watch history
+                                                        # compacts, streams
+                                                        # are 410-invalidated
+                                                        # (FakeApiServer.flap)
+
+    Optional keys on any fault: ``at`` (seconds after start(), default 0),
+    ``match`` (path substring; ``exact: True`` for equality), ``method``
+    (exact HTTP method), ``watch`` (True = only ``?watch=1`` GETs),
+    ``body`` (override the injected Status body), ``retry_after``
+    (seconds, emitted as a Retry-After header — fractional allowed so
+    tests stay fast; real servers send integers). A status fault with
+    neither ``for`` nor ``count`` fires on every match until clear().
+    Every fired fault is recorded in ``fired`` for assertions."""
+
+    def __init__(self, script):
+        self._lock = threading.Lock()
+        self._faults = [dict(f) for f in script]
+        self._t0: Optional[float] = None
+        self._timers: List[threading.Timer] = []
+        self.fired: List[Tuple[Any, str, str]] = []  # (status|'drop', m, p)
+
+    def start(self, server: "FakeApiServer") -> None:
+        """Arm the script: the clock starts now, and flap faults schedule
+        their restart timers against ``server``."""
+        self._t0 = time.monotonic()
+        for f in self._faults:
+            if f.get("flap"):
+                t = threading.Timer(max(0.0, f.get("at", 0.0)), server.flap)
+                t.daemon = True
+                t.start()
+                self._timers.append(t)
+
+    def stop(self) -> None:
+        for t in self._timers:
+            t.cancel()
+        self._timers = []
+
+    def clear(self) -> None:
+        """End the chaos: pending faults (and un-fired flap timers) are
+        dropped — the 'apiserver recovered' test hook."""
+        self.stop()
+        with self._lock:
+            self._faults = []
+
+    def intercept(self, method: str, path: str, is_watch: bool):
+        """None (pass through) | ("drop",) | ("status", code, headers,
+        body) for one request."""
+        if self._t0 is None:
+            now = 0.0
+        else:
+            now = time.monotonic() - self._t0
+        with self._lock:
+            for f in self._faults:
+                if f.get("flap"):
+                    continue  # timer-driven, never per-request
+                at = f.get("at", 0.0)
+                if now < at:
+                    continue
+                dur = f.get("for")
+                if dur is not None and now >= at + dur:
+                    continue
+                if f.get("method") and f["method"] != method:
+                    continue
+                if f.get("watch") and not is_watch:
+                    continue
+                m = f.get("match")
+                if m and (path != m if f.get("exact") else m not in path):
+                    continue
+                if "drop" in f:
+                    left = f.setdefault("_left", f["drop"])
+                    if left <= 0:
+                        continue
+                    f["_left"] = left - 1
+                    self.fired.append(("drop", method, path))
+                    return ("drop",)
+                status = f.get("status")
+                if status is None:
+                    continue
+                if dur is None and "count" in f:
+                    left = f.setdefault("_left", f["count"])
+                    if left <= 0:
+                        continue
+                    f["_left"] = left - 1
+                headers = {}
+                if f.get("retry_after") is not None:
+                    headers["Retry-After"] = str(f["retry_after"])
+                body = f.get("body") or {
+                    "kind": "Status", "code": status, "reason": "Chaos",
+                    "message": "injected fault"}
+                self.fired.append((status, method, path))
+                return ("status", status, headers, body)
+        return None
+
+
+def standard_fault_script(unit: float = 0.05) -> List[Dict[str, Any]]:
+    """The 'standard' chaos script the soak test and bench share: a 503
+    burst with Retry-After from t=0, two dropped connections once it
+    clears, then one watch-invalidating apiserver flap. ``unit`` scales
+    every timing so the same shape runs as a fast tier-1 case or a long
+    soak."""
+    return [
+        {"at": 0.0, "for": 3 * unit, "status": 503, "retry_after": unit},
+        {"at": 3 * unit, "drop": 2},
+        {"at": 5 * unit, "flap": True},
+    ]
+
+
 def make_self_signed(tmp_dir) -> Tuple[str, str]:
     """Generate a 127.0.0.1 self-signed cert+key pair for TLS-mode tests."""
     import subprocess
@@ -121,14 +248,27 @@ class FakeApiServer:
     whose GET lies 404 while the object IS stored — the stale-read window
     after a bounce/HA failover, where a client's create races the object's
     existence and must handle POST -> 409 AlreadyExists by patching;
-    the window clears after the first ghosted read."""
+    the window clears after the first ghosted read.
+
+    Fault injection: ``chaos`` takes a scripted fault schedule (see
+    :class:`ChaosEngine` for the format) armed when the server starts.
+    ``reject_posts`` (exact collection path -> status for its POSTs: RBAC
+    denial / admission-webhook rejection) and ``reject_watch`` (exact path
+    -> status for its ``?watch=1`` GETs: RBAC without the watch verb) are
+    legacy sugar, translated into unlimited chaos faults at construction.
+    ``watch_gone_once`` lists paths whose NEXT watch emits an ERROR/410
+    event and ends — the compacted-history window a real apiserver reports
+    when the client's resourceVersion fell off the end of etcd history;
+    ``flap()`` (or a ``{"flap": True}`` fault) simulates a full apiserver
+    restart, 410-invalidating every in-flight watch AND every pre-restart
+    resourceVersion."""
 
     def __init__(self, auto_ready: bool = True, tls=None, port: int = 0,
                  store: Optional[Dict[str, Dict[str, Any]]] = None,
                  ghost_get_404=(), reject_posts: Optional[Dict[str, int]] = None,
                  latency_s: float = 0.0,
                  reject_watch: Optional[Dict[str, int]] = None,
-                 watch_gone_once=()):
+                 watch_gone_once=(), chaos=None):
         self.auto_ready = auto_ready
         # Injected per-request service time (scripts/bench_rollout.py and
         # the shared-watcher tests): slept before EVERY handled request, on
@@ -138,16 +278,21 @@ class FakeApiServer:
         self._tls = tls
         self.store: Dict[str, Dict[str, Any]] = dict(store or {})
         self.ghost_get_404 = set(ghost_get_404)
-        # exact collection path -> HTTP status: force POST failures (RBAC
-        # denial / admission-webhook rejection simulation)
-        self.reject_posts: Dict[str, int] = dict(reject_posts or {})
-        # Watch fault hooks (degradation-path tests): ``reject_watch`` maps
-        # a path to an HTTP status its `?watch=1` GET answers with (403 =
-        # RBAC lacking the watch verb); ``watch_gone_once`` lists paths
-        # whose NEXT watch emits an ERROR/410 event and ends — the
-        # compacted-history window a real apiserver reports when the
-        # client's resourceVersion fell off the end of etcd history.
-        self.reject_watch: Dict[str, int] = dict(reject_watch or {})
+        faults: List[Dict[str, Any]] = []
+        for path, rc in (reject_posts or {}).items():
+            faults.append({"status": rc, "method": "POST", "match": path,
+                           "exact": True,
+                           "body": {"kind": "Status", "code": rc,
+                                    "reason": "Forbidden"}})
+        for path, rc in (reject_watch or {}).items():
+            faults.append({"status": rc, "watch": True, "match": path,
+                           "exact": True,
+                           "body": {"kind": "Status", "code": rc,
+                                    "reason": "Forbidden"}})
+        if chaos is not None:
+            faults.extend(chaos)
+        self.chaos: Optional[ChaosEngine] = (
+            ChaosEngine(faults) if faults else None)
         self.watch_gone_once = set(watch_gone_once)
         self.log: List[Tuple[str, str]] = []  # (method, path)
         self.created: List[str] = []          # stored object paths, in order
@@ -162,6 +307,9 @@ class FakeApiServer:
         self._changed = threading.Condition(self._lock)
         self._rev = 0
         self._changes: List[Tuple[int, str]] = []  # (rev, path)
+        # bumped by flap(): streams opened under an older epoch end with
+        # ERROR/410 — "the apiserver you were watching restarted"
+        self._flap_epoch = 0
 
         fake = self
 
@@ -191,6 +339,39 @@ class FakeApiServer:
                     fake.log.append((self.command, self.path))
                     fake.headers_seen.append(dict(self.headers))
 
+            def _chaos(self, is_watch: bool = False) -> bool:
+                """True when a scripted fault consumed this request —
+                either an injected status reply was sent, or the
+                connection was dropped without one. Must be called AFTER
+                the request body has been drained (an unread body would
+                be parsed as the next keep-alive request)."""
+                if fake.chaos is None:
+                    return False
+                path = self.path.partition("?")[0]
+                act = fake.chaos.intercept(self.command, path, is_watch)
+                if act is None:
+                    return False
+                if act[0] == "drop":
+                    # half-close the socket with no reply: the client sees
+                    # the connection die mid-request (RemoteDisconnected /
+                    # reset), i.e. transport status 0
+                    self.close_connection = True
+                    try:
+                        self.connection.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    return True
+                _, status, headers, body = act
+                payload = json.dumps(body).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                for k, v in headers.items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+                return True
+
             def _serve_watch(self, path: str, q: Dict[str, list]):
                 """`?watch=1` long-poll: stream newline-delimited watch
                 events for mutations at/under ``path`` until timeoutSeconds
@@ -203,13 +384,10 @@ class FakeApiServer:
                 from a LIST's resourceVersion. An RV older than the
                 retained change history — or a path armed via the
                 ``watch_gone_once`` fault hook — answers with a single
-                ERROR/410 event and ends: the client must re-LIST and
-                re-watch (real apiserver compaction semantics)."""
-                rc = fake.reject_watch.get(path)
-                if rc:
-                    self._reply(rc, {"kind": "Status", "code": rc,
-                                     "reason": "Forbidden"})
-                    return
+                ERROR/410 event and ends; a flap() ("apiserver restart")
+                while the stream is open does the same mid-stream: the
+                client must re-LIST and re-watch (real apiserver
+                compaction semantics)."""
                 try:
                     timeout_s = float(q.get("timeoutSeconds", ["30"])[0])
                 except ValueError:
@@ -220,8 +398,19 @@ class FakeApiServer:
                 self.send_header("Connection", "close")
                 self.end_headers()
                 self.close_connection = True
+                def send_gone():
+                    ev = {"type": "ERROR",
+                          "object": {"kind": "Status", "code": 410,
+                                     "reason": "Expired"}}
+                    try:
+                        self.wfile.write((json.dumps(ev) + "\n").encode())
+                        self.wfile.flush()
+                    except (BrokenPipeError, ConnectionResetError):
+                        pass
+
                 gone = False
                 with fake._lock:
+                    epoch = fake._flap_epoch
                     if path in fake.watch_gone_once:
                         fake.watch_gone_once.discard(path)
                         gone = True
@@ -239,32 +428,37 @@ class FakeApiServer:
                         else:
                             last_rev = start
                 if gone:
-                    ev = {"type": "ERROR",
-                          "object": {"kind": "Status", "code": 410,
-                                     "reason": "Expired"}}
-                    try:
-                        self.wfile.write((json.dumps(ev) + "\n").encode())
-                        self.wfile.flush()
-                    except (BrokenPipeError, ConnectionResetError):
-                        pass
+                    send_gone()
                     return
                 try:
                     while True:
                         with fake._changed:
-                            while fake._rev == last_rev:
+                            while fake._rev == last_rev \
+                                    and fake._flap_epoch == epoch:
                                 remaining = deadline - time.monotonic()
                                 if remaining <= 0:
                                     return  # clean end of the watch window
                                 fake._changed.wait(min(remaining, 1.0))
-                            touched = [p for r, p in fake._changes
-                                       if r > last_rev
-                                       and (p == path
-                                            or p.startswith(path + "/"))]
-                            last_rev = fake._rev
-                            events = [(p, json.loads(json.dumps(
-                                           fake.store[p]))
-                                       if p in fake.store else None)
-                                      for p in touched]
+                            if fake._flap_epoch != epoch:
+                                # the "apiserver" restarted under this
+                                # stream: its history is gone — invalidate
+                                # so the client re-LISTs and re-watches
+                                invalidated = True
+                                events = []
+                            else:
+                                invalidated = False
+                                touched = [p for r, p in fake._changes
+                                           if r > last_rev
+                                           and (p == path
+                                                or p.startswith(path + "/"))]
+                                last_rev = fake._rev
+                                events = [(p, json.loads(json.dumps(
+                                               fake.store[p]))
+                                           if p in fake.store else None)
+                                          for p in touched]
+                        if invalidated:
+                            send_gone()
+                            return
                         for p, obj in events:
                             if obj is None:
                                 ev = {"type": "DELETED",
@@ -282,7 +476,10 @@ class FakeApiServer:
                 self._record()
                 path, _, query = self.path.partition("?")
                 q = parse_qs(query)
-                if q.get("watch", ["0"])[0] in ("1", "true"):
+                is_watch = q.get("watch", ["0"])[0] in ("1", "true")
+                if self._chaos(is_watch):
+                    return
+                if is_watch:
                     self._serve_watch(path, q)
                     return
                 with fake._lock:
@@ -316,10 +513,7 @@ class FakeApiServer:
             def do_POST(self):
                 self._record()
                 obj = self._body()
-                rc = fake.reject_posts.get(self.path)
-                if rc:
-                    self._reply(rc, {"kind": "Status", "code": rc,
-                                     "reason": "Forbidden"})
+                if self._chaos():
                     return
                 name = (obj or {}).get("metadata", {}).get("name")
                 if not name:
@@ -363,6 +557,8 @@ class FakeApiServer:
             def do_PUT(self):
                 self._record()
                 obj = self._body()
+                if self._chaos():
+                    return
                 with fake._lock:
                     existed = self.path in fake.store
                     fake.store[self.path] = obj
@@ -372,6 +568,8 @@ class FakeApiServer:
             def do_PATCH(self):
                 self._record()
                 patch = self._body()
+                if self._chaos():
+                    return
                 # Status subresource: PATCH <object>/status applies only the
                 # patch's status field to the parent object and never bumps
                 # metadata.generation (real-apiserver semantics; the
@@ -422,6 +620,8 @@ class FakeApiServer:
 
             def do_DELETE(self):
                 self._record()
+                if self._chaos():
+                    return
                 with fake._lock:
                     gone = fake.store.pop(self.path, None)
                     if gone is not None:
@@ -451,9 +651,13 @@ class FakeApiServer:
 
     def start(self) -> "FakeApiServer":
         self._thread.start()
+        if self.chaos is not None:
+            self.chaos.start(self)  # the fault clock runs from serve time
         return self
 
     def stop(self):
+        if self.chaos is not None:
+            self.chaos.stop()
         self._server.shutdown()
         self._server.server_close()
 
@@ -491,6 +695,19 @@ class FakeApiServer:
         notifications)."""
         with self._lock:
             self._note_change(path)
+
+    def flap(self) -> None:
+        """Simulate an apiserver restart: the change history compacts (a
+        watch resumed from any pre-flap resourceVersion gets ERROR/410)
+        and every in-flight watch stream is invalidated with ERROR/410 —
+        clients must re-LIST and re-watch. The store itself survives (etcd
+        outlived the restart), and the revision counter jumps the way a
+        restarted apiserver's resourceVersions do."""
+        with self._lock:
+            self._rev += 1000
+            self._changes.clear()
+            self._flap_epoch += 1
+            self._changed.notify_all()
 
     # ------------------------------------------------------------- test hooks
 

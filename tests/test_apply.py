@@ -314,6 +314,68 @@ def test_apply_kubectl_backend_empty_daemonset_guard(spec):
     assert result.actions
 
 
+def test_apply_kubectl_rc124_timeout_is_retryable(spec):
+    """Satellite bugfix: kubectl_runner's kill path returns rc=124
+    ('kubectl killed after Ns') — a slow/flapping apiserver, not a
+    rejected manifest. The group apply must RETRY it under the policy
+    instead of failing the rollout on the first timeout."""
+    calls = []
+
+    def kubectl_times_out_once(argv, input_text=None):
+        calls.append(list(argv))
+        if argv[:2] == ["kubectl", "apply"]:
+            applies = [c for c in calls if c[:2] == ["kubectl", "apply"]]
+            if len(applies) == 1:
+                return 124, "", "kubectl killed after 30s"
+        if argv[1] == "get":
+            return 0, json.dumps({"kind": "DaemonSet", "status": {
+                "desiredNumberScheduled": 2, "numberReady": 2}}), ""
+        return 0, "ok", ""
+
+    groups = manifests.rollout_groups(spec)
+    result = kubeapply.apply_groups_kubectl(
+        groups, wait=True, stage_timeout=30, runner=kubectl_times_out_once,
+        retry=kubeapply.RetryPolicy(attempts=3, base_s=0.01))
+    applies = [c for c in calls if c[:2] == ["kubectl", "apply"]]
+    # group 1 was applied twice (timeout + retry), later groups once
+    assert len(applies) == len(groups) + 1
+    assert len(result.actions) == sum(len(g) for g in groups)
+
+
+def test_apply_kubectl_rc124_persistent_timeout_is_terminal(spec):
+    """...but a timeout that persists across every attempt still fails
+    loudly, naming the exhausted retries."""
+    def kubectl_always_times_out(argv, input_text=None):
+        if argv[:2] == ["kubectl", "apply"]:
+            return 124, "", "kubectl killed after 30s"
+        return 0, "ok", ""
+
+    with pytest.raises(kubeapply.ApplyError,
+                       match="retryable timeout persisted"):
+        kubeapply.apply_groups_kubectl(
+            manifests.rollout_groups(spec), wait=True,
+            runner=kubectl_always_times_out,
+            retry=kubeapply.RetryPolicy(attempts=2, base_s=0.01))
+
+
+def test_apply_kubectl_other_nonzero_rc_not_retried(spec):
+    """rc=1 (rejected manifest / RBAC) is terminal: exactly one apply
+    attempt, no retry loop delaying the real error."""
+    calls = []
+
+    def kubectl_rejects(argv, input_text=None):
+        calls.append(list(argv))
+        return (1, "", "error: forbidden") \
+            if argv[:2] == ["kubectl", "apply"] else (0, "ok", "")
+
+    with pytest.raises(kubeapply.ApplyError, match="forbidden"):
+        kubeapply.apply_groups_kubectl(
+            manifests.rollout_groups(spec), wait=True,
+            runner=kubectl_rejects,
+            retry=kubeapply.RetryPolicy(attempts=3, base_s=0.01))
+    assert len([c for c in calls if c[:2] == ["kubectl", "apply"]]) == 1
+
+
 def test_operator_install_crd_waves_and_rest_establishment(spec):
     """The TpuStackPolicy CR must trail its CRD's establishment: waves put
     the CRD in group 1 and the CR in group 2, and the REST backend polls

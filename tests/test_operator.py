@@ -287,6 +287,62 @@ def test_healthz_gates_on_first_convergence(native_build, bundle_dir):
             op.wait(timeout=10)
 
 
+def test_healthz_reports_degraded_detail_and_recovers(native_build,
+                                                      bundle_dir):
+    """A flapping apiserver must be VISIBLE, not silent: while passes
+    fail, /healthz carries the consecutive-failure count and the last
+    error (naming the status that caused it), /metrics gains the
+    tpu_operator_consecutive_failures gauge, and when the chaos clears
+    the surface recovers to 200 ok."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    # every DaemonSet POST 503s: stage 10 fails each pass (GETs are fine,
+    # so the operator sees a live-but-degraded apiserver, the chaos class
+    # the kubeclient retries are for — capped, so the pass still fails)
+    chaos = [{"status": 503, "method": "POST", "match": "/daemonsets"}]
+    with FakeApiServer(auto_ready=True, chaos=chaos) as api:
+        op = start_operator(
+            native_build, f"--apiserver={api.url}",
+            f"--bundle-dir={bundle_dir}", "--interval=1", "--poll-ms=20",
+            "--stage-timeout=2", f"--status-port={port}")
+        try:
+            def healthz():
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{port}/healthz",
+                            timeout=1) as r:
+                        return r.status, r.read().decode()
+                except urllib.error.HTTPError as exc:
+                    return exc.code, exc.read().decode()
+                except (urllib.error.URLError, OSError):
+                    return 0, ""
+
+            def degraded():
+                code, body = healthz()
+                return (code == 503 and "consecutive failure" in body
+                        and "503" in body)
+
+            assert wait_until(degraded, timeout=20), healthz()
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=1) as r:
+                metrics = r.read().decode()
+            assert "tpu_operator_consecutive_failures" in metrics
+            assert "tpu_operator_consecutive_failures 0" not in metrics
+            # the apiserver recovers: the next pass converges and the
+            # degraded surface resets — no operator restart needed
+            api.chaos.clear()
+            assert wait_until(lambda: healthz() == (200, "ok\n"),
+                              timeout=30), healthz()
+        finally:
+            op.send_signal(signal.SIGTERM)
+            op.wait(timeout=10)
+        stderr = op.stderr.read()
+        assert "503" in stderr  # the failing POST was loud in the log too
+
+
 def test_operator_https_curl_transport(native_build, bundle_dir, tmp_path):
     """The in-cluster transport for real: HTTPS apiserver, CA verification,
     bearer token via curl header file (never argv) — the full CurlHttps

@@ -6,9 +6,18 @@ collection-per-tick contract, keep-alive transport reuse (and its stale-
 socket retry), skip-unchanged re-applies, ClusterSnapshot parity with the
 per-check canned-runner results, and the bench_rollout JSON line the tier-1
 flow records.
+
+Plus the robustness layer (PR 3): the RetryPolicy failure taxonomy (one
+fast case per fault class — 429+Retry-After, 503 burst, connection drops,
+watch-invalidating flap — against the scripted chaos engine), the rollout
+journal's `--resume` semantics including a real mid-rollout SIGKILL, and a
+chaos soak asserting the full bundle converges under the standard fault
+script with zero manual intervention (slow-marked long variant included).
 """
 
 import json
+import os
+import signal
 import subprocess
 import sys
 import threading
@@ -16,12 +25,16 @@ import time
 
 import pytest
 
-from fake_apiserver import FakeApiServer
+from fake_apiserver import FakeApiServer, standard_fault_script
 from tpu_cluster import kubeapply, spec as specmod, verify
 from tpu_cluster.render import manifests, operator_bundle
 
 NS = "tpu-system"
 DS_COLL = f"/apis/apps/v1/namespaces/{NS}/daemonsets"
+
+# Bench-speed retry policy for fault tests: same taxonomy as production,
+# faster clock (the chaos windows are tens of milliseconds).
+FAST_RETRY = kubeapply.RetryPolicy(attempts=8, base_s=0.02, cap_s=0.3)
 
 
 @pytest.fixture()
@@ -462,6 +475,414 @@ def test_snapshot_single_fetch_under_concurrent_askers():
     assert len(calls) == 1 and snapshot.fetches == 1
 
 
+# ------------------------------------------------------------ failure taxonomy
+
+
+def test_retry_policy_classification_and_backoff():
+    """The taxonomy table every path converges through: 429/5xx/transport
+    retryable, 409 conflict (semantic re-GET-then-PATCH, never blind),
+    other 4xx terminal — and backoff honors Retry-After clamped to the
+    cap, else grows exponentially to the cap."""
+    p = kubeapply.RetryPolicy(attempts=4, base_s=0.1, cap_s=1.0, jitter=0.0)
+    for status in (0, 429, 500, 502, 503, 504):
+        assert p.classify(status) == "retryable", status
+    assert p.classify(409) == "conflict"
+    for status in (400, 401, 403, 404, 410, 422):
+        assert p.classify(status) == "terminal", status
+    for status in (200, 201, 202):
+        assert p.classify(status) == "ok", status
+    assert p.backoff_s(1) == pytest.approx(0.1)
+    assert p.backoff_s(2) == pytest.approx(0.2)
+    assert p.backoff_s(5) == pytest.approx(1.0)  # capped
+    assert p.backoff_s(1, retry_after=0.5) == pytest.approx(0.5)
+    assert p.backoff_s(1, retry_after=30.0) == pytest.approx(1.0)  # clamped
+
+
+def test_429_with_retry_after_honored_and_converges():
+    """Client-side throttling: the next 2 POSTs answer 429 with a
+    fractional Retry-After; the apply must wait it out (not hammer), then
+    converge — and the retry count must be visible on the client."""
+    obj = daemonset("ds-429")
+    chaos = [{"status": 429, "count": 2, "retry_after": 0.05,
+              "method": "POST"}]
+    with FakeApiServer(auto_ready=True, chaos=chaos) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY)
+        t0 = time.monotonic()
+        assert client.apply(obj) == "created"
+        elapsed = time.monotonic() - t0
+        assert client.retries == 2
+        posts = [p for m, p in api.log if m == "POST"]
+        assert len(posts) == 3  # 2 throttled + the one that landed
+        # both honored Retry-Afters were actually slept (sleep(0.05) x 2)
+        assert elapsed >= 0.09, elapsed
+        assert api.get(kubeapply.object_path(obj)) is not None
+        client.close()
+
+
+def test_503_burst_converges_and_terminal_403_does_not_retry():
+    """A 503-for-duration outage at rollout start is absorbed by backoff
+    (full operator bundle, pipelined) — while a terminal 403 fails
+    immediately with ZERO retries: retrying an RBAC denial only delays
+    the real error."""
+    spec = specmod.default_spec()
+    groups = operator_bundle.operator_install_groups(spec)
+    chaos = [{"at": 0.0, "for": 0.1, "status": 503}]
+    with FakeApiServer(auto_ready=True, chaos=chaos) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY)
+        kubeapply.apply_groups(client, groups, wait=True, stage_timeout=30,
+                               poll=0.02, max_inflight=8)
+        assert client.retries > 0
+        assert api.get(f"/api/v1/namespaces/{NS}") is not None
+        client.close()
+    deny = {"status": 403, "method": "POST"}
+    with FakeApiServer(auto_ready=True, chaos=[deny]) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY)
+        with pytest.raises(kubeapply.ApplyError, match="403"):
+            client.apply(daemonset("ds-403"))
+        assert client.retries == 0
+        assert len([1 for m, _ in api.log if m == "POST"]) == 1
+        client.close()
+
+
+def test_connection_drops_absorbed_by_retry():
+    """drop-next-N-connections: the server kills the socket without a
+    reply mid-rollout; the stale-socket fast retry plus the status-0
+    policy retry must converge the apply without surfacing an error."""
+    chaos = [{"drop": 3}]
+    with FakeApiServer(auto_ready=True, chaos=chaos) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY)
+        for i in range(3):
+            kubeapply.apply_groups(
+                client, [[daemonset(f"ds-drop-{i}")]], wait=True,
+                stage_timeout=10, poll=0.02)
+        assert len(api.paths("ds-drop-")) == 3
+        assert api.chaos.fired, "the drop faults never fired"
+        client.close()
+
+
+def test_watch_invalidating_flap_relists_and_rewatches():
+    """An apiserver restart (flap) mid-watch: every stream gets ERROR/410
+    and pre-flap resourceVersions are compacted away — the watch-mode
+    waiter must re-LIST + re-watch and still converge as a WATCH, not
+    degrade to polling, not hang."""
+    obj = daemonset("ds-flap")
+    with FakeApiServer(auto_ready=False) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY)
+        client.apply(obj)
+        applied = len(api.log)
+        stats, done = {}, []
+        t = threading.Thread(
+            target=lambda: (client.wait_ready([obj], timeout=10, poll=0.02,
+                                              watch=True, stats=stats),
+                            done.append(True)),
+            daemon=True)
+        t.start()
+        time.sleep(0.25)  # the stream is up and idle
+        api.flap()        # restart: history gone, stream 410-invalidated
+        time.sleep(0.15)
+        api.set_ready(kubeapply.object_path(obj))
+        t.join(timeout=5)
+        assert done, "watch did not converge across the flap"
+        assert stats["mode"] == "watch"  # re-watched, never fell to poll
+        paths = [p for _, p in api.log[applied:]]
+        assert len([p for p in paths if p == DS_COLL]) >= 2  # re-LIST
+        assert len([p for p in paths
+                    if p.startswith(DS_COLL + "?watch=1")]) >= 2  # re-watch
+        client.close()
+
+
+def test_watch_open_transport_failure_retries_before_degrading():
+    """A retryable watch-open failure (here: dropped connections) must
+    re-open the stream with backoff instead of abandoning watch mode —
+    the poll loop it would degrade to hits the same flaky server."""
+    obj = daemonset("ds-wdrop")
+    chaos = [{"drop": 1, "watch": True}]
+    with FakeApiServer(auto_ready=False, chaos=chaos) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY)
+        client.apply(obj)
+        stats, done = {}, []
+        t = threading.Thread(
+            target=lambda: (client.wait_ready([obj], timeout=10, poll=0.02,
+                                              watch=True, stats=stats),
+                            done.append(True)),
+            daemon=True)
+        t.start()
+        time.sleep(0.3)
+        api.set_ready(kubeapply.object_path(obj))
+        t.join(timeout=5)
+        assert done
+        assert stats["mode"] == "watch", stats
+        client.close()
+
+
+def test_transport_error_preserves_exception_class():
+    """Satellite bugfix: status-0 errors must carry the exception class
+    (and errno when present), and wait_ready's timeout hint must name it —
+    'connection refused for 300s' is a different triage path than a TLS
+    failure."""
+    # 127.0.0.1:9 (discard) is reliably closed: immediate ECONNREFUSED
+    client = kubeapply.Client("http://127.0.0.1:9", timeout=0.5,
+                              retry=kubeapply.NO_RETRY)
+    code, body = client.get("/api/v1/namespaces/x")
+    assert code == 0
+    assert body["errorClass"] == "ConnectionRefusedError", body
+    assert "ConnectionRefusedError" in body["message"]
+    assert body.get("errno") is not None
+    with pytest.raises(kubeapply.ApplyError,
+                       match="ConnectionRefusedError"):
+        client.wait_ready([daemonset("ds-refused")], timeout=0.2, poll=0.05)
+    client.close()
+    # the one-shot transport preserves the class the same way
+    oneshot = kubeapply.Client("http://127.0.0.1:9", timeout=0.5,
+                               keep_alive=False, retry=kubeapply.NO_RETRY)
+    code, body = oneshot.get("/x")
+    assert code == 0 and body["errorClass"] == "ConnectionRefusedError"
+
+
+def test_crd_timeout_names_last_error():
+    """wait_crd_established's timeout must distinguish 'the apiserver kept
+    failing' from 'the CRD never Established'."""
+    with FakeApiServer(auto_ready=True,
+                       chaos=[{"status": 503, "method": "GET"}]) as api:
+        client = kubeapply.Client(
+            api.url, retry=kubeapply.RetryPolicy(attempts=2, base_s=0.01))
+        with pytest.raises(kubeapply.ApplyError, match="last error.*503"):
+            client.wait_crd_established("x.tpu-stack.dev", timeout=0.15,
+                                        poll=0.02)
+        client.close()
+
+
+# ------------------------------------------------------------ rollout journal
+
+
+def test_journal_resume_skips_converged_groups_entirely(spec, tmp_path):
+    """A journal from a fully-converged rollout makes the re-run free:
+    every group skipped, ZERO apiserver requests."""
+    jpath = str(tmp_path / "rollout.journal")
+    groups = operator_bundle.operator_install_groups(spec)
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        with kubeapply.RolloutJournal(jpath, groups) as journal:
+            kubeapply.apply_groups(client, groups, wait=True,
+                                   stage_timeout=10, poll=0.02,
+                                   journal=journal)
+        before = len(api.log)
+        with kubeapply.RolloutJournal(jpath, groups,
+                                      resume=True) as journal:
+            assert journal.resumed
+            result = kubeapply.apply_groups(client, groups, wait=True,
+                                            stage_timeout=10, poll=0.02,
+                                            journal=journal)
+        assert len(api.log) == before, api.log[before:]
+        assert result.actions == []
+        client.close()
+
+
+def test_journal_fingerprint_mismatch_starts_fresh(spec, tmp_path):
+    """A journal recorded for a DIFFERENT rendered bundle must be
+    discarded on resume — honoring it would skip work that never
+    happened."""
+    jpath = str(tmp_path / "rollout.journal")
+    groups = operator_bundle.operator_install_groups(spec)
+    with kubeapply.RolloutJournal(jpath, groups) as journal:
+        journal.group_done(0)
+    other = [[daemonset("ds-other")]]
+    resumed = kubeapply.RolloutJournal(jpath, other, resume=True)
+    assert not resumed.resumed
+    assert not resumed.is_group_done(0)
+    resumed.close()
+    # the mismatch rewrote the journal for the NEW bundle: a later resume
+    # of that bundle honors it (and the old bundle's record is gone)
+    again = kubeapply.RolloutJournal(jpath, other, resume=True)
+    assert again.resumed and not again.is_group_done(0)
+    again.close()
+
+
+def test_journal_survives_torn_tail(spec, tmp_path):
+    """A SIGKILL mid-append leaves a torn last line; the journal must keep
+    the intact prefix instead of discarding the whole file — and the
+    resume's own writes must not weld onto the torn tail (the file is
+    rewritten clean), so a SECOND resume still sees everything."""
+    jpath = str(tmp_path / "rollout.journal")
+    groups = operator_bundle.operator_install_groups(spec)
+    with kubeapply.RolloutJournal(jpath, groups) as journal:
+        journal.group_done(0)
+    with open(jpath, "a", encoding="utf-8") as f:
+        f.write('{"group": 1')  # torn mid-write
+    resumed = kubeapply.RolloutJournal(jpath, groups, resume=True)
+    assert resumed.resumed
+    assert resumed.is_group_done(0) and not resumed.is_group_done(1)
+    resumed.group_done(1)  # would corrupt if appended after the torn tail
+    resumed.close()
+    again = kubeapply.RolloutJournal(jpath, groups, resume=True)
+    assert again.resumed
+    assert again.is_group_done(0) and again.is_group_done(1)
+    again.close()
+
+
+def test_journal_same_object_in_two_groups_applies_twice(tmp_path):
+    """Object records are per-group: a bundle that applies the same
+    kind/ns/name in two groups (bootstrap config early, final config
+    late) must apply BOTH even under --journal — a globally-keyed skip
+    would leave the bootstrap values live while reporting converged."""
+    early = {"apiVersion": "v1", "kind": "ConfigMap",
+             "metadata": {"name": "cfg", "namespace": NS},
+             "data": {"phase": "bootstrap"}}
+    late = dict(early, data={"phase": "final"})
+    groups = [[early], [late]]
+    jpath = str(tmp_path / "rollout.journal")
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        with kubeapply.RolloutJournal(jpath, groups) as journal:
+            result = kubeapply.apply_groups(client, groups, wait=True,
+                                            stage_timeout=10, poll=0.02,
+                                            journal=journal)
+        assert not any(a.startswith("journaled") for a in result.actions)
+        live = api.get(f"/api/v1/namespaces/{NS}/configmaps/cfg")
+        assert live["data"] == {"phase": "final"}
+        client.close()
+
+
+def test_journal_wait_false_groups_not_marked_converged(spec, tmp_path):
+    """wait=False submits without gating readiness — those groups must
+    NOT be journaled complete, so a later --resume --wait still runs the
+    gate (objects stay journaled: the resume re-sends nothing)."""
+    jpath = str(tmp_path / "rollout.journal")
+    groups = [[daemonset("ds-nowait")]]
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        with kubeapply.RolloutJournal(jpath, groups) as journal:
+            kubeapply.apply_groups(client, groups, wait=False,
+                                   stage_timeout=10, poll=0.02,
+                                   journal=journal)
+            assert not journal.is_group_done(0)
+        before = len(api.log)
+        with kubeapply.RolloutJournal(jpath, groups,
+                                      resume=True) as journal:
+            kubeapply.apply_groups(client, groups, wait=True,
+                                   stage_timeout=10, poll=0.02,
+                                   journal=journal)
+            assert journal.is_group_done(0)
+        waits = api.log[before:]
+        # no re-apply (object journaled), but readiness WAS gated
+        assert all(m == "GET" for m, _ in waits) and waits, waits
+        client.close()
+
+
+def test_resume_after_sigkill_reapplies_only_unfinished_groups(tmp_path):
+    """THE acceptance case: `tpuctl apply --journal` SIGKILL'd mid-rollout
+    (group 0 converged, group 1 applied but blocked on readiness), then
+    `tpuctl apply --resume` — the fake apiserver's request log must show
+    ZERO mutations on resume (group 0 skipped as a group; group 1's
+    already-applied objects skipped by the object journal) and only the
+    readiness re-gate touching the apiserver."""
+    jpath = str(tmp_path / "rollout.journal")
+    crd_path = ("/apis/apiextensions.k8s.io/v1/customresourcedefinitions/"
+                "tpustackpolicies.tpu-stack.dev")
+    dep_path = f"/apis/apps/v1/namespaces/{NS}/deployments/tpu-operator"
+    with FakeApiServer(auto_ready=False) as api:
+        stop = []
+
+        def establish_crd():
+            # stand in for the apiserver's CRD controller: Establish the
+            # CRD when it appears (auto_ready is off so readiness gating
+            # is under the test's control)
+            while not stop:
+                if api.get(crd_path) is not None:
+                    api.set_ready(crd_path)
+                    return
+                time.sleep(0.02)
+
+        t = threading.Thread(target=establish_crd, daemon=True)
+        t.start()
+        cmd = [sys.executable, "-m", "tpu_cluster", "apply",
+               "--apiserver", api.url, "--operator", "--journal", jpath,
+               "--poll", "0.05", "--stage-timeout", "60"]
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, cwd=os.path.dirname(
+                                    os.path.dirname(
+                                        os.path.abspath(__file__))))
+        try:
+            # wait until group 0 is journaled converged AND group 1's
+            # objects (incl. the Deployment) are applied — the rollout is
+            # now blocked in group 1's readiness wait
+            deadline = time.monotonic() + 30
+            def journaled_group0():
+                try:
+                    with open(jpath, encoding="utf-8") as f:
+                        return any(json.loads(l).get("group") == 0
+                                   for l in f if l.strip())
+                except (OSError, ValueError):
+                    return False
+            while time.monotonic() < deadline and not (
+                    journaled_group0() and api.get(dep_path) is not None):
+                time.sleep(0.02)
+            assert journaled_group0() and api.get(dep_path) is not None
+            proc.send_signal(signal.SIGKILL)  # mid-rollout crash
+            proc.wait(timeout=10)
+        finally:
+            stop.append(True)
+            if proc.poll() is None:
+                proc.kill()
+        mark = len(api.log)
+        api.set_ready(dep_path)  # the Deployment comes up while we're down
+        resumed = subprocess.run(
+            cmd + ["--resume"], capture_output=True, text=True, timeout=60,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        assert resumed.returncode == 0, resumed.stdout + resumed.stderr
+        assert "resuming from journal" in resumed.stdout
+        assert "apply: converged" in resumed.stdout
+        after = api.log[mark:]
+        mutations = [(m, p) for m, p in after
+                     if m in ("POST", "PATCH", "PUT", "DELETE")]
+        assert mutations == [], mutations  # nothing re-applied
+        # and nothing from converged group 0 was even read
+        for frag in ("clusterroles", "serviceaccounts",
+                     "customresourcedefinitions", "/api/v1/namespaces/"):
+            assert not any(frag in p for _, p in after), (frag, after)
+
+
+# ------------------------------------------------------------ chaos soak
+
+
+def _chaos_soak(unit: float, latency_s: float) -> None:
+    """Full operator+operand bundle, watch-mode pipelined rollout, under
+    the standard fault script (503 burst with Retry-After + connection
+    drops + one watch-invalidating flap): must converge with no manual
+    intervention, to the same store a clean rollout produces."""
+    spec = specmod.default_spec()
+    groups = (list(operator_bundle.operator_install_groups(spec))
+              + list(manifests.rollout_groups(spec)))
+    with FakeApiServer(auto_ready=True) as clean_api:
+        client = kubeapply.Client(clean_api.url)
+        kubeapply.apply_groups(client, groups, wait=True, stage_timeout=60,
+                               poll=0.02, max_inflight=8)
+        client.close()
+        clean_store = set(clean_api.snapshot())
+    with FakeApiServer(auto_ready=True, latency_s=latency_s,
+                       chaos=standard_fault_script(unit)) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY)
+        kubeapply.apply_groups(client, groups, wait=True, stage_timeout=60,
+                               poll=0.02, max_inflight=8, watch_ready=True)
+        assert client.retries > 0, "the fault script never fired"
+        assert api.chaos.fired
+        assert set(api.snapshot()) == clean_store
+        client.close()
+
+
+def test_chaos_soak_standard_fault_script_converges():
+    """Tier-1 acceptance: the standard script at bench speed."""
+    _chaos_soak(unit=0.03, latency_s=0.005)
+
+
+@pytest.mark.slow
+def test_chaos_soak_long():
+    """The long soak: second-scale outage windows and real RTTs — run via
+    `pytest -m slow` (excluded from tier-1 by time budget, not by
+    capability)."""
+    _chaos_soak(unit=0.5, latency_s=0.01)
+
+
 # ------------------------------------------------------------ bench line
 
 
@@ -498,5 +919,13 @@ def test_bench_rollout_json_line_meets_targets():
     if ready["drift_watch"] and "drift_to_repaired_s" in ready["drift_watch"]:
         assert (ready["drift_watch"]["drift_to_repaired_s"]
                 < ready["drift_poll"]["drift_to_repaired_s"])
+    # the robustness column: both readiness modes converge under the
+    # standard fault script, retries visible in the request count
+    for mode in ("watch", "poll"):
+        clean = doc["faults"][mode]["clean"]
+        faulted = doc["faults"][mode]["faulted"]
+        assert faulted["converged"] and clean["converged"]
+        assert faulted["retries"] > 0, (mode, faulted)
+        assert faulted["requests"] >= clean["requests"], (mode, doc["faults"])
     # the recorded line for the round artifacts / triage summary
     print(f"BENCH_ROLLOUT {json.dumps(doc, separators=(',', ':'))}")

@@ -80,6 +80,14 @@ def cmd_render(args) -> int:
     return 0
 
 
+def _retry_policy(args) -> kubeapply.RetryPolicy:
+    """The rollout failure taxonomy, tuned by --retry-attempts/--retry-base
+    (429/5xx/transport retried with jittered exponential backoff honoring
+    Retry-After; 409 re-GET-then-re-PATCH; other 4xx terminal)."""
+    return kubeapply.RetryPolicy(attempts=max(1, args.retry_attempts),
+                                 base_s=max(0.0, args.retry_base))
+
+
 def _rest_client(args):
     """Client for --apiserver mode, or None for the kubectl backend."""
     if not args.apiserver:
@@ -90,7 +98,8 @@ def _rest_client(args):
             token = f.read().strip()
     return kubeapply.Client(
         args.apiserver, token=token, ca_file=args.ca_file,
-        insecure_skip_tls_verify=args.insecure_skip_tls_verify)
+        insecure_skip_tls_verify=args.insecure_skip_tls_verify,
+        retry=_retry_policy(args))
 
 
 def _kubectl_mode_flags_ok(args, cmd: str) -> bool:
@@ -124,6 +133,22 @@ def cmd_apply(args) -> int:
         return 2
     max_inflight = ((8 if args.max_inflight is None else args.max_inflight)
                     if args.parallel else 1)
+    if args.resume and not args.journal:
+        print("apply: --resume needs --journal PATH (the journal a "
+              "previous run recorded)", file=sys.stderr)
+        return 2
+    journal = None
+    if args.journal:
+        journal = kubeapply.RolloutJournal(args.journal, groups,
+                                           resume=args.resume)
+        if args.resume and not journal.resumed:
+            # missing file or a different rendered bundle: resuming it
+            # would skip work that never happened — say so, start fresh
+            print("apply: note: journal absent or from a different bundle; "
+                  "starting a fresh rollout", file=sys.stderr)
+        elif args.resume:
+            print("apply: resuming from journal "
+                  f"{args.journal} (completed groups will be skipped)")
     try:
         client = _rest_client(args)
         if client is not None:
@@ -133,9 +158,12 @@ def cmd_apply(args) -> int:
                     stage_timeout=args.stage_timeout, poll=args.poll,
                     allow_empty_daemonsets=args.allow_empty_daemonsets,
                     log=lambda msg: print(msg), max_inflight=max_inflight,
-                    watch_ready=args.watch)
+                    watch_ready=args.watch, journal=journal)
             finally:
                 client.close()
+            if client.retries:
+                print(f"apply: retried {client.retries} request(s) "
+                      "against a flaky apiserver")
             if args.wait:
                 print(f"rollout phases: {result.timings_line()}")
         else:
@@ -160,10 +188,14 @@ def cmd_apply(args) -> int:
             kubeapply.apply_groups_kubectl(
                 groups, wait=args.wait, stage_timeout=args.stage_timeout,
                 allow_empty_daemonsets=args.allow_empty_daemonsets,
-                log=lambda msg: print(msg))
+                log=lambda msg: print(msg), retry=_retry_policy(args),
+                journal=journal)
     except kubeapply.ApplyError as exc:
         print(f"apply failed: {exc}", file=sys.stderr)
         return 1
+    finally:
+        if journal is not None:
+            journal.close()
     print("apply: converged" if args.wait else "apply: submitted")
     return 0
 
@@ -250,6 +282,15 @@ def build_parser() -> argparse.ArgumentParser:
                       help="allow https to an apiserver without CA "
                            "verification (DANGEROUS: exposes the bearer "
                            "token to MITM)")
+    conn.add_argument("--retry-attempts", type=int, default=5,
+                      help="total tries per apiserver request: 429/5xx and "
+                           "transport failures are retried with jittered "
+                           "exponential backoff honoring Retry-After; "
+                           "other 4xx fail immediately (default 5; 1 "
+                           "disables retries)")
+    conn.add_argument("--retry-base", type=float, default=0.1,
+                      help="first retry backoff in seconds, doubling per "
+                           "attempt up to a 5s cap (default 0.1)")
 
     p = sub.add_parser("render", help="render artifacts from a cluster-spec")
     p.add_argument("--spec", default="", help="cluster-spec YAML path "
@@ -286,6 +327,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "watches")
     p.add_argument("--allow-empty-daemonsets", action="store_true",
                    help="treat DaemonSets with no matching nodes as ready")
+    p.add_argument("--journal", default="",
+                   help="record rollout progress (applied objects, "
+                        "converged groups) durably in PATH — the file "
+                        "--resume reads after a crash/SIGKILL")
+    p.add_argument("--resume", action="store_true",
+                   help="with --journal: skip groups the journal already "
+                        "marks converged (and re-send nothing already "
+                        "applied in the interrupted group); a journal from "
+                        "a different rendered bundle is discarded")
     p.set_defaults(fn=cmd_apply)
 
     p = sub.add_parser(

@@ -41,12 +41,27 @@ explicit: 410 Gone / expired-RV re-LISTs and re-watches; a denied or
 failing watch transport falls back to the poll loop above (which itself
 degrades to per-object GETs when LIST is denied), so no credential that
 converged before can stop converging.
+
+FAILURE TAXONOMY (:class:`RetryPolicy`): every apiserver round trip in
+this module converges through one classification — 429/500/502/503/504
+and transport status 0 are RETRYABLE (jittered exponential backoff,
+honoring ``Retry-After``), 409 Conflict means re-GET-then-re-PATCH (the
+apply paths do), every other 4xx is TERMINAL. ``Client._request`` applies
+it uniformly, so ``apply_groups``, ``wait_crd_established`` and the
+readiness loops inherit it; the watch path retries stream re-opens under
+the same classification before degrading to polling. A
+:class:`RolloutJournal` (``tpuctl apply --journal/--resume``) makes the
+rollout itself restartable: a SIGKILL'd run resumes by re-applying only
+the groups that had not converged.
 """
 
 from __future__ import annotations
 
+import hashlib
 import http.client
 import json
+import os
+import random
 import ssl
 import threading
 import time
@@ -93,6 +108,90 @@ class _WatchDenied(Exception):
     def __init__(self, code: int, message: Any = ""):
         super().__init__(f"{code} {message}".strip())
         self.code = code
+
+
+# Statuses a retry can plausibly fix: transport failure (status 0 — refused
+# connection, reset, timeout), client-side throttling (429), and the 5xx
+# family a flapping apiserver / overloaded proxy emits. Mirrored by the C++
+# twin (kubeclient::RetryableStatus, pinned in native/operator/selftest.cc).
+RETRYABLE_STATUSES = frozenset({0, 429, 500, 502, 503, 504})
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """One failure taxonomy for every apiserver round trip.
+
+    - ``retryable`` (429/5xx gateway family + transport status 0): jittered
+      exponential backoff — ``base_s`` doubling per attempt, clamped to
+      ``cap_s`` — honoring a ``Retry-After`` header when the server sent
+      one (429/503 throttling), up to ``attempts`` total tries.
+    - ``conflict`` (409): not retried blindly; the apply paths resolve it
+      semantically (re-GET then re-PATCH — the object exists).
+    - ``terminal`` (every other 4xx): retrying cannot help; fail now.
+    """
+
+    attempts: int = 5
+    base_s: float = 0.1
+    cap_s: float = 5.0
+    jitter: float = 0.2  # +/- fraction applied to the computed backoff
+    retryable: frozenset = RETRYABLE_STATUSES
+
+    def classify(self, status: int) -> str:
+        """'ok' | 'retryable' | 'conflict' | 'terminal' for one status."""
+        if status in self.retryable:
+            return "retryable"
+        if status == 409:
+            return "conflict"
+        if 200 <= status < 400:
+            return "ok"
+        return "terminal"
+
+    def backoff_s(self, attempt: int,
+                  retry_after: Optional[float] = None) -> float:
+        """Sleep before retry number ``attempt`` (1-based). A server-sent
+        Retry-After wins (clamped to ``cap_s`` so a hostile/buggy header
+        cannot park the rollout); otherwise exponential from ``base_s``
+        with +/-``jitter`` so a fleet retrying the same blip doesn't
+        re-synchronize into a thundering herd."""
+        if retry_after is not None:
+            return max(0.0, min(retry_after, self.cap_s))
+        delay = min(self.cap_s, self.base_s * (2 ** (max(1, attempt) - 1)))
+        return delay * (1 - self.jitter + 2 * self.jitter * random.random())
+
+
+# Single-try policy: for probes that own their own retry cadence (or tests
+# that need the first answer, however bad).
+NO_RETRY = RetryPolicy(attempts=1)
+
+
+def _retry_after_s(value: Optional[str]) -> Optional[float]:
+    """Parse a Retry-After header: seconds (integer or fractional — the
+    fake apiserver uses fractions to keep tests fast). The http-date form
+    is ignored (None -> computed backoff)."""
+    if not value:
+        return None
+    try:
+        return max(0.0, float(value))
+    except ValueError:
+        return None
+
+
+def _transport_error(exc: BaseException) -> Dict[str, Any]:
+    """Status-0 error body that PRESERVES the exception class and errno —
+    'connection refused for 300s' must be distinguishable from a TLS
+    handshake failure in wait_ready/apply timeout messages."""
+    cause = exc
+    reason = getattr(exc, "reason", None)  # URLError wraps the real error
+    if isinstance(reason, BaseException):
+        cause = reason
+    body: Dict[str, Any] = {
+        "message": f"transport error: {type(cause).__name__}: {cause}",
+        "errorClass": type(cause).__name__,
+    }
+    errno_ = getattr(cause, "errno", None)
+    if errno_ is not None:
+        body["errno"] = errno_
+    return body
 
 
 def collection_path(obj: Dict[str, Any]) -> str:
@@ -238,6 +337,10 @@ class Client:
     # Persistent per-thread connection reuse. Off = a fresh urllib socket
     # per request (the original transport, the bench's sequential arm).
     keep_alive: bool = True
+    # The uniform failure taxonomy (None -> the default RetryPolicy):
+    # every _request converges through it, so apply/wait/delete inherit
+    # retries without per-call plumbing.
+    retry: Optional[RetryPolicy] = None
     _warned_insecure: bool = field(default=False, repr=False, compare=False)
     _local: Any = field(default=None, repr=False, compare=False)
     _conns: Any = field(default=None, repr=False, compare=False)
@@ -246,6 +349,14 @@ class Client:
         self._local = threading.local()
         self._conns = []  # every connection ever opened, for close()
         self._conns_lock = threading.Lock()
+        if self.retry is None:
+            self.retry = RetryPolicy()
+        # Retry accounting (the CLI and bench report it): how many requests
+        # were re-sent after a retryable failure, and the freshest
+        # transport-level error detail (exception class preserved).
+        self._retry_lock = threading.Lock()
+        self.retries = 0
+        self.last_transport_error: Optional[str] = None
 
     # ------------------------------------------------------------ transport
 
@@ -343,10 +454,12 @@ class Client:
 
     def _request_keepalive(self, method: str, path: str,
                            data: Optional[bytes], content_type: str):
-        """One request over the thread's persistent connection. A stale
-        keep-alive socket (server restarted, idle timeout) surfaces as
-        RemoteDisconnected / reset on the FIRST attempt only — retried once
-        on a fresh connection before reporting a transport failure."""
+        """One request over the thread's persistent connection, returning
+        ``(status, parsed, retry_after_s)``. A stale keep-alive socket
+        (server restarted, idle timeout) surfaces as RemoteDisconnected /
+        reset on the FIRST attempt only — retried once on a fresh
+        connection immediately; every further retry belongs to the
+        RetryPolicy loop in ``_request`` (with backoff)."""
         base_path = urllib.parse.urlsplit(self.base_url).path.rstrip("/")
         for attempt in (0, 1):
             conn = self._connection()
@@ -356,12 +469,13 @@ class Client:
                                                    content_type))
                 resp = conn.getresponse()
                 payload = resp.read()  # drains so the connection can reuse
+                retry_after = _retry_after_s(resp.getheader("Retry-After"))
                 try:
                     parsed = json.loads(payload or b"{}")
                 except ValueError:
                     parsed = {"message":
                               payload.decode(errors="replace")[:200]}
-                return resp.status, parsed
+                return resp.status, parsed, retry_after
             except (http.client.HTTPException, OSError) as exc:
                 self._drop_connection()
                 if attempt == 0 and isinstance(
@@ -369,7 +483,7 @@ class Client:
                               http.client.BadStatusLine,
                               BrokenPipeError, ConnectionResetError)):
                     continue  # stale pooled socket: one fresh retry
-                return 0, {"message": f"transport error: {exc}"}
+                return 0, _transport_error(exc), None
 
     def _request_oneshot(self, method: str, path: str,
                          data: Optional[bytes], content_type: str):
@@ -380,27 +494,50 @@ class Client:
         try:
             with urllib.request.urlopen(req, data=data, timeout=self.timeout,
                                         context=ctx) as resp:
-                return resp.status, json.loads(resp.read() or b"{}")
+                return (resp.status, json.loads(resp.read() or b"{}"),
+                        _retry_after_s(resp.headers.get("Retry-After")))
         except urllib.error.HTTPError as exc:
             payload = exc.read()
             try:
                 parsed = json.loads(payload or b"{}")
             except ValueError:
                 parsed = {"message": payload.decode(errors="replace")[:200]}
-            return exc.code, parsed
+            retry_after = _retry_after_s(
+                exc.headers.get("Retry-After") if exc.headers else None)
+            return exc.code, parsed, retry_after
         except (urllib.error.URLError, OSError, TimeoutError) as exc:
             # Transport failure (refused/reset/DNS/TLS/timeout): status 0,
-            # like the C++ twin's Response.error — wait_ready retries it,
-            # apply() turns it into a clean ApplyError.
-            return 0, {"message": f"transport error: {exc}"}
+            # like the C++ twin's Response.error — the retry loop backs
+            # off on it, apply() turns a terminal one into an ApplyError.
+            return 0, _transport_error(exc), None
 
     def _request(self, method: str, path: str,
                  body: Optional[Dict[str, Any]] = None,
                  content_type: str = "application/json"):
+        """One logical request under the RetryPolicy: retryable statuses
+        (429/5xx/transport) are re-sent with jittered exponential backoff,
+        honoring Retry-After; the final (or first non-retryable) answer is
+        returned as ``(status, parsed)``. Safe for POST too: a create whose
+        response was lost re-POSTs into 409 AlreadyExists, which the apply
+        paths resolve as re-GET-then-re-PATCH."""
         data = json.dumps(body).encode() if body is not None else None
-        if self.keep_alive:
-            return self._request_keepalive(method, path, data, content_type)
-        return self._request_oneshot(method, path, data, content_type)
+        policy = self.retry or NO_RETRY
+        attempt = 0
+        while True:
+            attempt += 1
+            if self.keep_alive:
+                code, parsed, retry_after = self._request_keepalive(
+                    method, path, data, content_type)
+            else:
+                code, parsed, retry_after = self._request_oneshot(
+                    method, path, data, content_type)
+            if code not in policy.retryable or attempt >= policy.attempts:
+                return code, parsed
+            with self._retry_lock:
+                self.retries += 1
+                if code == 0:
+                    self.last_transport_error = (parsed or {}).get("message")
+            time.sleep(policy.backoff_s(attempt, retry_after))
 
     def get(self, path: str):
         return self._request("GET", path)
@@ -460,13 +597,21 @@ class Client:
         path = ("/apis/apiextensions.k8s.io/v1/"
                 f"customresourcedefinitions/{name}")
         deadline = time.monotonic() + timeout
+        last_err: Optional[str] = None
         while True:
             code, live = self.get(path)
             if code == 200 and crd_established(live):
                 return
+            # keep the freshest FAILING read for the timeout message — "the
+            # apiserver kept 503ing" and "the CRD never Established" are
+            # different triage paths
+            last_err = (None if code == 200 else
+                        f"GET -> {code} {(live or {}).get('message', live)}")
             if time.monotonic() >= deadline:
+                hint = f" (last error: {last_err})" if last_err else ""
                 raise ApplyError(
-                    f"timed out waiting for CRD {name} to be Established")
+                    f"timed out waiting for CRD {name} to be "
+                    f"Established{hint}")
             time.sleep(poll)
 
     def wait_ready(self, objs: Sequence[Dict[str, Any]], timeout: float,
@@ -689,6 +834,8 @@ class Client:
             rv = relist()
         except _WatchDenied as exc:
             return degrade(f"LIST {coll}: {exc}")
+        policy = self.retry or NO_RETRY
+        denials = 0  # consecutive failed stream opens (reset on success)
         while pending:
             left = deadline - time.monotonic()
             if left <= 0:
@@ -698,9 +845,20 @@ class Client:
                 bump()
                 opened = time.monotonic()
                 conn, resp = self._open_watch(coll, rv, window)
+                denials = 0
             except _WatchDenied as exc:
-                # watch verb denied / transport down: the poll loop still
-                # converges on get+list (or per-object get) credentials
+                # Same taxonomy as _request: a RETRYABLE refusal (transport
+                # down, 429/5xx blip) re-opens the stream with backoff —
+                # the poll loop it would degrade to hits the same flaky
+                # server anyway. A terminal one (403: no watch verb)
+                # degrades immediately: polling there DOES converge.
+                denials += 1
+                if exc.code in policy.retryable \
+                        and denials < policy.attempts:
+                    time.sleep(min(policy.backoff_s(denials),
+                                   max(0.0,
+                                       deadline - time.monotonic())))
+                    continue
                 return degrade(f"watch {coll}: {exc}")
             fallback = None
             expired = False
@@ -782,6 +940,121 @@ class GroupResult:
         return line
 
 
+class RolloutJournal:
+    """Durable rollout progress for ``tpuctl apply --journal/--resume``.
+
+    A JSON-lines file: one header record pinning the bundle fingerprint,
+    then ``{"group": i, "object": key}`` per applied object (keyed per
+    group — the same name may be applied by two groups) and
+    ``{"group": i}`` per CONVERGED group (readiness gate passed, not just
+    submitted; ``wait=False`` groups are never marked). Every record is
+    flushed and fsync'd before the rollout proceeds, so a SIGKILL at any
+    instant leaves a journal describing exactly what finished (a torn
+    final line from a mid-write kill is dropped, and the file is
+    rewritten clean on open). Resuming with the same rendered groups
+    skips completed
+    groups outright (zero apiserver requests) and already-applied objects
+    inside the interrupted group — whose readiness is still re-gated:
+    convergence, not bookkeeping, completes a group. A journal whose
+    fingerprint doesn't match the groups (the spec changed between runs)
+    is discarded and restarted: resuming a different rollout would skip
+    work that never happened."""
+
+    def __init__(self, path: str,
+                 groups: Sequence[Sequence[Dict[str, Any]]],
+                 resume: bool = False):
+        self.path = path
+        self.fingerprint = self._fingerprint(groups)
+        # Objects are keyed PER GROUP: the same kind/ns/name may
+        # legitimately be applied by two groups (bootstrap config early,
+        # final config late), and a global key would skip the later one.
+        self._objects: set = set()   # (group index, object key)
+        self._groups: set = set()
+        self.resumed = False
+        if resume:
+            self._load()
+        # Always REWRITE from the parsed state (never append): a SIGKILL
+        # mid-append leaves a torn unterminated last line, and appending
+        # after it would weld the next record onto it — corrupting every
+        # later resume. The journal is small; a clean rewrite is cheap.
+        self._f = open(path, "w", encoding="utf-8")
+        self._append({"journal": "tpuctl-rollout",
+                      "fingerprint": self.fingerprint})
+        for group, key in sorted(self._objects):
+            self._append({"group": group, "object": key})
+        for group in sorted(self._groups):
+            self._append({"group": group})
+
+    @staticmethod
+    def _fingerprint(groups) -> str:
+        blob = json.dumps([list(g) for g in groups], sort_keys=True)
+        return hashlib.sha256(blob.encode()).hexdigest()
+
+    @staticmethod
+    def object_key(obj: Dict[str, Any]) -> str:
+        meta = obj.get("metadata") or {}
+        return (f"{obj.get('kind')}/{meta.get('namespace', '')}/"
+                f"{meta.get('name')}")
+
+    def _load(self) -> None:
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                raw = f.read().splitlines()
+        except OSError:
+            return  # no journal yet: fresh rollout
+        records = []
+        for line in raw:
+            if not line.strip():
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                break  # torn tail from a mid-write kill: keep the prefix
+        if not records or records[0].get("fingerprint") != self.fingerprint:
+            return  # different bundle (or corrupt header): start fresh
+        for rec in records[1:]:
+            if "object" in rec:
+                self._objects.add((int(rec.get("group", -1)),
+                                   rec["object"]))
+            elif "group" in rec:
+                self._groups.add(int(rec["group"]))
+        self.resumed = True
+
+    def _append(self, rec: Dict[str, Any]) -> None:
+        self._f.write(json.dumps(rec, sort_keys=True) + "\n")
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def object_done(self, obj: Dict[str, Any], group: int) -> None:
+        entry = (group, self.object_key(obj))
+        if entry not in self._objects:
+            self._objects.add(entry)
+            self._append({"group": group, "object": entry[1]})
+
+    def group_done(self, index: int) -> None:
+        if index not in self._groups:
+            self._groups.add(index)
+            self._append({"group": index})
+
+    def is_object_done(self, obj: Dict[str, Any], group: int) -> bool:
+        return (group, self.object_key(obj)) in self._objects
+
+    def is_group_done(self, index: int) -> bool:
+        return index in self._groups
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "RolloutJournal":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
 def kubectl_runner(argv: Sequence[str], input_text: Optional[str] = None,
                    timeout: float = 900):
     """Returns ``(rc, stdout, stderr)``. Streams stay separate so JSON output
@@ -805,13 +1078,23 @@ def kubectl_runner(argv: Sequence[str], input_text: Optional[str] = None,
 def apply_groups_kubectl(groups: Sequence[Sequence[Dict[str, Any]]],
                          wait: bool = True, stage_timeout: float = 600,
                          runner=None, allow_empty_daemonsets: bool = False,
-                         log=lambda msg: None) -> GroupResult:
+                         log=lambda msg: None,
+                         retry: Optional[RetryPolicy] = None,
+                         journal: Optional[RolloutJournal] = None
+                         ) -> GroupResult:
     """The kubectl-CLI twin of :func:`apply_groups` for hosts where only
     kubectl (not a proxied apiserver URL) is available — the common case on
     the reference guide's control-plane node. Readiness gating uses
     ``kubectl rollout status`` / ``kubectl wait``, then re-checks
     :func:`is_ready` on the live object so the empty-DaemonSet guard (no
-    node matched the selector) holds on this path too."""
+    node matched the selector) holds on this path too.
+
+    Shares the rollout failure taxonomy: rc=124 is :func:`kubectl_runner`'s
+    killed-after-timeout sentinel — a slow/flapping apiserver, not a
+    rejected manifest — so the group apply is RETRYABLE under ``retry``;
+    any other nonzero rc is terminal. ``journal`` records converged groups
+    (group granularity only: kubectl applies a whole group per
+    invocation), so ``--resume`` skips them."""
     import json as jsonmod
 
     import yaml
@@ -821,13 +1104,28 @@ def apply_groups_kubectl(groups: Sequence[Sequence[Dict[str, Any]]],
                    _t=stage_timeout + 120):  # outlive kubectl's own timeout
             return kubectl_runner(argv, input_text, timeout=_t)
 
+    retry = retry or RetryPolicy()
     result = GroupResult()
     for i, group in enumerate(groups):
+        if journal is not None and journal.is_group_done(i):
+            log(f"group {i + 1}/{len(groups)} already complete (journal); "
+                "skipping")
+            continue
         text = yaml.dump_all(group, sort_keys=False)
-        rc, out, err = runner(["kubectl", "apply", "-f", "-"], text)
+        for attempt in range(1, max(1, retry.attempts) + 1):
+            rc, out, err = runner(["kubectl", "apply", "-f", "-"], text)
+            if rc != 124 or attempt >= retry.attempts:
+                break
+            log(f"kubectl apply (group {i + 1}) killed after timeout "
+                f"(rc=124) — retryable; attempt "
+                f"{attempt}/{retry.attempts - 1}")
+            time.sleep(retry.backoff_s(attempt))
         if rc != 0:
-            raise ApplyError(
-                f"kubectl apply (group {i + 1}): {(out + err)[-400:]}")
+            detail = (out + err)[-400:]
+            if rc == 124:
+                detail += (f" [retryable timeout persisted across "
+                           f"{retry.attempts} attempt(s)]")
+            raise ApplyError(f"kubectl apply (group {i + 1}): {detail}")
         for obj in group:
             result.actions.append(
                 f"applied {obj['kind']}/{obj['metadata']['name']}")
@@ -845,6 +1143,9 @@ def apply_groups_kubectl(groups: Sequence[Sequence[Dict[str, Any]]],
                 raise ApplyError(
                     f"CRD {name} not Established: {(out + err)[-400:]}")
         if not wait:
+            # not journaled: a group is complete only once its readiness
+            # gate passed, and wait=False never gates (re-applying it on
+            # resume is idempotent and cheap — one kubectl apply)
             continue
         # stage_timeout bounds the WHOLE group (matching the REST path):
         # each sequential gate gets only the remaining budget.
@@ -900,6 +1201,8 @@ def apply_groups_kubectl(groups: Sequence[Sequence[Dict[str, Any]]],
                     raise ApplyError(
                         f"readiness gate failed: DaemonSet/{name} pods "
                         f"regressed after rollout ({ready}/{desired} ready)")
+        if journal is not None:
+            journal.group_done(i)
         log(f"group {i + 1}/{len(groups)} ready")
     return result
 
@@ -1011,7 +1314,8 @@ def apply_groups(client: Client, groups: Sequence[Sequence[Dict[str, Any]]],
                  wait: bool = True, stage_timeout: float = 600,
                  poll: float = 1.0, allow_empty_daemonsets: bool = False,
                  log=lambda msg: None, max_inflight: int = 1,
-                 watch_ready: bool = False) -> GroupResult:
+                 watch_ready: bool = False,
+                 journal: Optional[RolloutJournal] = None) -> GroupResult:
     """Ordered, readiness-gated rollout of manifest groups — the reference's
     operator behavior (SURVEY.md §3.3) as a one-shot procedure.
 
@@ -1020,25 +1324,42 @@ def apply_groups(client: Client, groups: Sequence[Sequence[Dict[str, Any]]],
     re-applies, and apply-response-seeded readiness. ``watch_ready``
     selects event-driven readiness (one watch stream per collection; see
     ``Client.wait_ready``). Groups stay ordered barriers in both modes,
-    and a failing object in group N always blocks group N+1."""
+    and a failing object in group N always blocks group N+1.
+
+    ``journal`` (``tpuctl apply --journal/--resume``) records progress
+    durably: groups it already marks converged are skipped outright, and
+    already-applied objects inside the interrupted group are not re-sent —
+    a SIGKILL'd rollout restarts idempotently, re-applying only unfinished
+    work. Retries against a flaky apiserver come from the Client's
+    RetryPolicy — this function never sees a retryable failure."""
     result = GroupResult()
     if max_inflight > 1:
         try:
             return _apply_groups_pipelined(
                 client, groups, wait, stage_timeout, poll,
                 allow_empty_daemonsets, log, max_inflight, result,
-                watch_ready)
+                watch_ready, journal)
         finally:
             # the pool's worker threads are gone; their thread-local
             # connections must not outlive them in the Client's pool
             client.reap_other_connections()
     for i, group in enumerate(groups):
+        if journal is not None and journal.is_group_done(i):
+            log(f"group {i + 1}/{len(groups)} already complete (journal); "
+                "skipping")
+            continue
         t0 = time.monotonic()
         for obj in group:
-            action = client.apply(obj)
             name = f"{obj['kind']}/{obj['metadata']['name']}"
+            if journal is not None and journal.is_object_done(obj, i):
+                result.actions.append(f"journaled {name}")
+                log(f"journaled {name} (already applied; resume)")
+                continue
+            action = client.apply(obj)
             result.actions.append(f"{action} {name}")
             log(f"{action} {name}")
+            if journal is not None:
+                journal.object_done(obj, i)
         result.timings["apply"] += time.monotonic() - t0
         # CRD establishment is a correctness gate for the NEXT group's CRs,
         # not a readiness nicety — enforce it even with wait=False.
@@ -1056,6 +1377,12 @@ def apply_groups(client: Client, groups: Sequence[Sequence[Dict[str, Any]]],
             result.timings["ready-wait"] += time.monotonic() - t0
             _note_ready_stats(result, stats)
             log(f"group {i + 1}/{len(groups)} ready")
+        if journal is not None and wait:
+            # a group is journaled complete only once CONVERGED — with
+            # wait=False nothing ever gated readiness, and a later
+            # --resume --wait must not skip the gate (the per-object
+            # records above still make that resume cheap)
+            journal.group_done(i)
     return result
 
 
@@ -1114,19 +1441,25 @@ def _apply_groups_pipelined(client: Client,
                             allow_empty_daemonsets: bool, log,
                             max_inflight: int,
                             result: GroupResult,
-                            watch_ready: bool = False) -> GroupResult:
+                            watch_ready: bool = False,
+                            journal: Optional[RolloutJournal] = None
+                            ) -> GroupResult:
     """The concurrent engine behind apply_groups(max_inflight>1).
 
     One LIST per distinct collection primes a shared live-object cache
     (client-go informer shape) — except on a fresh install, detected by
     probing the bundle's first Namespace: when that's absent nothing of
     ours exists, so the prefetch round trips are skipped and stragglers
-    are caught by the POST->409->PATCH fallback."""
+    are caught by the POST->409->PATCH fallback. Journal-completed groups
+    are excluded from the prefetch too — a resume touches only the
+    collections the unfinished groups need."""
     from concurrent.futures import ThreadPoolExecutor
 
     cache: Dict[str, Dict[str, Dict[str, Any]]] = {}
     cache_lock = threading.Lock()
-    all_objs = [o for group in groups for o in group]
+    all_objs = [o for gi, group in enumerate(groups)
+                if not (journal is not None and journal.is_group_done(gi))
+                for o in group]
     collections: List[str] = []
     for obj in all_objs:
         coll = collection_path(obj)
@@ -1153,11 +1486,24 @@ def _apply_groups_pipelined(client: Client,
                 cache[coll] = {**fut.result(), **cache.get(coll, {})}
 
         for i, group in enumerate(groups):
+            if journal is not None and journal.is_group_done(i):
+                log(f"group {i + 1}/{len(groups)} already complete "
+                    "(journal); skipping")
+                continue
             t0 = time.monotonic()
             for tier in _group_tiers(group):
+                todo = []
+                for obj in tier:
+                    if journal is not None \
+                            and journal.is_object_done(obj, i):
+                        name = f"{obj['kind']}/{obj['metadata']['name']}"
+                        result.actions.append(f"journaled {name}")
+                        log(f"journaled {name} (already applied; resume)")
+                        continue
+                    todo.append(obj)
                 futures2 = [(obj, pool.submit(_apply_one_cached, client,
                                               obj, cache, cache_lock))
-                            for obj in tier]
+                            for obj in todo]
                 errors = []
                 for obj, fut in futures2:
                     name = f"{obj['kind']}/{obj['metadata']['name']}"
@@ -1168,6 +1514,8 @@ def _apply_groups_pipelined(client: Client,
                         continue
                     result.actions.append(f"{action} {name}")
                     log(f"{action} {name}")
+                    if journal is not None:
+                        journal.object_done(obj, i)
                 if errors:
                     # group barrier: nothing from group N+1 (or a later
                     # tier) may start after a failure in group N
@@ -1201,4 +1549,8 @@ def _apply_groups_pipelined(client: Client,
                 result.timings["ready-wait"] += time.monotonic() - t0
                 _note_ready_stats(result, stats)
                 log(f"group {i + 1}/{len(groups)} ready")
+            if journal is not None and wait:
+                # converged-only, like the sequential engine: submit
+                # without readiness must never be resumed as complete
+                journal.group_done(i)
     return result
