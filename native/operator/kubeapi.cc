@@ -111,6 +111,17 @@ std::vector<std::string> SweepCollections(const std::string& ns) {
   return out;
 }
 
+const std::vector<std::string>& OperandWorkloadKinds() {
+  // Twin table of tpu_cluster/lint.py OPERAND_WORKLOAD_KINDS (both are
+  // apps/v1 kinds; CollectionPath supplies the group). A kind added here
+  // without its Python twin (or vice versa) fails the selftest/test_lint
+  // pins before it can ship skew between the linter's security-audit
+  // boundary and the operator's drift-watch set.
+  static const auto* kinds =
+      new std::vector<std::string>{"DaemonSet", "Deployment"};
+  return *kinds;
+}
+
 bool IsReady(const minijson::Value& obj) {
   std::string kind = obj.PathString("kind");
   // Upgrade semantics (kubectl `rollout status` parity, mirrored in

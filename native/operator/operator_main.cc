@@ -747,9 +747,12 @@ class Operator {
   // drift this repairs; config/RBAC drift waits for the interval resync.
   std::vector<std::string> OwnedWorkloadCollections() const {
     std::vector<std::string> colls;
+    const auto& watch_kinds = kubeapi::OperandWorkloadKinds();
     for (const auto& bo : bundle_) {
       std::string kind = bo.obj->PathString("kind");
-      if (kind != "DaemonSet" && kind != "Deployment") continue;
+      if (std::find(watch_kinds.begin(), watch_kinds.end(), kind) ==
+          watch_kinds.end())
+        continue;
       if (bo.disabled) continue;
       std::string err;
       std::string coll = kubeapi::CollectionPath(*bo.obj, &err);

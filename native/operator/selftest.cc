@@ -205,6 +205,37 @@ static void TestRetryClassification() {
   CHECK(kubeclient::ParseRetryAfterMs("retry-after: 999999") == 3600000);
 }
 
+static void TestOperandWorkloadTwinTable() {
+  // Pinned twin table (same pattern as TestRetryClassification): the
+  // kinds the operator drift-watches as operand workloads must be
+  // exactly the GVKs the Python bundle linter treats as operand
+  // workloads — tpu_cluster/lint.py OPERAND_WORKLOAD_KINDS pins
+  // (apps/v1, DaemonSet) and (apps/v1, Deployment); tests/test_lint.py
+  // greps THIS table out of kubeapi.cc to close the loop without a
+  // compiler.
+  const auto& kinds = kubeapi::OperandWorkloadKinds();
+  CHECK(kinds.size() == 2);
+  auto has = [&](const char* want) {
+    for (const auto& k : kinds)
+      if (k == want) return true;
+    return false;
+  };
+  CHECK(has("DaemonSet"));
+  CHECK(has("Deployment"));
+  // the apiVersion half of the GVK twin: both kinds resolve to apps/v1
+  // collections through the same Plurals/ApiVersions tables the operator
+  // applies with
+  for (const auto& k : kinds) {
+    std::string err;
+    auto obj = Obj(("{\"apiVersion\": \"apps/v1\", \"kind\": \"" + k +
+                    "\", \"metadata\": {\"name\": \"x\", \"namespace\": "
+                    "\"ns\"}}")
+                       .c_str());
+    std::string coll = kubeapi::CollectionPath(*obj, &err);
+    CHECK(coll.rfind("/apis/apps/v1/", 0) == 0);
+  }
+}
+
 static void TestWatchBackoff() {
   // Doubling from base, capped: the operand drift-watch reconnect
   // schedule. A persistently kClosed stream (each https open is a curl
@@ -230,6 +261,7 @@ int main() {
   TestSweepCollections();
   TestReadiness();
   TestRetryClassification();
+  TestOperandWorkloadTwinTable();
   TestWatchBackoff();
   if (g_failures) {
     fprintf(stderr, "operator_selftest: %d FAILURES\n", g_failures);

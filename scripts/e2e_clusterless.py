@@ -91,6 +91,42 @@ EXPORTER_DS = ("/apis/apps/v1/namespaces/tpu-system/daemonsets/"
                "tpu-metrics-exporter")
 
 
+def stage_lint(t: Transcript) -> None:
+    """Pre-apply static analysis: the step the reference runbook lacked
+    entirely (misconfiguration surfaced only as apiserver rejections or a
+    hung wait). The shipped bundles must be clean in strict mode, and a
+    crafted cross-object break must be caught BEFORE any request."""
+    from tpu_cluster import lint, spec as specmod
+    from tpu_cluster.render import manifests, operator_bundle
+
+    t.h2("Stage 0 — pre-apply lint (`tpuctl lint --strict`)")
+    spec = specmod.default_spec()
+    for label, groups in (
+            ("operand rollout groups", manifests.rollout_groups(spec)),
+            ("operator install waves",
+             operator_bundle.operator_install_groups(spec))):
+        findings = lint.lint_groups(groups, spec=spec)
+        t.emit(f"`{label}`: {len(findings)} finding(s)")
+        t.check(findings == [], f"{label} lint clean in strict mode")
+    # cross-object break: selector/template mismatch -> R03, apply refused
+    bad = [[{"apiVersion": "apps/v1", "kind": "DaemonSet",
+             "metadata": {"name": "broken", "namespace": "tpu-system"},
+             "spec": {"selector": {"matchLabels": {"app": "x"}},
+                      "template": {"metadata": {"labels": {"app": "y"}},
+                                   "spec": {"containers": [
+                                       {"name": "c", "image": "i:1"}]}}}}]]
+    findings = lint.lint_groups(bad)
+    t.code("\n".join(f.line() for f in findings))
+    try:
+        lint.gate(bad, "error")
+        gated = False
+    except lint.LintGateError:
+        gated = True
+    t.check(gated and [f.rule for f in findings] == ["R03"],
+            "crafted selector mismatch caught as R03; --lint=error gate "
+            "blocks with zero requests issued")
+
+
 def stage_operator(t: Transcript, api, bundle_dir: str) -> None:
     t.h2("Stage 1 — operator rollout (helm install --wait analog)")
 
@@ -385,6 +421,7 @@ def main() -> int:
                           "metadata": {"name": "default", "generation": 1}},
         }
         with FakeApiServer(auto_ready=True, store=seed) as api:
+            stage_lint(t)
             stage_operator(t, api, bundle_dir)
             stage_device_plugin(t, tmp)
             stage_feature_discovery(t, api)

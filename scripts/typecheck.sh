@@ -1,0 +1,13 @@
+#!/bin/sh
+# Targeted strict type-check: the modules whose contracts other layers
+# lean on hardest (the bundle linter, the spec loader, the topology
+# catalogue) must stay clean under `mypy --strict`. Global config
+# (follow_imports, ignore_missing_imports) lives in pyproject.toml
+# [tool.mypy]; the file list here is the strict set — grow it
+# module-by-module, don't loosen the flag.
+#
+# Run from anywhere; uses $PYTHON when set (tests pass sys.executable).
+set -e
+cd "$(dirname "$0")/.."
+exec "${PYTHON:-python3}" -m mypy --strict \
+  tpu_cluster/lint.py tpu_cluster/spec.py tpu_cluster/topology.py

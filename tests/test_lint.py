@@ -1,0 +1,567 @@
+"""Bundle static analyzer tests (tpu_cluster.lint).
+
+Three layers:
+
+- one crafted bad-bundle fixture per rule R01-R06, each asserting the
+  rule id AND the JSON-path locus, and that NO other rule fires (the
+  rules must be independently testable);
+- the self-audit: everything we ship — operand rollout groups, operator
+  install waves, validation jobs, the generated chart — must lint clean
+  in strict mode, swept over operand-switch x topology permutations of
+  valid ClusterSpecs;
+- the pre-apply gate: `tpuctl apply --lint=error` against a bad bundle
+  exits nonzero with ZERO requests issued to the (fake) apiserver, on
+  both the REST and kubectl backends.
+
+Plus the cross-language pins: the linter's operand-workload GVK table is
+the Python twin of the C++ operator's drift-watch kind list
+(kubeapi::OperandWorkloadKinds — native/operator/selftest.cc pins the
+other direction), and the linter's tier model must reproduce
+kubeapply._group_tiers exactly.
+"""
+
+import json
+import os
+import re
+import sys
+
+import pytest
+import yaml
+
+from fake_apiserver import FakeApiServer
+from tpu_cluster import kubeapply, lint
+from tpu_cluster import spec as specmod
+from tpu_cluster import __main__ as cli
+from tpu_cluster.render import gotmpl, jobs, manifests, operator_bundle
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHART = os.path.join(REPO, "deploy", "chart", "tpu-stack")
+NS = "tpu-system"
+
+
+# ---------------------------------------------------------------------------
+# fixture builders: minimal VALID objects a test then breaks in one way
+
+
+def mk_namespace(name=NS):
+    return {"apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": name}}
+
+
+def mk_workload(kind="DaemonSet", name="work", ns=NS, image="img:1",
+                labels=None, template_labels=None, pod=None):
+    labels = dict(labels or {"app": name})
+    api = {"DaemonSet": "apps/v1", "Deployment": "apps/v1",
+           "StatefulSet": "apps/v1", "Job": "batch/v1"}[kind]
+    pod_spec = {"containers": [{"name": "c", "image": image}]}
+    pod_spec.update(pod or {})
+    obj = {"apiVersion": api, "kind": kind,
+           "metadata": {"name": name, "namespace": ns},
+           "spec": {"selector": {"matchLabels": labels},
+                    "template": {
+                        "metadata": {"labels": dict(template_labels
+                                                    if template_labels
+                                                    is not None else labels)},
+                        "spec": pod_spec}}}
+    if kind == "Job":  # Job selectors are controller-generated
+        del obj["spec"]["selector"]
+    return obj
+
+
+def mk_configmap(name, ns=NS):
+    return {"apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": name, "namespace": ns}, "data": {}}
+
+
+def mk_sa(name, ns=NS):
+    return {"apiVersion": "v1", "kind": "ServiceAccount",
+            "metadata": {"name": name, "namespace": ns}}
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# one bad-bundle fixture per rule
+
+
+def test_r01_duplicate_across_groups():
+    bundle = [[mk_namespace(), mk_workload(name="dup")],
+              [mk_workload(name="dup")]]
+    fs = lint.lint_groups(bundle)
+    assert rules_of(fs) == {"R01"}
+    [f] = fs
+    assert f.severity == "error"
+    assert (f.kind, f.namespace, f.name) == ("DaemonSet", NS, "dup")
+    assert f.path == ".metadata.name"
+    assert "group 0" in f.message and "group 1" in f.message
+
+
+def test_r02_dangling_service_account():
+    bundle = [[mk_namespace(),
+               mk_workload(pod={"serviceAccountName": "ghost"})]]
+    fs = lint.lint_groups(bundle)
+    assert rules_of(fs) == {"R02"}
+    [f] = fs
+    assert f.path == ".spec.template.spec.serviceAccountName"
+    assert "ServiceAccount/tpu-system/ghost" in f.message
+
+
+def test_r02_dangling_configmap_volume_and_envfrom():
+    pod = {"volumes": [{"name": "v", "configMap": {"name": "no-such-cm"}}],
+           "containers": [{"name": "c", "image": "img:1",
+                           "envFrom": [{"secretRef": {"name": "no-such"}}]}]}
+    bundle = [[mk_namespace(), mk_workload(pod=pod)]]
+    fs = lint.lint_groups(bundle)
+    assert rules_of(fs) == {"R02"}
+    paths = {f.path for f in fs}
+    assert ".spec.template.spec.volumes[0].configMap.name" in paths
+    assert (".spec.template.spec.containers[0].envFrom[0].secretRef.name"
+            in paths)
+    # optional refs are not findings
+    pod_opt = {"volumes": [{"name": "v", "configMap": {
+        "name": "no-such-cm", "optional": True}}]}
+    assert lint.lint_groups([[mk_namespace(),
+                              mk_workload(pod=pod_opt)]]) == []
+
+
+def test_r02_dangling_rolebinding_and_subject():
+    binding = {"apiVersion": "rbac.authorization.k8s.io/v1",
+               "kind": "ClusterRoleBinding",
+               "metadata": {"name": "b"},
+               "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                           "kind": "ClusterRole", "name": "ghost-role"},
+               "subjects": [{"kind": "ServiceAccount", "name": "ghost-sa",
+                             "namespace": NS}]}
+    fs = lint.lint_groups([[mk_namespace(), binding]])
+    assert rules_of(fs) == {"R02"}
+    assert {f.path for f in fs} == {".roleRef.name", ".subjects[0].name"}
+
+
+def test_r02_service_selector_matches_nothing():
+    svc = {"apiVersion": "v1", "kind": "Service",
+           "metadata": {"name": "s", "namespace": NS},
+           "spec": {"selector": {"app": "nobody"},
+                    "ports": [{"port": 80}]}}
+    fs = lint.lint_groups([[mk_namespace(), mk_workload(), svc]])
+    assert rules_of(fs) == {"R02"}
+    [f] = fs
+    assert f.kind == "Service" and f.path == ".spec.selector"
+    # the same selector pointed at the real workload is clean
+    svc_ok = dict(svc, spec={"selector": {"app": "work"},
+                             "ports": [{"port": 80}]})
+    assert lint.lint_groups([[mk_namespace(), mk_workload(), svc_ok]]) == []
+
+
+def test_r02_external_allowlist_suppresses():
+    bundle = [[mk_namespace(),
+               mk_workload(pod={"serviceAccountName": "prometheus"})]]
+    assert rules_of(lint.lint_groups(bundle)) == {"R02"}
+    ext = set(lint.DEFAULT_EXTERNAL) | {"ServiceAccount/*/prometheus"}
+    assert lint.lint_groups(bundle, external=ext) == []
+
+
+def test_r03_selector_template_mismatch():
+    bundle = [[mk_namespace(),
+               mk_workload(labels={"app": "x"},
+                           template_labels={"app": "y"})]]
+    fs = lint.lint_groups(bundle)
+    assert rules_of(fs) == {"R03"}
+    [f] = fs
+    assert f.severity == "error"
+    assert f.path == ".spec.selector.matchLabels"
+    assert "422" in f.message
+
+
+def test_r03_match_expressions_only_selector_is_not_flagged():
+    """A legal apps/v1 selector using only matchExpressions cannot be
+    statically evaluated — the gate must never block a bundle the
+    apiserver would accept."""
+    obj = mk_workload()
+    obj["spec"]["selector"] = {"matchExpressions": [
+        {"key": "app", "operator": "In", "values": ["work"]}]}
+    assert lint.lint_groups([[mk_namespace(), obj]]) == []
+
+
+def test_r03_versioned_selector_key_warns_immutable():
+    labels = {"app": "w", "app.kubernetes.io/version": "1.2.3"}
+    bundle = [[mk_namespace(), mk_workload(labels=labels)]]
+    fs = lint.lint_groups(bundle)
+    assert rules_of(fs) == {"R03"}
+    [f] = fs
+    assert f.severity == "warn"
+    assert "immutable" in f.message
+
+
+def test_r04_cr_in_same_group_as_its_crd():
+    crd = operator_bundle.crd()
+    cr = {"apiVersion": "tpu-stack.dev/v1alpha1", "kind": "TpuStackPolicy",
+          "metadata": {"name": "default"}}
+    fs = lint.lint_groups([[crd, cr]])
+    assert rules_of(fs) == {"R04"}
+    [f] = fs
+    assert f.path == ".apiVersion" and "Established" in f.message
+    # a group boundary between them is the fix
+    assert lint.lint_groups([[crd], [cr]]) == []
+    # and a CR with no CRD anywhere is also R04 (unless allowlisted)
+    fs = lint.lint_groups([[cr]])
+    assert rules_of(fs) == {"R04"}
+    assert "no matches for kind" in fs[0].message
+    assert lint.lint_groups(
+        [[cr]], external={"TpuStackPolicy/*"}) == []
+
+
+def test_r04_namespaced_object_before_its_namespace():
+    bundle = [[mk_workload()], [mk_namespace()]]
+    fs = lint.lint_groups(bundle)
+    assert rules_of(fs) == {"R04"}
+    [f] = fs
+    assert f.path == ".metadata.namespace"
+    assert f.kind == "DaemonSet"
+
+
+def test_r04_reference_target_in_later_group():
+    pod = {"volumes": [{"name": "v", "configMap": {"name": "late-cm"}}]}
+    bundle = [[mk_namespace(), mk_workload(pod=pod)],
+              [mk_configmap("late-cm")]]
+    fs = lint.lint_groups(bundle)
+    assert rules_of(fs) == {"R04"}  # in-bundle, so NOT an R02 double-report
+    [f] = fs
+    assert f.path == ".spec.template.spec.volumes[0].configMap.name"
+    # same group is fine: config tier applies before the workload tier
+    assert lint.lint_groups([[mk_namespace(), mk_configmap("late-cm"),
+                              mk_workload(pod=pod)]]) == []
+
+
+def test_r05_tpu_request_limit_and_alignment():
+    spec = specmod.default_spec()  # v5e-8: aligned sizes 1, 4, 8
+    res = {"requests": {"google.com/tpu": "4"},
+           "limits": {"google.com/tpu": "8"}}
+    job = mk_workload(kind="Job", pod={"containers": [
+        {"name": "c", "image": "img:1", "resources": res}]})
+    fs = lint.lint_groups([[job]], spec=spec)
+    assert rules_of(fs) == {"R05"}
+    [f] = fs
+    assert f.path == ".spec.template.spec.containers[0].resources"
+    assert "request (4) != limit (8)" in f.message
+
+    res_bad = {"requests": {"google.com/tpu": "3"},
+               "limits": {"google.com/tpu": "3"}}
+    job = mk_workload(kind="Job", pod={"containers": [
+        {"name": "c", "image": "img:1", "resources": res_bad}]})
+    fs = lint.lint_groups([[job]], spec=spec)
+    assert rules_of(fs) == {"R05"}
+    assert "not an aligned size for v5e-8" in fs[0].message
+    assert "[1, 4, 8]" in fs[0].hint
+
+    res_ok = {"requests": {"google.com/tpu": "4"},
+              "limits": {"google.com/tpu": "4"}}
+    job = mk_workload(kind="Job", pod={"containers": [
+        {"name": "c", "image": "img:1", "resources": res_ok}]})
+    assert lint.lint_groups([[job]], spec=spec) == []
+
+
+def test_r05_host_access_audit_warns_and_allow_annotation():
+    pod = {"volumes": [{"name": "h", "hostPath": {"path": "/dev"}}],
+           "hostNetwork": True,
+           "containers": [{"name": "c", "image": "img:1",
+                           "securityContext": {"privileged": True}}]}
+    job = mk_workload(kind="Job", pod=pod)
+    fs = lint.lint_groups([[job]])
+    assert rules_of(fs) == {"R05"}
+    assert all(f.severity == "warn" for f in fs)
+    assert {f.path for f in fs} == {
+        ".spec.template.spec.hostNetwork",
+        ".spec.template.spec.volumes[0].hostPath",
+        ".spec.template.spec.containers[0].securityContext.privileged"}
+    # the scoped acknowledgement waives exactly the named checks...
+    job["metadata"]["annotations"] = {
+        lint.LINT_ALLOW_ANNOTATION: "hostPath, hostNetwork, privileged"}
+    assert lint.lint_groups([[job]]) == []
+    # ...but can never waive an error-severity finding
+    job["spec"]["template"]["spec"]["containers"][0]["resources"] = {
+        "requests": {"google.com/tpu": "1"},
+        "limits": {"google.com/tpu": "2"}}
+    fs = lint.lint_groups([[job]], spec=specmod.default_spec())
+    assert rules_of(fs) == {"R05"}
+    assert [f.severity for f in fs] == ["error"]
+    # operand workloads — an operand GVK (the C++ drift-watch twin set)
+    # that also carries the stack's identity labels — are exempt from the
+    # audit: host access is their job ...
+    host_pod = {"volumes": [{"name": "h", "hostPath": {"path": "/dev"}}],
+                "hostNetwork": True,
+                "containers": [{"name": "c", "image": "img:1",
+                                "securityContext": {"privileged": True}}]}
+    ds = mk_workload(pod=host_pod)
+    ds["metadata"]["labels"] = {"app.kubernetes.io/part-of": "tpu-stack"}
+    assert lint.lint_groups([[mk_namespace(), ds]]) == []
+    # ... but kind alone does not grant the exemption: an arbitrary
+    # privileged DaemonSet without the identity labels still warns
+    host_pod2 = {"containers": [{"name": "c", "image": "img:1",
+                                 "securityContext": {"privileged": True}}]}
+    stranger = mk_workload(name="stranger", pod=host_pod2)
+    fs = lint.lint_groups([[mk_namespace(), stranger]])
+    assert rules_of(fs) == {"R05"} and fs[0].severity == "warn"
+
+
+def test_r06_image_pins():
+    for image in ("repo/app", "repo/app:latest"):
+        fs = lint.lint_groups([[mk_namespace(), mk_workload(image=image)]])
+        assert rules_of(fs) == {"R06"}, image
+        [f] = fs
+        assert f.severity == "error"
+        assert f.path == ".spec.template.spec.containers[0].image"
+    # registry ports are not tags; digests are the strongest pin
+    for image in ("registry:5000/app:1.2", "repo/app@sha256:" + "0" * 64):
+        assert lint.lint_groups(
+            [[mk_namespace(), mk_workload(image=image)]]) == [], image
+
+
+def test_r06_probe_port_cross_check():
+    pod = {"containers": [{
+        "name": "c", "image": "img:1",
+        "ports": [{"name": "http", "containerPort": 80}],
+        "readinessProbe": {"httpGet": {"path": "/", "port": "web"}}}]}
+    fs = lint.lint_groups([[mk_namespace(),
+                            mk_workload(kind="Deployment", pod=pod)]])
+    assert rules_of(fs) == {"R06"}
+    [f] = fs
+    assert f.severity == "error"
+    assert f.path == \
+        ".spec.template.spec.containers[0].readinessProbe.httpGet.port"
+    # numeric-but-undeclared is a warning, not an error
+    pod["containers"][0]["readinessProbe"] = {
+        "httpGet": {"path": "/", "port": 8080}}
+    fs = lint.lint_groups([[mk_namespace(),
+                            mk_workload(kind="Deployment", pod=pod)]])
+    assert rules_of(fs) == {"R06"} and fs[0].severity == "warn"
+    # matching named/numeric probes are clean
+    pod["containers"][0]["readinessProbe"] = {
+        "httpGet": {"path": "/", "port": "http"}}
+    assert lint.lint_groups([[mk_namespace(),
+                              mk_workload(kind="Deployment",
+                                          pod=pod)]]) == []
+
+
+# ---------------------------------------------------------------------------
+# self-audit: everything we ship lints clean in strict mode
+
+
+def test_shipped_bundles_lint_clean_strict():
+    spec = specmod.default_spec()
+    for groups in (manifests.rollout_groups(spec),
+                   operator_bundle.operator_install_groups(spec),
+                   [jobs.render_validation_jobs(spec, 2)]):
+        assert lint.lint_groups(groups, spec=spec) == []
+
+
+@pytest.mark.parametrize("acc", ["v5e-1", "v5e-4", "v5e-8", "v4-8",
+                                 "v5e-16", "v5p-64", "v6e-8"])
+def test_lint_of_render_is_clean_for_valid_spec_sweep(acc):
+    """Property: lint(render(spec)) == [] for every valid ClusterSpec in
+    the sweep (all 32 operand enable combinations x topologies) — the
+    renderers may not emit anything the linter objects to, for any spec
+    a user can validly write."""
+    names = specmod.TpuSpec.OPERAND_NAMES
+    for bits in range(2 ** len(names)):
+        operands = {name: {"enabled": bool(bits >> i & 1)}
+                    for i, name in enumerate(names)}
+        spec = specmod.load(yaml.dump(
+            {"tpu": {"accelerator": acc, "operands": operands}}))
+        for groups in (manifests.rollout_groups(spec),
+                       operator_bundle.operator_install_groups(spec)):
+            findings = lint.lint_groups(groups, spec=spec)
+            assert findings == [], (acc, bits,
+                                    [f.line() for f in findings])
+
+
+def test_generated_chart_lints_clean():
+    """scripts/gen_chart.py output through the linter: helm installs
+    crds/ before templates render, so the chart lints as [crd] then the
+    rendered documents — clean under defaults and with the operator on."""
+    with open(os.path.join(CHART, "crds", "tpustackpolicy.yaml"),
+              encoding="utf-8") as f:
+        crd = yaml.safe_load(f)
+    for overrides in ({}, {"operator": {"enabled": True}},
+                      {"operator": {"enabled": True},
+                       "devicePlugin": {"enabled": False}}):
+        docs = gotmpl.render_chart(CHART, overrides)
+        findings = lint.lint_groups([[crd], docs],
+                                    spec=specmod.default_spec())
+        assert findings == [], [f.line() for f in findings]
+
+
+def test_tier_index_matches_apply_groups_tier_table():
+    """The linter's ordering model and the pipelined engine's tier split
+    must be the same function — R04 derives from kubeapply's table, so a
+    tier change there reshapes lint verdicts here, never silently."""
+    group = [mk_namespace(), operator_bundle.crd(), mk_sa("s"),
+             mk_configmap("c"), mk_workload(name="d"),
+             mk_workload(kind="Deployment", name="dep"),
+             mk_workload(kind="Job", name="j")]
+    want = [[o for o in group if lint._tier_index(o) == t]
+            for t in (0, 1, 2)]
+    assert kubeapply._group_tiers(group) == [t for t in want if t]
+
+
+def test_operand_workload_twin_table_pins_cpp_source():
+    """Python half of the twin-table pin (the C++ half lives in
+    native/operator/selftest.cc TestOperandWorkloadTwinTable): the kinds
+    kubeapi::OperandWorkloadKinds() constructs must equal the linter's
+    operand-workload GVK set, verified against the C++ source so the pin
+    holds even where no compiler is available."""
+    with open(os.path.join(REPO, "native", "operator", "kubeapi.cc"),
+              encoding="utf-8") as f:
+        src = f.read()
+    m = re.search(
+        r"OperandWorkloadKinds\(\)\s*\{.*?vector<std::string>\{([^}]*)\}",
+        src, re.S)
+    assert m, "kubeapi.cc OperandWorkloadKinds() initializer not found"
+    cpp_kinds = set(re.findall(r'"([A-Za-z]+)"', m.group(1)))
+    assert cpp_kinds == {kind for _, kind in lint.OPERAND_WORKLOAD_KINDS}
+    assert {api for api, _ in lint.OPERAND_WORKLOAD_KINDS} == {"apps/v1"}
+
+
+# ---------------------------------------------------------------------------
+# CLI + pre-apply gate
+
+
+def test_cli_lint_default_bundle_strict_clean(capsys):
+    assert cli.main(["lint", "--strict"]) == 0
+    assert cli.main(["lint", "--strict", "--operator"]) == 0
+    out = capsys.readouterr().out
+    assert "0 error(s), 0 warning(s)" in out
+
+
+def test_cli_lint_reports_findings_and_json(monkeypatch, capsys):
+    bad = [[mk_workload(labels={"app": "x"}, template_labels={"app": "y"})]]
+    monkeypatch.setattr(cli.manifests, "rollout_groups", lambda spec: bad)
+    assert cli.main(["lint"]) == 1
+    err = capsys.readouterr().err
+    assert "R03" in err and "1 error(s)" in err
+    assert cli.main(["lint", "--format", "json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["ok"] is False and doc["errors"] == 1
+    [f] = doc["findings"]
+    assert f["rule"] == "R03"
+    assert f["path"] == ".spec.selector.matchLabels"
+
+
+def test_cli_lint_strict_fails_on_warning_only(monkeypatch, capsys):
+    warn_only = [[mk_namespace(), mk_workload(
+        kind="Job", name="j",
+        pod={"volumes": [{"name": "h", "hostPath": {"path": "/x"}}]})]]
+    monkeypatch.setattr(cli.manifests, "rollout_groups",
+                        lambda spec: warn_only)
+    assert cli.main(["lint"]) == 0          # warnings tolerated by default
+    assert cli.main(["lint", "--strict"]) == 1
+    err = capsys.readouterr().err
+    assert "R05" in err
+
+
+def test_cli_lint_allow_external(monkeypatch):
+    bad = [[mk_namespace(),
+            mk_workload(pod={"serviceAccountName": "prom"})]]
+    monkeypatch.setattr(cli.manifests, "rollout_groups", lambda spec: bad)
+    assert cli.main(["lint"]) == 1
+    assert cli.main(["lint", "--allow-external",
+                     "ServiceAccount/*/prom"]) == 0
+
+
+def test_apply_lint_error_gate_issues_zero_requests(monkeypatch, capsys):
+    """The acceptance pin: `tpuctl apply --lint=error` against a crafted
+    bad bundle exits nonzero and the fake apiserver sees NOTHING."""
+    bad = [[mk_workload(labels={"app": "x"}, template_labels={"app": "y"})]]
+    monkeypatch.setattr(cli.manifests, "rollout_groups", lambda spec: bad)
+    with FakeApiServer() as api:
+        rc = cli.main(["apply", "--apiserver", api.url, "--lint=error"])
+        assert rc == 1
+        assert api.log == []  # zero requests reached the apiserver
+    out = capsys.readouterr()
+    assert "lint gate" in out.err
+    assert "R03" in out.out  # the findings were reported before the block
+
+
+def test_apply_lint_warn_reports_and_proceeds(monkeypatch, capsys):
+    bad = [[mk_workload(labels={"app": "x"}, template_labels={"app": "y"})]]
+    monkeypatch.setattr(cli.manifests, "rollout_groups", lambda spec: bad)
+    with FakeApiServer() as api:  # auto_ready: the rollout converges
+        rc = cli.main(["apply", "--apiserver", api.url])  # default: warn
+        assert rc == 0
+        assert len(api.log) > 0
+    out = capsys.readouterr().out
+    assert "R03" in out and "proceeding" in out
+
+
+def test_apply_lint_off_skips_analysis(monkeypatch, capsys):
+    bad = [[mk_workload(labels={"app": "x"}, template_labels={"app": "y"})]]
+    monkeypatch.setattr(cli.manifests, "rollout_groups", lambda spec: bad)
+    with FakeApiServer() as api:
+        assert cli.main(["apply", "--apiserver", api.url,
+                         "--lint=off"]) == 0
+    assert "R03" not in capsys.readouterr().out
+
+
+def test_apply_allow_external_reaches_the_gate(monkeypatch, capsys):
+    """A waiver that satisfies `tpuctl lint --allow-external X` must
+    satisfy `apply --lint=error` identically — the allowlist is plumbed
+    through both apply backends."""
+    bad = [[mk_namespace(),
+            mk_workload(pod={"serviceAccountName": "prom"})]]
+    monkeypatch.setattr(cli.manifests, "rollout_groups", lambda spec: bad)
+    with FakeApiServer() as api:
+        assert cli.main(["apply", "--apiserver", api.url,
+                         "--lint=error"]) == 1
+        assert api.log == []
+        assert cli.main(["apply", "--apiserver", api.url, "--lint=error",
+                         "--allow-external",
+                         "ServiceAccount/*/prom"]) == 0
+        assert len(api.log) > 0
+    capsys.readouterr()
+
+
+def test_gate_error_mode_with_warnings_only_proceeds_accurately():
+    """error mode with only warn-severity findings proceeds — and the
+    log line must say so for the mode actually in force, not claim the
+    gate was configured as warn."""
+    warn_only = [[mk_namespace(), mk_workload(
+        kind="Job", name="j",
+        pod={"volumes": [{"name": "h", "hostPath": {"path": "/x"}}]})]]
+    msgs = []
+    findings = lint.gate(warn_only, "error", log=msgs.append)
+    assert [f.severity for f in findings] == ["warn"]
+    assert any("--lint=error" in m and "warnings do not block" in m
+               for m in msgs)
+    assert not any("--lint=warn" in m for m in msgs)
+
+
+def test_kubectl_backend_gate_blocks_before_first_invocation():
+    calls = []
+
+    def runner(argv, input_text=None):
+        calls.append(argv)
+        return 0, "", ""
+
+    bad = [[mk_workload(labels={"app": "x"}, template_labels={"app": "y"})]]
+    with pytest.raises(lint.LintGateError):
+        kubeapply.apply_groups_kubectl(bad, wait=False, runner=runner,
+                                       lint_mode="error")
+    assert calls == []  # zero kubectl invocations
+
+
+def test_gate_rejects_unknown_mode():
+    with pytest.raises(ValueError):
+        lint.gate([[mk_namespace()]], "loud")
+
+
+def test_findings_sort_errors_first():
+    bundle = [[mk_namespace(),
+               mk_workload(image="repo/app:latest"),  # R06 error
+               mk_workload(kind="Job", name="j", pod={
+                   "volumes": [{"name": "h",
+                                "hostPath": {"path": "/x"}}]})]]  # R05 warn
+    fs = lint.lint_groups(bundle)
+    assert [f.severity for f in fs] == ["error", "warn"]
+    table = lint.format_table(fs)
+    assert table.splitlines()[-1] == "lint: 1 error(s), 1 warning(s)"

@@ -1,0 +1,27 @@
+"""Tier-1-adjacent static type gate: the strict-set modules
+(tpu_cluster/lint.py, spec.py, topology.py — the contracts the linter,
+CLI, and device plugin all lean on) must stay clean under
+``mypy --strict``. Shells scripts/typecheck.sh, the same entry CI runs,
+so the test and the pipeline cannot drift; skips cleanly on hosts whose
+environment ships no mypy (the driver containers)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytest.importorskip("mypy", reason="mypy not in this environment; "
+                    "pip install -e .[typecheck] to run the type gate")
+
+
+def test_strict_set_typechecks():
+    proc = subprocess.run(
+        ["sh", os.path.join(REPO, "scripts", "typecheck.sh")],
+        env={**os.environ, "PYTHON": sys.executable},
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, (
+        "mypy --strict regressions in the strict set:\n"
+        + proc.stdout + proc.stderr)

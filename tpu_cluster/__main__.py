@@ -6,8 +6,13 @@ with rendered artifacts and an ordered, readiness-gated apply:
 
   render   cluster-spec -> node-prep / kubeadm scripts, operand manifests,
            validation Jobs, operator install, operator bundle
+  lint     static cross-object analysis of the rendered bundle (rules
+           R01-R06: duplicates, dangling refs, selectors, apply order,
+           TPU resource sanity, image pins) — catches at render time what
+           the runbook only discovered at apply time
   apply    rollout against the apiserver, gating each group on readiness
-           (--operator deploys the in-cluster controller instead)
+           (--operator deploys the in-cluster controller instead); runs
+           the linter first (--lint=warn default, error blocks pre-request)
   delete   remove everything a spec renders, reverse order
            (helm uninstall analog, reference README.md kind-script flow)
   verify   the executable acceptance runbook (BASELINE configs)
@@ -23,7 +28,7 @@ from typing import Dict
 
 import yaml
 
-from . import kubeapply, spec as specmod, triage, verify
+from . import kubeapply, lint as lintmod, spec as specmod, triage, verify
 from .render import jobs, kubeadm, manifests, nodeprep, operator_bundle
 
 
@@ -112,16 +117,27 @@ def _kubectl_mode_flags_ok(args, cmd: str) -> bool:
 
 
 def _spec_groups(args):
+    """(spec, groups): the rendered bundle an apply/delete/lint command
+    operates on — operand rollout groups, or the operator install waves
+    with --operator (the TpuStackPolicy CR must trail its CRD's
+    establishment, see operator_bundle.operator_install_groups)."""
     spec = _load_spec(args.spec)
     if args.operator:
-        # two waves: the TpuStackPolicy CR must trail its CRD's
-        # establishment (see operator_bundle.operator_install_groups)
-        return operator_bundle.operator_install_groups(spec)
-    return manifests.rollout_groups(spec)
+        return spec, operator_bundle.operator_install_groups(spec)
+    return spec, manifests.rollout_groups(spec)
+
+
+def _lint_external(args):
+    """The pre-existing-on-cluster allowlist: built-ins plus every
+    --allow-external the invocation carried (shared by lint and the
+    apply gate, so a waiver that satisfies `tpuctl lint` also satisfies
+    `tpuctl apply --lint=error`)."""
+    return frozenset(lintmod.DEFAULT_EXTERNAL) | \
+        frozenset(getattr(args, "allow_external", None) or [])
 
 
 def cmd_apply(args) -> int:
-    groups = _spec_groups(args)
+    spec, groups = _spec_groups(args)
     if args.max_inflight is not None and not args.parallel:
         print("apply: note: --max-inflight has no effect without "
               "--parallel", file=sys.stderr)
@@ -158,7 +174,9 @@ def cmd_apply(args) -> int:
                     stage_timeout=args.stage_timeout, poll=args.poll,
                     allow_empty_daemonsets=args.allow_empty_daemonsets,
                     log=lambda msg: print(msg), max_inflight=max_inflight,
-                    watch_ready=args.watch, journal=journal)
+                    watch_ready=args.watch, journal=journal,
+                    lint_mode=args.lint, lint_spec=spec,
+                    lint_external=_lint_external(args))
             finally:
                 client.close()
             if client.retries:
@@ -189,7 +207,8 @@ def cmd_apply(args) -> int:
                 groups, wait=args.wait, stage_timeout=args.stage_timeout,
                 allow_empty_daemonsets=args.allow_empty_daemonsets,
                 log=lambda msg: print(msg), retry=_retry_policy(args),
-                journal=journal)
+                journal=journal, lint_mode=args.lint, lint_spec=spec,
+                lint_external=_lint_external(args))
     except kubeapply.ApplyError as exc:
         print(f"apply failed: {exc}", file=sys.stderr)
         return 1
@@ -201,7 +220,7 @@ def cmd_apply(args) -> int:
 
 
 def cmd_delete(args) -> int:
-    groups = _spec_groups(args)
+    _spec, groups = _spec_groups(args)
     try:
         client = _rest_client(args)
         if client is not None:
@@ -220,6 +239,30 @@ def cmd_delete(args) -> int:
         return 1
     print("delete: done")
     return 0
+
+
+def cmd_lint(args) -> int:
+    """Static cross-object analysis of the rendered bundle — the pre-apply
+    half of the acceptance runbook. Exit 0 = clean (warnings tolerated
+    unless --strict), 1 = findings, 2 = bad invocation/spec."""
+    spec, groups = _spec_groups(args)
+    findings = lintmod.lint_groups(groups, spec=spec,
+                                   external=_lint_external(args))
+    errs = lintmod.errors(findings)
+    failing = findings if args.strict else errs
+    if args.format == "json":
+        # machine-readable (CI gates, editor integrations)
+        print(json.dumps({
+            "ok": not failing,
+            "errors": len(errs),
+            "warnings": len(findings) - len(errs),
+            "strict": args.strict,
+            "findings": [f.to_dict() for f in findings],
+        }))
+    else:
+        print(lintmod.format_table(findings),
+              file=sys.stderr if failing else sys.stdout)
+    return 1 if failing else 0
 
 
 def cmd_verify(args) -> int:
@@ -336,7 +379,43 @@ def build_parser() -> argparse.ArgumentParser:
                         "marks converged (and re-send nothing already "
                         "applied in the interrupted group); a journal from "
                         "a different rendered bundle is discarded")
+    p.add_argument("--lint", choices=("off", "warn", "error"),
+                   default="warn",
+                   help="pre-apply static analysis of the rendered bundle "
+                        "(tpuctl lint rules R01-R06): warn reports "
+                        "findings and proceeds (default); error blocks "
+                        "the rollout BEFORE the first apiserver request "
+                        "when any error-severity finding exists")
+    p.add_argument("--allow-external", action="append", default=[],
+                   metavar="KIND[/NS]/NAME",
+                   help="lint-gate allowlist entry for a reference that "
+                        "pre-exists on-cluster (same syntax as tpuctl "
+                        "lint --allow-external; repeatable)")
     p.set_defaults(fn=cmd_apply)
+
+    p = sub.add_parser(
+        "lint", help="static cross-object analysis of the rendered "
+                     "bundle (duplicates, dangling refs, selector and "
+                     "ordering integrity, TPU resource sanity, image "
+                     "pins) — shift apply-time failures left of the "
+                     "first request")
+    p.add_argument("--spec", default="", help="cluster-spec YAML path "
+                                              "(default: built-in defaults)")
+    p.add_argument("--operator", action="store_true",
+                   help="lint the operator install waves (CRD, policy CR, "
+                        "bundle, controller) instead of the operand groups")
+    p.add_argument("--format", choices=("table", "json"), default="table",
+                   help="findings as a human table (default) or one JSON "
+                        "document")
+    p.add_argument("--strict", action="store_true",
+                   help="exit nonzero on warnings too (CI mode; the "
+                        "shipped default bundle must pass this)")
+    p.add_argument("--allow-external", action="append", default=[],
+                   metavar="KIND[/NS]/NAME",
+                   help="reference allowlisted as pre-existing on-cluster "
+                        "(repeatable; '*' wildcards namespace/name, e.g. "
+                        "ServiceAccount/*/default)")
+    p.set_defaults(fn=cmd_lint)
 
     p = sub.add_parser(
         "delete", help="remove everything a spec renders, reverse order "

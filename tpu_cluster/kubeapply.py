@@ -1080,8 +1080,10 @@ def apply_groups_kubectl(groups: Sequence[Sequence[Dict[str, Any]]],
                          runner=None, allow_empty_daemonsets: bool = False,
                          log=lambda msg: None,
                          retry: Optional[RetryPolicy] = None,
-                         journal: Optional[RolloutJournal] = None
-                         ) -> GroupResult:
+                         journal: Optional[RolloutJournal] = None,
+                         lint_mode: str = "off",
+                         lint_spec=None,
+                         lint_external=None) -> GroupResult:
     """The kubectl-CLI twin of :func:`apply_groups` for hosts where only
     kubectl (not a proxied apiserver URL) is available — the common case on
     the reference guide's control-plane node. Readiness gating uses
@@ -1094,10 +1096,16 @@ def apply_groups_kubectl(groups: Sequence[Sequence[Dict[str, Any]]],
     rejected manifest — so the group apply is RETRYABLE under ``retry``;
     any other nonzero rc is terminal. ``journal`` records converged groups
     (group granularity only: kubectl applies a whole group per
-    invocation), so ``--resume`` skips them."""
+    invocation), so ``--resume`` skips them.
+
+    ``lint_mode``/``lint_spec`` run the same pre-apply static gate as the
+    REST path — ``--lint=error`` blocks before the first kubectl
+    invocation."""
     import json as jsonmod
 
     import yaml
+
+    _lint_gate(groups, lint_mode, lint_spec, log, lint_external)
 
     if runner is None:
         def runner(argv, input_text=None,
@@ -1310,12 +1318,33 @@ def _note_ready_stats(result: GroupResult, stats: Dict[str, Any]) -> None:
         result.ready_mode = mode
 
 
+def _lint_gate(groups: Sequence[Sequence[Dict[str, Any]]],
+               lint_mode: str, lint_spec, log,
+               lint_external=None) -> None:
+    """Run the pre-apply static analysis (tpu_cluster.lint) when a caller
+    asked for it. Lazy import: lint imports THIS module for the shared
+    tier table, so the dependency must point one way at load time. In
+    ``error`` mode a finding raises before the rollout's first request.
+    ``lint_external`` extends the pre-existing-on-cluster allowlist
+    (``tpuctl apply --allow-external``) so a bundle that passes ``tpuctl
+    lint --allow-external X`` passes the gate with the same waiver."""
+    if lint_mode and lint_mode != "off":
+        from . import lint as lint_static
+        external = (lint_static.DEFAULT_EXTERNAL if lint_external is None
+                    else lint_external)
+        lint_static.gate(groups, lint_mode, spec=lint_spec, log=log,
+                         external=external)
+
+
 def apply_groups(client: Client, groups: Sequence[Sequence[Dict[str, Any]]],
                  wait: bool = True, stage_timeout: float = 600,
                  poll: float = 1.0, allow_empty_daemonsets: bool = False,
                  log=lambda msg: None, max_inflight: int = 1,
                  watch_ready: bool = False,
-                 journal: Optional[RolloutJournal] = None) -> GroupResult:
+                 journal: Optional[RolloutJournal] = None,
+                 lint_mode: str = "off",
+                 lint_spec=None,
+                 lint_external=None) -> GroupResult:
     """Ordered, readiness-gated rollout of manifest groups — the reference's
     operator behavior (SURVEY.md §3.3) as a one-shot procedure.
 
@@ -1331,7 +1360,17 @@ def apply_groups(client: Client, groups: Sequence[Sequence[Dict[str, Any]]],
     already-applied objects inside the interrupted group are not re-sent —
     a SIGKILL'd rollout restarts idempotently, re-applying only unfinished
     work. Retries against a flaky apiserver come from the Client's
-    RetryPolicy — this function never sees a retryable failure."""
+    RetryPolicy — this function never sees a retryable failure.
+
+    ``lint_mode`` (``tpuctl apply --lint=error|warn|off``) runs the static
+    bundle analysis (tpu_cluster.lint) BEFORE the first request: ``warn``
+    reports findings through ``log`` and proceeds; ``error`` raises
+    :class:`tpu_cluster.lint.LintGateError` on any error-severity
+    finding, guaranteeing zero requests reach the apiserver. ``lint_spec``
+    (the ClusterSpec the bundle was rendered from) enables the
+    accelerator-aware checks (R05 alignment); ``lint_external`` extends
+    the reference allowlist (``--allow-external``)."""
+    _lint_gate(groups, lint_mode, lint_spec, log, lint_external)
     result = GroupResult()
     if max_inflight > 1:
         try:

@@ -19,7 +19,7 @@ import dataclasses
 import ipaddress
 import re
 from dataclasses import dataclass, field
-from typing import Any, Dict, Optional
+from typing import Any, Collection, Dict, Optional, Tuple
 
 # camelCase and acronym spellings both normalise: podCidr and the
 # Kubernetes-canonical podCIDR -> pod_cidr.
@@ -41,7 +41,7 @@ DEFAULT_FLANNEL_URL = (
 )
 # Cloud metadata endpoints for control-plane address discovery. The reference
 # hardcodes AWS IMDSv1 (README.md:54); we parameterise (SURVEY.md §2.1).
-METADATA_ENDPOINTS = {
+METADATA_ENDPOINTS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "aws": ("http://169.254.169.254/latest/meta-data/local-ipv4", ()),
     "gcp": (
         "http://metadata.google.internal/computeMetadata/v1/instance/network-interfaces/0/ip",
@@ -165,7 +165,8 @@ class ClusterSpec:
         return self
 
 
-def _build(cls, data: Dict[str, Any], path: str, forbidden=()):
+def _build(cls: Any, data: Dict[str, Any], path: str,
+           forbidden: Collection[str] = ()) -> Any:
     """Construct dataclass ``cls`` from a camelCase-keyed mapping.
 
     ``forbidden`` names dataclass fields that load() fills programmatically
@@ -175,7 +176,7 @@ def _build(cls, data: Dict[str, Any], path: str, forbidden=()):
     if not isinstance(data, dict):
         raise SpecError(f"{path}: expected mapping, got {type(data).__name__}")
     fields = {f.name: f for f in dataclasses.fields(cls)}
-    kwargs = {}
+    kwargs: Dict[str, Any] = {}
     for key, value in data.items():
         name = _snake(key)
         if name not in fields or name in forbidden:
@@ -189,16 +190,17 @@ def load(text: str) -> ClusterSpec:
     if not isinstance(doc, dict):
         raise SpecError("spec must be a YAML mapping")
     cluster = dict(doc.get("cluster") or {})
-    cp = _build(ControlPlaneEndpoint, cluster.pop("controlPlaneEndpoint", None) or {},
-                "cluster.controlPlaneEndpoint")
-    spec = _build(ClusterSpec, cluster, "cluster",
-                  forbidden=("control_plane", "tpu"))
+    cp: ControlPlaneEndpoint = _build(
+        ControlPlaneEndpoint, cluster.pop("controlPlaneEndpoint", None) or {},
+        "cluster.controlPlaneEndpoint")
+    spec: ClusterSpec = _build(ClusterSpec, cluster, "cluster",
+                               forbidden=("control_plane", "tpu"))
     spec.control_plane = cp
 
     tpu_doc = dict(doc.get("tpu") or {})
     operands_doc = tpu_doc.pop("operands", {})
-    tpu = _build(TpuSpec, tpu_doc, "tpu", forbidden=("operands",))
-    operands = {}
+    tpu: TpuSpec = _build(TpuSpec, tpu_doc, "tpu", forbidden=("operands",))
+    operands: Dict[str, OperandSpec] = {}
     for name, od in (operands_doc or {}).items():
         if isinstance(od, bool):
             od = {"enabled": od}  # `devicePlugin: false` shorthand

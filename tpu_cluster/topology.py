@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import itertools
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 
 @dataclass(frozen=True)
@@ -288,7 +288,7 @@ def aligned_subsets(acc: AcceleratorType, size: int) -> List[Tuple[int, ...]]:
     coords = chip_coords(acc)
     coord_to_id = {c: i for i, c in enumerate(coords)}
     xdim, ydim = acc.topology
-    out = set()
+    out: Set[Tuple[int, ...]] = set()
     for (w, h) in {shape, shape[::-1]}:
         if w > xdim or h > ydim:
             continue
@@ -366,9 +366,9 @@ def validate_allocation(acc: AcceleratorType, device_ids: Sequence[int]) -> Tupl
     )
 
 
-def all_validation_cases(acc: AcceleratorType) -> List[Dict]:
+def all_validation_cases(acc: AcceleratorType) -> List[Dict[str, object]]:
     """Exhaustive (size<=chips) validate_allocation cases for golden tests."""
-    cases = []
+    cases: List[Dict[str, object]] = []
     ids = range(acc.chips_per_host)
     for n in range(1, acc.chips_per_host + 1):
         for combo in itertools.combinations(ids, n):
