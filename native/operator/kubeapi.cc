@@ -111,6 +111,14 @@ std::vector<std::string> SweepCollections(const std::string& ns) {
   return out;
 }
 
+const char* FieldManager() {
+  // Twin of tpu_cluster/kubeapply.py OPERATOR_FIELD_MANAGER (grep-pinned
+  // by tests/test_apply.py; checked against selftest.cc). Changing it
+  // orphans every field the deployed fleet's operators own — the old
+  // manager's entries linger in managedFields until force-reapplied.
+  return "tpu-operator";
+}
+
 const std::vector<std::string>& OperandWorkloadKinds() {
   // Twin table of tpu_cluster/lint.py OPERAND_WORKLOAD_KINDS (both are
   // apps/v1 kinds; CollectionPath supplies the group). A kind added here

@@ -46,6 +46,16 @@ std::vector<std::string> SweepCollections(const std::string& ns);
 // pattern as kubeclient::RetryableStatus).
 const std::vector<std::string>& OperandWorkloadKinds();
 
+// The field manager this operator applies under (server-side apply,
+// KEP-555): per-field ownership in metadata.managedFields is tracked per
+// manager, and the operator's name is deliberately DISTINCT from the
+// CLI's ("tpuctl", kubeapply.FIELD_MANAGER) so the two co-own the
+// bundle's fields instead of force-reverting each other. The C++ half of
+// a pinned twin table: kubeapply.OPERATOR_FIELD_MANAGER names the same
+// string, pinned by selftest.cc and a Python source-grep in
+// tests/test_apply.py (the RetryableStatus pattern).
+const char* FieldManager();
+
 }  // namespace kubeapi
 
 #endif  // TPU_NATIVE_OPERATOR_KUBEAPI_H_

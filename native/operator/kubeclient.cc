@@ -146,6 +146,8 @@ Response PlainHttp(const Config& cfg, const Url& url,
   std::string req = method + " " + url.base_path + path + " HTTP/1.1\r\n" +
                     "Host: " + url.host + "\r\n" +
                     "Connection: close\r\nAccept: application/json\r\n";
+  if (!cfg.user_agent.empty())
+    req += "User-Agent: " + cfg.user_agent + "\r\n";
   if (!cfg.token.empty()) req += "Authorization: Bearer " + cfg.token + "\r\n";
   if (!body.empty()) {
     req += "Content-Type: " + content_type + "\r\n";
@@ -296,6 +298,8 @@ Response CurlHttps(const Config& cfg, const std::string& method,
       "-w", "\n%{http_code}",
       "-H", "Accept: application/json",
   };
+  if (!cfg.user_agent.empty())
+    args.insert(args.end(), {"-A", cfg.user_agent});
   if (hdr_fd >= 0)
     args.insert(args.end(), {"-H", std::string("@") + hdr_path});
   if (!cfg.ca_file.empty()) {
@@ -529,6 +533,8 @@ bool WatchStream::Open(const Config& cfg, const std::string& path_and_query,
         std::to_string(max_seconds),
         "-H", "Accept: application/json",
     };
+    if (!cfg.user_agent.empty())
+      args.insert(args.end(), {"-A", cfg.user_agent});
     if (!cfg.token.empty()) {
       char hdr_path[] = "/tmp/tpuop-watch-hdr-XXXXXX";
       int hdr_fd = mkstemp(hdr_path);
@@ -588,6 +594,8 @@ bool WatchStream::Open(const Config& cfg, const std::string& path_and_query,
   std::string req = "GET " + url.base_path + path_and_query + " HTTP/1.1\r\n" +
                     "Host: " + url.host + "\r\n" +
                     "Connection: close\r\nAccept: application/json\r\n";
+  if (!cfg.user_agent.empty())
+    req += "User-Agent: " + cfg.user_agent + "\r\n";
   if (!cfg.token.empty()) req += "Authorization: Bearer " + cfg.token + "\r\n";
   req += "\r\n";
   size_t off = 0;

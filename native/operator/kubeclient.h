@@ -58,6 +58,13 @@ struct Config {
   std::string base_url;     // e.g. https://10.96.0.1:443 or http://127.0.0.1:8001
   std::string token;        // bearer token ("" = none)
   std::string ca_file;      // CA bundle for https
+  // Sent as User-Agent on every request. Doubles as the field-manager
+  // name real apiservers record for NON-apply writes (the GET+merge-
+  // PATCH fallback path): without it those fields would land in
+  // managedFields under "curl/x.y", which `tpuctl verify`'s ownership
+  // check would flag as foreign drift. Same parity fix as the Python
+  // client's "User-Agent: tpuctl"; defaults to the operator's manager.
+  std::string user_agent = "tpu-operator";
   // Without a ca_file, https requests FAIL unless this is set (sending a
   // ServiceAccount token over unverified TLS would hand cluster-admin-ish
   // credentials to any MITM). InCluster() sets it, loudly, when the

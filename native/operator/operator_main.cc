@@ -10,8 +10,11 @@
 //    by `tpu_cluster.render.operator_bundle`): flat files named
 //    "NN-stage--object.json"; lexicographic order = rollout order, the
 //    "NN-stage" prefix is the readiness gate boundary;
-//  - applies each stage against the apiserver (POST when absent,
-//    merge-PATCH when present — drift in our own operands is reverted);
+//  - applies each stage against the apiserver via server-side apply
+//    (one apply PATCH per object under the "tpu-operator" field manager,
+//    kubeapi::FieldManager(); drift in our own operands is force-reverted
+//    per-field), degrading to GET-then-POST/merge-PATCH — sticky per
+//    process — when the apiserver predates SSA (415/400);
 //  - waits for every workload object in the stage to be Ready before
 //    touching the next stage (helm --wait / operator ordering analog);
 //  - loops forever re-reconciling (DaemonSet deleted by hand -> recreated
@@ -1480,6 +1483,41 @@ class Operator {
       bo->error = err;
       return false;
     }
+    // Primary path: server-side apply — ONE apply PATCH under this
+    // operator's field manager, no prior GET. force=true is deliberate:
+    // reverting drift in our own operands is the reconcile contract, and
+    // with per-field ownership the force only claims fields the bundle
+    // actually specifies (tpuctl's co-applied fields carry equal values,
+    // so the two managers co-own instead of fighting). 415/400 = the
+    // apiserver predates SSA: flip the sticky fallback and use the
+    // GET+merge-PATCH path below for the rest of this process's life.
+    if (!ssa_unsupported_) {
+      std::string apply_path = obj_path + "?fieldManager=" +
+                               kubeapi::FieldManager() + "&force=true";
+      kubeclient::Response applied =
+          kubeclient::Call(cfg_, "PATCH", apply_path, bo->obj->Dump(),
+                           "application/apply-patch+yaml");
+      if (applied.ok()) {
+        RememberUid(bo, applied.body);
+        bo->applied = true;
+        return true;
+      }
+      if (applied.status == 415 || applied.status == 400) {
+        ssa_unsupported_ = true;
+        fprintf(stderr,
+                "tpu-operator: server-side apply unsupported (HTTP %d); "
+                "falling back to GET+merge-PATCH for this process\n",
+                applied.status);
+        // fall through to the merge path (which also surfaces a genuine
+        // 400 — a rejected manifest fails the POST/PATCH there too)
+      } else {
+        bo->error = "SSA PATCH " + obj_path + " -> " +
+                    std::to_string(applied.status) + " " +
+                    (applied.status ? applied.body.substr(0, 160)
+                                    : applied.error);
+        return false;
+      }
+    }
     kubeclient::Response get = kubeclient::Call(cfg_, "GET", obj_path);
     if (get.ok()) RememberUid(bo, get.body);
     if (get.status == 404) {
@@ -1556,6 +1594,10 @@ class Operator {
   kubeclient::Config cfg_;
   std::vector<BundleObject> bundle_;
   StatusServer status_;
+  // Sticky server-side-apply capability (probed by the first apply of
+  // the process): once an apply PATCH answers 415/400, every later
+  // ApplyObject uses the GET+merge-PATCH path without re-probing.
+  bool ssa_unsupported_ = false;
   int passes_ = 0;
   int event_seq_ = 0;
   bool healthy_ = false;

@@ -236,6 +236,17 @@ static void TestOperandWorkloadTwinTable() {
   }
 }
 
+static void TestFieldManagerTwin() {
+  // The field-manager twin table (RetryableStatus pattern): the name the
+  // operator applies under is pinned here and grep-pinned from Python
+  // (tests/test_apply.py checks kubeapi.cc's initializer equals
+  // kubeapply.OPERATOR_FIELD_MANAGER, and that it differs from the
+  // CLI's "tpuctl"). Per-field ownership means a silent rename orphans
+  // every field the deployed fleet's operators own.
+  CHECK(strcmp(kubeapi::FieldManager(), "tpu-operator") == 0);
+  CHECK(strcmp(kubeapi::FieldManager(), "tpuctl") != 0);
+}
+
 static void TestWatchBackoff() {
   // Doubling from base, capped: the operand drift-watch reconnect
   // schedule. A persistently kClosed stream (each https open is a curl
@@ -262,6 +273,7 @@ int main() {
   TestReadiness();
   TestRetryClassification();
   TestOperandWorkloadTwinTable();
+  TestFieldManagerTwin();
   TestWatchBackoff();
   if (g_failures) {
     fprintf(stderr, "operator_selftest: %d FAILURES\n", g_failures);

@@ -7,6 +7,10 @@ Speaks the small REST subset the tpu-operator and `tpuctl apply` use:
   POST   <collection>          -> 201, stores body at collection/<name>
   PUT    <collection>/<name>   -> 200, replaces
   PATCH  <collection>/<name>   -> 200, merge-patch (RFC 7386: null deletes)
+  PATCH  <collection>/<name>?fieldManager=M[&force=true]
+         with application/apply-patch+yaml -> server-side apply (KEP-555):
+         per-field managedFields ownership, dropped-field pruning, 409
+         Conflict naming the competing manager (see _serve_ssa)
   DELETE <collection>/<name>   -> 200 | 404
 
 The store is a flat {path: object} dict — the path grammar
@@ -40,6 +44,96 @@ def merge_patch(target: Any, patch: Any) -> Any:
             out.pop(k, None)
         else:
             out[k] = merge_patch(out.get(k), v)
+    return out
+
+
+# --------------------------------------------------------------- server-side
+# apply (KEP-555). The fake implements the real mechanism at the granularity
+# the clients rely on: per-field ownership tracked per fieldManager in
+# metadata.managedFields, apply-merge that prunes fields a manager owned
+# before but dropped from its new intent, and 409 Conflict (naming the
+# competing manager) when an apply would change a field another manager
+# owns, unless ?force=true takes it over. Simplification, documented and
+# mirrored by the Python twin (kubeapply._fields_v1): arrays are ATOMIC
+# leaves (x-kubernetes-list-type: atomic semantics) — no k:/v: list-member
+# keys — which is exactly how merge-patch already treated them here.
+
+def field_set(obj: Any) -> Dict[str, Any]:
+    """fieldsV1-style ownership descriptor for one applied intent: nested
+    ``{"f:<key>": {...}}`` dicts mirroring the object's dict structure;
+    scalars, arrays and nulls are leaves (``{}``). Twin of
+    ``kubeapply._fields_v1`` (parity-pinned by tests/test_pipeline.py)."""
+    out: Dict[str, Any] = {}
+    if not isinstance(obj, dict):
+        return out
+    for k, v in obj.items():
+        out[f"f:{k}"] = field_set(v) if isinstance(v, dict) else {}
+    return out
+
+
+def _leaf_paths(fields: Dict[str, Any], prefix=()) -> set:
+    """fieldsV1 nested dict -> set of owned leaf paths (tuples of keys)."""
+    paths = set()
+    for k, v in fields.items():
+        key = prefix + (k[2:],)  # strip the "f:" marker
+        if v:
+            paths |= _leaf_paths(v, key)
+        else:
+            paths.add(key)
+    return paths
+
+
+def _paths_to_fields(paths) -> Dict[str, Any]:
+    """Inverse of :func:`_leaf_paths` (canonical nested fieldsV1 form)."""
+    out: Dict[str, Any] = {}
+    for path in sorted(paths):
+        node = out
+        for k in path:
+            node = node.setdefault(f"f:{k}", {})
+    return out
+
+
+_MISSING = object()
+
+
+def _value_at(obj: Any, path) -> Any:
+    for k in path:
+        if not isinstance(obj, dict) or k not in obj:
+            return _MISSING
+        obj = obj[k]
+    return obj
+
+
+def _delete_at(obj: Any, path) -> None:
+    """Remove the value at ``path``, dropping dict parents it empties."""
+    if not path:
+        return
+    parents = []
+    node = obj
+    for k in path[:-1]:
+        if not isinstance(node, dict) or k not in node:
+            return
+        parents.append((node, k))
+        node = node[k]
+    if isinstance(node, dict):
+        node.pop(path[-1], None)
+    for parent, key in reversed(parents):
+        child = parent.get(key)
+        if isinstance(child, dict) and not child:
+            del parent[key]
+
+
+def ssa_merge(target: Any, intent: Any) -> Any:
+    """Apply-merge: dicts merge per key, everything else (scalars, arrays,
+    nulls) replaces wholesale. Unlike RFC 7386 there is NO null-deletes
+    rule — removal happens through ownership pruning, not the payload."""
+    if not isinstance(intent, dict):
+        return intent
+    if not isinstance(target, dict):
+        target = {}
+    out = dict(target)
+    for k, v in intent.items():
+        out[k] = ssa_merge(out.get(k), v)
     return out
 
 
@@ -124,11 +218,13 @@ class ChaosEngine:
     Optional keys on any fault: ``at`` (seconds after start(), default 0),
     ``match`` (path substring; ``exact: True`` for equality), ``method``
     (exact HTTP method), ``watch`` (True = only ``?watch=1`` GETs),
-    ``body`` (override the injected Status body), ``retry_after``
-    (seconds, emitted as a Retry-After header — fractional allowed so
-    tests stay fast; real servers send integers). A status fault with
-    neither ``for`` nor ``count`` fires on every match until clear().
-    Every fired fault is recorded in ``fired`` for assertions."""
+    ``ssa`` (True = only ``application/apply-patch+yaml`` PATCHes — the
+    server-side-apply requests), ``body`` (override the injected Status
+    body), ``retry_after`` (seconds, emitted as a Retry-After header —
+    fractional allowed so tests stay fast; real servers send integers).
+    A status fault with neither ``for`` nor ``count`` fires on every
+    match until clear(). Every fired fault is recorded in ``fired`` for
+    assertions."""
 
     def __init__(self, script):
         self._lock = threading.Lock()
@@ -160,7 +256,8 @@ class ChaosEngine:
         with self._lock:
             self._faults = []
 
-    def intercept(self, method: str, path: str, is_watch: bool):
+    def intercept(self, method: str, path: str, is_watch: bool,
+                  is_ssa: bool = False):
         """None (pass through) | ("drop",) | ("status", code, headers,
         body) for one request."""
         if self._t0 is None:
@@ -180,6 +277,8 @@ class ChaosEngine:
                 if f.get("method") and f["method"] != method:
                     continue
                 if f.get("watch") and not is_watch:
+                    continue
+                if f.get("ssa") and not is_ssa:
                     continue
                 m = f.get("match")
                 if m and (path != m if f.get("exact") else m not in path):
@@ -268,8 +367,13 @@ class FakeApiServer:
                  ghost_get_404=(), reject_posts: Optional[Dict[str, int]] = None,
                  latency_s: float = 0.0,
                  reject_watch: Optional[Dict[str, int]] = None,
-                 watch_gone_once=(), chaos=None):
+                 watch_gone_once=(), chaos=None,
+                 ssa_unsupported: bool = False):
         self.auto_ready = auto_ready
+        # An apiserver predating server-side apply: every
+        # application/apply-patch+yaml PATCH answers 415, the capability
+        # signal that flips the clients' sticky GET+merge-PATCH fallback.
+        self.ssa_unsupported = ssa_unsupported
         # Injected per-request service time (scripts/bench_rollout.py and
         # the shared-watcher tests): slept before EVERY handled request, on
         # that request's own handler thread, so concurrent clients overlap
@@ -282,6 +386,14 @@ class FakeApiServer:
         for path, rc in (reject_posts or {}).items():
             faults.append({"status": rc, "method": "POST", "match": path,
                            "exact": True,
+                           "body": {"kind": "Status", "code": rc,
+                                    "reason": "Forbidden"}})
+            # The same denial must cover the collection's server-side-apply
+            # creates: an RBAC rule that rejects POSTs rejects the
+            # equivalent apply PATCH too (kube RBAC gates the verb+resource,
+            # not the wire encoding).
+            faults.append({"status": rc, "method": "PATCH", "ssa": True,
+                           "match": path + "/",
                            "body": {"kind": "Status", "code": rc,
                                     "reason": "Forbidden"}})
         for path, rc in (reject_watch or {}).items():
@@ -339,7 +451,8 @@ class FakeApiServer:
                     fake.log.append((self.command, self.path))
                     fake.headers_seen.append(dict(self.headers))
 
-            def _chaos(self, is_watch: bool = False) -> bool:
+            def _chaos(self, is_watch: bool = False,
+                       is_ssa: bool = False) -> bool:
                 """True when a scripted fault consumed this request —
                 either an injected status reply was sent, or the
                 connection was dropped without one. Must be called AFTER
@@ -348,7 +461,8 @@ class FakeApiServer:
                 if fake.chaos is None:
                     return False
                 path = self.path.partition("?")[0]
-                act = fake.chaos.intercept(self.command, path, is_watch)
+                act = fake.chaos.intercept(self.command, path, is_watch,
+                                           is_ssa)
                 if act is None:
                     return False
                 if act[0] == "drop":
@@ -510,6 +624,35 @@ class FakeApiServer:
                 else:
                     self._reply(200, obj)
 
+            def _finalize_create_locked(self, path: str, obj: Dict[str, Any],
+                                        manager: str = "",
+                                        intent_fields=None) -> Dict[str, Any]:
+                """Stamp a freshly-created object the way the apiserver
+                does (uid, generation, auto_ready status + its kubelet
+                ownership entry, apply-manager ownership for SSA creates —
+                ``intent_fields`` is the field set of the RAW intent, never
+                of the stamped object), store it and wake watchers. Caller
+                holds fake._lock."""
+                obj = dict(obj)
+                obj["metadata"] = dict(obj.get("metadata") or {})
+                obj["metadata"].setdefault(
+                    "uid", f"uid-{len(fake.store) + 1:04d}")
+                if obj.get("kind") in GENERATION_KINDS:
+                    obj["metadata"]["generation"] = 1
+                if manager:
+                    obj["metadata"]["managedFields"] = [
+                        {"manager": manager, "operation": "Apply",
+                         "fieldsV1": intent_fields or {}}]
+                if fake.auto_ready:
+                    st = ready_status(obj)
+                    if st:
+                        obj["status"] = st
+                        fake._note_kubelet_status(obj)
+                fake.store[path] = obj
+                fake.created.append(path)
+                fake._note_change(path)
+                return obj
+
             def do_POST(self):
                 self._record()
                 obj = self._body()
@@ -531,27 +674,13 @@ class FakeApiServer:
                             "message": "event namespace does not match "
                                        "involvedObject namespace"})
                         return
-                path = f"{self.path}/{name}"
+                path = f"{self.path.partition('?')[0]}/{name}"
                 with fake._lock:
                     if path in fake.store:
                         self._reply(409, {"kind": "Status", "code": 409,
                                           "reason": "AlreadyExists"})
                         return
-                    obj = dict(obj)
-                    obj["metadata"] = dict(obj.get("metadata") or {})
-                    # apiserver behavior: every created object gets a uid
-                    obj["metadata"].setdefault(
-                        "uid", f"uid-{len(fake.store) + 1:04d}")
-                    if obj.get("kind") in GENERATION_KINDS:
-                        obj["metadata"]["generation"] = 1
-                    if fake.auto_ready:
-                        st = ready_status(obj)
-                        if st:
-                            obj = dict(obj)
-                            obj["status"] = st
-                    fake.store[path] = obj
-                    fake.created.append(path)
-                    fake._note_change(path)
+                    obj = self._finalize_create_locked(path, obj)
                 self._reply(201, obj)
 
             def do_PUT(self):
@@ -565,10 +694,125 @@ class FakeApiServer:
                     fake._note_change(self.path)
                 self._reply(200 if existed else 201, obj)
 
+            def _serve_ssa(self, path: str, q: Dict[str, list],
+                           intent: Any):
+                """`PATCH application/apply-patch+yaml?fieldManager=M` —
+                server-side apply with real KEP-555 semantics: create when
+                absent; otherwise conflict-check fields other managers own,
+                prune fields M owned before but dropped from this intent,
+                apply-merge the rest, and rewrite managedFields. JSON is
+                YAML, so the JSON bodies the clients send parse as-is."""
+                if fake.ssa_unsupported:
+                    # an apiserver predating SSA: the capability signal
+                    # the clients' sticky merge fallback keys on
+                    self._reply(415, {
+                        "kind": "Status", "code": 415,
+                        "message": "server-side apply not supported "
+                                   "(no application/apply-patch+yaml)"})
+                    return
+                manager = q.get("fieldManager", [""])[0]
+                force = q.get("force", ["false"])[0] in ("true", "1")
+                if not manager:
+                    self._reply(400, {
+                        "kind": "Status", "code": 400,
+                        "message": "fieldManager is required for "
+                                   "apply-patch requests"})
+                    return
+                if not isinstance(intent, dict) or not (
+                        intent.get("metadata") or {}).get("name"):
+                    self._reply(422, {"message": "metadata.name required"})
+                    return
+                new_fields = field_set(intent)
+                new_paths = _leaf_paths(new_fields)
+                with fake._lock:
+                    cur = fake.store.get(path)
+                    if cur is None:
+                        obj = self._finalize_create_locked(
+                            path, intent, manager=manager,
+                            intent_fields=new_fields)
+                        self._reply(201, obj)
+                        return
+                    # per-manager owned leaf-path sets from managedFields
+                    entries = (cur.get("metadata") or {}).get(
+                        "managedFields") or []
+                    owned = {}       # manager -> set of leaf paths
+                    operations = {}  # manager -> recorded operation
+                    for e in entries:
+                        m = e.get("manager")
+                        if not m:
+                            continue
+                        owned[m] = _leaf_paths(e.get("fieldsV1") or {})
+                        operations[m] = e.get("operation", "Update")
+                    # conflicts: this intent CHANGES a field another
+                    # manager owns (equal values co-own without conflict)
+                    conflicts = []
+                    for p in sorted(new_paths):
+                        for other, oset in sorted(owned.items()):
+                            if other == manager or p not in oset:
+                                continue
+                            if _value_at(cur, p) != _value_at(intent, p):
+                                conflicts.append((other, p))
+                    if conflicts and not force:
+                        causes = [{"field": "." + ".".join(p),
+                                   "message": f'conflict with "{m}"'}
+                                  for m, p in conflicts]
+                        first_mgr, first_path = conflicts[0]
+                        self._reply(409, {
+                            "kind": "Status", "code": 409,
+                            "reason": "Conflict",
+                            "message": (
+                                f"Apply failed with {len(conflicts)} "
+                                f"conflict(s): conflict with "
+                                f'"{first_mgr}": '
+                                + "." + ".".join(first_path)),
+                            "details": {"causes": causes}})
+                        return
+                    for other, p in conflicts:  # force: take ownership
+                        owned[other].discard(p)
+                    # deep-copy first: pruning below edits nested dicts in
+                    # place, and the old stored object may still be mid-
+                    # serialization in a concurrent GET handler
+                    merged = ssa_merge(json.loads(json.dumps(cur)), intent)
+                    # prune: fields this manager owned before but dropped
+                    # from the new intent, unless someone else still owns
+                    # them
+                    for p in sorted(owned.get(manager, set()) - new_paths):
+                        if any(p in oset for m, oset in owned.items()
+                               if m != manager):
+                            continue
+                        _delete_at(merged, p)
+                    owned[manager] = new_paths
+                    operations[manager] = "Apply"
+                    merged["metadata"] = dict(merged.get("metadata") or {})
+                    merged["metadata"]["managedFields"] = [
+                        {"manager": m, "operation": operations[m],
+                         "fieldsV1": _paths_to_fields(paths)}
+                        for m, paths in sorted(owned.items()) if paths]
+                    # spec changes bump generation, exactly like the
+                    # merge-PATCH path
+                    if (merged.get("kind") in GENERATION_KINDS
+                            and merged.get("spec") != cur.get("spec")):
+                        merged["metadata"]["generation"] = \
+                            cur.get("metadata", {}).get("generation", 1) + 1
+                    if fake.auto_ready and "status" not in intent:
+                        st = ready_status(merged)
+                        if st:
+                            merged["status"] = st
+                            fake._note_kubelet_status(merged)
+                    fake.store[path] = merged
+                    fake._note_change(path)
+                self._reply(200, merged)
+
             def do_PATCH(self):
                 self._record()
                 patch = self._body()
-                if self._chaos():
+                ctype = self.headers.get("Content-Type") or ""
+                is_ssa = ctype.startswith("application/apply-patch+yaml")
+                if self._chaos(is_ssa=is_ssa):
+                    return
+                if is_ssa:
+                    path, _, query = self.path.partition("?")
+                    self._serve_ssa(path, parse_qs(query), patch)
                     return
                 # Status subresource: PATCH <object>/status applies only the
                 # patch's status field to the parent object and never bumps
@@ -688,6 +932,21 @@ class FakeApiServer:
         self._changes.append((self._rev, path))
         del self._changes[:-1000]  # bounded; watchers re-read current state
         self._changed.notify_all()
+
+    def _note_kubelet_status(self, obj: Dict[str, Any]) -> None:
+        """Record the node agent's ownership of ``status`` in
+        managedFields whenever auto_ready writes one — real clusters show
+        exactly this (kubelet / controller status writers appear as
+        non-Apply managers), and the ownership-drift check must know to
+        tolerate it. Caller must hold self._lock."""
+        meta = obj.setdefault("metadata", {})
+        entries = meta.setdefault("managedFields", [])
+        for e in entries:
+            if e.get("manager") == "kubelet":
+                e["fieldsV1"] = {"f:status": {}}
+                return
+        entries.append({"manager": "kubelet", "operation": "Update",
+                        "fieldsV1": {"f:status": {}}})
 
     def touch(self, path: str) -> None:
         """Wake watchers after a DIRECT store mutation (tests that edit

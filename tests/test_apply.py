@@ -43,6 +43,28 @@ def test_paths_match_cpp_selftest_goldens(spec):
         kubeapply.collection_path({"apiVersion": "v1", "kind": "Wombat"})
 
 
+def test_field_manager_twin_table_pins_cpp_source():
+    """Field-manager twin table (the RetryableStatus/OperandWorkloadKinds
+    pattern): the name the C++ operator applies under
+    (kubeapi::FieldManager()) must equal kubeapply.OPERATOR_FIELD_MANAGER,
+    verified against the C++ source so the pin holds even where no
+    compiler is available — and the two stack managers must be DISTINCT
+    (per-field co-ownership instead of mutual force-reverts is the whole
+    point of the split)."""
+    import os
+    import re as remod
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "native", "operator", "kubeapi.cc"),
+              encoding="utf-8") as f:
+        src = f.read()
+    m = remod.search(
+        r'FieldManager\(\)\s*\{[^}]*?return\s+"([^"]+)"\s*;', src, remod.S)
+    assert m, "kubeapi.cc FieldManager() initializer not found"
+    assert m.group(1) == kubeapply.OPERATOR_FIELD_MANAGER
+    assert kubeapply.FIELD_MANAGER == "tpuctl"
+    assert kubeapply.FIELD_MANAGER != kubeapply.OPERATOR_FIELD_MANAGER
+
+
 def test_readiness_rules_match_cpp(spec):
     assert not kubeapply.is_ready(
         {"kind": "DaemonSet", "status": {"desiredNumberScheduled": 0,
@@ -393,12 +415,16 @@ def test_operator_install_crd_waves_and_rest_establishment(spec):
                     "/tpustackpolicies.tpu-stack.dev")
         cr_path = "/apis/tpu-stack.dev/v1alpha1/tpustackpolicies/default"
         assert api.get(cr_path) is not None
-        # the establishment poll (GET on the CRD) happened before the CR POST
+        # the establishment poll (GET on the CRD) happened before the CR
+        # was created (SSA apply PATCH by default; POST on the merge path)
         log = api.log
         est_get = log.index(("GET", crd_path))
-        cr_post = log.index(
-            ("POST", "/apis/tpu-stack.dev/v1alpha1/tpustackpolicies"))
-        assert est_get < cr_post
+        cr_creates = [i for i, (m, p) in enumerate(log)
+                      if m in ("POST", "PATCH")
+                      and p.startswith(
+                          "/apis/tpu-stack.dev/v1alpha1/tpustackpolicies")
+                      and "/status" not in p]
+        assert cr_creates and est_get < min(cr_creates)
 
 
 def test_operator_install_kubectl_gates_on_crd_established(spec):

@@ -299,10 +299,14 @@ def test_healthz_reports_degraded_detail_and_recovers(native_build,
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
-    # every DaemonSet POST 503s: stage 10 fails each pass (GETs are fine,
-    # so the operator sees a live-but-degraded apiserver, the chaos class
-    # the kubeclient retries are for — capped, so the pass still fails)
-    chaos = [{"status": 503, "method": "POST", "match": "/daemonsets"}]
+    # every DaemonSet create 503s — the POST and its server-side-apply
+    # equivalent (the operator's default path): stage 10 fails each pass
+    # (GETs are fine, so the operator sees a live-but-degraded apiserver,
+    # the chaos class the kubeclient retries are for — capped, so the
+    # pass still fails)
+    chaos = [{"status": 503, "method": "POST", "match": "/daemonsets"},
+             {"status": 503, "method": "PATCH", "ssa": True,
+              "match": "/daemonsets/"}]
     with FakeApiServer(auto_ready=True, chaos=chaos) as api:
         op = start_operator(
             native_build, f"--apiserver={api.url}",
@@ -529,7 +533,10 @@ def test_operator_rbac_covers_bundle_grants():
 def test_post_409_falls_back_to_patch(native_build, bundle_dir):
     """Stale-read window after an apiserver bounce: GET says 404, POST says
     409 AlreadyExists. The operator must PATCH instead of failing the pass
-    (the duplicate-create path from the round-1 verdict, next-round #8)."""
+    (the duplicate-create path from the round-1 verdict, next-round #8).
+    This race only exists on the GET+merge-PATCH path, so the fake is run
+    WITHOUT server-side apply — which also pins the operator's sticky
+    415 fallback: one refused apply patch, then merge for the rest."""
     ghost = f"{DS}/tpu-device-plugin"
     seed = {
         ghost: {"apiVersion": "apps/v1", "kind": "DaemonSet",
@@ -541,14 +548,19 @@ def test_post_409_falls_back_to_patch(native_build, bundle_dir):
                            "observedGeneration": 1}},
     }
     with FakeApiServer(auto_ready=True, store=seed,
-                       ghost_get_404=[ghost]) as api:
+                       ghost_get_404=[ghost], ssa_unsupported=True) as api:
         proc = run_operator(
             native_build, f"--apiserver={api.url}",
             f"--bundle-dir={bundle_dir}", "--once", "--poll-ms=20",
             "--stage-timeout=10", "--status-port=0")
         assert proc.returncode == 0, proc.stderr
+        assert "server-side apply unsupported" in proc.stderr
         status = json.loads(proc.stdout)
         assert status["healthy"], status
+        # sticky capability probe: exactly ONE 415'd apply-patch attempt
+        ssa_attempts = [(m, p) for (m, p) in api.log
+                        if m == "PATCH" and "fieldManager=" in p]
+        assert len(ssa_attempts) == 1, ssa_attempts
         # the wire saw the race: POST (rejected 409) then PATCH on the path
         posts = [(m, p) for (m, p) in api.log
                  if m == "POST" and p == DS]
@@ -584,15 +596,21 @@ def test_operator_survives_apiserver_bounce(native_build, bundle_dir):
             with FakeApiServer(auto_ready=True, port=port,
                                store=carried) as api2:
                 # reconvergence: a full pass lands on the revived server
+                # (SSA apply paths carry ?fieldManager=..., hence `in`)
                 assert wait_until(
-                    lambda: any(m == "PATCH" and p.endswith(
-                        "tpu-node-status-exporter")
-                        for (m, p) in api2.log),
+                    lambda: any(m == "PATCH"
+                                and "tpu-node-status-exporter" in p
+                                for (m, p) in api2.log),
                     timeout=30), api2.log
-                # no duplicate creates: every object survived in the store,
-                # so the repair pass is pure GET->PATCH
-                assert api2.created == [], api2.created
-                posts = [(m, p) for (m, p) in api2.log if m == "POST"]
+                # no duplicate creates: every BUNDLE object survived in
+                # the store, so the repair pass is pure apply-PATCH. A
+                # failure Event from the dead-server window may land here
+                # (its best-effort POST is retried and can straddle the
+                # revival) — events are reports, not bundle duplicates.
+                created = [p for p in api2.created if "/events/" not in p]
+                assert created == [], created
+                posts = [(m, p) for (m, p) in api2.log
+                         if m == "POST" and "/events" not in p]
                 assert posts == [], posts
         finally:
             api.stop()  # idempotent if the bounce already happened

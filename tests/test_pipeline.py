@@ -149,6 +149,327 @@ def test_patch_noop_tolerates_listed_items_without_kind():
         dict(live_from_list, data={"k": "OLD"}), desired)
 
 
+# ------------------------------------------------------------ server-side apply
+
+
+def full_stack_groups(spec):
+    return (list(operator_bundle.operator_install_groups(spec))
+            + list(manifests.rollout_groups(spec)))
+
+
+MUTATING = ("POST", "PATCH", "PUT", "DELETE")
+
+
+def test_ssa_warm_reapply_issues_zero_mutations(spec):
+    """THE tentpole acceptance: after an SSA install, a steady-state
+    re-apply of the FULL bundle — through a fresh client, so the no-op
+    proof can only come from the live objects' managedFields, never a
+    client-side memo — must issue zero POST/PATCH mutations at the fake
+    apiserver: LIST reads only."""
+    groups = full_stack_groups(spec)
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        result = kubeapply.apply_groups(client, groups, wait=True,
+                                        stage_timeout=30, poll=0.02,
+                                        max_inflight=8, apply_mode="ssa")
+        assert result.apply_mode == "ssa"
+        client.close()
+        mark = len(api.log)
+        fresh = kubeapply.Client(api.url)
+        result = kubeapply.apply_groups(fresh, groups, wait=True,
+                                        stage_timeout=30, poll=0.02,
+                                        max_inflight=8, apply_mode="ssa")
+        fresh.close()
+        warm = api.log[mark:]
+        mutations = [(m, p) for m, p in warm if m in MUTATING]
+        assert mutations == [], mutations
+        assert warm, "warm converge made no requests at all (client memo?)"
+        assert all(a.startswith("unchanged") for a in result.actions), \
+            result.actions
+
+
+def test_ssa_cold_install_one_request_per_object(spec):
+    """SSA collapses the cold apply to ONE apply PATCH per unique object —
+    no GET-before-write anywhere in the install."""
+    groups = full_stack_groups(spec)
+    unique = {(o["kind"], o["metadata"].get("namespace", ""),
+               o["metadata"]["name"]) for g in groups for o in g}
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        kubeapply.apply_groups(client, groups, wait=True, stage_timeout=30,
+                               poll=0.02, max_inflight=8, apply_mode="ssa")
+        client.close()
+        writes = [(m, p) for m, p in api.log if m in MUTATING]
+        assert len(writes) <= len(unique)
+        assert all(m == "PATCH" and "fieldManager=tpuctl" in p
+                   for m, p in writes), writes
+        # the only read is the fresh-install probe: cold cost is bounded
+        # by one request per object plus one
+        assert len(api.log) <= len(unique) + 1, api.log
+
+
+def test_ssa_merge_parity_same_store(spec):
+    """Both apply mechanisms must converge the same bundle to the same
+    object set (managedFields bookkeeping aside)."""
+    stores = {}
+    for mode in ("ssa", "merge"):
+        with FakeApiServer(auto_ready=True) as api:
+            client = kubeapply.Client(api.url)
+            kubeapply.apply_groups(client, full_stack_groups(spec),
+                                   wait=True, stage_timeout=30, poll=0.02,
+                                   max_inflight=8, apply_mode=mode)
+            client.close()
+            stores[mode] = set(api.snapshot())
+    assert stores["ssa"] == stores["merge"]
+
+
+def test_ssa_415_sticky_fallback_converges_full_bundle(spec):
+    """Degraded path: an apiserver predating SSA answers the first apply
+    patch with 415 — the client must flip its sticky capability flag
+    (probed once, not per object) and converge the whole bundle through
+    GET+merge-PATCH."""
+    groups = full_stack_groups(spec)
+    with FakeApiServer(auto_ready=True, ssa_unsupported=True) as api:
+        client = kubeapply.Client(api.url)
+        result = kubeapply.apply_groups(client, groups, wait=True,
+                                        stage_timeout=30, poll=0.02,
+                                        max_inflight=8)  # default auto
+        client.close()
+        assert result.apply_mode == "merge"
+        assert client.ssa_supported is False
+        # probed once per client: ONE 415'd apply-patch attempt, then the
+        # merge path only (sticky — no per-object re-probing)
+        ssa_attempts = [p for m, p in api.log
+                        if m == "PATCH" and "fieldManager=" in p]
+        assert len(ssa_attempts) == 1, ssa_attempts
+        # and the bundle is fully there
+        assert api.paths("daemonsets/tpu-device-plugin")
+        assert api.paths("/deployments/tpu-operator")
+    # explicit --apply-mode=ssa against the same server is a loud error
+    with FakeApiServer(auto_ready=True, ssa_unsupported=True) as api:
+        client = kubeapply.Client(api.url)
+        with pytest.raises(kubeapply.SSAUnsupportedError):
+            kubeapply.apply_groups(client, groups, wait=True,
+                                   stage_timeout=30, poll=0.02,
+                                   max_inflight=8, apply_mode="ssa")
+        client.close()
+
+
+def test_ssa_conflict_without_force_names_competing_manager():
+    """A 409 field conflict (force=False) must surface WHO owns the
+    contested field — the triage line that tells the operator on call
+    whose change they are about to revert."""
+    ds = daemonset("ds-conflict")
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        # someone hand-edited via kubectl's server-side apply
+        edited = json.loads(json.dumps(ds))
+        edited["spec"]["template"]["spec"]["image"] = "hand-edited:v9"
+        assert client.apply_ssa(edited, manager="kubectl-edit") == "created"
+        with pytest.raises(kubeapply.ApplyError,
+                           match=r'kubectl-edit') as exc:
+            client.apply_ssa(ds, force=False)
+        assert "conflict" in str(exc.value)
+        # force=True (the rollout default) takes the field over
+        assert client.apply_ssa(ds) == "patched"
+        live = api.get(kubeapply.object_path(ds))
+        assert live["spec"]["template"]["spec"]["image"] == "ds-conflict:v1"
+        client.close()
+
+
+def test_ssa_ownership_transfer_and_dropped_field_pruning():
+    """FakeApiServer SSA semantics, pinned directly: a manager's dropped
+    field is pruned when solely owned, kept when co-owned; force
+    transfers ownership in managedFields."""
+    base = {"apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "cm-ssa", "namespace": NS},
+            "data": {"shared": "x", "solo": "y"}}
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        client.apply_ssa(base, manager="a")
+        co = json.loads(json.dumps(base))
+        del co["data"]["solo"]
+        client.apply_ssa(co, manager="b")  # b co-owns data.shared
+        # a drops 'shared' and 'solo': solo is solely-owned -> pruned;
+        # shared is co-owned by b -> kept
+        a2 = json.loads(json.dumps(base))
+        a2["data"] = {"fresh": "z"}
+        client.apply_ssa(a2, manager="a")
+        live = api.get(f"/api/v1/namespaces/{NS}/configmaps/cm-ssa")
+        assert live["data"] == {"shared": "x", "fresh": "z"}, live["data"]
+        managers = {e["manager"]: e["fieldsV1"]
+                    for e in live["metadata"]["managedFields"]}
+        assert "f:solo" not in json.dumps(managers.get("a", {}))
+        assert "f:shared" in json.dumps(managers.get("b", {}))
+        # force takeover moves the leaf out of the loser's set
+        b2 = json.loads(json.dumps(co))
+        b2["data"]["shared"] = "taken"
+        client.apply_ssa(b2, manager="b")  # force=True default
+        live = api.get(f"/api/v1/namespaces/{NS}/configmaps/cm-ssa")
+        assert live["data"]["shared"] == "taken"
+        client.close()
+
+
+def test_fields_v1_twins_agree(spec):
+    """kubeapply._fields_v1 and the fake apiserver's field_set are the
+    same function in two files (the package must not import tests/) —
+    byte-identical output over every object in the rendered bundle, so
+    the exact no-op check and the server's ownership bookkeeping can
+    never drift."""
+    from fake_apiserver import field_set
+
+    for group in full_stack_groups(spec):
+        for obj in group:
+            assert kubeapply._fields_v1(obj) == field_set(obj), \
+                obj["metadata"]["name"]
+
+
+def test_ssa_noop_check_is_exact_not_heuristic():
+    """What makes the SSA check EXACT: server-side defaulting of fields
+    the manager never applied does not defeat it (the merge heuristic's
+    known gap), while a genuine ownership difference or value drift does."""
+    desired = {"apiVersion": "v1", "kind": "ConfigMap",
+               "metadata": {"name": "cm", "namespace": NS},
+               "data": {"k": "v"}}
+    fields = kubeapply._fields_v1(desired)
+    live = {"metadata": {"name": "cm", "namespace": NS, "uid": "u1",
+                         "resourceVersion": "5",
+                         "managedFields": [
+                             {"manager": "tpuctl", "operation": "Apply",
+                              "fieldsV1": fields},
+                             {"manager": "kubelet", "operation": "Update",
+                              "fieldsV1": {"f:status": {}}}]},
+            "data": {"k": "v"},
+            # server-side additions OUTSIDE the applied intent
+            "status": {"whatever": 1}}
+    assert kubeapply._ssa_is_noop(live, desired)
+    # value drift under our ownership -> must re-apply
+    drifted = json.loads(json.dumps(live))
+    drifted["data"]["k"] = "DRIFT"
+    assert not kubeapply._ssa_is_noop(drifted, desired)
+    # ownership mismatch (another manager force-took a field, so our
+    # fieldsV1 no longer equals the intent's) -> must re-apply
+    stolen = json.loads(json.dumps(live))
+    stolen["metadata"]["managedFields"][0]["fieldsV1"] = \
+        {"f:metadata": fields["f:metadata"]}
+    assert not kubeapply._ssa_is_noop(stolen, desired)
+    # no Apply entry at all (object created via POST/merge) -> re-apply
+    unowned = json.loads(json.dumps(live))
+    unowned["metadata"]["managedFields"] = []
+    assert not kubeapply._ssa_is_noop(unowned, desired)
+
+
+def test_journal_records_mode_and_resume_refuses_mismatch(spec, tmp_path):
+    """The journal pins the rollout's apply mode; --resume replays in the
+    same mode (auto adopts it) and refuses an explicit mismatch with an
+    actionable error instead of silently re-applying the other way."""
+    jpath = str(tmp_path / "rollout.journal")
+    groups = operator_bundle.operator_install_groups(spec)
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        with kubeapply.RolloutJournal(jpath, groups) as journal:
+            kubeapply.apply_groups(client, groups, wait=True,
+                                   stage_timeout=10, poll=0.02,
+                                   journal=journal)  # auto -> ssa
+            assert journal.mode == "ssa"
+        # resume with the OTHER explicit mode: refused before any request
+        before = len(api.log)
+        with kubeapply.RolloutJournal(jpath, groups,
+                                      resume=True) as journal:
+            assert journal.mode == "ssa"
+            with pytest.raises(kubeapply.ApplyError,
+                               match="mode mismatch.*ssa"):
+                kubeapply.apply_groups(client, groups, wait=True,
+                                       stage_timeout=10, poll=0.02,
+                                       journal=journal, apply_mode="merge")
+        assert len(api.log) == before  # refused pre-request
+        # auto (and explicit ssa) adopt the journal's mode and resume free
+        with kubeapply.RolloutJournal(jpath, groups,
+                                      resume=True) as journal:
+            result = kubeapply.apply_groups(client, groups, wait=True,
+                                            stage_timeout=10, poll=0.02,
+                                            journal=journal)
+            assert result.apply_mode == "ssa"
+        assert len(api.log) == before
+        client.close()
+
+
+def test_kubectl_backend_refuses_rest_mode_journal(spec, tmp_path):
+    """A journal recorded by the REST backend (mode ssa/merge) must not
+    resume through kubectl client-side apply — a third mechanism with its
+    own field manager — and the refusal must land before any kubectl
+    invocation."""
+    jpath = str(tmp_path / "rollout.journal")
+    groups = operator_bundle.operator_install_groups(spec)
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        with kubeapply.RolloutJournal(jpath, groups) as journal:
+            kubeapply.apply_groups(client, groups, wait=True,
+                                   stage_timeout=10, poll=0.02,
+                                   journal=journal)
+        client.close()
+    calls = []
+
+    def fake_kubectl(argv, input_text=None):
+        calls.append(list(argv))
+        return 0, "ok", ""
+
+    with kubeapply.RolloutJournal(jpath, groups, resume=True) as journal:
+        assert journal.mode == "ssa"
+        with pytest.raises(kubeapply.ApplyError, match="kubectl backend"):
+            kubeapply.apply_groups_kubectl(groups, wait=True,
+                                           runner=fake_kubectl,
+                                           journal=journal)
+    assert calls == []
+    # and the mirror: a kubectl-backend journal (mode "kubectl",
+    # recorded at backend entry) refuses to resume via REST — half the
+    # bundle would otherwise flip to a different field manager
+    kpath = str(tmp_path / "kubectl.journal")
+
+    def ok_kubectl(argv, input_text=None):
+        if argv[1] == "get":
+            return 0, json.dumps({"kind": "DaemonSet", "status": {
+                "desiredNumberScheduled": 2, "numberReady": 2}}), ""
+        return 0, "ok", ""
+
+    with kubeapply.RolloutJournal(kpath, groups) as journal:
+        kubeapply.apply_groups_kubectl(groups, wait=True,
+                                       runner=ok_kubectl, journal=journal)
+        assert journal.mode == "kubectl"
+    # same-backend resume of its OWN journal still works (the guard must
+    # only refuse FOREIGN mechanisms): every group skips via the journal
+    kubectl_calls = []
+
+    def count_kubectl(argv, input_text=None):
+        kubectl_calls.append(list(argv))
+        return ok_kubectl(argv, input_text)
+
+    with kubeapply.RolloutJournal(kpath, groups, resume=True) as journal:
+        assert journal.resumed and journal.mode == "kubectl"
+        kubeapply.apply_groups_kubectl(groups, wait=True,
+                                       runner=count_kubectl,
+                                       journal=journal)
+    assert kubectl_calls == []  # all groups journaled converged
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        with kubeapply.RolloutJournal(kpath, groups,
+                                      resume=True) as journal:
+            with pytest.raises(kubeapply.ApplyError,
+                               match="same backend"):
+                kubeapply.apply_groups(client, groups, wait=True,
+                                       stage_timeout=10, poll=0.02,
+                                       journal=journal)
+        assert api.log == []
+        client.close()
+
+
+def test_chaos_soak_ssa_mode_store_parity():
+    """Robustness satellite: the full bundle converges in SSA mode under
+    the standard fault script, to the same object set a clean install
+    produces."""
+    _chaos_soak(unit=0.03, latency_s=0.005, apply_mode="ssa")
+
+
 # ------------------------------------------------------------ shared watcher
 
 
@@ -845,11 +1166,14 @@ def test_resume_after_sigkill_reapplies_only_unfinished_groups(tmp_path):
 # ------------------------------------------------------------ chaos soak
 
 
-def _chaos_soak(unit: float, latency_s: float) -> None:
+def _chaos_soak(unit: float, latency_s: float,
+                apply_mode: str = "auto") -> None:
     """Full operator+operand bundle, watch-mode pipelined rollout, under
     the standard fault script (503 burst with Retry-After + connection
     drops + one watch-invalidating flap): must converge with no manual
-    intervention, to the same store a clean rollout produces."""
+    intervention, to the same store a clean rollout produces.
+    ``apply_mode="ssa"`` runs the same soak through server-side apply
+    (the robustness satellite for the SSA round)."""
     spec = specmod.default_spec()
     groups = (list(operator_bundle.operator_install_groups(spec))
               + list(manifests.rollout_groups(spec)))
@@ -862,8 +1186,12 @@ def _chaos_soak(unit: float, latency_s: float) -> None:
     with FakeApiServer(auto_ready=True, latency_s=latency_s,
                        chaos=standard_fault_script(unit)) as api:
         client = kubeapply.Client(api.url, retry=FAST_RETRY)
-        kubeapply.apply_groups(client, groups, wait=True, stage_timeout=60,
-                               poll=0.02, max_inflight=8, watch_ready=True)
+        result = kubeapply.apply_groups(client, groups, wait=True,
+                                        stage_timeout=60, poll=0.02,
+                                        max_inflight=8, watch_ready=True,
+                                        apply_mode=apply_mode)
+        if apply_mode == "ssa":
+            assert result.apply_mode == "ssa"
         assert client.retries > 0, "the fault script never fired"
         assert api.chaos.fired
         assert set(api.snapshot()) == clean_store
@@ -927,5 +1255,14 @@ def test_bench_rollout_json_line_meets_targets():
         assert faulted["converged"] and clean["converged"]
         assert faulted["retries"] > 0, (mode, faulted)
         assert faulted["requests"] >= clean["requests"], (mode, doc["faults"])
+    # the server-side-apply column (ISSUE 5 acceptance): cold install
+    # >=40% fewer requests than the GET-then-merge cold path, and the
+    # warm steady-state converge is reads-only — zero mutations — while
+    # actually LISTing the live state (requests > 0)
+    ssa = doc["ssa"]
+    assert ssa["cold_reduction"] >= 0.40, ssa
+    assert ssa["warm"]["mutations"] == 0, ssa
+    assert ssa["warm"]["requests"] > 0, ssa
+    assert ssa["cold"]["requests"] < ssa["merge_cold"]["requests"], ssa
     # the recorded line for the round artifacts / triage summary
     print(f"BENCH_ROLLOUT {json.dumps(doc, separators=(',', ':'))}")

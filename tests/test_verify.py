@@ -35,6 +35,24 @@ def job(name, completions=1, succeeded=1, failed=0):
             "status": {"succeeded": succeeded, "failed": failed}}
 
 
+def managed(kind, name, managers=("tpuctl", "kubelet")):
+    """A stack object as `kubectl get --show-managed-fields -o json`
+    renders it: managedFields entries per field manager (Apply for the
+    stack appliers, Update for status writers)."""
+    return {"kind": kind,
+            "metadata": {"name": name, "managedFields": [
+                {"manager": m,
+                 "operation": ("Update" if m in ("kubelet",
+                                                 "kube-controller-manager")
+                               else "Apply"),
+                 "fieldsV1": {}}
+                for m in managers]}}
+
+
+OWNERSHIP_KEY = ("get daemonsets,deployments,services,serviceaccounts,"
+                 "configmaps -n tpu-system --show-managed-fields")
+
+
 class CannedRunner:
     """Maps a recognizable slice of the kubectl argv onto canned payloads,
     recording every call."""
@@ -52,6 +70,12 @@ class CannedRunner:
                 {"items": [node("tpu-node-0")]},
             **{f"get job -n tpu-system {j}": job(j)
                for j in verify.VALIDATION_JOBS},
+            OWNERSHIP_KEY: {"items": [
+                managed("DaemonSet", "tpu-device-plugin"),
+                managed("Deployment", "tpu-operator",
+                        ("tpu-operator", "kube-controller-manager")),
+                managed("ConfigMap", "tpu-operator-bundle", ("tpuctl",)),
+            ]},
         }
         self.raw = {"proxy/metrics": "tpu_chips_total 8\n"
                                      "tpu_chip_present 1\n"
@@ -74,6 +98,11 @@ class CannedRunner:
                 {"items": []}
             self.responses["get job -n tpu-system tpu-psum"] = \
                 job("tpu-psum", succeeded=0, failed=2)
+            # someone kubectl-edited a DaemonSet: a foreign field manager
+            self.responses[OWNERSHIP_KEY] = {"items": [
+                managed("DaemonSet", "tpu-device-plugin",
+                        ("tpuctl", "kubectl-edit", "kubelet")),
+            ]}
             self.responses["get events -n tpu-system "
                            "--field-selector=type=Warning "
                            "--sort-by=.lastTimestamp"] = {"items": [{
@@ -135,6 +164,29 @@ def test_checks_fail_loudly_on_broken_cluster(spec):
     # job succeeded but golden output shows a partial chip set -> FAIL
     assert not results["device-query"].ok
     assert "saw 4 devices" in results["device-query"].detail
+    # the kubectl-edit shows up as a foreign field manager, named with
+    # its object so the operator knows whose change the next reconcile
+    # will force-revert
+    assert not results["ownership"].ok
+    assert "kubectl-edit" in results["ownership"].detail
+    assert "DaemonSet/tpu-device-plugin" in results["ownership"].detail
+
+
+def test_ownership_check_details(spec):
+    """check_ownership directly: known managers pass (Apply appliers +
+    status writers), an unlistable namespace fails closed, and the
+    known-manager set is anchored to the appliers' real names."""
+    assert verify.FIELD_MANAGER in verify.KNOWN_FIELD_MANAGERS
+    assert verify.OPERATOR_FIELD_MANAGER in verify.KNOWN_FIELD_MANAGERS
+    runner = CannedRunner(healthy=True)
+    res = verify.check_ownership(runner, spec)
+    assert res.ok, res.detail
+    assert "3 object(s)" in res.detail
+    # the listing itself failing must FAIL the check, not pass silently
+    def broken(argv):
+        return 1, ""
+    res = verify.check_ownership(broken, spec)
+    assert not res.ok and "cannot list" in res.detail
 
 
 def test_device_query_fails_closed_without_logs(spec):

@@ -12,7 +12,9 @@ with rendered artifacts and an ordered, readiness-gated apply:
            the runbook only discovered at apply time
   apply    rollout against the apiserver, gating each group on readiness
            (--operator deploys the in-cluster controller instead); runs
-           the linter first (--lint=warn default, error blocks pre-request)
+           the linter first (--lint=warn default, error blocks pre-request);
+           applies via server-side apply by default (--apply-mode) with a
+           sticky merge-patch fallback for pre-SSA apiservers
   delete   remove everything a spec renders, reverse order
            (helm uninstall analog, reference README.md kind-script flow)
   verify   the executable acceptance runbook (BASELINE configs)
@@ -176,7 +178,8 @@ def cmd_apply(args) -> int:
                     log=lambda msg: print(msg), max_inflight=max_inflight,
                     watch_ready=args.watch, journal=journal,
                     lint_mode=args.lint, lint_spec=spec,
-                    lint_external=_lint_external(args))
+                    lint_external=_lint_external(args),
+                    apply_mode=args.apply_mode)
             finally:
                 client.close()
             if client.retries:
@@ -196,6 +199,11 @@ def cmd_apply(args) -> int:
                 print("apply: note: --watch has no effect on the kubectl "
                       "backend (kubectl rollout status blocks on its own "
                       "watch); pass --apiserver for event-driven readiness",
+                      file=sys.stderr)
+            if args.apply_mode != "auto":
+                print("apply: note: --apply-mode has no effect on the "
+                      "kubectl backend (kubectl apply manages its own "
+                      "patching); pass --apiserver for server-side apply",
                       file=sys.stderr)
             if args.poll != 1.0:
                 print("apply: note: --poll has no effect on the kubectl "
@@ -368,6 +376,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "LIST per poll tick; readiness fires on the "
                         "event, degrading to the poll loop on 410/denied "
                         "watches")
+    p.add_argument("--apply-mode", choices=kubeapply.APPLY_MODES,
+                   default="auto",
+                   help="apply mechanism (REST backend): auto (default) "
+                        "uses server-side apply — one apply PATCH per "
+                        "object under the 'tpuctl' field manager, "
+                        "force-owning the bundle's fields — and falls "
+                        "back to GET+merge-PATCH for good when the "
+                        "apiserver answers 415/400; ssa requires "
+                        "server-side apply; merge forces the legacy path. "
+                        "--resume refuses a journal recorded in a "
+                        "different explicit mode")
     p.add_argument("--allow-empty-daemonsets", action="store_true",
                    help="treat DaemonSets with no matching nodes as ready")
     p.add_argument("--journal", default="",

@@ -42,6 +42,27 @@ failing watch transport falls back to the poll loop above (which itself
 degrades to per-object GETs when LIST is denied), so no credential that
 converged before can stop converging.
 
+SERVER-SIDE APPLY (KEP-555) is the PRIMARY apply path
+(``apply_mode="auto"``, the default): one ``PATCH
+application/apply-patch+yaml?fieldManager=tpuctl&force=true`` per object —
+no prior GET — with per-field ownership tracked by the apiserver under
+this client's field manager (:data:`FIELD_MANAGER`; the in-cluster C++
+operator applies under its own, :data:`OPERATOR_FIELD_MANAGER`, so the
+two stop overwriting each other's fields). Capability is probed once per
+client: a 415/400 answer to an apply patch (an apiserver predating SSA)
+flips the sticky ``Client.ssa_supported`` flag and the rollout falls back
+to the PR-1 GET+merge-PATCH path for good. Because SSA ownership is
+exact, the steady-state no-op check is exact too
+(:func:`_ssa_is_noop`): a warm re-apply of an unchanged bundle through
+the PIPELINED engine (``max_inflight>1``, the engine that holds the
+live-object cache the check reads) issues LIST + watch reads only —
+zero POST/PATCH mutations — where the merge path's check stayed
+conservative; the sequential engine has no cache and re-applies
+unconditionally, which SSA at least makes idempotent. The mode actually
+used is recorded in the :class:`RolloutJournal`, and ``--resume``
+refuses to replay a journal in a different mode (or through a different
+backend).
+
 FAILURE TAXONOMY (:class:`RetryPolicy`): every apiserver round trip in
 this module converges through one classification — 429/500/502/503/504
 and transport status 0 are RETRYABLE (jittered exponential backoff,
@@ -69,12 +90,18 @@ import urllib.error
 import urllib.parse
 import urllib.request
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import (Any, Callable, Dict, FrozenSet, List, Optional,
+                    Sequence, Set, Tuple)
+
+# Shared callable shapes: rollout progress logging, and the kubectl
+# runner seam (``(argv, input_text=...) -> (rc, stdout, stderr)``).
+LogFn = Callable[[str], None]
+KubectlRunner = Callable[..., Tuple[int, str, str]]
 
 # kind -> (api prefix builder, plural, cluster-scoped). Mirrors
 # native/operator/kubeapi.cc Plurals() — a lookup table so unsupported kinds
 # fail loudly instead of 404ing a guessed path.
-_KINDS: Dict[str, tuple] = {
+_KINDS: Dict[str, Tuple[str, bool]] = {
     "Namespace": ("namespaces", True),
     "ConfigMap": ("configmaps", False),
     "Secret": ("secrets", False),
@@ -96,16 +123,43 @@ _KINDS: Dict[str, tuple] = {
 
 WORKLOAD_KINDS = ("DaemonSet", "Deployment", "Job")
 
+# Field-manager twin table: the name THIS client applies under, and the
+# name the in-cluster C++ operator applies under
+# (kubeapi::FieldManager(), native/operator/kubeapi.cc). Distinct on
+# purpose — server-side apply tracks per-field ownership per manager, so
+# the CLI and the operator co-own the bundle's fields instead of
+# force-reverting each other. Pinned as twins by tests/test_apply.py
+# (Python source-grep of kubeapi.cc) and native/operator/selftest.cc,
+# the RetryableStatus/OperandWorkloadKinds pattern.
+FIELD_MANAGER = "tpuctl"
+OPERATOR_FIELD_MANAGER = "tpu-operator"
+
+# apply_groups rollout strategies for reaching desired state:
+#   auto  — server-side apply, falling back to merge for good when the
+#           server answers an apply patch with 415/400 (sticky, probed
+#           once per client)
+#   ssa   — server-side apply required; an unsupported server is an error
+#   merge — the PR-1 GET+merge-PATCH path, unconditionally
+APPLY_MODES = ("auto", "ssa", "merge")
+
 
 class ApplyError(RuntimeError):
     pass
+
+
+class SSAUnsupportedError(ApplyError):
+    """The apiserver answered an ``application/apply-patch+yaml`` request
+    with 415/400 — it predates server-side apply (or rejects the content
+    type). The client's ``ssa_supported`` flag is already flipped sticky
+    when this raises; ``apply_mode="auto"`` catches it and downgrades the
+    rollout to merge-patch, ``apply_mode="ssa"`` surfaces it."""
 
 
 class _WatchDenied(Exception):
     """A watch (or its priming LIST) was refused or the transport failed —
     the caller degrades to the poll loop instead of surfacing an error."""
 
-    def __init__(self, code: int, message: Any = ""):
+    def __init__(self, code: int, message: Any = "") -> None:
         super().__init__(f"{code} {message}".strip())
         self.code = code
 
@@ -134,7 +188,7 @@ class RetryPolicy:
     base_s: float = 0.1
     cap_s: float = 5.0
     jitter: float = 0.2  # +/- fraction applied to the computed backoff
-    retryable: frozenset = RETRYABLE_STATUSES
+    retryable: FrozenSet[int] = RETRYABLE_STATUSES
 
     def classify(self, status: int) -> str:
         """'ok' | 'retryable' | 'conflict' | 'terminal' for one status."""
@@ -299,23 +353,69 @@ def _merge_patch(target: Any, patch: Any) -> Any:
 
 def _patch_is_noop(live: Dict[str, Any], desired: Dict[str, Any]) -> bool:
     """True when merge-patching ``desired`` into ``live`` changes nothing —
-    the pipelined re-apply skips the round trip entirely (the diff-aware
-    half of the informer pattern: the shared cache already proves the
-    object's spec is current). Real apiservers omit per-item ``kind`` /
-    ``apiVersion`` from LIST items while the manifest always carries them —
-    grafted onto the live side first so that cosmetic gap alone can't turn
-    every steady-state re-apply into a PATCH.
+    the MERGE-mode re-apply skips the round trip entirely. Real apiservers
+    omit per-item ``kind`` / ``apiVersion`` from LIST items while the
+    manifest always carries them — grafted onto the live side first so
+    that cosmetic gap alone can't turn every steady-state re-apply into a
+    PATCH. Merge equality is inherently heuristic (arrays replace
+    wholesale, so server-side defaulting inside pod templates defeats it
+    on real clusters); it only backs the 415-fallback path now — the
+    default SSA mode uses the EXACT ownership-based check
+    (:func:`_ssa_is_noop`) instead."""
+    grafts = {k: desired[k] for k in ("kind", "apiVersion")
+              if k in desired and k not in live}
+    if grafts:
+        live = dict(live, **grafts)
+    return _merge_patch(live, desired) == live
 
-    Conservative by design: merge patch (RFC 7386) replaces arrays
-    wholesale, so server-side defaulting INSIDE pod-template containers
-    (imagePullPolicy, terminationMessagePath, ...) makes live != merged for
-    workloads on a real apiserver and the re-apply PATCHes them anyway —
-    correct, just not saved. The skip reliably fires for array-free objects
-    (Namespace, ServiceAccount, ConfigMap, RBAC) everywhere, and for the
-    whole bundle against stores that keep manifests verbatim (the fake
-    apiserver, hence the bench's steady-state numbers). Closing the gap for
-    real clusters needs a last-applied-manifest annotation (kubectl's
-    approach) — not worth the per-object payload until profiles say so."""
+
+def _fields_v1(obj: Any) -> Dict[str, Any]:
+    """fieldsV1-style ownership descriptor for one applied intent: nested
+    ``{"f:<key>": {...}}`` dicts mirroring the object's dict structure,
+    with scalars/arrays/nulls as ``{}`` leaves. Arrays are ATOMIC
+    (x-kubernetes-list-type: atomic semantics — no ``k:``/``v:`` list-
+    member keys), matching how the merge-patch path already treats them.
+    Twin of the fake apiserver's ``field_set`` (kept here so the package
+    never imports from tests/; parity-pinned by tests/test_pipeline.py)."""
+    out: Dict[str, Any] = {}
+    if not isinstance(obj, dict):
+        return out
+    for k, v in obj.items():
+        out[f"f:{k}"] = _fields_v1(v) if isinstance(v, dict) else {}
+    return out
+
+
+def _ssa_is_noop(live: Optional[Dict[str, Any]], desired: Dict[str, Any],
+                 manager: str = FIELD_MANAGER) -> bool:
+    """EXACT steady-state check for server-side apply: re-applying
+    ``desired`` under ``manager`` is a guaranteed no-op iff (a) the live
+    object's managedFields record an Apply entry for ``manager`` owning
+    exactly the intent's field set — so no ownership transfer and no
+    dropped-field pruning can result — and (b) every intent value already
+    matches the live object (apply-merge changes nothing). Server-side
+    defaulting cannot defeat it the way it defeats the merge heuristic:
+    defaulted SIBLING fields sit at paths the intent never mentions, which
+    apply-merge leaves untouched, so only values the manager actually
+    owns enter the comparison (an owned atomic array still compares
+    wholesale — if something rewrote it, the re-apply correctly PATCHes).
+    kind/apiVersion are grafted onto LIST items that omit them, as in
+    :func:`_patch_is_noop`.
+
+    FAILS CLOSED on encoding mismatch: a server whose fieldsV1 encoding
+    differs from :func:`_fields_v1` (real apiservers use ``k:``/``v:``
+    member keys for listType=map lists where we model arrays as atomic
+    leaves) never equals the intent's set, so the skip doesn't fire and
+    the object is re-applied — idempotent under SSA, just not saved. The
+    zero-mutation steady state is pinned against the fake apiserver's
+    encoding (the twin of ours)."""
+    if live is None:
+        return False
+    entries = (live.get("metadata") or {}).get("managedFields") or []
+    mine = next((e for e in entries
+                 if e.get("manager") == manager
+                 and e.get("operation") == "Apply"), None)
+    if mine is None or mine.get("fieldsV1") != _fields_v1(desired):
+        return False
     grafts = {k: desired[k] for k in ("kind", "apiVersion")
               if k in desired and k not in live}
     if grafts:
@@ -341,11 +441,16 @@ class Client:
     # every _request converges through it, so apply/wait/delete inherit
     # retries without per-call plumbing.
     retry: Optional[RetryPolicy] = None
+    # Sticky server-side-apply capability, probed once per client by the
+    # first apply_ssa: None = unknown, True = the server accepted an
+    # apply patch, False = it answered 415/400 (every later SSA attempt
+    # short-circuits into SSAUnsupportedError without a round trip).
+    ssa_supported: Optional[bool] = None
     _warned_insecure: bool = field(default=False, repr=False, compare=False)
     _local: Any = field(default=None, repr=False, compare=False)
     _conns: Any = field(default=None, repr=False, compare=False)
 
-    def __post_init__(self):
+    def __post_init__(self) -> None:
         self._local = threading.local()
         self._conns = []  # every connection ever opened, for close()
         self._conns_lock = threading.Lock()
@@ -357,6 +462,9 @@ class Client:
         self._retry_lock = threading.Lock()
         self.retries = 0
         self.last_transport_error: Optional[str] = None
+        # Serializes the FIRST server-side-apply attempt while
+        # ssa_supported is unknown (the once-per-client capability probe).
+        self._ssa_probe_lock = threading.Lock()
 
     # ------------------------------------------------------------ transport
 
@@ -399,7 +507,7 @@ class Client:
             self._conns.append(conn)
         return conn
 
-    def _drop_connection(self):
+    def _drop_connection(self) -> None:
         conn = getattr(self._local, "conn", None)
         if conn is not None:
             self._local.conn = None
@@ -411,7 +519,7 @@ class Client:
             except OSError:
                 pass
 
-    def close(self):
+    def close(self) -> None:
         """Close every pooled connection (idempotent)."""
         with self._conns_lock:
             conns, self._conns = self._conns, []
@@ -421,7 +529,7 @@ class Client:
             except OSError:
                 pass
 
-    def reap_other_connections(self):
+    def reap_other_connections(self) -> None:
         """Close every pooled connection EXCEPT the calling thread's.
         Worker threads die with their executor but their thread-local
         connections would stay open (and strongly referenced here)
@@ -441,19 +549,27 @@ class Client:
     def __enter__(self) -> "Client":
         return self
 
-    def __exit__(self, *exc):
+    def __exit__(self, *exc: object) -> None:
         self.close()
 
     def _headers(self, has_body: bool, content_type: str) -> Dict[str, str]:
-        headers = {"Accept": "application/json"}
+        # User-Agent doubles as the default field-manager name real
+        # apiservers record for NON-apply writes (POST/merge-PATCH, the
+        # fallback path) — without it the merge fallback's fields would
+        # show up in managedFields as "Python-urllib", which the
+        # ownership drift check would flag as foreign.
+        headers = {"Accept": "application/json",
+                   "User-Agent": FIELD_MANAGER}
         if self.token:
             headers["Authorization"] = f"Bearer {self.token}"
         if has_body:
             headers["Content-Type"] = content_type
         return headers
 
-    def _request_keepalive(self, method: str, path: str,
-                           data: Optional[bytes], content_type: str):
+    def _request_keepalive(
+            self, method: str, path: str, data: Optional[bytes],
+            content_type: str
+    ) -> Tuple[int, Dict[str, Any], Optional[float]]:
         """One request over the thread's persistent connection, returning
         ``(status, parsed, retry_after_s)``. A stale keep-alive socket
         (server restarted, idle timeout) surfaces as RemoteDisconnected /
@@ -484,9 +600,12 @@ class Client:
                               BrokenPipeError, ConnectionResetError)):
                     continue  # stale pooled socket: one fresh retry
                 return 0, _transport_error(exc), None
+        raise AssertionError("unreachable: both attempts return")
 
-    def _request_oneshot(self, method: str, path: str,
-                         data: Optional[bytes], content_type: str):
+    def _request_oneshot(
+            self, method: str, path: str, data: Optional[bytes],
+            content_type: str
+    ) -> Tuple[int, Dict[str, Any], Optional[float]]:
         req = urllib.request.Request(self.base_url + path, method=method)
         for k, v in self._headers(data is not None, content_type).items():
             req.add_header(k, v)
@@ -513,7 +632,8 @@ class Client:
 
     def _request(self, method: str, path: str,
                  body: Optional[Dict[str, Any]] = None,
-                 content_type: str = "application/json"):
+                 content_type: str = "application/json"
+                 ) -> Tuple[int, Dict[str, Any]]:
         """One logical request under the RetryPolicy: retryable statuses
         (429/5xx/transport) are re-sent with jittered exponential backoff,
         honoring Retry-After; the final (or first non-retryable) answer is
@@ -539,7 +659,7 @@ class Client:
                     self.last_transport_error = (parsed or {}).get("message")
             time.sleep(policy.backoff_s(attempt, retry_after))
 
-    def get(self, path: str):
+    def get(self, path: str) -> Tuple[int, Dict[str, Any]]:
         return self._request("GET", path)
 
     def list_collection(self, path: str) -> Dict[str, Dict[str, Any]]:
@@ -584,6 +704,74 @@ class Client:
         if code != 200:
             raise ApplyError(f"PATCH {path}: {code} {resp}")
         return "patched"
+
+    def _apply_ssa_raw(self, obj: Dict[str, Any], force: bool = True,
+                       manager: str = FIELD_MANAGER
+                       ) -> Tuple[str, Dict[str, Any]]:
+        """One server-side apply round trip: ``(action, live object)``.
+
+        A single ``PATCH application/apply-patch+yaml`` with this
+        client's field manager — no prior GET; the apiserver resolves
+        create-vs-update itself (201 vs 200). ``force=True`` (the rollout
+        default — reverting drift in our own operands is the point, like
+        the C++ operator's reconcile) takes ownership of conflicting
+        fields; ``force=False`` surfaces a 409 naming the competing
+        manager, for callers that want conflicts visible. 415/400 flips
+        the sticky ``ssa_supported`` flag and raises
+        :class:`SSAUnsupportedError` — and capability is probed ONCE per
+        client: while the flag is unknown the first caller holds the
+        probe lock through its round trip, so a concurrent first tier
+        cannot fan N probe requests at an apiserver that will 415 them
+        all."""
+        if self.ssa_supported is None:
+            with self._ssa_probe_lock:
+                if self.ssa_supported is None:
+                    return self._apply_ssa_once(obj, force, manager)
+        return self._apply_ssa_once(obj, force, manager)
+
+    def _apply_ssa_once(self, obj: Dict[str, Any], force: bool,
+                        manager: str) -> Tuple[str, Dict[str, Any]]:
+        if self.ssa_supported is False:
+            raise SSAUnsupportedError(
+                f"{self.base_url} does not support server-side apply "
+                "(previous apply patch answered 415/400)")
+        path = (f"{object_path(obj)}?fieldManager={manager}"
+                f"&force={'true' if force else 'false'}")
+        code, resp = self._request("PATCH", path, obj,
+                                   "application/apply-patch+yaml")
+        if code in (415, 400):
+            # 400 is ambiguous: pre-SSA apiservers answered apply
+            # patches 400 (hence it flips the flag, like 415), but a
+            # modern server can also 400 a genuinely bad manifest. The
+            # conflation is safe: in auto mode the merge fallback
+            # re-sends the same object via POST/PATCH, which surfaces
+            # the REAL 400 terminally; in strict ssa mode the error
+            # below carries the server's message for triage.
+            self.ssa_supported = False
+            raise SSAUnsupportedError(
+                f"PATCH {path}: {code} "
+                f"{(resp or {}).get('message', resp)} — server-side "
+                "apply unsupported; merge fallback required")
+        if code == 409:
+            # field conflict (only reachable with force=False): name the
+            # competing manager(s) so the operator on call knows WHO to
+            # talk to before force-reverting their edit
+            causes = ((resp or {}).get("details") or {}).get("causes") or []
+            detail = "; ".join(
+                f"{c.get('field', '?')}: {c.get('message', '')}"
+                for c in causes) or (resp or {}).get("message", str(resp))
+            raise ApplyError(
+                f"server-side apply conflict on {object_path(obj)} "
+                f"(another field manager owns contested fields): {detail}")
+        if code not in (200, 201):
+            raise ApplyError(f"SSA PATCH {path}: {code} {resp}")
+        self.ssa_supported = True
+        return ("created" if code == 201 else "patched"), resp
+
+    def apply_ssa(self, obj: Dict[str, Any], force: bool = True,
+                  manager: str = FIELD_MANAGER) -> str:
+        """Server-side apply one object; returns 'created' | 'patched'."""
+        return self._apply_ssa_raw(obj, force, manager)[0]
 
     def delete(self, path: str) -> Tuple[int, Any]:
         """DELETE one object; (status, parsed body)."""
@@ -661,7 +849,8 @@ class Client:
             by_collection.setdefault(collection_path(obj), []).append(obj)
         failures: List[str] = []
 
-        def run(coll, members, drop_conn=False):
+        def run(coll: str, members: List[Dict[str, Any]],
+                drop_conn: bool = False) -> None:
             try:
                 self._watch_ready_collection(coll, members, deadline, poll,
                                              allow_empty_daemonsets, stats,
@@ -696,10 +885,12 @@ class Client:
 
     def _poll_ready(self, pending: List[Dict[str, Any]], deadline: float,
                     poll: float, allow_empty_daemonsets: bool,
-                    stats: Dict[str, Any], lock: threading.Lock) -> None:
+                    stats: Dict[str, Any],
+                    lock: Any) -> None:  # threading.Lock (factory fn
+                                         # in typeshed < 3.13)
         """The tick loop shared by poll-mode wait_ready and the watch
         mode's per-collection degradation path."""
-        def bump(n=1):
+        def bump(n: int = 1) -> None:
             with lock:
                 stats["requests"] += n
 
@@ -790,17 +981,17 @@ class Client:
                                 deadline: float, poll: float,
                                 allow_empty_daemonsets: bool,
                                 stats: Dict[str, Any],
-                                lock: threading.Lock) -> None:
+                                lock: Any) -> None:  # threading.Lock
         """Event-driven readiness for one collection: LIST once, then hold
         one watch stream from the LIST's resourceVersion until every
         member is ready. The server's timeoutSeconds window is clamped to
         the remaining deadline, so a silent stream ends exactly when the
         wait would time out anyway."""
-        def bump(n=1):
+        def bump(n: int = 1) -> None:
             with lock:
                 stats["requests"] += n
 
-        def degrade(why: str):
+        def degrade(why: str) -> None:
             with lock:
                 stats["mode"] = "poll-fallback"
                 stats.setdefault("fallbacks", []).append(why)
@@ -931,9 +1122,15 @@ class GroupResult:
     # ("watch", "poll", or "poll-fallback" when a watch degraded).
     ready_requests: int = 0
     ready_mode: str = ""
+    # The apply mechanism the rollout actually used: "ssa" (server-side
+    # apply) or "merge" (GET+merge-PATCH — requested, or the sticky
+    # 415/400 fallback). "" on the kubectl backend.
+    apply_mode: str = ""
 
     def timings_line(self) -> str:
         line = ", ".join(f"{k} {v:.2f}s" for k, v in self.timings.items())
+        if self.apply_mode:
+            line += f" [apply via {self.apply_mode}]"
         if self.ready_mode:
             line += (f" [ready-wait: {self.ready_requests} request(s) "
                      f"via {self.ready_mode}]")
@@ -962,14 +1159,21 @@ class RolloutJournal:
 
     def __init__(self, path: str,
                  groups: Sequence[Sequence[Dict[str, Any]]],
-                 resume: bool = False):
+                 resume: bool = False) -> None:
         self.path = path
         self.fingerprint = self._fingerprint(groups)
         # Objects are keyed PER GROUP: the same kind/ns/name may
         # legitimately be applied by two groups (bootstrap config early,
         # final config late), and a global key would skip the later one.
-        self._objects: set = set()   # (group index, object key)
-        self._groups: set = set()
+        self._objects: Set[Tuple[int, str]] = set()
+        self._groups: Set[int] = set()
+        # The apply mechanism ("ssa" | "merge" | "kubectl") the journaled
+        # rollout ran under, recorded with the first applied object (or
+        # at backend entry for kubectl). A --resume must replay through
+        # the SAME mechanism: each records fields under a different
+        # manager, so switching mid-bundle would silently change the
+        # ownership story — both backends refuse a mismatch.
+        self.mode: Optional[str] = None
         self.resumed = False
         if resume:
             self._load()
@@ -980,13 +1184,15 @@ class RolloutJournal:
         self._f = open(path, "w", encoding="utf-8")
         self._append({"journal": "tpuctl-rollout",
                       "fingerprint": self.fingerprint})
+        if self.mode is not None:
+            self._append({"apply_mode": self.mode})
         for group, key in sorted(self._objects):
             self._append({"group": group, "object": key})
         for group in sorted(self._groups):
             self._append({"group": group})
 
     @staticmethod
-    def _fingerprint(groups) -> str:
+    def _fingerprint(groups: Sequence[Sequence[Dict[str, Any]]]) -> str:
         blob = json.dumps([list(g) for g in groups], sort_keys=True)
         return hashlib.sha256(blob.encode()).hexdigest()
 
@@ -1018,12 +1224,23 @@ class RolloutJournal:
                                    rec["object"]))
             elif "group" in rec:
                 self._groups.add(int(rec["group"]))
+            elif "apply_mode" in rec:
+                self.mode = str(rec["apply_mode"])
         self.resumed = True
 
     def _append(self, rec: Dict[str, Any]) -> None:
         self._f.write(json.dumps(rec, sort_keys=True) + "\n")
         self._f.flush()
         os.fsync(self._f.fileno())
+
+    def set_mode(self, mode: str) -> None:
+        """Record the resolved apply mode (first call wins — the mode is
+        per-rollout and cannot flip after an object applied under it:
+        auto-mode downgrade is sticky and happens before the first
+        journaled object)."""
+        if self.mode is None and mode:
+            self.mode = mode
+            self._append({"apply_mode": mode})
 
     def object_done(self, obj: Dict[str, Any], group: int) -> None:
         entry = (group, self.object_key(obj))
@@ -1051,12 +1268,12 @@ class RolloutJournal:
     def __enter__(self) -> "RolloutJournal":
         return self
 
-    def __exit__(self, *exc):
+    def __exit__(self, *exc: object) -> None:
         self.close()
 
 
 def kubectl_runner(argv: Sequence[str], input_text: Optional[str] = None,
-                   timeout: float = 900):
+                   timeout: float = 900) -> Tuple[int, str, str]:
     """Returns ``(rc, stdout, stderr)``. Streams stay separate so JSON output
     can be parsed from stdout alone — kubectl routinely writes deprecation /
     version-skew warnings to stderr, and concatenating them would corrupt
@@ -1077,13 +1294,15 @@ def kubectl_runner(argv: Sequence[str], input_text: Optional[str] = None,
 
 def apply_groups_kubectl(groups: Sequence[Sequence[Dict[str, Any]]],
                          wait: bool = True, stage_timeout: float = 600,
-                         runner=None, allow_empty_daemonsets: bool = False,
-                         log=lambda msg: None,
+                         runner: Optional[KubectlRunner] = None,
+                         allow_empty_daemonsets: bool = False,
+                         log: LogFn = lambda msg: None,
                          retry: Optional[RetryPolicy] = None,
                          journal: Optional[RolloutJournal] = None,
                          lint_mode: str = "off",
-                         lint_spec=None,
-                         lint_external=None) -> GroupResult:
+                         lint_spec: Optional[Any] = None,
+                         lint_external: Optional[FrozenSet[str]] = None
+                         ) -> GroupResult:
     """The kubectl-CLI twin of :func:`apply_groups` for hosts where only
     kubectl (not a proxied apiserver URL) is available — the common case on
     the reference guide's control-plane node. Readiness gating uses
@@ -1107,9 +1326,32 @@ def apply_groups_kubectl(groups: Sequence[Sequence[Dict[str, Any]]],
 
     _lint_gate(groups, lint_mode, lint_spec, log, lint_external)
 
+    if journal is not None and journal.resumed and journal.mode \
+            and journal.mode != "kubectl":
+        # The journal came from the REST backend, which recorded its
+        # apply mechanism (ssa/merge). kubectl client-side apply is a
+        # THIRD mechanism with its own field manager — replaying the
+        # remaining groups through it would silently change the
+        # ownership story mid-bundle, exactly what the REST resume's
+        # mode guard refuses. A 'kubectl' journal is OURS and resumes
+        # normally.
+        raise ApplyError(
+            f"--resume: the journal recorded apply mode "
+            f"'{journal.mode}' (REST backend); resuming through the "
+            "kubectl backend would re-apply under a different "
+            "mechanism — pass --apiserver to resume, or drop --resume "
+            "to start fresh")
+    if journal is not None:
+        # Record this backend's mechanism too, so the guard is
+        # symmetric: a kubectl-backend journal resumed via --apiserver
+        # is refused by _resolve_apply_mode instead of silently
+        # re-applying half the bundle under a REST field manager.
+        journal.set_mode("kubectl")
+
     if runner is None:
-        def runner(argv, input_text=None,
-                   _t=stage_timeout + 120):  # outlive kubectl's own timeout
+        def runner(argv: Sequence[str], input_text: Optional[str] = None,
+                   _t: float = stage_timeout + 120  # outlive kubectl's own
+                   ) -> Tuple[int, str, str]:      # timeout
             return kubectl_runner(argv, input_text, timeout=_t)
 
     retry = retry or RetryPolicy()
@@ -1217,7 +1459,7 @@ def apply_groups_kubectl(groups: Sequence[Sequence[Dict[str, Any]]],
 
 def delete_groups(client: Client,
                   groups: Sequence[Sequence[Dict[str, Any]]],
-                  log=lambda msg: None) -> GroupResult:
+                  log: LogFn = lambda msg: None) -> GroupResult:
     """`helm uninstall` analog for the REST backend: delete everything the
     groups render, in REVERSE order (workloads before the RBAC they run
     under, the namespace last). Absent objects are fine — uninstall is
@@ -1244,7 +1486,8 @@ def delete_groups(client: Client,
     return result
 
 
-def _crd_kinds(groups: Sequence[Sequence[Dict[str, Any]]]):
+def _crd_kinds(groups: Sequence[Sequence[Dict[str, Any]]]
+               ) -> Set[Tuple[Optional[str], Optional[str]]]:
     """(apiGroup, kind) pairs defined by CRDs inside ``groups`` — the docs
     whose resource type vanishes with the CRD."""
     kinds = set()
@@ -1258,8 +1501,8 @@ def _crd_kinds(groups: Sequence[Sequence[Dict[str, Any]]]):
 
 
 def delete_groups_kubectl(groups: Sequence[Sequence[Dict[str, Any]]],
-                          runner=None,
-                          log=lambda msg: None) -> GroupResult:
+                          runner: Optional[KubectlRunner] = None,
+                          log: LogFn = lambda msg: None) -> GroupResult:
     """The kubectl twin of :func:`delete_groups`: one reverse-ordered
     `kubectl delete --ignore-not-found` per group, last group first.
 
@@ -1272,7 +1515,8 @@ def delete_groups_kubectl(groups: Sequence[Sequence[Dict[str, Any]]],
     import yaml
 
     if runner is None:
-        def runner(argv, input_text=None):
+        def runner(argv: Sequence[str], input_text: Optional[str] = None
+                   ) -> Tuple[int, str, str]:
             return kubectl_runner(argv, input_text, timeout=900)
 
     crd_kinds = _crd_kinds(groups)
@@ -1319,8 +1563,8 @@ def _note_ready_stats(result: GroupResult, stats: Dict[str, Any]) -> None:
 
 
 def _lint_gate(groups: Sequence[Sequence[Dict[str, Any]]],
-               lint_mode: str, lint_spec, log,
-               lint_external=None) -> None:
+               lint_mode: str, lint_spec: Optional[Any], log: LogFn,
+               lint_external: Optional[FrozenSet[str]] = None) -> None:
     """Run the pre-apply static analysis (tpu_cluster.lint) when a caller
     asked for it. Lazy import: lint imports THIS module for the shared
     tier table, so the dependency must point one way at load time. In
@@ -1336,15 +1580,94 @@ def _lint_gate(groups: Sequence[Sequence[Dict[str, Any]]],
                          external=external)
 
 
+class _ModeState:
+    """The rollout's resolved apply mechanism, shared across the worker
+    pool. The only transition is the one-way sticky downgrade ssa ->
+    merge when the server answers the first apply patch with 415/400;
+    ``strict`` (apply_mode="ssa", or a journal resumed in ssa) forbids
+    even that — the SSAUnsupportedError surfaces instead."""
+
+    def __init__(self, mode: str, strict: bool) -> None:
+        self.mode = mode
+        self.strict = strict
+        self.downgraded: Optional[str] = None  # reason, logged once
+
+    def downgrade(self, reason: str) -> None:
+        self.mode = "merge"
+        if self.downgraded is None:
+            self.downgraded = reason
+
+
+def _resolve_apply_mode(client: Client, apply_mode: str,
+                        journal: Optional[RolloutJournal]) -> _ModeState:
+    """Pick the rollout's starting mode from the request, the journal
+    being resumed, and the client's sticky capability flag. A resumed
+    journal's recorded mode WINS (and pins strict): replaying half a
+    bundle through the other mechanism would silently change which
+    manager owns what."""
+    if apply_mode not in APPLY_MODES:
+        raise ApplyError(
+            f"unknown apply_mode {apply_mode!r}; expected one of "
+            f"{'/'.join(APPLY_MODES)}")
+    if journal is not None and journal.resumed and journal.mode:
+        if journal.mode not in ("ssa", "merge"):
+            # recorded by the kubectl backend: client-side apply is a
+            # third mechanism with its own field manager — replaying the
+            # rest of the bundle via REST would silently change the
+            # ownership story mid-bundle (the mirror of the guard in
+            # apply_groups_kubectl)
+            raise ApplyError(
+                f"--resume: the journal recorded apply mode "
+                f"'{journal.mode}'; resume it through the same backend "
+                "(drop --apiserver), or drop --resume to start fresh")
+        if apply_mode != "auto" and apply_mode != journal.mode:
+            raise ApplyError(
+                f"--resume mode mismatch: the journal recorded apply "
+                f"mode '{journal.mode}' but this run requests "
+                f"'{apply_mode}'; re-run with --apply-mode="
+                f"{journal.mode} (or drop --resume to start fresh)")
+        return _ModeState(journal.mode, strict=True)
+    if apply_mode == "merge":
+        return _ModeState("merge", strict=True)
+    if apply_mode == "auto":
+        if client.ssa_supported is False:
+            return _ModeState("merge", strict=False)
+        return _ModeState("ssa", strict=False)
+    return _ModeState("ssa", strict=True)  # explicit ssa
+
+
+def _apply_with_mode(client: Client, obj: Dict[str, Any],
+                     state: _ModeState) -> str:
+    """One object through the resolved mode: server-side apply, or the
+    GET+merge-PATCH path (requested, or the sticky 415/400 fallback)."""
+    if state.mode == "ssa":
+        try:
+            return client.apply_ssa(obj)
+        except SSAUnsupportedError as exc:
+            if state.strict:
+                raise
+            state.downgrade(str(exc))
+    return client.apply(obj)
+
+
+def _log_downgrade_once(state: _ModeState,
+                        log: Callable[[str], None]) -> None:
+    if state.downgraded is not None:
+        log("server-side apply unavailable; this rollout continues via "
+            f"GET+merge-PATCH ({state.downgraded})")
+        state.downgraded = None
+
+
 def apply_groups(client: Client, groups: Sequence[Sequence[Dict[str, Any]]],
                  wait: bool = True, stage_timeout: float = 600,
                  poll: float = 1.0, allow_empty_daemonsets: bool = False,
-                 log=lambda msg: None, max_inflight: int = 1,
+                 log: LogFn = lambda msg: None, max_inflight: int = 1,
                  watch_ready: bool = False,
                  journal: Optional[RolloutJournal] = None,
                  lint_mode: str = "off",
-                 lint_spec=None,
-                 lint_external=None) -> GroupResult:
+                 lint_spec: Optional[Any] = None,
+                 lint_external: Optional[FrozenSet[str]] = None,
+                 apply_mode: str = "auto") -> GroupResult:
     """Ordered, readiness-gated rollout of manifest groups — the reference's
     operator behavior (SURVEY.md §3.3) as a one-shot procedure.
 
@@ -1369,15 +1692,23 @@ def apply_groups(client: Client, groups: Sequence[Sequence[Dict[str, Any]]],
     finding, guaranteeing zero requests reach the apiserver. ``lint_spec``
     (the ClusterSpec the bundle was rendered from) enables the
     accelerator-aware checks (R05 alignment); ``lint_external`` extends
-    the reference allowlist (``--allow-external``)."""
+    the reference allowlist (``--allow-external``).
+
+    ``apply_mode`` selects the apply mechanism: ``auto`` (default) uses
+    server-side apply, downgrading to the merge path for good if the
+    server answers 415/400; ``ssa`` requires it; ``merge`` forces the
+    PR-1 GET+merge-PATCH path. The resolved mode is recorded in the
+    journal, and resuming a journal in a different explicit mode is
+    refused."""
     _lint_gate(groups, lint_mode, lint_spec, log, lint_external)
+    mode_state = _resolve_apply_mode(client, apply_mode, journal)
     result = GroupResult()
     if max_inflight > 1:
         try:
             return _apply_groups_pipelined(
                 client, groups, wait, stage_timeout, poll,
                 allow_empty_daemonsets, log, max_inflight, result,
-                watch_ready, journal)
+                watch_ready, journal, mode_state)
         finally:
             # the pool's worker threads are gone; their thread-local
             # connections must not outlive them in the Client's pool
@@ -1394,10 +1725,12 @@ def apply_groups(client: Client, groups: Sequence[Sequence[Dict[str, Any]]],
                 result.actions.append(f"journaled {name}")
                 log(f"journaled {name} (already applied; resume)")
                 continue
-            action = client.apply(obj)
+            action = _apply_with_mode(client, obj, mode_state)
+            _log_downgrade_once(mode_state, log)
             result.actions.append(f"{action} {name}")
             log(f"{action} {name}")
             if journal is not None:
+                journal.set_mode(mode_state.mode)
                 journal.object_done(obj, i)
         result.timings["apply"] += time.monotonic() - t0
         # CRD establishment is a correctness gate for the NEXT group's CRs,
@@ -1422,6 +1755,7 @@ def apply_groups(client: Client, groups: Sequence[Sequence[Dict[str, Any]]],
             # --resume --wait must not skip the gate (the per-object
             # records above still make that resume cheap)
             journal.group_done(i)
+    result.apply_mode = mode_state.mode
     return result
 
 
@@ -1432,7 +1766,8 @@ def apply_groups(client: Client, groups: Sequence[Sequence[Dict[str, Any]]],
 _TIER_FIRST = ("Namespace", "CustomResourceDefinition")
 
 
-def _group_tiers(group: Sequence[Dict[str, Any]]):
+def _group_tiers(group: Sequence[Dict[str, Any]]
+                 ) -> List[List[Dict[str, Any]]]:
     """Split one group into dependency tiers whose members may apply
     concurrently: (Namespace/CRD) -> (RBAC/config) -> (workloads)."""
     first = [o for o in group if o.get("kind") in _TIER_FIRST]
@@ -1443,16 +1778,36 @@ def _group_tiers(group: Sequence[Dict[str, Any]]):
 
 def _apply_one_cached(client: Client, obj: Dict[str, Any],
                       cache: Dict[str, Dict[str, Dict[str, Any]]],
-                      cache_lock: threading.Lock) -> str:
-    """Create-or-patch one object against the shared live-object cache:
-    absent -> POST (409 -> PATCH, the stale-cache window), present and
-    identical -> skip, present and different -> PATCH. The response object
-    refreshes the cache so readiness seeding sees the newest state."""
+                      cache_lock: Any,  # threading.Lock
+                      mode_state: _ModeState) -> str:
+    """Apply one object against the shared live-object cache.
+
+    SSA mode: present and provably identical under this manager's
+    ownership (:func:`_ssa_is_noop` — the EXACT check) -> skip with zero
+    requests; anything else -> one apply PATCH, whatever the server
+    holds. Merge mode (requested or the sticky 415/400 fallback): absent
+    -> POST (409 -> PATCH, the stale-cache window), present and
+    merge-identical -> skip, present and different -> PATCH. Either way
+    the response object refreshes the cache so readiness seeding sees
+    the newest state."""
     coll = collection_path(obj)
     path = object_path(obj)
     name = obj["metadata"]["name"]
     with cache_lock:
         live = cache.get(coll, {}).get(name)
+    if mode_state.mode == "ssa":
+        if live is not None and _ssa_is_noop(live, obj):
+            return "unchanged"
+        try:
+            action, resp = client._apply_ssa_raw(obj)
+        except SSAUnsupportedError as exc:
+            if mode_state.strict:
+                raise
+            mode_state.downgrade(str(exc))
+        else:
+            with cache_lock:
+                cache.setdefault(coll, {})[name] = resp
+            return action
     if live is not None and _patch_is_noop(live, obj):
         return "unchanged"
     if live is None:
@@ -1477,11 +1832,12 @@ def _apply_one_cached(client: Client, obj: Dict[str, Any],
 def _apply_groups_pipelined(client: Client,
                             groups: Sequence[Sequence[Dict[str, Any]]],
                             wait: bool, stage_timeout: float, poll: float,
-                            allow_empty_daemonsets: bool, log,
+                            allow_empty_daemonsets: bool, log: LogFn,
                             max_inflight: int,
                             result: GroupResult,
                             watch_ready: bool = False,
-                            journal: Optional[RolloutJournal] = None
+                            journal: Optional[RolloutJournal] = None,
+                            mode_state: Optional[_ModeState] = None
                             ) -> GroupResult:
     """The concurrent engine behind apply_groups(max_inflight>1).
 
@@ -1494,6 +1850,8 @@ def _apply_groups_pipelined(client: Client,
     collections the unfinished groups need."""
     from concurrent.futures import ThreadPoolExecutor
 
+    if mode_state is None:
+        mode_state = _ModeState("merge", strict=True)
     cache: Dict[str, Dict[str, Dict[str, Any]]] = {}
     cache_lock = threading.Lock()
     all_objs = [o for gi, group in enumerate(groups)
@@ -1541,19 +1899,27 @@ def _apply_groups_pipelined(client: Client,
                         continue
                     todo.append(obj)
                 futures2 = [(obj, pool.submit(_apply_one_cached, client,
-                                              obj, cache, cache_lock))
+                                              obj, cache, cache_lock,
+                                              mode_state))
                             for obj in todo]
                 errors = []
                 for obj, fut in futures2:
                     name = f"{obj['kind']}/{obj['metadata']['name']}"
                     try:
                         action = fut.result()
+                    except SSAUnsupportedError:
+                        # strict ssa (apply_mode="ssa" / a journal resumed
+                        # in ssa): a server without SSA aborts the rollout
+                        # AS a capability error, not a per-object failure
+                        raise
                     except ApplyError as exc:
                         errors.append(str(exc))
                         continue
+                    _log_downgrade_once(mode_state, log)
                     result.actions.append(f"{action} {name}")
                     log(f"{action} {name}")
                     if journal is not None:
+                        journal.set_mode(mode_state.mode)
                         journal.object_done(obj, i)
                 if errors:
                     # group barrier: nothing from group N+1 (or a later
@@ -1592,4 +1958,5 @@ def _apply_groups_pipelined(client: Client,
                 # converged-only, like the sequential engine: submit
                 # without readiness must never be resumed as complete
                 journal.group_done(i)
+    result.apply_mode = mode_state.mode
     return result

@@ -19,9 +19,30 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
+from .kubeapply import FIELD_MANAGER, OPERATOR_FIELD_MANAGER
 from .spec import ClusterSpec
 
 Runner = Callable[[List[str]], Tuple[int, str]]
+
+# Field managers expected on stack objects under server-side apply: the
+# CLI's and the in-cluster operator's (imported from kubeapply so the
+# runbook can never drift from the names the appliers actually use), plus
+# the cluster components that legitimately write status/scale on every
+# cluster. Anything else in managedFields is a FOREIGN manager — a manual
+# `kubectl edit` / `kubectl apply` that the next stack reconcile will
+# force-revert; check_ownership surfaces it before that happens.
+KNOWN_FIELD_MANAGERS = frozenset({
+    FIELD_MANAGER, OPERATOR_FIELD_MANAGER,
+    "kubelet", "kube-controller-manager", "kube-scheduler",
+    # The kubectl BACKEND (`tpuctl apply` without --apiserver) deploys
+    # through kubectl itself, which records these managers on every
+    # object it creates/applies — they cannot be "foreign" on a cluster
+    # the tool deployed that way. The cost: a human's own `kubectl
+    # apply -f` is indistinguishable and passes too; `kubectl edit` /
+    # `kubectl patch` still surface (managers "kubectl-edit" /
+    # "kubectl-patch").
+    "kubectl-client-side-apply", "kubectl-create",
+})
 
 
 class ClusterSnapshot:
@@ -486,6 +507,57 @@ def check_policy(runner: Runner, spec: ClusterSpec) -> CheckResult:
     return CheckResult("policy", True, line)
 
 
+# Stack object kinds whose field ownership the runbook audits — the
+# kinds the appliers manage in the operand namespace (workloads + the
+# config/identity objects a manual edit most plausibly touches).
+_OWNERSHIP_KINDS = ("daemonsets", "deployments", "services",
+                    "serviceaccounts", "configmaps")
+
+
+def check_ownership(runner: Runner, spec: ClusterSpec) -> CheckResult:
+    """Field-ownership drift (server-side apply round): list the stack's
+    objects WITH managedFields and flag any field manager that is not
+    tpuctl / tpu-operator / a known cluster component. A foreign manager
+    means someone `kubectl edit`-ed or `kubectl patch`-ed over the stack:
+    where their edit touches fields the bundle specifies, the next
+    reconcile's force-apply reverts it; a purely ADDITIVE edit persists
+    outside the stack's ownership. Either way it is unmanaged drift this
+    check makes visible, naming the object, the manager and its
+    operation."""
+    doc = _kubectl_json(runner, ["get", ",".join(_OWNERSHIP_KINDS),
+                                 "-n", spec.tpu.namespace,
+                                 "--show-managed-fields"])
+    if doc is None:
+        return CheckResult("ownership", False,
+                           f"cannot list stack objects in "
+                           f"{spec.tpu.namespace}")
+    foreign: List[str] = []
+    managed = 0
+    for item in doc.get("items") or []:
+        meta = item.get("metadata") or {}
+        entries = meta.get("managedFields") or []
+        if entries:
+            managed += 1
+        kind = item.get("kind", "?")
+        name = meta.get("name", "?")
+        for entry in entries:
+            mgr = entry.get("manager")
+            if mgr and mgr not in KNOWN_FIELD_MANAGERS:
+                foreign.append(
+                    f"{kind}/{name}: {mgr} "
+                    f"({entry.get('operation', '?')})")
+    if foreign:
+        return CheckResult(
+            "ownership", False,
+            "foreign field manager(s) — manual edits (contested fields "
+            "are force-reverted by the next reconcile; additive ones "
+            "persist unmanaged): " + "; ".join(sorted(foreign)))
+    return CheckResult(
+        "ownership", True,
+        f"{managed} object(s) owned by "
+        f"{FIELD_MANAGER}/{OPERATOR_FIELD_MANAGER} only")
+
+
 CHECKS: Dict[str, Callable[[Runner, ClusterSpec], CheckResult]] = {
     "smoke": check_smoke,
     "operands": check_operands,
@@ -493,6 +565,7 @@ CHECKS: Dict[str, Callable[[Runner, ClusterSpec], CheckResult]] = {
     "conditions": check_conditions,
     "allocatable": check_allocatable,
     "policy": check_policy,
+    "ownership": check_ownership,
     "device-query": check_device_query,
     "vector-add": check_vector_add,
     "metrics": check_metrics,
