@@ -119,6 +119,26 @@ const char* FieldManager() {
   return "tpu-operator";
 }
 
+const std::vector<std::string>& OperatorMetricNames() {
+  // Twin table of tpu_cluster/telemetry.py OPERATOR_METRIC_NAMES (the
+  // RetryableStatus pattern: selftest.cc pins this side, a Python
+  // source-grep in tests/test_telemetry.py pins the equality, and the
+  // live scrape is gated by `tpuctl verify --config operator-metrics`).
+  // operator_main.cc's Metrics() must emit every family named here.
+  static const auto* names = new std::vector<std::string>{
+      "tpu_operator_objects",
+      "tpu_operator_passes_total",
+      "tpu_operator_healthy",
+      "tpu_operator_consecutive_failures",
+      "tpu_operator_policy_generation",
+      "tpu_operator_reconcile_duration_seconds",
+      "tpu_operator_watch_reconnects_total",
+      "tpu_operator_queue_depth",
+      "tpu_operator_sync_lag_seconds",
+  };
+  return *names;
+}
+
 const std::vector<std::string>& OperandWorkloadKinds() {
   // Twin table of tpu_cluster/lint.py OPERAND_WORKLOAD_KINDS (both are
   // apps/v1 kinds; CollectionPath supplies the group). A kind added here

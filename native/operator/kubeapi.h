@@ -56,6 +56,17 @@ const std::vector<std::string>& OperandWorkloadKinds();
 // tests/test_apply.py (the RetryableStatus pattern).
 const char* FieldManager();
 
+// Prometheus metric families the operator's /metrics endpoint MUST
+// emit (every configuration — conditional families like the
+// --leader-elect-only tpu_operator_leader gauge are excluded). The C++
+// half of a pinned twin table: tpu_cluster/telemetry.py
+// OPERATOR_METRIC_NAMES names the same families, pinned by selftest.cc
+// (compiler-side) and a Python source-grep in tests/test_telemetry.py
+// (compiler-free), and `tpuctl verify --config operator-metrics` FAILs a
+// live scrape missing any of them. Renaming a family here without its
+// twin breaks the pin before it breaks a dashboard.
+const std::vector<std::string>& OperatorMetricNames();
+
 }  // namespace kubeapi
 
 #endif  // TPU_NATIVE_OPERATOR_KUBEAPI_H_

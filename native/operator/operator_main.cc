@@ -268,6 +268,10 @@ class Operator {
     char host[256] = "host";
     gethostname(host, sizeof(host) - 1);
     identity_ = std::string(host) + "-" + std::to_string(getpid());
+    // sync lag is "seconds since the last CONVERGED pass"; before the
+    // first one it counts from process start, so a never-converging
+    // operator shows an ever-growing lag instead of a flat 0
+    clock_gettime(CLOCK_MONOTONIC, &start_ts_);
   }
 
   bool LoadOrReloadBundle() {
@@ -291,6 +295,8 @@ class Operator {
   // degraded-state counters /healthz and /metrics surface: consecutive
   // failed passes and the first error of the latest failed one.
   bool ReconcilePass() {
+    struct timespec t0;
+    clock_gettime(CLOCK_MONOTONIC, &t0);
     bool ok = ReconcileObjects();
     if (ok) {
       consecutive_failures_ = 0;
@@ -300,6 +306,15 @@ class Operator {
       last_error_ = FirstError();
     }
     WritePolicyStatus(ok);
+    // telemetry (ISSUE 6): the pass duration feeds the fixed-bucket
+    // reconcile histogram (including the status write-back — the
+    // whole pass is what the interval budget buys), and a CONVERGED
+    // pass resets the sync-lag clock the /metrics gauge reads.
+    ObserveReconcileSeconds(kubeclient::ElapsedMs(t0) / 1000.0);
+    if (ok) {
+      clock_gettime(CLOCK_MONOTONIC, &last_sync_);
+      synced_ = true;
+    }
     return ok;
   }
 
@@ -907,6 +922,12 @@ class Operator {
               ? 0
               : kubeclient::WatchBackoffMs(ow->strikes, 1000, 30000);
       ow->ws.Close();
+      // each back_off forces exactly one stream re-open attempt later —
+      // the tpu_operator_watch_reconnects_total counter /metrics serves.
+      // Both flavors count: quick closes/failed opens (the churn a
+      // rejecting proxy causes) and windows the server ended early; a
+      // stream that idles out the whole sleep never lands here.
+      ++watch_reconnects_;
     };
     while (!g_stop) {
       recompute_left();
@@ -1196,6 +1217,27 @@ class Operator {
     return root->Dump() + "\n";
   }
 
+  // Reconcile-duration histogram buckets (seconds), FIXED so two
+  // operators' scrapes aggregate bucket-for-bucket. A pass spans apply +
+  // readiness gates, so the tail reaches minutes; +Inf is implicit.
+  static constexpr double kReconcileBucketsS[] = {
+      0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60};
+  static constexpr size_t kReconcileBuckets =
+      sizeof(kReconcileBucketsS) / sizeof(kReconcileBucketsS[0]);
+
+  void ObserveReconcileSeconds(double secs) {
+    size_t idx = kReconcileBuckets;  // +Inf unless a bound catches it
+    for (size_t i = 0; i < kReconcileBuckets; ++i) {
+      if (secs <= kReconcileBucketsS[i]) {
+        idx = i;
+        break;
+      }
+    }
+    ++reconcile_counts_[idx];
+    reconcile_sum_s_ += secs;
+    ++reconcile_count_;
+  }
+
   std::string Metrics() const {
     int applied = 0, ready = 0, disabled = 0;
     for (const auto& bo : bundle_) {
@@ -1221,6 +1263,51 @@ class Operator {
              bundle_.size(), applied, ready, disabled, passes_,
              healthy_ ? 1 : 0, consecutive_failures_, policy_generation_);
     std::string out = buf;
+    // Telemetry families (ISSUE 6; names pinned via
+    // kubeapi::OperatorMetricNames() — the telemetry.py twin table).
+    // Histogram: Prometheus cumulative `le` encoding.
+    out += "# TYPE tpu_operator_reconcile_duration_seconds histogram\n";
+    long cum = 0;
+    for (size_t i = 0; i < kReconcileBuckets; ++i) {
+      cum += reconcile_counts_[i];
+      snprintf(buf, sizeof(buf),
+               "tpu_operator_reconcile_duration_seconds_bucket"
+               "{le=\"%g\"} %ld\n",
+               kReconcileBucketsS[i], cum);
+      out += buf;
+    }
+    snprintf(buf, sizeof(buf),
+             "tpu_operator_reconcile_duration_seconds_bucket"
+             "{le=\"+Inf\"} %ld\n"
+             "tpu_operator_reconcile_duration_seconds_sum %.6f\n"
+             "tpu_operator_reconcile_duration_seconds_count %ld\n",
+             reconcile_count_, reconcile_sum_s_, reconcile_count_);
+    out += buf;
+    // Watch-path churn + the ROADMAP item-2 precursors: queue depth =
+    // bundle objects the latest pass left unapplied (the informer
+    // refactor's rate-limited workqueue depth lands on this name), sync
+    // lag = seconds since the last converged pass (counted from process
+    // start until the first one).
+    int queue_depth = static_cast<int>(bundle_.size()) - applied - disabled;
+    if (queue_depth < 0) queue_depth = 0;
+    // seconds computed directly from the timespec (NOT ElapsedMs, whose
+    // int-milliseconds return overflows after ~24.8 days — exactly the
+    // long-outage case this gauge exists to expose)
+    struct timespec now;
+    clock_gettime(CLOCK_MONOTONIC, &now);
+    const struct timespec& sync_ref = synced_ ? last_sync_ : start_ts_;
+    double lag_s = static_cast<double>(now.tv_sec - sync_ref.tv_sec) +
+                   (now.tv_nsec - sync_ref.tv_nsec) / 1e9;
+    if (lag_s < 0) lag_s = 0;
+    snprintf(buf, sizeof(buf),
+             "# TYPE tpu_operator_watch_reconnects_total counter\n"
+             "tpu_operator_watch_reconnects_total %ld\n"
+             "# TYPE tpu_operator_queue_depth gauge\n"
+             "tpu_operator_queue_depth %d\n"
+             "# TYPE tpu_operator_sync_lag_seconds gauge\n"
+             "tpu_operator_sync_lag_seconds %.3f\n",
+             watch_reconnects_, queue_depth, lag_s);
+    out += buf;
     if (opt_.leader_elect)
       out += "# TYPE tpu_operator_leader gauge\n"
              "tpu_operator_leader " + std::to_string(leader_ ? 1 : 0) + "\n";
@@ -1601,6 +1688,17 @@ class Operator {
   int passes_ = 0;
   int event_seq_ = 0;
   bool healthy_ = false;
+  // telemetry (ISSUE 6): reconcile-duration histogram (fixed buckets +
+  // the +Inf overflow slot), watch reconnect counter (operand/policy
+  // streams re-opened after an abnormal close), and the sync-lag clock
+  // (last converged pass; process start until the first one)
+  long reconcile_counts_[kReconcileBuckets + 1] = {0};
+  double reconcile_sum_s_ = 0;
+  long reconcile_count_ = 0;
+  long watch_reconnects_ = 0;
+  struct timespec start_ts_ = {0, 0};
+  struct timespec last_sync_ = {0, 0};
+  bool synced_ = false;
   // degraded-state surface (/healthz, /status, /metrics): consecutive
   // failed passes and the first error of the latest failed one
   int consecutive_failures_ = 0;

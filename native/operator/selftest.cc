@@ -247,6 +247,37 @@ static void TestFieldManagerTwin() {
   CHECK(strcmp(kubeapi::FieldManager(), "tpuctl") != 0);
 }
 
+static void TestOperatorMetricNamesTwinTable() {
+  // Pinned twin table (RetryableStatus pattern): the families the
+  // operator's /metrics endpoint must emit — tpu_cluster/telemetry.py
+  // OPERATOR_METRIC_NAMES names the same set, tests/test_telemetry.py
+  // greps THIS table out of kubeapi.cc to close the loop without a
+  // compiler, and `tpuctl verify --config operator-metrics` gates the
+  // live scrape. A rename lands here before it lands on a dashboard.
+  const auto& names = kubeapi::OperatorMetricNames();
+  CHECK(names.size() == 9);
+  auto has = [&](const char* want) {
+    for (const auto& n : names)
+      if (n == want) return true;
+    return false;
+  };
+  CHECK(has("tpu_operator_objects"));
+  CHECK(has("tpu_operator_passes_total"));
+  CHECK(has("tpu_operator_healthy"));
+  CHECK(has("tpu_operator_consecutive_failures"));
+  CHECK(has("tpu_operator_policy_generation"));
+  CHECK(has("tpu_operator_reconcile_duration_seconds"));
+  CHECK(has("tpu_operator_watch_reconnects_total"));
+  CHECK(has("tpu_operator_queue_depth"));
+  CHECK(has("tpu_operator_sync_lag_seconds"));
+  // uniqueness + the namespace prefix every family must carry
+  for (size_t i = 0; i < names.size(); ++i) {
+    CHECK(names[i].rfind("tpu_operator_", 0) == 0);
+    for (size_t j = i + 1; j < names.size(); ++j)
+      CHECK(names[i] != names[j]);
+  }
+}
+
 static void TestWatchBackoff() {
   // Doubling from base, capped: the operand drift-watch reconnect
   // schedule. A persistently kClosed stream (each https open is a curl
@@ -274,6 +305,7 @@ int main() {
   TestRetryClassification();
   TestOperandWorkloadTwinTable();
   TestFieldManagerTwin();
+  TestOperatorMetricNamesTwinTable();
   TestWatchBackoff();
   if (g_failures) {
     fprintf(stderr, "operator_selftest: %d FAILURES\n", g_failures);

@@ -38,6 +38,15 @@ steady-state re-applies through FRESH clients: the exact managedFields
 no-op check must converge on reads alone — zero POST/PATCH mutations —
 which the merge path's conservative heuristic could not promise.
 
+EVERY number in the JSON line is derived from the telemetry span tree
+(tpu_cluster.telemetry — the same spans `tpuctl apply --trace-out` hands
+a user), not from private counters: per-phase timings come from phase
+spans, request/mutation counts from the http leaf spans (one per wire
+attempt), retries from the registry. Clean arms additionally assert
+span-count == the fake apiserver's own audit log, exactly — the bench
+line and the user-facing trace cannot disagree. ``--trace-out`` saves
+the pipelined arm's trace for chrome://tracing / `tpuctl top`.
+
 Usage:
   python scripts/bench_rollout.py                 # print the JSON line
   python scripts/bench_rollout.py --check         # also exit 1 unless
@@ -65,6 +74,7 @@ sys.path.insert(0, os.path.join(REPO, "tests"))
 from fake_apiserver import FakeApiServer, standard_fault_script  # noqa: E402
 from tpu_cluster import kubeapply  # noqa: E402
 from tpu_cluster import spec as specmod  # noqa: E402
+from tpu_cluster import telemetry  # noqa: E402
 from tpu_cluster.render import manifests, operator_bundle  # noqa: E402
 
 REQUEST_RATIO_TARGET = 3.0
@@ -91,38 +101,80 @@ def full_stack_groups(spec):
             + list(manifests.rollout_groups(spec)))
 
 
+MUTATING = ("POST", "PATCH", "PUT", "DELETE")
+
+
+# ------------------------------------------------------------------ span
+# derivation (ISSUE 6): every number the bench reports comes FROM the
+# telemetry span tree — the same trace `tpuctl apply --trace-out` gives a
+# user — so the bench line and the user-facing trace cannot disagree. On
+# clean runs the span-derived request count is additionally asserted
+# equal to the fake apiserver's own audit log (one leaf span per wire
+# attempt == one server-side log entry); under chaos a request can die
+# before the server sees it, so the parity assert is clean-run-only.
+
+
+def _trace_requests(tel, verbs=None) -> int:
+    """Wire attempts recorded in the span tree (cat == "http"),
+    optionally restricted to a verb set (MUTATING for the warm
+    zero-mutation gate)."""
+    events = telemetry.request_events(tel.chrome_trace())
+    if verbs is None:
+        return len(events)
+    return sum(1 for e in events
+               if e.get("args", {}).get("verb") in verbs)
+
+
+def _trace_phases(tel) -> dict:
+    """Per-phase wall seconds summed from the phase spans."""
+    return {k: round(v, 3)
+            for k, v in telemetry.phase_totals(tel.chrome_trace()).items()}
+
+
+def _assert_audit_parity(tel, api) -> None:
+    """Clean-run contract: summed request spans == the apiserver's own
+    audit count, exactly. A mismatch means the instrumentation dropped
+    or double-counted a wire attempt — fail the bench loudly rather than
+    report numbers the trace can't back."""
+    spans = _trace_requests(tel)
+    audit = len(api.log)
+    if spans != audit:
+        raise SystemExit(f"bench_rollout: span/audit mismatch — "
+                         f"{spans} request span(s) vs {audit} "
+                         f"apiserver-logged request(s)")
+
+
 def run_arm(name: str, latency_s: float, passes: int,
-            max_inflight: int) -> dict:
+            max_inflight: int, trace_out: str = "") -> dict:
     """One fresh fake apiserver; install + `passes` steady-state re-applies.
-    Returns wall clock, apiserver request count, and per-phase timings.
-    Both arms are pinned to the MERGE apply path: they are the PR-1
-    sequential-vs-pipelined comparison the 3x/2x gates were calibrated
-    on; the server-side-apply engine gets its own ``ssa`` column
-    (:func:`ssa_arm`) measured against them."""
+    Returns wall clock, apiserver request count, and per-phase timings —
+    requests and phases DERIVED FROM THE SPAN TREE (audit-parity checked
+    against the fake's log). Both arms are pinned to the MERGE apply
+    path: they are the PR-1 sequential-vs-pipelined comparison the 3x/2x
+    gates were calibrated on; the server-side-apply engine gets its own
+    ``ssa`` column (:func:`ssa_arm`) measured against them."""
     spec = specmod.default_spec()
     groups = full_stack_groups(spec)
-    phases = {"apply": 0.0, "crd-establish": 0.0, "ready-wait": 0.0}
+    tel = telemetry.Telemetry()
     with FakeApiServer(auto_ready=True, latency_s=latency_s) as api:
-        client = kubeapply.Client(api.url, keep_alive=(max_inflight > 1))
+        client = kubeapply.Client(api.url, keep_alive=(max_inflight > 1),
+                                  telemetry=tel)
         t0 = time.monotonic()
         for _ in range(1 + passes):
-            result = kubeapply.apply_groups(
+            kubeapply.apply_groups(
                 client, groups, wait=True, stage_timeout=60, poll=0.05,
                 max_inflight=max_inflight, apply_mode="merge")
-            for k, v in result.timings.items():
-                phases[k] += v
         wall = time.monotonic() - t0
         client.close()
-        requests = len(api.log)
+        _assert_audit_parity(tel, api)
+    if trace_out:
+        tel.write_trace(trace_out)
     return {
         "arm": name,
         "wall_s": round(wall, 3),
-        "requests": requests,
-        "phases": {k: round(v, 3) for k, v in phases.items()},
+        "requests": _trace_requests(tel),
+        "phases": _trace_phases(tel),
     }
-
-
-MUTATING = ("POST", "PATCH", "PUT", "DELETE")
 
 
 def ssa_arm(latency_s: float, passes: int, max_inflight: int) -> dict:
@@ -145,46 +197,58 @@ def ssa_arm(latency_s: float, passes: int, max_inflight: int) -> dict:
     through a FRESH client each time (no client-side memo — the no-op
     proof comes from the live objects' managedFields, the exact
     ownership check). The contract: reads only (LIST prefetch), ZERO
-    POST/PATCH mutations, gated by --check and tests/test_pipeline.py."""
+    POST/PATCH mutations, gated by --check and tests/test_pipeline.py.
+    Request and mutation counts are span-derived (one telemetry per
+    phase; the warm clients share one registry), parity-checked against
+    the fake's audit log."""
     spec = specmod.default_spec()
     groups = full_stack_groups(spec)
+    tel_cold = telemetry.Telemetry()
+    tel_warm = telemetry.Telemetry()
     with FakeApiServer(auto_ready=True, latency_s=latency_s) as api:
-        client = kubeapply.Client(api.url)
+        client = kubeapply.Client(api.url, telemetry=tel_cold)
         t0 = time.monotonic()
         kubeapply.apply_groups(client, groups, wait=True, stage_timeout=60,
                                poll=0.05, max_inflight=max_inflight,
                                apply_mode="ssa")
         cold_wall = time.monotonic() - t0
         client.close()
-        cold_requests = len(api.log)
+        _assert_audit_parity(tel_cold, api)
+        cold_requests = _trace_requests(tel_cold)
         mark = len(api.log)
         t0 = time.monotonic()
         for _ in range(max(1, passes)):
-            warm_client = kubeapply.Client(api.url)
+            warm_client = kubeapply.Client(api.url, telemetry=tel_warm)
             kubeapply.apply_groups(warm_client, groups, wait=True,
                                    stage_timeout=60, poll=0.05,
                                    max_inflight=max_inflight,
                                    apply_mode="ssa")
             warm_client.close()
         warm_wall = time.monotonic() - t0
-        warm = api.log[mark:]
-        mutations = sum(1 for m, _ in warm if m in MUTATING)
+        warm_requests = _trace_requests(tel_warm)
+        if warm_requests != len(api.log) - mark:
+            raise SystemExit(
+                f"bench_rollout: warm span/audit mismatch — "
+                f"{warm_requests} span(s) vs {len(api.log) - mark}")
+        mutations = _trace_requests(tel_warm, MUTATING)
+    tel_merge = telemetry.Telemetry()
     with FakeApiServer(auto_ready=True, latency_s=latency_s) as api:
-        client = kubeapply.Client(api.url)
+        client = kubeapply.Client(api.url, telemetry=tel_merge)
         t0 = time.monotonic()
         kubeapply.apply_groups(client, groups, wait=True, stage_timeout=60,
                                poll=0.05, max_inflight=1,
                                apply_mode="merge")
         merge_wall = time.monotonic() - t0
         client.close()
-        merge_requests = len(api.log)
+        _assert_audit_parity(tel_merge, api)
+        merge_requests = _trace_requests(tel_merge)
     return {
         "cold": {"requests": cold_requests, "wall_s": round(cold_wall, 3)},
         "merge_cold": {"requests": merge_requests,
                        "wall_s": round(merge_wall, 3)},
         "cold_reduction": round(1 - cold_requests / max(1, merge_requests),
                                 3),
-        "warm": {"passes": max(1, passes), "requests": len(warm),
+        "warm": {"passes": max(1, passes), "requests": warm_requests,
                  "mutations": mutations, "wall_s": round(warm_wall, 3)},
     }
 
@@ -199,11 +263,13 @@ def readiness_arm(latency_s: float, watch: bool, objects: int = 4) -> dict:
              "metadata": {"name": f"bench-ds-{i}", "namespace": "tpu-system"},
              "spec": {"template": {"spec": {"image": f"img:{i}"}}}}
             for i in range(objects)]
+    tel = telemetry.Telemetry()
     with FakeApiServer(auto_ready=False, latency_s=latency_s) as api:
-        client = kubeapply.Client(api.url)
+        client = kubeapply.Client(api.url, telemetry=tel)
         for obj in objs:
             client.apply(obj)
         applied = len(api.log)
+        applied_spans = _trace_requests(tel)
         stats: dict = {}
         flipped = []
 
@@ -231,7 +297,11 @@ def readiness_arm(latency_s: float, watch: bool, objects: int = 4) -> dict:
         latency = time.monotonic() - flipped[0]
         t.join()
         client.close()
-        requests = len(api.log) - applied
+        # span-derived: the wait's wire attempts are everything recorded
+        # after the setup applies (audit-parity checked)
+        requests = _trace_requests(tel) - applied_spans
+        if _trace_requests(tel) != len(api.log):
+            raise SystemExit("bench_rollout: readiness span/audit mismatch")
     return {"mutation_to_ready_s": round(latency, 4),
             "requests": requests, "mode": stats["mode"]}
 
@@ -247,17 +317,27 @@ def faults_arm(latency_s: float, watch: bool, faulted: bool) -> dict:
     spec = specmod.default_spec()
     groups = full_stack_groups(spec)
     script = standard_fault_script(FAULT_UNIT_S) if faulted else None
+    tel = telemetry.Telemetry()
     with FakeApiServer(auto_ready=True, latency_s=latency_s,
                        chaos=script) as api:
-        client = kubeapply.Client(api.url, retry=FAULT_RETRY)
+        client = kubeapply.Client(api.url, retry=FAULT_RETRY, telemetry=tel)
         t0 = time.monotonic()
         kubeapply.apply_groups(client, groups, wait=True, stage_timeout=60,
                                poll=0.05, max_inflight=8, watch_ready=watch)
         wall = time.monotonic() - t0
         client.close()
-        requests = len(api.log)
-    return {"wall_s": round(wall, 3), "requests": requests,
-            "retries": client.retries, "converged": True}
+        if not faulted:
+            _assert_audit_parity(tel, api)
+    # span-derived requests (under faults the client's count is the
+    # honest one: a request that died before the server saw it is still
+    # a request the rollout paid for) + registry-derived retries, which
+    # must agree with the client's own counter
+    retries = int(tel.metrics.total(telemetry.RETRIES_TOTAL))
+    if retries != client.retries:
+        raise SystemExit(f"bench_rollout: retry count mismatch — registry "
+                         f"{retries} vs client {client.retries}")
+    return {"wall_s": round(wall, 3), "requests": _trace_requests(tel),
+            "retries": retries, "converged": True}
 
 
 def _operator_binary() -> str:
@@ -347,12 +427,18 @@ def main(argv=None) -> int:
                     help=f"exit 1 unless requests drop "
                          f">={REQUEST_RATIO_TARGET:g}x and wall clock drops "
                          f">={SPEEDUP_TARGET:g}x")
+    ap.add_argument("--trace-out", default="", metavar="PATH",
+                    help="write the pipelined arm's span tree as Chrome "
+                         "trace-event JSON (the same format tpuctl apply "
+                         "--trace-out emits; CI uploads it as an "
+                         "artifact)")
     args = ap.parse_args(argv)
 
     latency_s = args.latency_ms / 1000.0
     seq = run_arm("sequential", latency_s, args.passes, max_inflight=1)
     pipe = run_arm("pipelined", latency_s, args.passes,
-                   max_inflight=args.max_inflight)
+                   max_inflight=args.max_inflight,
+                   trace_out=args.trace_out)
     ssa = ssa_arm(latency_s, args.passes, args.max_inflight)
     ready_watch = readiness_arm(latency_s, watch=True)
     ready_poll = readiness_arm(latency_s, watch=False)

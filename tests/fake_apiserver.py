@@ -409,6 +409,18 @@ class FakeApiServer:
         self.log: List[Tuple[str, str]] = []  # (method, path)
         self.created: List[str] = []          # stored object paths, in order
         self.headers_seen: List[Dict[str, str]] = []
+        # Server-side request audit by (verb, path-sans-query, status):
+        # every request that reached a handler gets exactly ONE entry —
+        # normal replies, watch streams (status 200), chaos status
+        # injections, and dropped connections (status 0) — so
+        # sum(responses.values()) == len(log) always, and the
+        # /__fake_metrics endpoint can publish it for client-vs-server
+        # accounting assertions. Scrapes of /__fake_metrics itself are
+        # excluded from BOTH (the observer must not move the needle).
+        self.responses: Dict[Tuple[str, str, int], int] = {}
+        # own lock: _reply fires inside handlers that already hold _lock
+        # (which is non-reentrant), so the audit cannot share it
+        self._responses_lock = threading.Lock()
         self._lock = threading.Lock()
         # watch support (?watch=1): every mutation through the HTTP
         # handlers (or the touch() test hook) bumps _rev and records the
@@ -437,6 +449,8 @@ class FakeApiServer:
                 return json.loads(raw) if raw else None
 
             def _reply(self, code: int, obj: Any = None):
+                fake._note_response(self.command,
+                                    self.path.partition("?")[0], code)
                 body = json.dumps(obj if obj is not None else {}).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
@@ -469,6 +483,7 @@ class FakeApiServer:
                     # half-close the socket with no reply: the client sees
                     # the connection die mid-request (RemoteDisconnected /
                     # reset), i.e. transport status 0
+                    fake._note_response(self.command, path, 0)
                     self.close_connection = True
                     try:
                         self.connection.shutdown(socket.SHUT_RDWR)
@@ -476,6 +491,7 @@ class FakeApiServer:
                         pass
                     return True
                 _, status, headers, body = act
+                fake._note_response(self.command, path, status)
                 payload = json.dumps(body).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
@@ -507,6 +523,7 @@ class FakeApiServer:
                 except ValueError:
                     timeout_s = 30.0
                 deadline = time.monotonic() + max(0.0, min(timeout_s, 300.0))
+                fake._note_response("GET", path, 200)
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Connection", "close")
@@ -587,6 +604,21 @@ class FakeApiServer:
                     pass  # watcher went away; nothing to clean up
 
             def do_GET(self):
+                if self.path.partition("?")[0] == "/__fake_metrics":
+                    # The audit-log-as-metrics endpoint (ISSUE 6): the
+                    # server's own request accounting in Prometheus text,
+                    # so tests can assert client-side and server-side
+                    # counts agree. Served OUTSIDE _record/_chaos — the
+                    # observer is not part of the audit, and chaos must
+                    # not black-hole it.
+                    body = fake.fake_metrics_text().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 self._record()
                 path, _, query = self.path.partition("?")
                 q = parse_qs(query)
@@ -916,6 +948,40 @@ class FakeApiServer:
 
     def __exit__(self, *exc):
         self.stop()
+
+    # ------------------------------------------------------------- metrics
+
+    def _note_response(self, method: str, path: str, status: int) -> None:
+        """One audit entry per handled request (see ``responses``)."""
+        key = (method, path, status)
+        with self._responses_lock:
+            self.responses[key] = self.responses.get(key, 0) + 1
+
+    def fake_metrics_text(self) -> str:
+        """The `/__fake_metrics` body: the request audit as Prometheus
+        text — `fake_apiserver_requests_total{verb,path,code}` (one
+        sample per distinct triple; dropped connections are code="0"),
+        plus `fake_apiserver_chaos_faults_total{kind}` from the chaos
+        engine's fired list. Label order is fixed and families sorted so
+        scrapes are byte-stable for equal state."""
+        with self._responses_lock:
+            rows = sorted(self.responses.items())
+        lines = ["# TYPE fake_apiserver_requests_total counter"]
+        for (method, path, status), n in rows:
+            lines.append(
+                f'fake_apiserver_requests_total{{verb="{method}",'
+                f'path="{path}",code="{status}"}} {n}')
+        fired: Dict[str, int] = {}
+        if self.chaos is not None:
+            for status, _m, _p in list(self.chaos.fired):
+                kind = str(status)
+                fired[kind] = fired.get(kind, 0) + 1
+        lines.append("# TYPE fake_apiserver_chaos_faults_total counter")
+        for kind in sorted(fired):
+            lines.append(
+                f'fake_apiserver_chaos_faults_total{{kind="{kind}"}} '
+                f"{fired[kind]}")
+        return "\n".join(lines) + "\n"
 
     # ------------------------------------------------------------- watch
 

@@ -141,6 +141,20 @@ def test_drift_recreated_and_status_served(native_build, bundle_dir):
                 timeout=20)
             code, metrics = fetch("/metrics")
             assert code == 200 and "tpu_operator_healthy 1" in metrics
+            # the LIVE half of the metric-name twin pin (ISSUE 6): every
+            # family telemetry.OPERATOR_METRIC_NAMES pins must be present
+            # on the real endpoint, and the reconcile histogram must have
+            # observed the passes that just converged
+            from tpu_cluster import telemetry
+            metric_lines = metrics.splitlines()
+            missing = [n for n in telemetry.OPERATOR_METRIC_NAMES
+                       if not any(ln.startswith(n) for ln in metric_lines)]
+            assert not missing, (missing, metrics)
+            count_line = next(
+                ln for ln in metric_lines
+                if ln.startswith(
+                    "tpu_operator_reconcile_duration_seconds_count"))
+            assert int(count_line.split()[-1]) >= 1, metrics
             code, _ = fetch("/healthz")
             assert code == 200
 
@@ -483,10 +497,12 @@ def test_operator_bundle_render_shape():
 
     install = operator_bundle.operator_install(spec)
     kinds = [o["kind"] for o in install]
-    # CRD before its CR before the controller that polls it
+    # CRD before its CR before the controller that polls it (the Service
+    # is the operator's /metrics scrape surface, ISSUE 6)
     assert kinds == ["Namespace", "ServiceAccount", "ClusterRole",
                      "ClusterRoleBinding", "CustomResourceDefinition",
-                     "TpuStackPolicy", "ConfigMap", "Deployment"]
+                     "TpuStackPolicy", "ConfigMap", "Service",
+                     "Deployment"]
     cm = install[6]
     assert set(cm["data"]) == set(files)
     # bundle documents round-trip through the ConfigMap encoding

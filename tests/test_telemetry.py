@@ -1,0 +1,459 @@
+"""Unified telemetry tests (ISSUE 6): histogram bucket math, Chrome
+trace-event schema, span-tree integrity under chaos, the pinned
+trace-vs-apiserver-audit exact-count contract, the metric-name twin pins
+(Python table vs C++ source), and the FakeApiServer /__fake_metrics
+endpoint."""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import urllib.request
+
+import pytest
+
+from fake_apiserver import FakeApiServer, standard_fault_script
+from tpu_cluster import kubeapply, telemetry
+from tpu_cluster import spec as specmod
+from tpu_cluster.render import manifests, operator_bundle
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NS = "tpu-system"
+
+FAST_RETRY = kubeapply.RetryPolicy(attempts=8, base_s=0.02, cap_s=0.3)
+
+
+@pytest.fixture()
+def spec():
+    return specmod.default_spec()
+
+
+def full_stack_groups(spec):
+    return (list(operator_bundle.operator_install_groups(spec))
+            + list(manifests.rollout_groups(spec)))
+
+
+# ------------------------------------------------------------- registry
+
+
+def test_histogram_bucket_math_and_rendering():
+    """Fixed-bucket histogram: observations land in the right cumulative
+    `le` buckets, +Inf equals the observation count, and the rendered
+    text is valid Prometheus exposition."""
+    reg = telemetry.MetricsRegistry()
+    h = reg.histogram("t_seconds", "help text", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.01, 0.05, 0.5, 5.0):
+        h.observe(v)
+    # non-cumulative: (<=0.01): 2, (<=0.1): 1, (<=1.0): 1, +Inf: 1
+    assert h.counts == [2, 1, 1, 1]
+    assert h.cumulative() == [2, 3, 4, 5]
+    assert h.count == 5
+    assert abs(h.sum - 5.565) < 1e-9
+    text = reg.render()
+    assert 't_seconds_bucket{le="0.01"} 2' in text
+    assert 't_seconds_bucket{le="0.1"} 3' in text
+    assert 't_seconds_bucket{le="1"} 4' in text
+    assert 't_seconds_bucket{le="+Inf"} 5' in text
+    assert "t_seconds_sum 5.565" in text
+    assert "t_seconds_count 5" in text
+    assert "# TYPE t_seconds histogram" in text
+    # buckets must be strictly increasing — a typo'd table is a bug, not
+    # a silently-weird distribution
+    with pytest.raises(ValueError):
+        reg.histogram("bad_seconds", buckets=(0.1, 0.1, 1.0))
+    # re-registering a family with DIFFERENT buckets is as loud as a
+    # type mismatch — never silently drop the caller's layout
+    with pytest.raises(ValueError, match="already registered"):
+        reg.histogram("t_seconds", buckets=(1.0, 60.0))
+
+
+def test_registry_counters_gauges_labels_and_type_guard():
+    reg = telemetry.MetricsRegistry()
+    reg.counter("reqs_total", "requests", verb="GET", code="200").inc(3)
+    reg.counter("reqs_total", verb="POST", code="201").inc()
+    reg.gauge("depth").set(7)
+    assert reg.total("reqs_total") == 4
+    assert reg.total("reqs_total", verb="GET") == 3
+    assert reg.total("absent_total") == 0.0
+    text = reg.render()
+    assert 'reqs_total{code="200",verb="GET"} 3' in text
+    assert 'reqs_total{code="201",verb="POST"} 1' in text
+    assert "depth 7" in text
+    # same name, different type: loud error, not silent coercion
+    with pytest.raises(ValueError):
+        reg.gauge("reqs_total")
+    with pytest.raises(ValueError):
+        reg.counter("neg_total").inc(-1)
+
+
+def test_prometheus_label_escaping():
+    reg = telemetry.MetricsRegistry()
+    reg.counter("esc_total", path='say "hi"\nback\\slash').inc()
+    line = [ln for ln in reg.render().splitlines()
+            if ln.startswith("esc_total{")][0]
+    assert '\\"hi\\"' in line and "\\n" in line and "\\\\slash" in line
+
+
+# ------------------------------------------------------------- tracing
+
+
+def _check_nesting(span, eps=0.05):
+    """Every child's [start, end] must sit inside its parent's (within a
+    small epsilon — leaf spans are retro-dated by measured duration)."""
+    end = span.end_s if span.end_s is not None else float("inf")
+    for child in span.children:
+        c_end = child.end_s if child.end_s is not None else end
+        assert child.start_s >= span.start_s - eps, (child.name, span.name)
+        assert c_end <= end + eps, (child.name, span.name)
+        _check_nesting(child, eps)
+
+
+def test_span_stack_parents_and_explicit_parent_override():
+    tel = telemetry.Telemetry()
+    with tel.span("root", "rollout") as root:
+        with tel.span("child", "group") as child:
+            assert tel.current() is child
+            tel.leaf("GET /x", "http", 0.001, status=200, verb="GET")
+        other = tel.tracer.start("threaded", "watch", parent=root)
+        other.end()
+    assert tel.current() is None
+    assert [s.name for s in tel.tracer.roots] == ["root"]
+    assert [c.name for c in root.children] == ["child", "threaded"]
+    assert [c.name for c in root.children[0].children] == ["GET /x"]
+    _check_nesting(root)
+
+
+def test_chrome_trace_schema():
+    """The exported document must be loadable by chrome://tracing /
+    Perfetto: traceEvents array, X events with numeric ts/dur in
+    microseconds, pid/tid present, args a dict — and round-trip JSON."""
+    tel = telemetry.Telemetry()
+    with tel.span("rollout", "rollout", groups=2) as sp:
+        sp.event("retry", code=503, backoff_s=0.1)
+        tel.leaf("GET /c", "http", 0.002, status=200, verb="GET")
+    doc = json.loads(json.dumps(tel.chrome_trace()))
+    events = doc["traceEvents"]
+    assert doc["displayTimeUnit"] == "ms"
+    complete = [e for e in events if e["ph"] == "X"]
+    instants = [e for e in events if e["ph"] == "i"]
+    assert {e["name"] for e in complete} == {"rollout", "GET /c"}
+    assert [e["name"] for e in instants] == ["retry"]
+    for e in events:
+        assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        assert isinstance(e["name"], str) and isinstance(e["cat"], str)
+        if e["ph"] == "X":
+            assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+    root = [e for e in complete if e["name"] == "rollout"][0]
+    # span args surface in the trace (the breakdown `tpuctl top` reads)
+    assert root["args"]["groups"] == 2
+    # an unfinished span exports marked, with duration-so-far
+    tel2 = telemetry.Telemetry()
+    tel2.tracer.start("crashed", "rollout")
+    doc2 = tel2.chrome_trace()
+    assert doc2["traceEvents"][0]["args"]["unfinished"] is True
+
+
+def test_span_tree_integrity_under_chaos(spec):
+    """The satellite acceptance: a standard_fault_script() rollout's
+    trace still nests correctly, records the retries as instant events
+    (with the PR-3 taxonomy classification), and counts them in the
+    registry — chaos must be READABLE off the trace, not just survived."""
+    groups = full_stack_groups(spec)
+    tel = telemetry.Telemetry()
+    with FakeApiServer(auto_ready=True,
+                       chaos=standard_fault_script(0.03)) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY, telemetry=tel)
+        kubeapply.apply_groups(client, groups, wait=True, stage_timeout=60,
+                               poll=0.02, max_inflight=8, watch_ready=True)
+        client.close()
+        assert client.retries > 0, "the fault script never fired"
+        assert api.chaos.fired
+    for root in tel.tracer.roots:
+        _check_nesting(root)
+    # every span ended (the rollout returned)
+    for span in tel.tracer.walk():
+        assert span.end_s is not None, span.name
+    doc = tel.chrome_trace()
+    retries = [e for e in doc["traceEvents"]
+               if e["ph"] == "i" and e["name"] == "retry"]
+    assert len(retries) == client.retries
+    for ev in retries:
+        assert ev["args"]["classification"] == "retryable"
+        assert ev["args"]["code"] in (0, 429, 500, 502, 503, 504)
+        assert ev["args"]["backoff_s"] >= 0
+    assert tel.metrics.total(telemetry.RETRIES_TOTAL) == client.retries
+    # the faulted statuses the chaos injected are visible on http spans
+    http = telemetry.request_events(doc)
+    assert any(e["args"]["status"] in (503, 0) for e in http), \
+        "no faulted wire attempt recorded"
+
+
+# ----------------------------------------------- trace vs apiserver audit
+
+
+def _fake_metrics(api):
+    with urllib.request.urlopen(api.url + "/__fake_metrics") as r:
+        return r.read().decode()
+
+
+def _audit_total(text):
+    return sum(int(ln.rsplit(" ", 1)[1]) for ln in text.splitlines()
+               if ln.startswith("fake_apiserver_requests_total{"))
+
+
+def test_trace_request_spans_match_apiserver_audit_exactly(spec):
+    """THE acceptance pin: a full-bundle `tpuctl apply --parallel
+    --trace-out` (operator waves + operand groups, through the REAL CLI)
+    produces a valid Chrome trace whose summed request spans equal the
+    FakeApiServer's audit count EXACTLY — client-side and server-side
+    request accounting agree to the request."""
+    import tempfile
+    with FakeApiServer(auto_ready=True) as api:
+        with tempfile.TemporaryDirectory() as d:
+            traces = []
+            for extra in (["--operator"], []):
+                out = os.path.join(d, f"trace{len(traces)}.json")
+                proc = subprocess.run(
+                    [sys.executable, "-m", "tpu_cluster", "apply",
+                     "--apiserver", api.url, "--parallel", "--watch",
+                     "--poll", "0.05", "--stage-timeout", "30",
+                     "--trace-out", out,
+                     "--metrics-out", os.path.join(d, "m.prom"), *extra],
+                    capture_output=True, text=True, timeout=120, cwd=REPO)
+                assert proc.returncode == 0, proc.stdout + proc.stderr
+                traces.append(json.load(open(out)))
+            span_count = sum(len(telemetry.request_events(t))
+                             for t in traces)
+            metrics_text = _fake_metrics(api)
+            assert span_count == _audit_total(metrics_text) == len(api.log)
+            # and the registry dump agrees with the trace
+            prom = open(os.path.join(d, "m.prom")).read()
+            assert "tpuctl_requests_total" in prom
+            # phases present in both traces (schema sanity via top's
+            # helpers)
+            for t in traces:
+                totals = telemetry.phase_totals(t)
+                assert set(totals) == set(telemetry.PHASE_NAMES)
+
+
+def test_fake_metrics_endpoint_by_verb_path_status(spec):
+    """/__fake_metrics: the audit broken down by verb/path/status matches
+    what the client-side registry counted by verb/status, chaos faults
+    are published, and scraping is observer-neutral (doesn't bump the
+    audit)."""
+    groups = operator_bundle.operator_install_groups(spec)
+    tel = telemetry.Telemetry()
+    chaos = [{"status": 503, "count": 2, "retry_after": 0.01}]
+    with FakeApiServer(auto_ready=True, chaos=chaos) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY, telemetry=tel)
+        kubeapply.apply_groups(client, groups, wait=True, stage_timeout=30,
+                               poll=0.02, max_inflight=8)
+        client.close()
+        text = _fake_metrics(api)
+        audit_before = _audit_total(text)
+        assert _audit_total(_fake_metrics(api)) == audit_before  # neutral
+        assert len(api.log) == audit_before
+    # server-side 503 count == client-side 503 count
+    server_503 = sum(
+        int(ln.rsplit(" ", 1)[1]) for ln in text.splitlines()
+        if ln.startswith("fake_apiserver_requests_total{")
+        and 'code="503"' in ln)
+    assert server_503 == 2
+    assert tel.metrics.total(telemetry.REQUESTS_TOTAL, code="503") == 2
+    assert 'fake_apiserver_chaos_faults_total{kind="503"} 2' in text
+    # per-verb agreement across the board
+    for verb in ("GET", "POST", "PATCH"):
+        server = sum(
+            int(ln.rsplit(" ", 1)[1]) for ln in text.splitlines()
+            if ln.startswith("fake_apiserver_requests_total{")
+            and f'verb="{verb}"' in ln)
+        assert server == tel.metrics.total(telemetry.REQUESTS_TOTAL,
+                                           verb=verb), verb
+
+
+# ------------------------------------------------------------- twin pins
+
+
+def test_operator_metric_names_twin_pins_cpp_source():
+    """The metric-name twin table (RetryableStatus pattern): the families
+    kubeapi::OperatorMetricNames() pins in C++ must equal
+    telemetry.OPERATOR_METRIC_NAMES — source-grep so the pin holds with
+    no compiler — AND every family must be emitted by operator_main.cc's
+    Metrics() and re-pinned in selftest.cc."""
+    with open(os.path.join(REPO, "native", "operator", "kubeapi.cc"),
+              encoding="utf-8") as f:
+        src = f.read()
+    m = re.search(r"OperatorMetricNames\(\)\s*\{.*?"
+                  r"new std::vector<std::string>\s*\{(.*?)\};", src, re.S)
+    assert m, "kubeapi.cc OperatorMetricNames() initializer not found"
+    cpp_names = tuple(re.findall(r'"([^"]+)"', m.group(1)))
+    assert cpp_names == telemetry.OPERATOR_METRIC_NAMES
+    with open(os.path.join(REPO, "native", "operator", "operator_main.cc"),
+              encoding="utf-8") as f:
+        main_src = f.read()
+    with open(os.path.join(REPO, "native", "operator", "selftest.cc"),
+              encoding="utf-8") as f:
+        selftest_src = f.read()
+    for name in telemetry.OPERATOR_METRIC_NAMES:
+        assert name in main_src, f"{name} not emitted by operator_main.cc"
+        assert f'"{name}"' in selftest_src, f"{name} not selftest-pinned"
+    # the table is the verify check's source too: no hand-copied list
+    import inspect
+
+    from tpu_cluster import verify
+    assert "OPERATOR_METRIC_NAMES" in inspect.getsource(
+        verify.check_operator_metrics)
+
+
+# ------------------------------------------------------------ tpuctl top
+
+
+def test_tpuctl_top_renders_breakdown(tmp_path, spec):
+    groups = operator_bundle.operator_install_groups(spec)
+    tel = telemetry.Telemetry()
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url, telemetry=tel)
+        kubeapply.apply_groups(client, groups, wait=True, stage_timeout=30,
+                               poll=0.02, max_inflight=8)
+        client.close()
+    trace = tmp_path / "trace.json"
+    tel.write_trace(str(trace))
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_cluster", "top", str(trace),
+         "--limit", "3"],
+        capture_output=True, text=True, timeout=60, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr
+    out = proc.stdout
+    assert "phase breakdown" in out
+    for phase in telemetry.PHASE_NAMES:
+        assert phase in out
+    assert "requests:" in out and "slowest spans" in out
+    # non-trace inputs are clean CLI errors, not stack traces: a JSON
+    # object without traceEvents, a top-level array, a missing file
+    bogus = tmp_path / "bogus.json"
+    bogus.write_text('{"not": "a trace"}')
+    arr = tmp_path / "arr.json"
+    arr.write_text("[1, 2]")
+    for path, want in ((str(bogus), "not a Chrome trace"),
+                       (str(arr), "not a Chrome trace"),
+                       (str(tmp_path / "absent.json"), "cannot read")):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpu_cluster", "top", path],
+            capture_output=True, text=True, timeout=60, cwd=REPO)
+        assert proc.returncode == 2, (path, proc.stderr)
+        assert want in proc.stderr, (path, proc.stderr)
+        assert "Traceback" not in proc.stderr, (path, proc.stderr)
+
+
+# ------------------------------------------------- instrumentation detail
+
+
+def test_unchanged_counter_and_ready_histogram(spec):
+    """Warm SSA re-apply: every object lands in the skip-unchanged
+    counter (mode=ssa); the readiness histogram observed each gated
+    workload."""
+    groups = full_stack_groups(spec)
+    with FakeApiServer(auto_ready=True) as api:
+        cold = kubeapply.Client(api.url)
+        kubeapply.apply_groups(cold, groups, wait=True, stage_timeout=30,
+                               poll=0.02, max_inflight=8, apply_mode="ssa")
+        cold.close()
+        tel = telemetry.Telemetry()
+        warm = kubeapply.Client(api.url, telemetry=tel)
+        kubeapply.apply_groups(warm, groups, wait=True, stage_timeout=30,
+                               poll=0.02, max_inflight=8, apply_mode="ssa")
+        warm.close()
+    objects = sum(len(g) for g in groups)
+    assert tel.metrics.total(telemetry.UNCHANGED_TOTAL, mode="ssa") == \
+        objects
+    assert tel.metrics.total(telemetry.REQUESTS_TOTAL,
+                             verb="POST") == 0  # zero warm mutations
+    for verb in ("PATCH", "PUT", "DELETE"):
+        assert tel.metrics.total(telemetry.REQUESTS_TOTAL, verb=verb) == 0
+
+
+def test_watch_reconnect_counter_on_flap():
+    """An apiserver flap 410-invalidates the readiness watch stream; the
+    re-watch must land in tpuctl_watch_reconnects_total."""
+    import threading
+    import time as timemod
+    obj = {"apiVersion": "apps/v1", "kind": "DaemonSet",
+           "metadata": {"name": "ds-flapm", "namespace": NS},
+           "spec": {"template": {"spec": {}}}}
+    tel = telemetry.Telemetry()
+    with FakeApiServer(auto_ready=False) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY, telemetry=tel)
+        client.apply(obj)
+        done = []
+        t = threading.Thread(
+            target=lambda: (client.wait_ready([obj], timeout=10, poll=0.02,
+                                              watch=True),
+                            done.append(True)),
+            daemon=True)
+        t.start()
+        timemod.sleep(0.25)
+        api.flap()
+        timemod.sleep(0.15)
+        api.set_ready(kubeapply.object_path(obj))
+        t.join(timeout=5)
+        assert done
+        client.close()
+    assert tel.metrics.total(telemetry.WATCH_RECONNECTS_TOTAL) >= 1
+
+
+def test_journal_skip_counter(tmp_path, spec):
+    """A --resume of a converged journal counts its skipped groups."""
+    groups = operator_bundle.operator_install_groups(spec)
+    jpath = str(tmp_path / "r.journal")
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        with kubeapply.RolloutJournal(jpath, groups) as journal:
+            kubeapply.apply_groups(client, groups, wait=True,
+                                   stage_timeout=30, poll=0.02,
+                                   journal=journal)
+        tel = telemetry.Telemetry()
+        client.telemetry = tel
+        with kubeapply.RolloutJournal(jpath, groups,
+                                      resume=True) as journal:
+            kubeapply.apply_groups(client, groups, wait=True,
+                                   stage_timeout=30, poll=0.02,
+                                   journal=journal)
+        client.close()
+    assert tel.metrics.total(telemetry.JOURNAL_SKIPS_TOTAL,
+                             kind="group") == len(groups)
+    assert tel.metrics.total(telemetry.REQUESTS_TOTAL) == 0
+
+
+def test_unwritable_trace_path_does_not_fail_a_converged_rollout(spec):
+    """An OSError writing --trace-out/--metrics-out must not turn a
+    converged rollout into a failure (or mask a real ApplyError): the
+    apply still exits 0, reporting the write problem on stderr."""
+    import tempfile
+    with FakeApiServer(auto_ready=True) as api:
+        with tempfile.TemporaryDirectory() as d:
+            proc = subprocess.run(
+                [sys.executable, "-m", "tpu_cluster", "apply",
+                 "--apiserver", api.url, "--operator", "--parallel",
+                 "--poll", "0.05", "--stage-timeout", "30",
+                 "--trace-out", os.path.join(d, "no", "such", "t.json"),
+                 "--metrics-out", os.path.join(d, "no", "such", "m.prom")],
+                capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "apply: converged" in proc.stdout
+    assert "cannot write trace" in proc.stderr
+    assert "cannot write metrics" in proc.stderr
+    assert "Traceback" not in proc.stderr
+
+
+def test_telemetry_off_is_behaviorally_identical(spec):
+    """telemetry=None (the default): no spans, no counters, same store."""
+    groups = operator_bundle.operator_install_groups(spec)
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        assert client.telemetry is None
+        kubeapply.apply_groups(client, groups, wait=True, stage_timeout=30,
+                               poll=0.02, max_inflight=8)
+        client.close()
+        assert api.get(f"/api/v1/namespaces/{NS}") is not None

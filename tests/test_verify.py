@@ -5,7 +5,16 @@ import json
 
 import pytest
 
-from tpu_cluster import spec as specmod, triage, verify
+from tpu_cluster import spec as specmod, telemetry, triage, verify
+
+
+def operator_metrics_payload(missing=()):
+    """A canned operator /metrics scrape: one sample per pinned family
+    (telemetry.OPERATOR_METRIC_NAMES — generated from the table so this
+    fixture can't drift), minus any families the test wants absent."""
+    return "\n".join(f"{name} 1"
+                     for name in telemetry.OPERATOR_METRIC_NAMES
+                     if name not in missing) + "\n"
 
 
 def node(name, ready=True, tpu=8, labeled=True):
@@ -77,7 +86,15 @@ class CannedRunner:
                 managed("ConfigMap", "tpu-operator-bundle", ("tpuctl",)),
             ]},
         }
-        self.raw = {"proxy/metrics": "tpu_chips_total 8\n"
+        # operator Service installed with all pinned metric families on
+        # its scrape (the operator-metrics check's healthy path)
+        self.responses["get service -n tpu-system tpu-operator"] = {
+            "kind": "Service", "metadata": {"name": "tpu-operator"}}
+        # NOTE: the operator frag must precede the generic "proxy/metrics"
+        # frag — raw matching is first-substring-wins in insertion order
+        self.raw = {"tpu-operator:9402/proxy/metrics":
+                        operator_metrics_payload(),
+                    "proxy/metrics": "tpu_chips_total 8\n"
                                      "tpu_chip_present 1\n"
                                      'tpu_hbm_capacity_bytes{chip="0"} '
                                      "17179869184\n",
@@ -170,6 +187,60 @@ def test_checks_fail_loudly_on_broken_cluster(spec):
     assert not results["ownership"].ok
     assert "kubectl-edit" in results["ownership"].detail
     assert "DaemonSet/tpu-device-plugin" in results["ownership"].detail
+    # the operator Service exists but its scrape is dead — the pinned
+    # metric-name check must fail closed, not shrug
+    assert not results["operator-metrics"].ok
+    assert "scrape failed" in results["operator-metrics"].detail
+
+
+def test_operator_metrics_check_paths(spec):
+    """check_operator_metrics: all pinned families present -> PASS; any
+    family missing -> FAIL naming it; operator genuinely absent -> PASS
+    with a note (plain `tpuctl apply` installs no operator); service
+    query failing -> FAIL (an unreachable apiserver must not masquerade
+    as 'not installed')."""
+    runner = CannedRunner(healthy=True)
+    res = verify.check_operator_metrics(runner, spec)
+    assert res.ok and str(len(telemetry.OPERATOR_METRIC_NAMES)) in \
+        res.detail
+
+    runner = CannedRunner(healthy=True)
+    runner.raw["tpu-operator:9402/proxy/metrics"] = \
+        operator_metrics_payload(
+            missing=("tpu_operator_reconcile_duration_seconds",
+                     "tpu_operator_queue_depth"))
+    res = verify.check_operator_metrics(runner, spec)
+    assert not res.ok
+    assert "tpu_operator_reconcile_duration_seconds" in res.detail
+    assert "tpu_operator_queue_depth" in res.detail
+
+    runner = CannedRunner(healthy=True)
+    del runner.responses["get service -n tpu-system tpu-operator"]
+    res = verify.check_operator_metrics(runner, spec)
+    assert res.ok and "not installed" in res.detail
+
+    failing = lambda argv: (1, "")  # noqa: E731 — kubectl itself failing
+    res = verify.check_operator_metrics(failing, spec)
+    assert not res.ok and "cannot query" in res.detail
+
+
+def test_snapshot_fetch_count_is_registry_backed(spec):
+    """The kubectl_calls fold (ISSUE 6 satellite): snapshot.fetches IS
+    the tpuctl_verify_kubectl_calls_total counter — one source of truth
+    for the CLI's JSON field and any aggregating registry."""
+    registry = telemetry.MetricsRegistry()
+    snapshot = verify.ClusterSnapshot(CannedRunner(healthy=True),
+                                      registry=registry)
+    results = verify.run_checks(list(verify.CHECKS), spec, snapshot)
+    assert results and snapshot.fetches > 0
+    assert snapshot.fetches == \
+        registry.total(telemetry.VERIFY_KUBECTL_CALLS)
+    # a snapshot without an injected registry still counts (own registry)
+    own = verify.ClusterSnapshot(CannedRunner(healthy=True))
+    own(["kubectl", "get", "nodes", "-o", "json"])
+    own(["kubectl", "get", "nodes", "-o", "json"])  # cached: no new fetch
+    assert own.fetches == 1
+    assert own.registry.total(telemetry.VERIFY_KUBECTL_CALLS) == 1
 
 
 def test_ownership_check_details(spec):

@@ -19,6 +19,10 @@ with rendered artifacts and an ordered, readiness-gated apply:
            (helm uninstall analog, reference README.md kind-script flow)
   verify   the executable acceptance runbook (BASELINE configs)
   triage   the executable troubleshooting runbook
+  top      per-phase/per-object breakdown of a rollout trace captured
+           with `apply --trace-out` (spans: rollout -> group -> tier ->
+           object -> HTTP attempt; docs/GUIDE.md "reading a rollout
+           trace")
 """
 
 from __future__ import annotations
@@ -30,7 +34,8 @@ from typing import Dict
 
 import yaml
 
-from . import kubeapply, lint as lintmod, spec as specmod, triage, verify
+from . import (kubeapply, lint as lintmod, spec as specmod, telemetry,
+               triage, verify)
 from .render import jobs, kubeadm, manifests, nodeprep, operator_bundle
 
 
@@ -140,6 +145,10 @@ def _lint_external(args):
 
 def cmd_apply(args) -> int:
     spec, groups = _spec_groups(args)
+    # Telemetry is opt-in per invocation: either output flag arms the
+    # span tree + metrics registry for the whole rollout (REST backend).
+    tel = (telemetry.Telemetry()
+           if (args.trace_out or args.metrics_out) else None)
     if args.max_inflight is not None and not args.parallel:
         print("apply: note: --max-inflight has no effect without "
               "--parallel", file=sys.stderr)
@@ -170,6 +179,7 @@ def cmd_apply(args) -> int:
     try:
         client = _rest_client(args)
         if client is not None:
+            client.telemetry = tel
             try:
                 result = kubeapply.apply_groups(
                     client, groups, wait=args.wait,
@@ -209,6 +219,12 @@ def cmd_apply(args) -> int:
                 print("apply: note: --poll has no effect on the kubectl "
                       "backend (kubectl rollout status does its own "
                       "polling)", file=sys.stderr)
+            if tel is not None:
+                print("apply: note: --trace-out/--metrics-out instrument "
+                      "the REST engine's requests; the kubectl backend "
+                      "delegates the wire to kubectl, so its outputs "
+                      "will be empty — pass --apiserver for a real trace",
+                      file=sys.stderr)
             # no URL given: use kubectl from PATH (the reference guide's
             # control-plane-node workflow)
             kubeapply.apply_groups_kubectl(
@@ -223,6 +239,26 @@ def cmd_apply(args) -> int:
     finally:
         if journal is not None:
             journal.close()
+        # written even when the rollout FAILED: a crashed rollout's trace
+        # (unfinished spans marked, retries annotated) is the one worth
+        # reading. An unwritable output path must not crash a converged
+        # rollout or mask a real ApplyError — report and move on.
+        if tel is not None and args.trace_out:
+            try:
+                tel.write_trace(args.trace_out)
+                print(f"apply: trace written to {args.trace_out} "
+                      "(chrome://tracing / Perfetto; summarize with "
+                      f"`tpuctl top {args.trace_out}`)")
+            except OSError as exc:
+                print(f"apply: cannot write trace to {args.trace_out}: "
+                      f"{exc}", file=sys.stderr)
+        if tel is not None and args.metrics_out:
+            try:
+                tel.write_metrics(args.metrics_out)
+                print(f"apply: metrics written to {args.metrics_out}")
+            except OSError as exc:
+                print(f"apply: cannot write metrics to "
+                      f"{args.metrics_out}: {exc}", file=sys.stderr)
     print("apply: converged" if args.wait else "apply: submitted")
     return 0
 
@@ -310,6 +346,27 @@ def cmd_verify(args) -> int:
 def cmd_triage(args) -> int:
     spec = _load_spec(args.spec)
     print(triage.run_triage(spec).text())
+    return 0
+
+
+def cmd_top(args) -> int:
+    """Per-phase / per-object breakdown of a saved rollout trace
+    (`tpuctl apply --trace-out`) — where the wall time went, without
+    leaving the terminal."""
+    try:
+        with open(args.trace, encoding="utf-8") as f:
+            doc = json.load(f)
+    except OSError as exc:
+        print(f"top: cannot read {args.trace}: {exc}", file=sys.stderr)
+        return 2
+    except ValueError as exc:
+        print(f"top: {args.trace} is not JSON: {exc}", file=sys.stderr)
+        return 2
+    try:
+        print(telemetry.summarize_trace(doc, limit=args.limit))
+    except ValueError as exc:
+        print(f"top: {exc}", file=sys.stderr)
+        return 2
     return 0
 
 
@@ -410,6 +467,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="lint-gate allowlist entry for a reference that "
                         "pre-exists on-cluster (same syntax as tpuctl "
                         "lint --allow-external; repeatable)")
+    p.add_argument("--trace-out", default="", metavar="PATH",
+                   help="write the rollout's span tree as Chrome "
+                        "trace-event JSON (load in chrome://tracing or "
+                        "ui.perfetto.dev; summarize with `tpuctl top`): "
+                        "rollout -> group -> tier -> object -> HTTP "
+                        "attempt, retries/backoff as instant events. "
+                        "Written even when the rollout fails")
+    p.add_argument("--metrics-out", default="", metavar="PATH",
+                   help="dump the rollout's metrics registry as "
+                        "Prometheus text: per-verb/status request "
+                        "counters, latency and time-to-ready histograms, "
+                        "retry/skip/reconnect counters")
     p.set_defaults(fn=cmd_apply)
 
     p = sub.add_parser(
@@ -457,6 +526,17 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("triage", help="run the troubleshooting runbook")
     p.add_argument("--spec", default="")
     p.set_defaults(fn=cmd_triage)
+
+    p = sub.add_parser(
+        "top", help="summarize a saved rollout trace (tpuctl apply "
+                    "--trace-out): per-phase totals, request counts by "
+                    "verb/status, retries, slowest spans")
+    p.add_argument("trace", help="Chrome trace-event JSON written by "
+                                 "tpuctl apply --trace-out (or "
+                                 "bench_rollout.py --trace-out)")
+    p.add_argument("--limit", type=int, default=10,
+                   help="how many slowest spans to show (default 10)")
+    p.set_defaults(fn=cmd_top)
     return ap
 
 

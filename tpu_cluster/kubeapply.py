@@ -74,6 +74,19 @@ the same classification before degrading to polling. A
 :class:`RolloutJournal` (``tpuctl apply --journal/--resume``) makes the
 rollout itself restartable: a SIGKILL'd run resumes by re-applying only
 the groups that had not converged.
+
+TELEMETRY (``Client.telemetry``, a :class:`tpu_cluster.telemetry.
+Telemetry`): when attached (``tpuctl apply --trace-out/--metrics-out``,
+the bench), the rollout records a hierarchical span tree — rollout ->
+group -> tier -> object -> HTTP wire attempt, with retry/backoff
+annotations from the taxonomy above as instant events — plus a metrics
+registry: per-verb/status request counters, request-latency and
+time-to-ready histograms, retry / skip-unchanged / journal-skip / watch
+reconnect counters. One leaf span per WIRE attempt (including the
+stale-socket fast retry and watch stream opens), so a clean rollout's
+summed http spans equal the apiserver's own request count exactly.
+``telemetry=None`` (default) is zero-overhead and behaviorally
+identical.
 """
 
 from __future__ import annotations
@@ -92,6 +105,8 @@ import urllib.request
 from dataclasses import dataclass, field
 from typing import (Any, Callable, Dict, FrozenSet, List, Optional,
                     Sequence, Set, Tuple)
+
+from . import telemetry as _telemetry
 
 # Shared callable shapes: rollout progress logging, and the kubectl
 # runner seam (``(argv, input_text=...) -> (rc, stdout, stderr)``).
@@ -446,6 +461,13 @@ class Client:
     # apply patch, False = it answered 415/400 (every later SSA attempt
     # short-circuits into SSAUnsupportedError without a round trip).
     ssa_supported: Optional[bool] = None
+    # Unified telemetry (tpu_cluster.telemetry): when set, every wire
+    # attempt records a leaf span (cat "http") + per-verb/status counter
+    # + latency histogram, retries bump tpuctl_retries_total, the
+    # readiness loops feed the time-to-ready histogram, and apply_groups
+    # builds the rollout span tree around it. None (default) = zero
+    # overhead, identical behavior.
+    telemetry: Optional[_telemetry.Telemetry] = None
     _warned_insecure: bool = field(default=False, repr=False, compare=False)
     _local: Any = field(default=None, repr=False, compare=False)
     _conns: Any = field(default=None, repr=False, compare=False)
@@ -566,6 +588,29 @@ class Client:
             headers["Content-Type"] = content_type
         return headers
 
+    def _note_attempt(self, method: str, path: str, status: int,
+                      dt: float, **extra: Any) -> None:
+        """Record ONE wire attempt in the telemetry (leaf span, cat
+        "http", under the calling thread's open span; per-verb/status
+        request counter; latency histogram). One note per request that
+        actually hit the wire — including the keep-alive stale-socket
+        fast retry and watch stream opens — so summed http spans equal
+        the apiserver's audit count on a clean run (the pinned trace
+        test; only a request that died before the server saw it can
+        diverge, and only under chaos)."""
+        tel = self.telemetry
+        if tel is None:
+            return
+        short = path.partition("?")[0]
+        tel.leaf(f"{method} {short}", "http", dt, verb=method,
+                 status=status, **extra)
+        tel.counter(_telemetry.REQUESTS_TOTAL,
+                    "apiserver wire attempts by verb and status",
+                    verb=method, code=str(status)).inc()
+        tel.histogram(_telemetry.REQUEST_SECONDS,
+                      "apiserver round-trip latency",
+                      verb=method).observe(dt)
+
     def _request_keepalive(
             self, method: str, path: str, data: Optional[bytes],
             content_type: str
@@ -579,6 +624,7 @@ class Client:
         base_path = urllib.parse.urlsplit(self.base_url).path.rstrip("/")
         for attempt in (0, 1):
             conn = self._connection()
+            t0 = time.monotonic()
             try:
                 conn.request(method, base_path + path, body=data,
                              headers=self._headers(data is not None,
@@ -591,6 +637,8 @@ class Client:
                 except ValueError:
                     parsed = {"message":
                               payload.decode(errors="replace")[:200]}
+                self._note_attempt(method, path, resp.status,
+                                   time.monotonic() - t0)
                 return resp.status, parsed, retry_after
             except (http.client.HTTPException, OSError) as exc:
                 self._drop_connection()
@@ -598,11 +646,27 @@ class Client:
                         exc, (http.client.RemoteDisconnected,
                               http.client.BadStatusLine,
                               BrokenPipeError, ConnectionResetError)):
-                    continue  # stale pooled socket: one fresh retry
+                    # stale pooled socket: one fresh retry — still a wire
+                    # attempt the server may have seen (chaos drops reply
+                    # with a closed socket AFTER logging the request)
+                    self._note_attempt(method, path, 0,
+                                       time.monotonic() - t0, stale=True)
+                    continue
+                self._note_attempt(method, path, 0, time.monotonic() - t0)
                 return 0, _transport_error(exc), None
         raise AssertionError("unreachable: both attempts return")
 
     def _request_oneshot(
+            self, method: str, path: str, data: Optional[bytes],
+            content_type: str
+    ) -> Tuple[int, Dict[str, Any], Optional[float]]:
+        t0 = time.monotonic()
+        code, parsed, retry_after = self._request_oneshot_raw(
+            method, path, data, content_type)
+        self._note_attempt(method, path, code, time.monotonic() - t0)
+        return code, parsed, retry_after
+
+    def _request_oneshot_raw(
             self, method: str, path: str, data: Optional[bytes],
             content_type: str
     ) -> Tuple[int, Dict[str, Any], Optional[float]]:
@@ -657,7 +721,21 @@ class Client:
                 self.retries += 1
                 if code == 0:
                     self.last_transport_error = (parsed or {}).get("message")
-            time.sleep(policy.backoff_s(attempt, retry_after))
+            backoff = policy.backoff_s(attempt, retry_after)
+            if self.telemetry is not None:
+                # the PR-3 taxonomy, annotated: which status triggered the
+                # retry, which attempt this was, how long we back off —
+                # an instant event on the innermost open span so chaos is
+                # readable straight off the trace
+                self.telemetry.counter(
+                    _telemetry.RETRIES_TOTAL,
+                    "requests re-sent after a retryable failure",
+                    code=str(code)).inc()
+                self.telemetry.event(
+                    "retry", code=code, attempt=attempt,
+                    classification=policy.classify(code),
+                    backoff_s=round(backoff, 4))
+            time.sleep(backoff)
 
     def get(self, path: str) -> Tuple[int, Dict[str, Any]]:
         return self._request("GET", path)
@@ -831,7 +909,8 @@ class Client:
             stats = {}
         stats.setdefault("requests", 0)
         stats["mode"] = "watch" if watch else "poll"
-        deadline = time.monotonic() + timeout
+        started = time.monotonic()
+        deadline = started + timeout
         pending = [o for o in objs if o.get("kind") in WORKLOAD_KINDS]
         if seed:
             pending = [o for o in pending
@@ -842,19 +921,29 @@ class Client:
         lock = threading.Lock()
         if not watch:
             self._poll_ready(pending, deadline, poll,
-                             allow_empty_daemonsets, stats, lock)
+                             allow_empty_daemonsets, stats, lock,
+                             started=started)
             return stats
         by_collection: Dict[str, List[Dict[str, Any]]] = {}
         for obj in pending:
             by_collection.setdefault(collection_path(obj), []).append(obj)
         failures: List[str] = []
+        # parent for the per-collection watcher threads' spans: the span
+        # open on THIS thread (the ready-wait phase span when called from
+        # apply_groups) — thread-local stacks don't cross threads
+        tel = self.telemetry
+        parent = tel.current() if tel is not None else None
 
         def run(coll: str, members: List[Dict[str, Any]],
                 drop_conn: bool = False) -> None:
             try:
-                self._watch_ready_collection(coll, members, deadline, poll,
-                                             allow_empty_daemonsets, stats,
-                                             lock)
+                with _telemetry.maybe_span(tel, f"watch {coll}", "watch",
+                                           parent=parent,
+                                           members=len(members)):
+                    self._watch_ready_collection(
+                        coll, members, deadline, poll,
+                        allow_empty_daemonsets, stats, lock,
+                        started=started)
             except ApplyError as exc:
                 with lock:
                     failures.append(str(exc))
@@ -883,11 +972,22 @@ class Client:
             raise ApplyError("; ".join(sorted(failures)))
         return stats
 
+    def _observe_ready(self, started: Optional[float]) -> None:
+        """Feed the time-to-ready histogram when one waited object
+        resolves (``started`` = when the readiness wait began)."""
+        tel = self.telemetry
+        if tel is None or started is None:
+            return
+        tel.histogram(_telemetry.READY_SECONDS,
+                      "seconds from wait start to object readiness"
+                      ).observe(time.monotonic() - started)
+
     def _poll_ready(self, pending: List[Dict[str, Any]], deadline: float,
                     poll: float, allow_empty_daemonsets: bool,
                     stats: Dict[str, Any],
-                    lock: Any) -> None:  # threading.Lock (factory fn
-                                         # in typeshed < 3.13)
+                    lock: Any,  # threading.Lock (factory fn
+                                # in typeshed < 3.13)
+                    started: Optional[float] = None) -> None:
         """The tick loop shared by poll-mode wait_ready and the watch
         mode's per-collection degradation path."""
         def bump(n: int = 1) -> None:
@@ -929,6 +1029,8 @@ class Client:
                     live = items.get(obj["metadata"]["name"])
                     if not _seed_ready(live, obj, allow_empty_daemonsets):
                         still.append(obj)
+                    else:
+                        self._observe_ready(started)
             pending = still
             if not pending:
                 return
@@ -948,6 +1050,7 @@ class Client:
         keep-alive transport). Returns ``(conn, resp)`` on 200; raises
         :class:`_WatchDenied` on any other status or transport failure."""
         url = urllib.parse.urlsplit(self.base_url)
+        t0 = time.monotonic()
         try:
             if url.scheme == "https":
                 conn = http.client.HTTPSConnection(
@@ -965,7 +1068,11 @@ class Client:
                          headers=self._headers(False, ""))
             resp = conn.getresponse()
         except (http.client.HTTPException, OSError) as exc:
+            self._note_attempt("GET", coll, 0, time.monotonic() - t0,
+                               watch=True)
             raise _WatchDenied(0, f"transport error: {exc}")
+        self._note_attempt("GET", coll, resp.status,
+                           time.monotonic() - t0, watch=True)
         if resp.status != 200:
             try:
                 body = json.loads(resp.read() or b"{}")
@@ -981,7 +1088,8 @@ class Client:
                                 deadline: float, poll: float,
                                 allow_empty_daemonsets: bool,
                                 stats: Dict[str, Any],
-                                lock: Any) -> None:  # threading.Lock
+                                lock: Any,  # threading.Lock
+                                started: Optional[float] = None) -> None:
         """Event-driven readiness for one collection: LIST once, then hold
         one watch stream from the LIST's resourceVersion until every
         member is ready. The server's timeoutSeconds window is clamped to
@@ -995,8 +1103,12 @@ class Client:
             with lock:
                 stats["mode"] = "poll-fallback"
                 stats.setdefault("fallbacks", []).append(why)
+            if self.telemetry is not None:
+                self.telemetry.event("watch-degraded", collection=coll,
+                                     why=why)
             self._poll_ready(list(pending.values()), deadline, poll,
-                             allow_empty_daemonsets, stats, lock)
+                             allow_empty_daemonsets, stats, lock,
+                             started=started)
 
         pending = {o["metadata"]["name"]: o for o in members}
 
@@ -1019,6 +1131,7 @@ class Client:
                 if _seed_ready(items.get(name), pending[name],
                                allow_empty_daemonsets):
                     del pending[name]
+                    self._observe_ready(started)
             return rv
 
         try:
@@ -1027,6 +1140,7 @@ class Client:
             return degrade(f"LIST {coll}: {exc}")
         policy = self.retry or NO_RETRY
         denials = 0  # consecutive failed stream opens (reset on success)
+        opens = 0    # successful stream opens (reopen #2+ = a reconnect)
         while pending:
             left = deadline - time.monotonic()
             if left <= 0:
@@ -1037,6 +1151,16 @@ class Client:
                 opened = time.monotonic()
                 conn, resp = self._open_watch(coll, rv, window)
                 denials = 0
+                opens += 1
+                if opens > 1 and self.telemetry is not None:
+                    # every stream beyond the first is a RECONNECT (410
+                    # re-watch, flapped apiserver, expired window) — the
+                    # gauge of watch-path churn the operator mirrors as
+                    # tpu_operator_watch_reconnects_total
+                    self.telemetry.counter(
+                        _telemetry.WATCH_RECONNECTS_TOTAL,
+                        "readiness watch streams re-opened after the "
+                        "first", collection=coll).inc()
             except _WatchDenied as exc:
                 # Same taxonomy as _request: a RETRYABLE refusal (transport
                 # down, 429/5xx blip) re-opens the stream with backoff —
@@ -1085,6 +1209,7 @@ class Client:
                     if name in pending and _seed_ready(
                             obj, pending[name], allow_empty_daemonsets):
                         del pending[name]
+                        self._observe_ready(started)
             finally:
                 conn.close()  # before any fallback holds the wait
             if fallback is not None:
@@ -1562,6 +1687,15 @@ def _note_ready_stats(result: GroupResult, stats: Dict[str, Any]) -> None:
         result.ready_mode = mode
 
 
+def _journal_skip(tel: Optional[_telemetry.Telemetry], kind: str) -> None:
+    """Count work a --resume skipped on journal evidence (kind =
+    "group" | "object") — the journal/resume path's telemetry."""
+    if tel is not None:
+        tel.counter(_telemetry.JOURNAL_SKIPS_TOTAL,
+                    "journaled groups/objects skipped on resume",
+                    kind=kind).inc()
+
+
 def _lint_gate(groups: Sequence[Sequence[Dict[str, Any]]],
                lint_mode: str, lint_spec: Optional[Any], log: LogFn,
                lint_external: Optional[FrozenSet[str]] = None) -> None:
@@ -1703,58 +1837,85 @@ def apply_groups(client: Client, groups: Sequence[Sequence[Dict[str, Any]]],
     _lint_gate(groups, lint_mode, lint_spec, log, lint_external)
     mode_state = _resolve_apply_mode(client, apply_mode, journal)
     result = GroupResult()
-    if max_inflight > 1:
-        try:
-            return _apply_groups_pipelined(
-                client, groups, wait, stage_timeout, poll,
-                allow_empty_daemonsets, log, max_inflight, result,
-                watch_ready, journal, mode_state)
-        finally:
-            # the pool's worker threads are gone; their thread-local
-            # connections must not outlive them in the Client's pool
-            client.reap_other_connections()
-    for i, group in enumerate(groups):
-        if journal is not None and journal.is_group_done(i):
-            log(f"group {i + 1}/{len(groups)} already complete (journal); "
-                "skipping")
-            continue
-        t0 = time.monotonic()
-        for obj in group:
-            name = f"{obj['kind']}/{obj['metadata']['name']}"
-            if journal is not None and journal.is_object_done(obj, i):
-                result.actions.append(f"journaled {name}")
-                log(f"journaled {name} (already applied; resume)")
+    tel = client.telemetry
+    engine = "pipelined" if max_inflight > 1 else "sequential"
+    with _telemetry.maybe_span(
+            tel, "rollout", "rollout", engine=engine, groups=len(groups),
+            resumed=bool(journal is not None and journal.resumed)
+    ) as rollout_span:
+        if max_inflight > 1:
+            try:
+                return _apply_groups_pipelined(
+                    client, groups, wait, stage_timeout, poll,
+                    allow_empty_daemonsets, log, max_inflight, result,
+                    watch_ready, journal, mode_state)
+            finally:
+                # the pool's worker threads are gone; their thread-local
+                # connections must not outlive them in the Client's pool
+                client.reap_other_connections()
+                if rollout_span is not None:
+                    rollout_span.annotate("apply_mode", mode_state.mode)
+        for i, group in enumerate(groups):
+            if journal is not None and journal.is_group_done(i):
+                log(f"group {i + 1}/{len(groups)} already complete "
+                    "(journal); skipping")
+                _journal_skip(tel, "group")
                 continue
-            action = _apply_with_mode(client, obj, mode_state)
-            _log_downgrade_once(mode_state, log)
-            result.actions.append(f"{action} {name}")
-            log(f"{action} {name}")
-            if journal is not None:
-                journal.set_mode(mode_state.mode)
-                journal.object_done(obj, i)
-        result.timings["apply"] += time.monotonic() - t0
-        # CRD establishment is a correctness gate for the NEXT group's CRs,
-        # not a readiness nicety — enforce it even with wait=False.
-        t0 = time.monotonic()
-        for obj in group:
-            if obj.get("kind") == "CustomResourceDefinition":
-                client.wait_crd_established(obj["metadata"]["name"],
-                                            stage_timeout, poll)
-        result.timings["crd-establish"] += time.monotonic() - t0
-        if wait:
-            t0 = time.monotonic()
-            stats = client.wait_ready(group, stage_timeout, poll,
-                                      allow_empty_daemonsets,
-                                      watch=watch_ready)
-            result.timings["ready-wait"] += time.monotonic() - t0
-            _note_ready_stats(result, stats)
-            log(f"group {i + 1}/{len(groups)} ready")
-        if journal is not None and wait:
-            # a group is journaled complete only once CONVERGED — with
-            # wait=False nothing ever gated readiness, and a later
-            # --resume --wait must not skip the gate (the per-object
-            # records above still make that resume cheap)
-            journal.group_done(i)
+            with _telemetry.maybe_span(tel, f"group-{i + 1}", "group",
+                                       objects=len(group)):
+                t0 = time.monotonic()
+                with _telemetry.maybe_span(tel, "apply", "phase"):
+                    for obj in group:
+                        name = f"{obj['kind']}/{obj['metadata']['name']}"
+                        if journal is not None \
+                                and journal.is_object_done(obj, i):
+                            result.actions.append(f"journaled {name}")
+                            log(f"journaled {name} "
+                                "(already applied; resume)")
+                            _journal_skip(tel, "object")
+                            continue
+                        with _telemetry.maybe_span(tel, name,
+                                                   "apply") as obj_span:
+                            action = _apply_with_mode(client, obj,
+                                                      mode_state)
+                            if obj_span is not None:
+                                obj_span.annotate("action", action)
+                        _log_downgrade_once(mode_state, log)
+                        result.actions.append(f"{action} {name}")
+                        log(f"{action} {name}")
+                        if journal is not None:
+                            journal.set_mode(mode_state.mode)
+                            journal.object_done(obj, i)
+                result.timings["apply"] += time.monotonic() - t0
+                # CRD establishment is a correctness gate for the NEXT
+                # group's CRs, not a readiness nicety — enforce it even
+                # with wait=False.
+                t0 = time.monotonic()
+                with _telemetry.maybe_span(tel, "crd-establish", "phase"):
+                    for obj in group:
+                        if obj.get("kind") == "CustomResourceDefinition":
+                            client.wait_crd_established(
+                                obj["metadata"]["name"], stage_timeout,
+                                poll)
+                result.timings["crd-establish"] += time.monotonic() - t0
+                if wait:
+                    t0 = time.monotonic()
+                    with _telemetry.maybe_span(tel, "ready-wait", "phase"):
+                        stats = client.wait_ready(group, stage_timeout,
+                                                  poll,
+                                                  allow_empty_daemonsets,
+                                                  watch=watch_ready)
+                    result.timings["ready-wait"] += time.monotonic() - t0
+                    _note_ready_stats(result, stats)
+                    log(f"group {i + 1}/{len(groups)} ready")
+            if journal is not None and wait:
+                # a group is journaled complete only once CONVERGED — with
+                # wait=False nothing ever gated readiness, and a later
+                # --resume --wait must not skip the gate (the per-object
+                # records above still make that resume cheap)
+                journal.group_done(i)
+        if rollout_span is not None:
+            rollout_span.annotate("apply_mode", mode_state.mode)
     result.apply_mode = mode_state.mode
     return result
 
@@ -1779,7 +1940,32 @@ def _group_tiers(group: Sequence[Dict[str, Any]]
 def _apply_one_cached(client: Client, obj: Dict[str, Any],
                       cache: Dict[str, Dict[str, Dict[str, Any]]],
                       cache_lock: Any,  # threading.Lock
-                      mode_state: _ModeState) -> str:
+                      mode_state: _ModeState,
+                      parent_span: Optional[_telemetry.Span] = None) -> str:
+    """Span-wrapped :func:`_apply_one_uncounted`: one "apply" span per
+    object (parented to the TIER span explicitly — worker-pool threads
+    have no inherited span stack), annotated with the action taken, and
+    the skip-unchanged / SSA-noop counter."""
+    tel = client.telemetry
+    name = f"{obj['kind']}/{obj['metadata']['name']}"
+    with _telemetry.maybe_span(tel, name, "apply",
+                               parent=parent_span) as span:
+        action = _apply_one_uncounted(client, obj, cache, cache_lock,
+                                      mode_state)
+        if span is not None:
+            span.annotate("action", action)
+        if action == "unchanged" and tel is not None:
+            tel.counter(_telemetry.UNCHANGED_TOTAL,
+                        "re-applies skipped as provably no-op "
+                        "(ssa = exact managedFields check)",
+                        mode=mode_state.mode).inc()
+        return action
+
+
+def _apply_one_uncounted(client: Client, obj: Dict[str, Any],
+                         cache: Dict[str, Dict[str, Dict[str, Any]]],
+                         cache_lock: Any,  # threading.Lock
+                         mode_state: _ModeState) -> str:
     """Apply one object against the shared live-object cache.
 
     SSA mode: present and provably identical under this manager's
@@ -1852,6 +2038,7 @@ def _apply_groups_pipelined(client: Client,
 
     if mode_state is None:
         mode_state = _ModeState("merge", strict=True)
+    tel = client.telemetry
     cache: Dict[str, Dict[str, Dict[str, Any]]] = {}
     cache_lock = threading.Lock()
     all_objs = [o for gi, group in enumerate(groups)
@@ -1864,96 +2051,136 @@ def _apply_groups_pipelined(client: Client,
             collections.append(coll)
 
     with ThreadPoolExecutor(max_workers=max_inflight) as pool:
-        ns_names = [o["metadata"]["name"] for o in all_objs
-                    if o.get("kind") == "Namespace"]
-        fresh = False
-        if ns_names:
-            code, live = client.get(f"/api/v1/namespaces/{ns_names[0]}")
-            if code == 404:
-                fresh = True
-            elif code == 200:
-                cache["/api/v1/namespaces"] = {ns_names[0]: live}
-        if fresh:
-            for coll in collections:
-                cache.setdefault(coll, {})
-        else:
-            futures = {coll: pool.submit(client.list_collection, coll)
-                       for coll in collections}
-            for coll, fut in futures.items():
-                cache[coll] = {**fut.result(), **cache.get(coll, {})}
+        with _telemetry.maybe_span(tel, "prefetch", "prefetch",
+                                   collections=len(collections)
+                                   ) as prefetch_span:
+            ns_names = [o["metadata"]["name"] for o in all_objs
+                        if o.get("kind") == "Namespace"]
+            fresh = False
+            if ns_names:
+                code, live = client.get(
+                    f"/api/v1/namespaces/{ns_names[0]}")
+                if code == 404:
+                    fresh = True
+                elif code == 200:
+                    cache["/api/v1/namespaces"] = {ns_names[0]: live}
+            if prefetch_span is not None:
+                prefetch_span.annotate("fresh_install", fresh)
+            if fresh:
+                for coll in collections:
+                    cache.setdefault(coll, {})
+            else:
+                # worker threads have no span stack: parent the prefetch
+                # LIST spans through a thread-boundary wrapper
+                def _list(coll: str) -> Dict[str, Dict[str, Any]]:
+                    with _telemetry.maybe_span(tel, f"LIST {coll}",
+                                               "prefetch",
+                                               parent=prefetch_span):
+                        return client.list_collection(coll)
+
+                futures = {coll: pool.submit(_list, coll)
+                           for coll in collections}
+                for coll, fut in futures.items():
+                    cache[coll] = {**fut.result(), **cache.get(coll, {})}
 
         for i, group in enumerate(groups):
             if journal is not None and journal.is_group_done(i):
                 log(f"group {i + 1}/{len(groups)} already complete "
                     "(journal); skipping")
+                _journal_skip(tel, "group")
                 continue
-            t0 = time.monotonic()
-            for tier in _group_tiers(group):
-                todo = []
-                for obj in tier:
-                    if journal is not None \
-                            and journal.is_object_done(obj, i):
-                        name = f"{obj['kind']}/{obj['metadata']['name']}"
-                        result.actions.append(f"journaled {name}")
-                        log(f"journaled {name} (already applied; resume)")
-                        continue
-                    todo.append(obj)
-                futures2 = [(obj, pool.submit(_apply_one_cached, client,
-                                              obj, cache, cache_lock,
-                                              mode_state))
-                            for obj in todo]
-                errors = []
-                for obj, fut in futures2:
-                    name = f"{obj['kind']}/{obj['metadata']['name']}"
-                    try:
-                        action = fut.result()
-                    except SSAUnsupportedError:
-                        # strict ssa (apply_mode="ssa" / a journal resumed
-                        # in ssa): a server without SSA aborts the rollout
-                        # AS a capability error, not a per-object failure
-                        raise
-                    except ApplyError as exc:
-                        errors.append(str(exc))
-                        continue
-                    _log_downgrade_once(mode_state, log)
-                    result.actions.append(f"{action} {name}")
-                    log(f"{action} {name}")
-                    if journal is not None:
-                        journal.set_mode(mode_state.mode)
-                        journal.object_done(obj, i)
-                if errors:
-                    # group barrier: nothing from group N+1 (or a later
-                    # tier) may start after a failure in group N
-                    raise ApplyError(
-                        f"group {i + 1}: {len(errors)} object(s) failed: "
-                        + "; ".join(errors))
-            result.timings["apply"] += time.monotonic() - t0
-
-            t0 = time.monotonic()
-            for obj in group:
-                if obj.get("kind") != "CustomResourceDefinition":
-                    continue
-                name = obj["metadata"]["name"]
-                with cache_lock:
-                    live = cache.get(collection_path(obj), {}).get(name)
-                if not crd_established(live):
-                    client.wait_crd_established(name, stage_timeout, poll)
-            result.timings["crd-establish"] += time.monotonic() - t0
-
-            if wait:
+            group_scope = _telemetry.maybe_span(
+                tel, f"group-{i + 1}", "group", objects=len(group))
+            with group_scope:
                 t0 = time.monotonic()
-                with cache_lock:
-                    seed = {object_path(o):
-                            cache.get(collection_path(o),
-                                      {}).get(o["metadata"]["name"])
-                            for o in group
-                            if o.get("kind") in WORKLOAD_KINDS}
-                stats = client.wait_ready(group, stage_timeout, poll,
-                                          allow_empty_daemonsets, seed=seed,
-                                          watch=watch_ready)
-                result.timings["ready-wait"] += time.monotonic() - t0
-                _note_ready_stats(result, stats)
-                log(f"group {i + 1}/{len(groups)} ready")
+                with _telemetry.maybe_span(tel, "apply", "phase"):
+                    for ti, tier in enumerate(_group_tiers(group)):
+                        with _telemetry.maybe_span(
+                                tel, f"tier-{ti}", "tier",
+                                kinds=sorted({o.get("kind", "?")
+                                              for o in tier})) as tier_span:
+                            todo = []
+                            for obj in tier:
+                                if journal is not None \
+                                        and journal.is_object_done(obj, i):
+                                    name = (f"{obj['kind']}/"
+                                            f"{obj['metadata']['name']}")
+                                    result.actions.append(
+                                        f"journaled {name}")
+                                    log(f"journaled {name} "
+                                        "(already applied; resume)")
+                                    _journal_skip(tel, "object")
+                                    continue
+                                todo.append(obj)
+                            futures2 = [
+                                (obj, pool.submit(_apply_one_cached,
+                                                  client, obj, cache,
+                                                  cache_lock, mode_state,
+                                                  tier_span))
+                                for obj in todo]
+                            errors = []
+                            for obj, fut in futures2:
+                                name = (f"{obj['kind']}/"
+                                        f"{obj['metadata']['name']}")
+                                try:
+                                    action = fut.result()
+                                except SSAUnsupportedError:
+                                    # strict ssa (apply_mode="ssa" / a
+                                    # journal resumed in ssa): a server
+                                    # without SSA aborts the rollout AS a
+                                    # capability error, not a per-object
+                                    # failure
+                                    raise
+                                except ApplyError as exc:
+                                    errors.append(str(exc))
+                                    continue
+                                _log_downgrade_once(mode_state, log)
+                                result.actions.append(f"{action} {name}")
+                                log(f"{action} {name}")
+                                if journal is not None:
+                                    journal.set_mode(mode_state.mode)
+                                    journal.object_done(obj, i)
+                            if errors:
+                                # group barrier: nothing from group N+1
+                                # (or a later tier) may start after a
+                                # failure in group N
+                                raise ApplyError(
+                                    f"group {i + 1}: {len(errors)} "
+                                    "object(s) failed: "
+                                    + "; ".join(errors))
+                result.timings["apply"] += time.monotonic() - t0
+
+                t0 = time.monotonic()
+                with _telemetry.maybe_span(tel, "crd-establish", "phase"):
+                    for obj in group:
+                        if obj.get("kind") != "CustomResourceDefinition":
+                            continue
+                        name = obj["metadata"]["name"]
+                        with cache_lock:
+                            live = cache.get(collection_path(obj),
+                                             {}).get(name)
+                        if not crd_established(live):
+                            client.wait_crd_established(name,
+                                                        stage_timeout,
+                                                        poll)
+                result.timings["crd-establish"] += time.monotonic() - t0
+
+                if wait:
+                    t0 = time.monotonic()
+                    with cache_lock:
+                        seed = {object_path(o):
+                                cache.get(collection_path(o),
+                                          {}).get(o["metadata"]["name"])
+                                for o in group
+                                if o.get("kind") in WORKLOAD_KINDS}
+                    with _telemetry.maybe_span(tel, "ready-wait", "phase"):
+                        stats = client.wait_ready(
+                            group, stage_timeout, poll,
+                            allow_empty_daemonsets, seed=seed,
+                            watch=watch_ready)
+                    result.timings["ready-wait"] += time.monotonic() - t0
+                    _note_ready_stats(result, stats)
+                    log(f"group {i + 1}/{len(groups)} ready")
             if journal is not None and wait:
                 # converged-only, like the sequential engine: submit
                 # without readiness must never be resumed as complete

@@ -317,6 +317,28 @@ def deployment(spec: ClusterSpec) -> Dict[str, Any]:
     }
 
 
+def service(spec: ClusterSpec) -> Dict[str, Any]:
+    """ClusterIP Service in front of the operator's status port — the
+    ServiceMonitor-analog scrape surface (the reference stack fronts
+    DCGM-exporter the same way). `tpuctl verify --config
+    operator-metrics` reaches /metrics through the apiserver service
+    proxy on this Service, and a Prometheus in-cluster scrapes it via
+    the annotations."""
+    port = STATUS_PORT
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {**manifests._meta(OPERATOR_NAME, spec, "operator"),
+                     "annotations": {"prometheus.io/scrape": "true",
+                                     "prometheus.io/port": str(port)}},
+        "spec": {
+            "selector": {"app.kubernetes.io/name": OPERATOR_NAME},
+            "ports": [{"name": "status", "port": port,
+                       "targetPort": port}],
+        },
+    }
+
+
 def operator_install_groups(spec: ClusterSpec) -> List[List[Dict[str, Any]]]:
     """Apply waves for ``tpuctl apply --operator``. The CRD rides in the
     first wave and the TpuStackPolicy CR in the second: a real apiserver
@@ -326,7 +348,8 @@ def operator_install_groups(spec: ClusterSpec) -> List[List[Dict[str, Any]]]:
     wave boundary."""
     return [
         [manifests.namespace(spec)] + rbac(spec) + [crd()],
-        [policy(spec), bundle_configmap(spec), deployment(spec)],
+        [policy(spec), bundle_configmap(spec), service(spec),
+         deployment(spec)],
     ]
 
 

@@ -1,0 +1,635 @@
+"""Unified telemetry: hierarchical spans + a Prometheus-text metrics
+registry, dependency-free (stdlib only).
+
+Why this exists: the stack grew five layers of rollout machinery
+(pipelined apply, streaming watches, retry/chaos, lint gate, server-side
+apply) whose only instrumentation was ad-hoc ``--timing`` print lines and
+three hand-rolled operator gauges. Nothing could answer "where did the
+rollout spend its wall time" or feed a metrics-driven control loop. The
+real GPU Operator the reference deploys ships DCGM-exporter +
+ServiceMonitor as first-class operands for the same reason: operating a
+device stack without a metrics pipeline is flying blind.
+
+Two halves, one facade (:class:`Telemetry`):
+
+TRACING — :class:`Tracer` builds a tree of :class:`Span` objects
+(rollout -> group -> tier -> object -> HTTP attempt). Parent linkage is a
+per-thread span stack, with an explicit ``parent=`` override at thread
+boundaries (the pipelined engine's worker pool, the per-collection watch
+threads). Spans carry ``args`` (annotations: status codes, apply actions)
+and instant *events* (retry/backoff/chaos marks). The whole tree exports
+as Chrome trace-event format (``chrome_trace()``): one ``ph: "X"``
+complete event per span, one ``ph: "i"`` instant event per span event —
+loadable in ``chrome://tracing`` / Perfetto, summarized by ``tpuctl top``
+(:func:`summarize_trace`).
+
+METRICS — :class:`MetricsRegistry` holds counter / gauge / histogram
+families keyed by name, each with labeled children created on demand.
+Histograms use FIXED buckets (cumulative ``le`` encoding, ``+Inf``
+implicit) so two processes observing the same distribution render
+byte-comparable bucket lines. ``render()`` emits Prometheus text
+exposition format (the same dialect the C++ operator's ``/metrics`` and
+the native exporter speak).
+
+TWIN TABLE — :data:`OPERATOR_METRIC_NAMES` names every metric family the
+C++ operator's ``/metrics`` endpoint MUST emit. It is pinned three ways
+(the RetryableStatus pattern): ``kubeapi::OperatorMetricNames()`` in
+native/operator/kubeapi.cc is source-grep-compared against this table by
+tests/test_telemetry.py, native/operator/selftest.cc pins the C++ side
+compiler-only, and ``tpuctl verify --config operator-metrics`` FAILs a
+live scrape that lacks any pinned family. The fleet-scale and
+informer/workqueue roadmap items land on this already-instrumented
+baseline.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional, Tuple, Union
+
+# --------------------------------------------------------------------------
+# Pinned metric names.
+
+# Families the C++ operator's /metrics endpoint must emit (see module
+# docstring for the three-way pin). Conditional families (e.g. the
+# --leader-elect-only tpu_operator_leader gauge) are deliberately NOT
+# here: the live-scrape check must hold on every configuration.
+OPERATOR_METRIC_NAMES: Tuple[str, ...] = (
+    "tpu_operator_objects",
+    "tpu_operator_passes_total",
+    "tpu_operator_healthy",
+    "tpu_operator_consecutive_failures",
+    "tpu_operator_policy_generation",
+    "tpu_operator_reconcile_duration_seconds",
+    "tpu_operator_watch_reconnects_total",
+    "tpu_operator_queue_depth",
+    "tpu_operator_sync_lag_seconds",
+)
+
+# The Python client/rollout family names (one place so instrumentation
+# sites and assertions cannot drift on spelling).
+REQUESTS_TOTAL = "tpuctl_requests_total"
+REQUEST_SECONDS = "tpuctl_request_duration_seconds"
+RETRIES_TOTAL = "tpuctl_retries_total"
+UNCHANGED_TOTAL = "tpuctl_apply_unchanged_total"
+READY_SECONDS = "tpuctl_ready_seconds"
+WATCH_RECONNECTS_TOTAL = "tpuctl_watch_reconnects_total"
+JOURNAL_SKIPS_TOTAL = "tpuctl_journal_skips_total"
+VERIFY_KUBECTL_CALLS = "tpuctl_verify_kubectl_calls_total"
+
+# Fixed default buckets, request-latency shaped (seconds). Shared with
+# the ready-wait histogram: its tail rides the +Inf bucket.
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0)
+
+LabelPairs = Tuple[Tuple[str, str], ...]
+
+
+def _label_pairs(labels: Dict[str, str]) -> LabelPairs:
+    return tuple(sorted(labels.items()))
+
+
+def _escape(value: str) -> str:
+    """Prometheus label-value escaping (backslash, quote, newline)."""
+    return (value.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt(value: float) -> str:
+    """Render a sample value: integers without a trailing .0 (the C++
+    twin prints counters with %d), floats with up to 6 significant
+    decimals."""
+    if value == int(value) and abs(value) < 1e15:
+        return str(int(value))
+    return repr(round(value, 9))
+
+
+class Counter:
+    """Monotonic counter (one labeled child of a family)."""
+
+    def __init__(self) -> None:
+        self._lock: Any = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Set-to-current-value gauge (one labeled child of a family)."""
+
+    def __init__(self) -> None:
+        self._lock: Any = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = v
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram. ``counts[i]`` is the NON-cumulative count
+    for bucket i (rendering emits the cumulative ``le`` encoding, with
+    ``+Inf`` as the implicit last bucket)."""
+
+    def __init__(self, buckets: Tuple[float, ...] = DEFAULT_BUCKETS) -> None:
+        if list(buckets) != sorted(buckets) or len(set(buckets)) != \
+                len(buckets):
+            raise ValueError(f"buckets must be strictly increasing: "
+                             f"{buckets}")
+        self.buckets = tuple(float(b) for b in buckets)
+        self._lock: Any = threading.Lock()
+        self.counts = [0] * (len(self.buckets) + 1)  # +1 = the +Inf bucket
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, v: float) -> None:
+        idx = len(self.buckets)  # +Inf unless a bound catches it
+        for i, bound in enumerate(self.buckets):
+            if v <= bound:
+                idx = i
+                break
+        with self._lock:
+            self.counts[idx] += 1
+            self.sum += v
+            self.count += 1
+
+    def cumulative(self) -> List[int]:
+        """Cumulative per-bucket counts, ``le`` encoding (last == count)."""
+        out: List[int] = []
+        total = 0
+        with self._lock:
+            for c in self.counts:
+                total += c
+                out.append(total)
+        return out
+
+
+class _Family:
+    def __init__(self, name: str, mtype: str, help_text: str,
+                 buckets: Tuple[float, ...]) -> None:
+        self.name = name
+        self.mtype = mtype
+        self.help = help_text
+        self.buckets = buckets
+        self.children: Dict[LabelPairs, Any] = {}
+
+
+class MetricsRegistry:
+    """Counter/gauge/histogram families, rendered as Prometheus text."""
+
+    def __init__(self) -> None:
+        self._lock: Any = threading.Lock()
+        self._families: Dict[str, _Family] = {}
+
+    def _child(self, name: str, mtype: str, help_text: str,
+               labels: Dict[str, str],
+               buckets: Tuple[float, ...] = DEFAULT_BUCKETS) -> Any:
+        key = _label_pairs(labels)
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = _Family(name, mtype, help_text, buckets)
+                self._families[name] = fam
+            elif fam.mtype != mtype:
+                raise ValueError(
+                    f"metric {name} is a {fam.mtype}, not a {mtype}")
+            elif mtype == "histogram" and tuple(buckets) != fam.buckets:
+                # as loud as the type-mismatch above: silently dropping a
+                # caller's buckets would pile its observations into the
+                # wrong distribution (one bucket layout per family)
+                raise ValueError(
+                    f"histogram {name} already registered with buckets "
+                    f"{fam.buckets}, not {tuple(buckets)}")
+            child = fam.children.get(key)
+            if child is None:
+                if mtype == "counter":
+                    child = Counter()
+                elif mtype == "gauge":
+                    child = Gauge()
+                else:
+                    child = Histogram(fam.buckets)
+                fam.children[key] = child
+            return child
+
+    def counter(self, name: str, help_text: str = "",
+                **labels: str) -> Counter:
+        child = self._child(name, "counter", help_text, labels)
+        assert isinstance(child, Counter)
+        return child
+
+    def gauge(self, name: str, help_text: str = "",
+              **labels: str) -> Gauge:
+        child = self._child(name, "gauge", help_text, labels)
+        assert isinstance(child, Gauge)
+        return child
+
+    def histogram(self, name: str, help_text: str = "",
+                  buckets: Tuple[float, ...] = DEFAULT_BUCKETS,
+                  **labels: str) -> Histogram:
+        child = self._child(name, "histogram", help_text, labels,
+                            buckets=buckets)
+        assert isinstance(child, Histogram)
+        return child
+
+    def total(self, name: str, **label_filter: str) -> float:
+        """Sum of a family's children values (counters/gauges; histograms
+        contribute their observation COUNT), restricted to children whose
+        labels include every ``label_filter`` pair. 0.0 for an absent
+        family — assertions read totals without creating families."""
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                return 0.0
+            children = list(fam.children.items())
+        want = set(label_filter.items())
+        out = 0.0
+        for key, child in children:
+            if not want <= set(key):
+                continue
+            if isinstance(child, Histogram):
+                out += child.count
+            else:
+                out += float(child.value)
+        return out
+
+    def render(self) -> str:
+        """Prometheus text exposition format, families and children in
+        sorted order (byte-stable across runs with equal contents)."""
+        lines: List[str] = []
+        with self._lock:
+            families = sorted(self._families.items())
+        for name, fam in families:
+            if fam.help:
+                lines.append(f"# HELP {name} {fam.help}")
+            lines.append(f"# TYPE {name} {fam.mtype}")
+            for key in sorted(fam.children):
+                child = fam.children[key]
+                label_text = ",".join(
+                    f'{k}="{_escape(v)}"' for k, v in key)
+                if isinstance(child, Histogram):
+                    cum = child.cumulative()
+                    for bound, c in zip(child.buckets, cum):
+                        b_labels = ",".join(filter(None, [
+                            label_text, f'le="{_fmt(bound)}"']))
+                        lines.append(
+                            f"{name}_bucket{{{b_labels}}} {c}")
+                    inf_labels = ",".join(filter(None,
+                                                 [label_text, 'le="+Inf"']))
+                    lines.append(f"{name}_bucket{{{inf_labels}}} "
+                                 f"{child.count}")
+                    suffix = f"{{{label_text}}}" if label_text else ""
+                    lines.append(f"{name}_sum{suffix} {_fmt(child.sum)}")
+                    lines.append(f"{name}_count{suffix} {child.count}")
+                else:
+                    suffix = f"{{{label_text}}}" if label_text else ""
+                    lines.append(f"{name}{suffix} {_fmt(child.value)}")
+        return "\n".join(lines) + "\n"
+
+
+# --------------------------------------------------------------------------
+# Tracing.
+
+
+class Span:
+    """One timed node of the trace tree. Created via :meth:`Tracer.span`
+    (context-managed) or :meth:`Tracer.leaf` (already-completed wire
+    attempts); ``annotate`` adds args, ``event`` adds an instant mark
+    (retry/backoff/chaos annotations ride here)."""
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str,
+                 parent: Optional["Span"],
+                 args: Dict[str, Any]) -> None:
+        self.tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.parent = parent
+        self.args: Dict[str, Any] = dict(args)
+        self.start_s = time.monotonic() - tracer.t0
+        self.end_s: Optional[float] = None
+        self.tid = threading.get_ident()
+        self.children: List[Span] = []
+        # (name, offset_s, args) instant events within this span
+        self.events: List[Tuple[str, float, Dict[str, Any]]] = []
+
+    def annotate(self, key: str, value: Any) -> None:
+        with self.tracer.lock:
+            self.args[key] = value
+
+    def event(self, name: str, **args: Any) -> None:
+        offset = time.monotonic() - self.tracer.t0
+        with self.tracer.lock:
+            self.events.append((name, offset, dict(args)))
+
+    def end(self) -> None:
+        if self.end_s is None:
+            self.end_s = time.monotonic() - self.tracer.t0
+
+    @property
+    def duration_s(self) -> float:
+        end = (self.end_s if self.end_s is not None
+               else time.monotonic() - self.tracer.t0)
+        return max(0.0, end - self.start_s)
+
+
+class _SpanScope:
+    """Context manager: pushes the span on the calling thread's stack so
+    nested instrumentation (HTTP attempts inside an object apply) parents
+    correctly, pops + ends on exit."""
+
+    def __init__(self, tracer: "Tracer", span: Span) -> None:
+        self._tracer = tracer
+        self.span = span
+
+    def __enter__(self) -> Span:
+        self._tracer.push(self.span)
+        return self.span
+
+    def __exit__(self, *exc: object) -> None:
+        self._tracer.pop(self.span)
+        self.span.end()
+
+
+class _NullScope:
+    """The no-telemetry stand-in :func:`maybe_span` hands out."""
+
+    def __enter__(self) -> None:
+        return None
+
+    def __exit__(self, *exc: object) -> None:
+        return None
+
+
+class Tracer:
+    def __init__(self) -> None:
+        self.t0 = time.monotonic()
+        # epoch anchor so two traces (or a trace and a server log) can be
+        # aligned on wall-clock time
+        self.epoch = time.time()
+        self.lock: Any = threading.Lock()
+        self.roots: List[Span] = []
+        self._tls = threading.local()
+
+    # ---------------------------------------------------- span lifecycle
+
+    def _stack(self) -> List[Span]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = []
+            self._tls.stack = stack
+        return stack  # type: ignore[no-any-return]
+
+    def current(self) -> Optional[Span]:
+        stack = self._stack()
+        return stack[-1] if stack else None
+
+    def push(self, span: Span) -> None:
+        self._stack().append(span)
+
+    def pop(self, span: Span) -> None:
+        stack = self._stack()
+        if stack and stack[-1] is span:
+            stack.pop()
+
+    def start(self, name: str, cat: str, parent: Optional[Span] = None,
+              **args: Any) -> Span:
+        """Create (and attach) a span; caller must ``end()`` it. Parent
+        resolution: explicit ``parent`` wins (thread boundaries), else the
+        calling thread's innermost open span, else a new root."""
+        if parent is None:
+            parent = self.current()
+        span = Span(self, name, cat, parent, args)
+        with self.lock:
+            if parent is not None:
+                parent.children.append(span)
+            else:
+                self.roots.append(span)
+        return span
+
+    def span(self, name: str, cat: str, parent: Optional[Span] = None,
+             **args: Any) -> _SpanScope:
+        return _SpanScope(self, self.start(name, cat, parent, **args))
+
+    def leaf(self, name: str, cat: str, duration_s: float,
+             parent: Optional[Span] = None, **args: Any) -> Span:
+        """Record an already-completed leaf span ending NOW (wire attempts
+        are timed by the transport and reported after the fact)."""
+        span = self.start(name, cat, parent, **args)
+        span.start_s = max(0.0, span.start_s - max(0.0, duration_s))
+        span.end_s = span.start_s + max(0.0, duration_s)
+        return span
+
+    def event(self, name: str, **args: Any) -> None:
+        """Instant event on the calling thread's innermost open span
+        (dropped when no span is open — a bare Client call outside any
+        rollout)."""
+        cur = self.current()
+        if cur is not None:
+            cur.event(name, **args)
+
+    # ---------------------------------------------------------- export
+
+    def walk(self) -> Iterator[Span]:
+        with self.lock:
+            stack = list(self.roots)
+        while stack:
+            span = stack.pop()
+            yield span
+            with self.lock:
+                stack.extend(span.children)
+
+    def chrome_trace(self) -> Dict[str, Any]:
+        """Chrome trace-event format (the JSON object form):
+        ``{"traceEvents": [...], "displayTimeUnit": "ms"}``. One ``X``
+        (complete) event per span — ``ts``/``dur`` in MICROSECONDS, as the
+        format requires — and one ``i`` (instant) event per span event.
+        Unfinished spans export with their duration so far and
+        ``args.unfinished = true`` (a crashed rollout's trace is the most
+        interesting one)."""
+        events: List[Dict[str, Any]] = []
+        now = time.monotonic() - self.t0
+        for span in self.walk():
+            end = span.end_s if span.end_s is not None else now
+            args = dict(span.args)
+            if span.end_s is None:
+                args["unfinished"] = True
+            events.append({
+                "name": span.name, "cat": span.cat, "ph": "X",
+                "ts": round(span.start_s * 1e6, 1),
+                "dur": round(max(0.0, end - span.start_s) * 1e6, 1),
+                "pid": 1, "tid": span.tid, "args": args,
+            })
+            for ev_name, offset, ev_args in list(span.events):
+                events.append({
+                    "name": ev_name, "cat": span.cat, "ph": "i", "s": "t",
+                    "ts": round(offset * 1e6, 1),
+                    "pid": 1, "tid": span.tid, "args": dict(ev_args),
+                })
+        events.sort(key=lambda e: (e["ts"], e["ph"] != "X"))
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "otherData": {"producer": "tpuctl",
+                              "epoch": self.epoch}}
+
+
+class Telemetry:
+    """The facade instrumented code holds: one tracer + one registry."""
+
+    def __init__(self) -> None:
+        self.tracer = Tracer()
+        self.metrics = MetricsRegistry()
+
+    # tracing delegates
+    def span(self, name: str, cat: str, parent: Optional[Span] = None,
+             **args: Any) -> _SpanScope:
+        return self.tracer.span(name, cat, parent, **args)
+
+    def leaf(self, name: str, cat: str, duration_s: float,
+             parent: Optional[Span] = None, **args: Any) -> Span:
+        return self.tracer.leaf(name, cat, duration_s, parent, **args)
+
+    def current(self) -> Optional[Span]:
+        return self.tracer.current()
+
+    def event(self, name: str, **args: Any) -> None:
+        self.tracer.event(name, **args)
+
+    # metrics delegates
+    def counter(self, name: str, help_text: str = "",
+                **labels: str) -> Counter:
+        return self.metrics.counter(name, help_text, **labels)
+
+    def gauge(self, name: str, help_text: str = "", **labels: str) -> Gauge:
+        return self.metrics.gauge(name, help_text, **labels)
+
+    def histogram(self, name: str, help_text: str = "",
+                  buckets: Tuple[float, ...] = DEFAULT_BUCKETS,
+                  **labels: str) -> Histogram:
+        return self.metrics.histogram(name, help_text, buckets=buckets,
+                                      **labels)
+
+    # export
+    def chrome_trace(self) -> Dict[str, Any]:
+        return self.tracer.chrome_trace()
+
+    def write_trace(self, path: str) -> None:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.chrome_trace(), f, separators=(",", ":"))
+            f.write("\n")
+
+    def write_metrics(self, path: str) -> None:
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(self.metrics.render())
+
+
+def maybe_span(tel: Optional[Telemetry], name: str, cat: str,
+               parent: Optional[Span] = None,
+               **args: Any) -> Union[_SpanScope, _NullScope]:
+    """Span scope when telemetry is enabled, a no-op scope otherwise —
+    instrumented call sites stay one-liners with zero overhead off."""
+    if tel is None:
+        return _NullScope()
+    return tel.span(name, cat, parent, **args)
+
+
+# --------------------------------------------------------------------------
+# Trace summarization (`tpuctl top`).
+
+# Rollout phase names in canonical order (the timings_line order); the
+# summary and the bench both filter phase spans to this set.
+PHASE_NAMES: Tuple[str, ...] = ("apply", "crd-establish", "ready-wait")
+
+
+def _complete_events(trace: Dict[str, Any]) -> List[Dict[str, Any]]:
+    if not isinstance(trace, dict):
+        raise ValueError("not a Chrome trace: top-level JSON is not an "
+                         "object")
+    events = trace.get("traceEvents")
+    if not isinstance(events, list):
+        raise ValueError("not a Chrome trace: no traceEvents array")
+    return [e for e in events
+            if isinstance(e, dict) and e.get("ph") == "X"]
+
+
+def phase_totals(trace: Dict[str, Any]) -> Dict[str, float]:
+    """Summed wall seconds per rollout phase (cat == "phase", canonical
+    names only) — what the bench derives its phases column from."""
+    out = {name: 0.0 for name in PHASE_NAMES}
+    for e in _complete_events(trace):
+        if e.get("cat") == "phase" and e.get("name") in out:
+            out[str(e["name"])] += float(e.get("dur", 0.0)) / 1e6
+    return out
+
+
+def request_events(trace: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Every HTTP wire-attempt span (cat == "http") in the trace."""
+    return [e for e in _complete_events(trace) if e.get("cat") == "http"]
+
+
+def summarize_trace(trace: Dict[str, Any], limit: int = 10) -> str:
+    """Human breakdown of a saved rollout trace: per-phase totals,
+    request counts by verb/status, retry marks, and the slowest object /
+    request spans — the `tpuctl top` renderer."""
+    complete = _complete_events(trace)
+    if not complete:
+        raise ValueError("trace has no complete (ph=X) span events")
+    lines: List[str] = []
+    rollouts = [e for e in complete if e.get("cat") == "rollout"]
+    for r in rollouts:
+        lines.append(f"rollout: {r.get('dur', 0.0) / 1e6:.3f}s "
+                     f"({json.dumps(r.get('args', {}), sort_keys=True)})")
+    lines.append("")
+    lines.append("phase breakdown (summed across groups):")
+    for name, secs in phase_totals(trace).items():
+        lines.append(f"  {name:<14} {secs:8.3f}s")
+    reqs = request_events(trace)
+    by_verb: Dict[str, int] = {}
+    by_status: Dict[str, int] = {}
+    for e in reqs:
+        args = e.get("args", {})
+        verb = str(args.get("verb", "?"))
+        by_verb[verb] = by_verb.get(verb, 0) + 1
+        status = str(args.get("status", "?"))
+        by_status[status] = by_status.get(status, 0) + 1
+    lines.append("")
+    verb_text = ", ".join(f"{v} {n}" for v, n in sorted(by_verb.items()))
+    status_text = ", ".join(
+        f"{s}: {n}" for s, n in sorted(by_status.items()))
+    lines.append(f"requests: {len(reqs)} ({verb_text})")
+    lines.append(f"  by status: {status_text}")
+    retries = [e for e in trace["traceEvents"]
+               if isinstance(e, dict) and e.get("ph") == "i"
+               and e.get("name") == "retry"]
+    if retries:
+        lines.append(f"  retries: {len(retries)} "
+                     "(see instant events in the trace)")
+    lines.append("")
+    lines.append(f"slowest spans (top {limit}):")
+    interesting = [e for e in complete
+                   if e.get("cat") in ("apply", "http", "watch", "group")]
+    interesting.sort(key=lambda e: -float(e.get("dur", 0.0)))
+    for e in interesting[:limit]:
+        status = e.get("args", {}).get("status", "")
+        suffix = f"  [{status}]" if status != "" else ""
+        lines.append(f"  {float(e.get('dur', 0.0)) / 1e6:8.3f}s  "
+                     f"{e.get('cat', '?'):<6} {e.get('name', '?')}{suffix}")
+    return "\n".join(lines)

@@ -21,6 +21,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from .kubeapply import FIELD_MANAGER, OPERATOR_FIELD_MANAGER
 from .spec import ClusterSpec
+from .telemetry import (OPERATOR_METRIC_NAMES, VERIFY_KUBECTL_CALLS,
+                        MetricsRegistry)
 
 Runner = Callable[[List[str]], Tuple[int, str]]
 
@@ -59,14 +61,32 @@ class ClusterSnapshot:
     key becomes its fetcher and later askers park on an Event instead of
     double-spawning kubectl. Snapshots are single-shot by design — a fresh
     one per runbook run, never reused across runs (staleness is the point:
-    all checks judge the same instant)."""
+    all checks judge the same instant).
 
-    def __init__(self, runner: Runner):
+    Fetch accounting lives in a telemetry registry
+    (``tpuctl_verify_kubectl_calls_total`` — pass your own
+    :class:`~tpu_cluster.telemetry.MetricsRegistry` to aggregate runbook
+    runs into a larger surface); ``fetches`` reads the counter, so the
+    CLI's ``kubectl_calls`` JSON field and the registry can never
+    disagree."""
+
+    def __init__(self, runner: Runner,
+                 registry: Optional[MetricsRegistry] = None):
         self._runner = runner
         self._lock = threading.Lock()
         self._done: Dict[tuple, Tuple[int, str]] = {}
         self._inflight: Dict[tuple, threading.Event] = {}
-        self.fetches = 0  # underlying runner invocations actually made
+        self.registry = registry if registry is not None else \
+            MetricsRegistry()
+        self._fetch_counter = self.registry.counter(
+            VERIFY_KUBECTL_CALLS,
+            "kubectl invocations the snapshot actually made")
+
+    @property
+    def fetches(self) -> int:
+        """Underlying runner invocations actually made (the registry
+        counter's value — the runbook's one source of request truth)."""
+        return int(self._fetch_counter.value)
 
     def __call__(self, argv: List[str]) -> Tuple[int, str]:
         key = tuple(argv)
@@ -77,7 +97,7 @@ class ClusterSnapshot:
                 event = self._inflight.get(key)
                 if event is None:
                     self._inflight[key] = threading.Event()
-                    self.fetches += 1
+                    self._fetch_counter.inc()
                     break
             event.wait()
         try:
@@ -558,6 +578,49 @@ def check_ownership(runner: Runner, spec: ClusterSpec) -> CheckResult:
         f"{FIELD_MANAGER}/{OPERATOR_FIELD_MANAGER} only")
 
 
+def check_operator_metrics(runner: Runner, spec: ClusterSpec) -> CheckResult:
+    """The operator's /metrics scrape against the PINNED metric-name
+    table (telemetry.OPERATOR_METRIC_NAMES — the twin of
+    kubeapi::OperatorMetricNames()): every family the fleet dashboards
+    and the metrics-driven autoscaler key on must be present, by name,
+    on the live endpoint. A missing family FAILs — a renamed metric is a
+    broken dashboard, caught here instead of on the Grafana screen.
+    Genuine operator absence (no tpu-operator Service) passes with a
+    note, like check_policy: plain `tpuctl apply` installs no operator."""
+    from .render.operator_bundle import OPERATOR_NAME, STATUS_PORT
+    rc, out = runner(["kubectl", "get", "service", "-n",
+                      spec.tpu.namespace, OPERATOR_NAME,
+                      "--ignore-not-found", "-o", "json"])
+    if rc != 0:
+        return CheckResult("operator-metrics", False,
+                           f"cannot query the {OPERATOR_NAME} service "
+                           f"(kubectl rc {rc})")
+    if not out.strip():
+        return CheckResult("operator-metrics", True,
+                           "operator not installed (tpuctl apply "
+                           "--operator deploys it); nothing to scrape")
+    rc, out = runner([
+        "kubectl", "get", "--raw",
+        f"/api/v1/namespaces/{spec.tpu.namespace}/services/"
+        f"{OPERATOR_NAME}:{STATUS_PORT}/proxy/metrics",
+    ])
+    if rc != 0:
+        return CheckResult("operator-metrics", False,
+                           "operator /metrics scrape failed (service "
+                           "proxy)")
+    lines = out.splitlines()
+    missing = [name for name in OPERATOR_METRIC_NAMES
+               if not any(ln.startswith(name) for ln in lines)]
+    if missing:
+        return CheckResult(
+            "operator-metrics", False,
+            f"scrape lacks pinned metric families: {missing}")
+    return CheckResult(
+        "operator-metrics", True,
+        f"all {len(OPERATOR_METRIC_NAMES)} pinned metric families "
+        "present")
+
+
 CHECKS: Dict[str, Callable[[Runner, ClusterSpec], CheckResult]] = {
     "smoke": check_smoke,
     "operands": check_operands,
@@ -569,6 +632,7 @@ CHECKS: Dict[str, Callable[[Runner, ClusterSpec], CheckResult]] = {
     "device-query": check_device_query,
     "vector-add": check_vector_add,
     "metrics": check_metrics,
+    "operator-metrics": check_operator_metrics,
     "psum": check_psum,
     "burnin": check_burnin,
 }
