@@ -1,0 +1,279 @@
+// Threaded stress selftest — the native half of the concurrency
+// correctness suite, built to run under ThreadSanitizer
+// (-fsanitize=thread; CI's TSan job, plus scripts/asan_interop.py
+// --tsan).
+//
+// The grpcmin/h2/hpack stack and the operator's minijson/kubeclient
+// helpers all claim "single-threaded per connection, shared-nothing
+// across threads" (h2.h header contract). Nothing enforced that: a
+// lazily-initialized static table or a shared scratch buffer added to
+// hpack would be invisible to the single-threaded selftests and surface
+// as a production heisenbug inside the kubelet's grpc-go peer. This
+// binary makes the claim testable — N threads drive private instances
+// of every layer concurrently, so ANY hidden cross-thread mutable state
+// becomes a TSan report with two stacks attached. A mutex+condvar work
+// queue between producer and consumer threads exercises the
+// synchronized path too (TSan validates the happy path as well as
+// catching the races).
+//
+// Runs clean (and fast) without sanitizers as a plain pthread smoke —
+// CMake builds it unconditionally and tests/test_native.py runs it.
+#include <atomic>
+#include <condition_variable>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include "grpc.h"
+#include "h2.h"
+#include "hpack.h"
+#include "kubeclient.h"
+#include "minijson.h"
+
+using grpcmin::Header;
+using grpcmin::HpackDecoder;
+using grpcmin::HpackEncoder;
+
+static std::atomic<int> g_failures{0};
+
+#define CHECK(cond)                                                   \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      std::printf("FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond);     \
+      g_failures.fetch_add(1, std::memory_order_relaxed);             \
+    }                                                                 \
+  } while (0)
+
+// Per-thread seeded LCG: the single-threaded selftest's generator is a
+// GLOBAL (fine there, a data race here) — each worker owns its state.
+struct Rng {
+  uint32_t s;
+  explicit Rng(uint32_t seed) : s(seed) {}
+  uint32_t next() {
+    s = s * 1664525u + 1013904223u;
+    return s >> 8;
+  }
+};
+
+// ---------------------------------------------------------------- HPACK
+
+static void HpackRound(Rng* rng) {
+  HpackDecoder dec(4096);
+  for (int block = 0; block < 8; ++block) {
+    std::vector<Header> in;
+    int n = 1 + int(rng->next() % 6);
+    for (int i = 0; i < n; ++i) {
+      std::string name = "x-k" + std::to_string(rng->next() % 16);
+      std::string value(rng->next() % 48, char('a' + rng->next() % 26));
+      in.push_back({name, value});
+    }
+    std::vector<uint8_t> wire;
+    HpackEncoder::EncodeAll(in, &wire);
+    std::vector<Header> out;
+    CHECK(dec.Decode(wire.data(), wire.size(), &out));
+    CHECK(out == in);  // Header is a (name, value) pair
+  }
+  // hostile bytes must not corrupt a decoder another thread's twin is
+  // using (they share NOTHING — that is the claim under test)
+  std::vector<uint8_t> garbage(rng->next() % 96);
+  for (auto& b : garbage) b = uint8_t(rng->next());
+  HpackDecoder hostile(256);
+  std::vector<Header> sink;
+  (void)hostile.Decode(garbage.data(), garbage.size(), &sink);
+}
+
+// ------------------------------------------------------------------- H2
+
+// One private server-role conn per call, fed random frames over a
+// socketpair (the single-threaded selftest's fuzz shape, parallelized).
+static void H2Round(Rng* rng) {
+  int sv[2];
+  if (socketpair(AF_UNIX, SOCK_STREAM, 0, sv) != 0) {
+    CHECK(false && "socketpair");
+    return;
+  }
+  fcntl(sv[0], F_SETFL, O_NONBLOCK);
+  fcntl(sv[1], F_SETFL, O_NONBLOCK);
+  {
+    grpcmin::H2Conn conn(sv[0], grpcmin::H2Conn::Role::kServer);
+    conn.Start();
+    std::string bytes = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+    int frames = 1 + int(rng->next() % 6);
+    for (int i = 0; i < frames; ++i) {
+      size_t len = rng->next() % 128;
+      uint8_t type = uint8_t(rng->next() % 11);
+      uint8_t flags = uint8_t(rng->next());
+      uint32_t stream = rng->next() % 7;
+      uint8_t hdr[9] = {uint8_t(len >> 16), uint8_t(len >> 8),
+                        uint8_t(len),       type,
+                        flags,              uint8_t(stream >> 24),
+                        uint8_t(stream >> 16), uint8_t(stream >> 8),
+                        uint8_t(stream)};
+      bytes.append(reinterpret_cast<char*>(hdr), sizeof(hdr));
+      for (size_t j = 0; j < len; ++j) bytes.push_back(char(rng->next()));
+    }
+    size_t off = 0;
+    bool live = true;
+    while (off < bytes.size() && live) {
+      size_t chunk = std::min<size_t>(1024, bytes.size() - off);
+      ssize_t w = write(sv[1], bytes.data() + off, chunk);
+      if (w <= 0) break;
+      off += size_t(w);
+      live = conn.OnReadable();
+      char sink[8192];
+      while (read(sv[1], sink, sizeof(sink)) > 0) {
+      }
+    }
+    (void)conn.OnReadable();
+  }  // conn closes sv[0]
+  close(sv[1]);
+}
+
+// -------------------------------------------------------- minijson + kube
+
+static void JsonRound(Rng* rng) {
+  // build -> dump -> parse -> spot-check, all thread-private
+  auto obj = minijson::Value::MakeObject();
+  obj->Set("kind", std::make_shared<minijson::Value>(std::string("Test")));
+  auto status = minijson::Value::MakeObject();
+  double ready = double(rng->next() % 100);
+  status->Set("numberReady",
+              std::make_shared<minijson::Value>(ready));
+  obj->Set("status", status);
+  auto arr = minijson::Value::MakeArray();
+  for (int i = 0; i < int(rng->next() % 5); ++i) {
+    arr->Append(std::make_shared<minijson::Value>(double(i)));
+  }
+  obj->Set("items", arr);
+  std::string text = obj->Dump();
+  std::string err;
+  auto back = minijson::Parse(text, &err);
+  CHECK(back != nullptr);
+  if (back) {
+    CHECK(back->PathNumber("status.numberReady", -1) == ready);
+    CHECK(back->PathString("kind") == "Test");
+  }
+  // malformed input: parser must fail cleanly, thread-locally
+  auto broken = minijson::Parse("{\"unterminated\": ", &err);
+  CHECK(broken == nullptr && !err.empty());
+  // the retry taxonomy + backoff pacing are pure functions — hammer
+  // them concurrently so an accidental static cache would trip TSan
+  CHECK(kubeclient::RetryableStatus(503));
+  CHECK(!kubeclient::RetryableStatus(404));
+  int ms = kubeclient::WatchBackoffMs(1 + int(rng->next() % 6), 100, 2000);
+  CHECK(ms >= 0 && ms <= 2000);
+}
+
+// --------------------------------------------- shared mutex/condvar queue
+
+struct WorkQueue {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::deque<std::string> items;
+  bool done = false;
+};
+
+static void Producer(WorkQueue* q, int id, int rounds) {
+  Rng rng(uint32_t(1000 + id));
+  for (int i = 0; i < rounds; ++i) {
+    auto obj = minijson::Value::MakeObject();
+    obj->Set("producer", std::make_shared<minijson::Value>(double(id)));
+    obj->Set("seq", std::make_shared<minijson::Value>(double(i)));
+    std::string doc = obj->Dump();
+    {
+      std::lock_guard<std::mutex> hold(q->mu);
+      q->items.push_back(doc);
+    }
+    q->cv.notify_one();
+  }
+}
+
+static int Consumer(WorkQueue* q) {
+  int consumed = 0;
+  for (;;) {
+    std::string doc;
+    {
+      std::unique_lock<std::mutex> hold(q->mu);
+      q->cv.wait(hold, [q] { return !q->items.empty() || q->done; });
+      if (q->items.empty()) return consumed;
+      doc = q->items.front();
+      q->items.pop_front();
+    }
+    std::string err;
+    auto v = minijson::Parse(doc, &err);
+    CHECK(v != nullptr && v->PathNumber("seq", -1) >= 0);
+    ++consumed;
+  }
+}
+
+// ------------------------------------------------------------------ main
+
+int main(int argc, char** argv) {
+  int threads = 8;
+  int rounds = 20;
+  for (int i = 1; i < argc; ++i) {
+    if (std::strncmp(argv[i], "--threads=", 10) == 0)
+      threads = std::atoi(argv[i] + 10);
+    if (std::strncmp(argv[i], "--rounds=", 9) == 0)
+      rounds = std::atoi(argv[i] + 9);
+  }
+  if (threads < 2) threads = 2;
+  if (rounds < 1) rounds = 1;
+
+  // phase 1: shared-nothing parallel hammer over every claimed
+  // single-threaded layer
+  {
+    std::vector<std::thread> pool;
+    for (int t = 0; t < threads; ++t) {
+      pool.emplace_back([t, rounds] {
+        Rng rng(uint32_t(1 + t));
+        for (int r = 0; r < rounds; ++r) {
+          HpackRound(&rng);
+          H2Round(&rng);
+          JsonRound(&rng);
+        }
+      });
+    }
+    for (auto& th : pool) th.join();
+  }
+
+  // phase 2: producers feeding one consumer through a locked queue —
+  // the synchronized path TSan should bless
+  {
+    WorkQueue q;
+    std::thread consumer_thread;
+    int consumed = 0;
+    consumer_thread = std::thread([&q, &consumed] {
+      consumed = Consumer(&q);
+    });
+    std::vector<std::thread> producers;
+    for (int t = 0; t < threads; ++t) {
+      producers.emplace_back(Producer, &q, t, rounds);
+    }
+    for (auto& th : producers) th.join();
+    {
+      std::lock_guard<std::mutex> hold(q.mu);
+      q.done = true;
+    }
+    q.cv.notify_all();
+    consumer_thread.join();
+    CHECK(consumed == threads * rounds);
+  }
+
+  int failures = g_failures.load();
+  if (failures == 0) {
+    std::printf("concurrency stress selftest: all OK "
+                "(%d threads x %d rounds)\n", threads, rounds);
+    return 0;
+  }
+  std::printf("concurrency stress selftest: %d failure(s)\n", failures);
+  return 1;
+}

@@ -7,7 +7,14 @@ kubelet stand-in), tpu-operator against the fake apiserver — under an
 ASan/UBSan build. This caught a real use-after-free in grpcmin's stream
 teardown (a unary handler calling ForgetStream inside on_data).
 
-Usage: python scripts/asan_interop.py [build_dir=native/build-asan]
+TSan mode (``--tsan``, for a ``-DTPU_SANITIZE=thread`` build): the same
+daemon hammers run under ThreadSanitizer, plus the threaded
+``concurrency_stress_selftest`` with a bigger thread x round budget than
+the CI unit invocation. A build without tpud (``-DTPU_NATIVE_NO_PROTO=ON``
+— TSan builds skip protobuf, see native/CMakeLists.txt) skips the tpud
+hammer loudly instead of failing on the missing binary.
+
+Usage: python scripts/asan_interop.py [build_dir=native/build-asan] [--tsan]
 Exit 0 = clean; nonzero = crash or sanitizer report.
 """
 
@@ -25,12 +32,19 @@ sys.path.insert(0, os.path.join(REPO, "tests"))
 
 
 def check_clean(name: str, stderr: str) -> None:
-    if "AddressSanitizer" in stderr or "runtime error" in stderr:
+    if "AddressSanitizer" in stderr or "ThreadSanitizer" in stderr \
+            or "runtime error" in stderr:
         print(f"{name}: SANITIZER REPORT\n{stderr[-4000:]}", file=sys.stderr)
         raise SystemExit(1)
 
 
 def hammer_tpud(build: str, rounds: int = 20) -> None:
+    if not os.path.exists(os.path.join(build, "tpud")):
+        # -DTPU_NATIVE_NO_PROTO=ON builds (the TSan job) have no tpud;
+        # say so instead of crashing on the missing binary
+        print("tpud hammer: SKIPPED (binary not in this build — "
+              "protobuf-free configuration)")
+        return
     import grpc
 
     from tpu_cluster.plugin_api.client import DevicePluginClient
@@ -245,9 +259,35 @@ def hammer_tfd(build: str, rounds: int = 10) -> None:
     print(f"tpu-tfd hammer ({rounds} rounds x 4 trees): clean")
 
 
+def stress_threads(build: str) -> None:
+    """The threaded stress selftest at interop scale — only meaningful
+    breadth beyond the unit invocation when TSan is watching."""
+    binary = os.path.join(build, "concurrency_stress_selftest")
+    if not os.path.exists(binary):
+        print("concurrency stress: SKIPPED (selftest not in this build)")
+        return
+    proc = subprocess.run([binary, "--threads=16", "--rounds=40"],
+                          capture_output=True, text=True, timeout=600)
+    check_clean("concurrency_stress_selftest", proc.stderr)
+    if proc.returncode != 0:
+        print(f"concurrency stress rc={proc.returncode}:\n"
+              f"{proc.stdout[-2000:]}{proc.stderr[-2000:]}",
+              file=sys.stderr)
+        raise SystemExit(1)
+    print("concurrency stress (16 threads x 40 rounds): clean")
+
+
 def main() -> int:
-    build = sys.argv[1] if len(sys.argv) > 1 else \
-        os.path.join(REPO, "native", "build-asan")
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    tsan = "--tsan" in sys.argv[1:]
+    build = args[0] if args else \
+        os.path.join(REPO, "native",
+                     "build-tsan" if tsan else "build-asan")
+    if tsan:
+        # history_size: the operator/exporter daemons run long enough
+        # under the hammers that TSan's default shadow history can wrap
+        os.environ.setdefault("TSAN_OPTIONS", "history_size=4")
+        stress_threads(build)
     hammer_tpud(build)
     converge_operator(build)
     hammer_exporter(build)

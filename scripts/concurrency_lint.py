@@ -1,0 +1,24 @@
+#!/usr/bin/env python3
+"""Concurrency correctness lint over Python sources (CI surface).
+
+Thin wrapper over tpu_cluster.conlint — the guarded-by annotation
+checker (rules CL01-CL04; annotation grammar documented in the module).
+With no arguments it audits the package plus tests/fake_apiserver.py,
+which is exactly what CI gates on:
+
+    python scripts/concurrency_lint.py            # repo self-audit
+    python scripts/concurrency_lint.py tpu_cluster/
+    python scripts/concurrency_lint.py --format json some/file.py
+
+Exit 0 = clean, 1 = findings, 2 = bad invocation.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpu_cluster import conlint  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(conlint.main(sys.argv[1:]))

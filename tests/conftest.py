@@ -10,6 +10,17 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Lock-order detector (tpu_cluster/lockorder.py): installed BEFORE any
+# repo code creates a lock, so the whole tier-1 run — pipelined engine,
+# shared watcher, chaos soak — feeds one acquisition graph. Locks created
+# by stdlib/third-party files stay untracked real locks. The observed
+# graph is asserted cycle-free and pinned by tests/test_lockorder.py;
+# TPU_LOCKORDER=0 opts out (e.g. when bisecting monitor-vs-product).
+from tpu_cluster import lockorder  # noqa: E402
+
+if os.environ.get("TPU_LOCKORDER", "1") != "0":
+    lockorder.install()
+
 from tpu_cluster.virtualmesh import force_virtual_cpu_mesh  # noqa: E402
 
 force_virtual_cpu_mesh(8)
@@ -41,6 +52,9 @@ _GXX_TARGETS = {
                              "common/devenum.cc"],
     "grpcmin_selftest": ["grpcmin/selftest.cc", "grpcmin/hpack.cc",
                          "grpcmin/h2.cc", "grpcmin/grpc.cc"],
+    "concurrency_stress_selftest": [
+        "grpcmin/stress_selftest.cc", "grpcmin/hpack.cc",
+        "grpcmin/h2.cc", "grpcmin/grpc.cc"] + _OPERATOR_CORE,
 }
 _GXX_INCLUDES = ["operator", "common", "grpcmin", "plugin"]
 
@@ -70,6 +84,25 @@ def _gxx_fallback_build() -> str:
              "-pthread"],
             check=True, capture_output=True, timeout=600)
     return NATIVE_BUILD_DIR
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Fail the run on lock-order violations recorded at ANY point —
+    tests/test_lockorder.py hard-asserts the graph when it runs, but a
+    cycle introduced by a test that executes after it must gate too
+    (the whole point is that a deadlock candidate is a CI failure, not
+    a stderr footnote)."""
+    mon = lockorder.installed()
+    if mon is None:
+        return
+    violations = mon.snapshot_violations()
+    if violations:
+        print("\nLOCK-ORDER VIOLATIONS (tpu_cluster.lockorder):",
+              file=sys.stderr)
+        for v in violations:
+            print(f"  {v}", file=sys.stderr)
+        if session.exitstatus == 0:
+            session.exitstatus = 1
 
 
 @pytest.fixture(scope="session")

@@ -228,16 +228,21 @@ class ChaosEngine:
 
     def __init__(self, script):
         self._lock = threading.Lock()
-        self._faults = [dict(f) for f in script]
-        self._t0: Optional[float] = None
-        self._timers: List[threading.Timer] = []
-        self.fired: List[Tuple[Any, str, str]] = []  # (status|'drop', m, p)
+        self._faults = [dict(f) for f in script]  # guarded-by: _lock
+        self._t0: Optional[float] = None  # guarded-by: _lock
+        # armed/cancelled only by the controlling thread (the server's
+        # start/stop and test hooks); the timer threads never touch it
+        self._timers: List[threading.Timer] = []  # thread-owned
+        # (status|'drop', method, path), appended per fired fault
+        self.fired: List[Tuple[Any, str, str]] = []  # guarded-by: _lock
 
     def start(self, server: "FakeApiServer") -> None:
         """Arm the script: the clock starts now, and flap faults schedule
         their restart timers against ``server``."""
-        self._t0 = time.monotonic()
-        for f in self._faults:
+        with self._lock:
+            self._t0 = time.monotonic()
+            faults = list(self._faults)
+        for f in faults:
             if f.get("flap"):
                 t = threading.Timer(max(0.0, f.get("at", 0.0)), server.flap)
                 t.daemon = True
@@ -256,15 +261,19 @@ class ChaosEngine:
         with self._lock:
             self._faults = []
 
+    def fired_snapshot(self) -> List[Tuple[Any, str, str]]:
+        """Copy of ``fired`` taken under the engine's lock — handler
+        threads append concurrently while /__fake_metrics renders."""
+        with self._lock:
+            return list(self.fired)
+
     def intercept(self, method: str, path: str, is_watch: bool,
                   is_ssa: bool = False):
         """None (pass through) | ("drop",) | ("status", code, headers,
         body) for one request."""
-        if self._t0 is None:
-            now = 0.0
-        else:
-            now = time.monotonic() - self._t0
         with self._lock:
+            now = (0.0 if self._t0 is None
+                   else time.monotonic() - self._t0)
             for f in self._faults:
                 if f.get("flap"):
                     continue  # timer-driven, never per-request
@@ -380,8 +389,8 @@ class FakeApiServer:
         # their waits exactly like round trips to a remote apiserver.
         self.latency_s = latency_s
         self._tls = tls
-        self.store: Dict[str, Dict[str, Any]] = dict(store or {})
-        self.ghost_get_404 = set(ghost_get_404)
+        self.store: Dict[str, Dict[str, Any]] = dict(store or {})  # guarded-by: _lock
+        self.ghost_get_404 = set(ghost_get_404)  # guarded-by: _lock
         faults: List[Dict[str, Any]] = []
         for path, rc in (reject_posts or {}).items():
             faults.append({"status": rc, "method": "POST", "match": path,
@@ -405,10 +414,12 @@ class FakeApiServer:
             faults.extend(chaos)
         self.chaos: Optional[ChaosEngine] = (
             ChaosEngine(faults) if faults else None)
-        self.watch_gone_once = set(watch_gone_once)
-        self.log: List[Tuple[str, str]] = []  # (method, path)
-        self.created: List[str] = []          # stored object paths, in order
-        self.headers_seen: List[Dict[str, str]] = []
+        self.watch_gone_once = set(watch_gone_once)  # guarded-by: _lock
+        # (method, path) per request
+        self.log: List[Tuple[str, str]] = []  # guarded-by: _lock
+        # stored object paths, in order
+        self.created: List[str] = []  # guarded-by: _lock
+        self.headers_seen: List[Dict[str, str]] = []  # guarded-by: _lock
         # Server-side request audit by (verb, path-sans-query, status):
         # every request that reached a handler gets exactly ONE entry —
         # normal replies, watch streams (status 200), chaos status
@@ -417,9 +428,11 @@ class FakeApiServer:
         # /__fake_metrics endpoint can publish it for client-vs-server
         # accounting assertions. Scrapes of /__fake_metrics itself are
         # excluded from BOTH (the observer must not move the needle).
-        self.responses: Dict[Tuple[str, str, int], int] = {}
+        self.responses: Dict[Tuple[str, str, int], int] = {}  # guarded-by: _responses_lock
         # own lock: _reply fires inside handlers that already hold _lock
-        # (which is non-reentrant), so the audit cannot share it
+        # (which is non-reentrant), so the audit cannot share it —
+        # tests/test_lockorder.py pins the resulting _lock ->
+        # _responses_lock edge as the fake's ONLY lock nesting
         self._responses_lock = threading.Lock()
         self._lock = threading.Lock()
         # watch support (?watch=1): every mutation through the HTTP
@@ -429,11 +442,12 @@ class FakeApiServer:
         # watcher always re-reads the CURRENT object, so dropped history
         # only loses intermediate states, like a real compacted etcd.
         self._changed = threading.Condition(self._lock)
-        self._rev = 0
-        self._changes: List[Tuple[int, str]] = []  # (rev, path)
+        self._rev = 0  # guarded-by: _lock
+        # (rev, path) change feed
+        self._changes: List[Tuple[int, str]] = []  # guarded-by: _lock
         # bumped by flap(): streams opened under an older epoch end with
         # ERROR/410 — "the apiserver you were watching restarted"
-        self._flap_epoch = 0
+        self._flap_epoch = 0  # guarded-by: _lock
 
         fake = self
 
@@ -656,6 +670,7 @@ class FakeApiServer:
                 else:
                     self._reply(200, obj)
 
+            # requires: fake._lock
             def _finalize_create_locked(self, path: str, obj: Dict[str, Any],
                                         manager: str = "",
                                         intent_fields=None) -> Dict[str, Any]:
@@ -852,21 +867,29 @@ class FakeApiServer:
                 # operator's TpuStackPolicy status write-back relies on it).
                 # Tests that seed the literal "<path>/status" key keep the
                 # original flat-store simplification instead.
-                if (self.path.endswith("/status")
-                        and self.path not in fake.store):
+                if self.path.endswith("/status"):
                     parent_path = self.path[: -len("/status")]
+                    subresource = False
+                    parent: Optional[Dict[str, Any]] = None
                     with fake._lock:
-                        parent = fake.store.get(parent_path)
-                        if parent is not None:
-                            st = (patch or {}).get("status")
-                            parent["status"] = merge_patch(
-                                parent.get("status"), st)
-                            fake._note_change(parent_path)
-                    if parent is None:
-                        self._reply(404, {"kind": "Status", "code": 404})
-                    else:
-                        self._reply(200, parent)
-                    return
+                        # the membership probe reads the store too — one
+                        # lock hold covers probe and patch (conlint CL01
+                        # caught the probe outside it)
+                        if self.path not in fake.store:
+                            subresource = True
+                            parent = fake.store.get(parent_path)
+                            if parent is not None:
+                                st = (patch or {}).get("status")
+                                parent["status"] = merge_patch(
+                                    parent.get("status"), st)
+                                fake._note_change(parent_path)
+                    if subresource:
+                        if parent is None:
+                            self._reply(404,
+                                        {"kind": "Status", "code": 404})
+                        else:
+                            self._reply(200, parent)
+                        return
                 with fake._lock:
                     cur = fake.store.get(self.path)
                     if cur is None:
@@ -973,7 +996,7 @@ class FakeApiServer:
                 f'path="{path}",code="{status}"}} {n}')
         fired: Dict[str, int] = {}
         if self.chaos is not None:
-            for status, _m, _p in list(self.chaos.fired):
+            for status, _m, _p in self.chaos.fired_snapshot():
                 kind = str(status)
                 fired[kind] = fired.get(kind, 0) + 1
         lines.append("# TYPE fake_apiserver_chaos_faults_total counter")
@@ -985,6 +1008,7 @@ class FakeApiServer:
 
     # ------------------------------------------------------------- watch
 
+    # requires: self._lock
     def _note_change(self, path: str) -> None:
         """Record a mutation for watchers and stamp the object's
         metadata.resourceVersion (apiserver behavior — clients resume
@@ -999,6 +1023,7 @@ class FakeApiServer:
         del self._changes[:-1000]  # bounded; watchers re-read current state
         self._changed.notify_all()
 
+    # requires: self._lock
     def _note_kubelet_status(self, obj: Dict[str, Any]) -> None:
         """Record the node agent's ownership of ``status`` in
         managedFields whenever auto_ready writes one — real clusters show

@@ -1,0 +1,474 @@
+"""Concurrency lint tests (tpu_cluster.conlint).
+
+Three layers, mirroring test_lint.py's structure for the bundle linter:
+
+- one seeded-violation fixture per rule CL01-CL04: a minimal bad snippet
+  on which EXACTLY that rule fires, paired with the fixed version on
+  which nothing fires (the rules must be independently testable);
+- the annotation-model tests: requires-functions (body + caller side),
+  Condition aliasing, receiver-sensitivity, dataclass class-level
+  fields, the line-above attachment, and the ignore pragma;
+- the self-audit pin (the acceptance criterion): the whole package plus
+  tests/fake_apiserver.py analyze clean, through the library, the
+  scripts/concurrency_lint.py CLI, and the `tpuctl conlint` subcommand.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+from tpu_cluster import conlint
+from tpu_cluster import __main__ as cli
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def analyze(src):
+    return conlint.analyze_source(textwrap.dedent(src), "fixture.py")
+
+
+def rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# CL01 — guarded attribute accessed without its lock
+
+
+BAD_CL01 = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.items = []  # guarded-by: _lock
+
+        def add(self, x):
+            self.items.append(x)
+    """
+
+GOOD_CL01 = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.items = []  # guarded-by: _lock
+
+        def add(self, x):
+            with self._lock:
+                self.items.append(x)
+    """
+
+
+def test_cl01_fires_on_unguarded_access_and_not_on_fixed():
+    findings = analyze(BAD_CL01)
+    assert rules(findings) == [conlint.RULE_UNGUARDED]
+    assert "self.items" in findings[0].message
+    assert "self._lock" in findings[0].message
+    assert analyze(GOOD_CL01) == []
+
+
+def test_cl01_checks_reads_too_not_just_writes():
+    findings = analyze("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []  # guarded-by: _lock
+
+            def size(self):
+                return len(self.items)
+        """)
+    assert rules(findings) == [conlint.RULE_UNGUARDED]
+
+
+def test_cl01_receiver_sensitive():
+    # holding MY lock does not license touching ANOTHER instance's
+    # guarded state — the with must match the access receiver
+    findings = analyze("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []  # guarded-by: _lock
+
+            def steal(self, other):
+                with self._lock:
+                    return list(other.items)
+        """)
+    assert rules(findings) == [conlint.RULE_UNGUARDED]
+    assert "other._lock" in findings[0].message
+    clean = analyze("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []  # guarded-by: _lock
+
+            def steal(self, other):
+                with other._lock:
+                    return list(other.items)
+        """)
+    assert clean == []
+
+
+def test_cl01_requires_annotation_covers_body_and_callers():
+    clean = analyze("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []  # guarded-by: _lock
+
+            # requires: self._lock
+            def _add_locked(self, x):
+                self.items.append(x)
+
+            def add(self, x):
+                with self._lock:
+                    self._add_locked(x)
+        """)
+    assert clean == []
+    bad_caller = analyze("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []  # guarded-by: _lock
+
+            # requires: self._lock
+            def _add_locked(self, x):
+                self.items.append(x)
+
+            def add(self, x):
+                self._add_locked(x)
+        """)
+    assert rules(bad_caller) == [conlint.RULE_UNGUARDED]
+    assert "_add_locked" in bad_caller[0].message
+
+
+def test_cl01_condition_alias_satisfies_the_underlying_lock():
+    clean = analyze("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+                self.items = []  # guarded-by: _lock
+
+            def drain(self):
+                with self._cv:
+                    out, self.items = self.items, []
+                return out
+        """)
+    assert clean == []
+
+
+def test_cl01_nested_function_does_not_inherit_the_with():
+    # the closure runs LATER, outside the with — same reason the span
+    # stack doesn't cross threads
+    findings = analyze("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []  # guarded-by: _lock
+
+            def deferred(self):
+                with self._lock:
+                    def later():
+                        return list(self.items)
+                return later
+        """)
+    assert rules(findings) == [conlint.RULE_UNGUARDED]
+
+
+def test_cl01_init_exempt_and_ignore_pragma():
+    clean = analyze("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []  # guarded-by: _lock
+                self.items.append(0)
+
+            def peek(self):
+                return self.items[0]  # conlint: ignore[CL01]
+        """)
+    assert clean == []
+
+
+def test_cl01_dataclass_class_level_annotation():
+    findings = analyze("""
+        import threading
+        from dataclasses import dataclass
+        from typing import Optional
+
+        @dataclass
+        class Client:
+            flag: Optional[bool] = None  # guarded-by: _probe_lock
+
+            def __post_init__(self):
+                self._probe_lock = threading.Lock()
+
+            def check(self):
+                return self.flag is None
+        """)
+    assert rules(findings) == [conlint.RULE_UNGUARDED]
+
+
+# ---------------------------------------------------------------------------
+# CL02 — annotation names a lock the class does not have
+
+
+def test_cl02_unknown_lock_and_fixed():
+    findings = analyze("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []  # guarded-by: _lok
+        """)
+    assert rules(findings) == [conlint.RULE_UNKNOWN_LOCK]
+    assert "_lok" in findings[0].message
+    assert analyze(GOOD_CL01) == []
+
+
+def test_cl02_guard_must_be_a_lock_not_any_attribute():
+    findings = analyze("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.name = "box"
+                self.items = []  # guarded-by: name
+        """)
+    assert rules(findings) == [conlint.RULE_UNKNOWN_LOCK]
+
+
+def test_cl02_requires_with_unknown_self_lock():
+    findings = analyze("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            # requires: self._lok
+            def poke(self):
+                pass
+        """)
+    assert rules(findings) == [conlint.RULE_UNKNOWN_LOCK]
+
+
+# ---------------------------------------------------------------------------
+# CL03 — lock-owning / thread-spawning class with unannotated shared state
+
+
+BAD_CL03 = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.junk = {}
+    """
+
+
+def test_cl03_fires_on_lock_owning_class_and_annotations_clear_it():
+    findings = analyze(BAD_CL03)
+    assert rules(findings) == [conlint.RULE_UNANNOTATED_SHARED]
+    assert "junk" in findings[0].message
+    assert analyze(BAD_CL03.replace(
+        "self.junk = {}", "self.junk = {}  # guarded-by: _lock")) == []
+    assert analyze(BAD_CL03.replace(
+        "self.junk = {}", "self.junk = {}  # thread-owned")) == []
+
+
+def test_cl03_fires_on_thread_spawning_class_without_any_lock():
+    findings = analyze("""
+        import threading
+
+        class Runner:
+            def __init__(self):
+                self.results = []
+
+            def go(self):
+                threading.Thread(target=print).start()
+        """)
+    assert rules(findings) == [conlint.RULE_UNANNOTATED_SHARED]
+
+
+def test_cl03_silent_without_locks_or_threads_and_for_sync_values():
+    assert analyze("""
+        import threading
+
+        class Plain:
+            def __init__(self):
+                self.items = []
+        """) == []
+    assert analyze("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._tls = threading.local()
+                self.done = threading.Event()
+        """) == []
+
+
+# ---------------------------------------------------------------------------
+# CL04 — span created in a thread-entry function without explicit parent=
+
+
+BAD_CL04 = """
+    import threading
+
+    def worker():
+        with maybe_span(tel, "work", "phase"):
+            pass
+
+    def spawn():
+        threading.Thread(target=worker).start()
+    """
+
+
+def test_cl04_fires_for_thread_target_and_parent_kw_clears_it():
+    findings = analyze(BAD_CL04)
+    assert rules(findings) == [conlint.RULE_SPAN_PARENT]
+    assert "worker" in findings[0].message
+    fixed = BAD_CL04.replace('maybe_span(tel, "work", "phase")',
+                             'maybe_span(tel, "work", "phase", '
+                             'parent=parent)')
+    assert analyze(fixed) == []
+
+
+def test_cl04_covers_bound_method_targets():
+    # Thread(target=self._run) resolves by method name — the refactor
+    # from a closure target to a bound method must not lose coverage
+    findings = analyze("""
+        import threading
+
+        class Watcher:
+            def _run(self):
+                with maybe_span(tel, "watch", "watch"):
+                    pass
+
+            def start(self):
+                threading.Thread(target=self._run).start()
+        """)
+    assert rules(findings) == [conlint.RULE_SPAN_PARENT]
+
+
+def test_cl04_covers_pool_submit_callees():
+    findings = analyze("""
+        def task(tel):
+            with tel.span("work", "phase"):
+                pass
+
+        def fan_out(pool):
+            pool.submit(task, object())
+        """)
+    assert rules(findings) == [conlint.RULE_SPAN_PARENT]
+
+
+def test_cl04_not_fired_outside_thread_entry_functions():
+    assert analyze("""
+        def inline(tel):
+            with tel.span("work", "phase"):
+                pass
+        """) == []
+
+
+# ---------------------------------------------------------------------------
+# parse failures surface instead of passing silently
+
+
+def test_unparseable_source_is_a_finding():
+    findings = conlint.analyze_source("def broken(:\n", "x.py")
+    assert [f.rule for f in findings] == [conlint.RULE_PARSE]
+
+
+def test_annotation_tokens_inside_string_literals_are_ignored():
+    # comments are located via tokenize: a '#' inside a string literal
+    # must not register a phantom guard (which would CL02 on good code)
+    assert analyze("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.banner = "see # guarded-by: sig"
+
+            def read(self):
+                return self.banner
+        """) == []
+
+
+# ---------------------------------------------------------------------------
+# the self-audit pin (acceptance: `concurrency_lint.py tpu_cluster/`
+# exits 0) — library, script and subcommand surfaces
+
+
+def test_package_and_fake_apiserver_audit_clean():
+    findings = conlint.analyze_paths(
+        [os.path.join(REPO, "tpu_cluster"),
+         os.path.join(REPO, "tests", "fake_apiserver.py")])
+    assert findings == [], "\n" + conlint.format_findings(findings)
+
+
+def test_script_surface_exits_zero_on_package():
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "concurrency_lint.py"),
+         os.path.join(REPO, "tpu_cluster")],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stdout
+
+
+def test_script_surface_exits_nonzero_on_violation(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent(BAD_CL01))
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "concurrency_lint.py"), str(bad)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    assert conlint.RULE_UNGUARDED in proc.stderr
+
+
+def test_cli_subcommand_default_paths_clean(capsys):
+    rc = cli.main(["conlint"])
+    assert rc == 0
+    assert "clean" in capsys.readouterr().out
+
+
+def test_cli_subcommand_json_on_violation(tmp_path, capsys):
+    import json
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent(BAD_CL04))
+    rc = cli.main(["conlint", str(bad), "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert not out["ok"]
+    assert [f["rule"] for f in out["findings"]] == [conlint.RULE_SPAN_PARENT]
+
+
+def test_generated_pb2_sources_are_skipped(tmp_path):
+    gen = tmp_path / "thing_pb2.py"
+    gen.write_text(textwrap.dedent(BAD_CL01))
+    assert conlint.analyze_paths([str(tmp_path)]) == []

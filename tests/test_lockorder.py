@@ -1,0 +1,280 @@
+"""Lock-order detector tests (tpu_cluster.lockorder).
+
+Two layers:
+
+- seeded-violation units against a PRIVATE monitor (never the global
+  one — a deliberately-created cycle must not poison the session graph):
+  ABBA cycle detection with the full path named, RLock reentrancy,
+  self-deadlock on a non-reentrant re-acquire, Condition integration;
+- the regression pin against the GLOBAL monitor conftest installs: a
+  full pipelined + shared-watcher + chaos-soak rollout (the satellite's
+  "shared watcher + cache_lock interplay"), after which the acquisition
+  graph must be cycle-free, the client/telemetry/verify stack must be
+  FLAT (zero nesting — the discipline kubeapply keeps on purpose: every
+  lock is released before the next is taken), and the fake apiserver
+  must show exactly its one known edge (_lock -> _responses_lock, the
+  reply-inside-SSA-create path). Any new edge fails the pin and gets
+  reviewed before it can deadlock.
+"""
+
+import threading
+import time
+
+import pytest
+
+from fake_apiserver import FakeApiServer, standard_fault_script
+from tpu_cluster import kubeapply, lockorder, telemetry
+from tpu_cluster import spec as specmod
+from tpu_cluster.render import manifests
+
+FAST_RETRY = kubeapply.RetryPolicy(attempts=8, base_s=0.02, cap_s=0.3)
+
+
+# ---------------------------------------------------------------- units
+
+
+def test_abba_cycle_detected_with_path():
+    m = lockorder.LockOrderMonitor()
+    a = m.make_lock("A")
+    b = m.make_lock("B")
+    with a:
+        with b:
+            pass
+    assert m.snapshot_violations() == []
+    with b:
+        with a:
+            pass
+    violations = m.snapshot_violations()
+    assert len(violations) == 1
+    assert "cycle" in violations[0]
+    assert "A" in violations[0] and "B" in violations[0]
+    assert set(m.snapshot_edges()) == {("A", "B"), ("B", "A")}
+
+
+def test_three_lock_cycle_detected():
+    m = lockorder.LockOrderMonitor()
+    a, b, c = m.make_lock("A"), m.make_lock("B"), m.make_lock("C")
+    for first, second in ((a, b), (b, c)):
+        with first:
+            with second:
+                pass
+    assert m.snapshot_violations() == []
+    with c:
+        with a:
+            pass
+    violations = m.snapshot_violations()
+    assert len(violations) == 1 and "cycle" in violations[0]
+
+
+def test_rlock_reentry_is_not_a_violation():
+    m = lockorder.LockOrderMonitor()
+    r = m.make_lock("R", reentrant=True)
+    with r:
+        with r:
+            pass
+    assert m.snapshot_violations() == []
+    assert m.snapshot_edges() == {}
+
+
+def test_nonreentrant_self_reacquire_raises_instead_of_hanging():
+    m = lockorder.LockOrderMonitor()
+    a = m.make_lock("A")
+    with a:
+        with pytest.raises(RuntimeError, match="self-deadlock"):
+            a.acquire()
+    assert any("self-deadlock" in v for v in m.snapshot_violations())
+
+
+def test_timed_reacquire_returns_false_instead_of_raising():
+    # acquire(timeout=...) on a held non-reentrant lock is a LEGAL
+    # pattern that times out — the monitor must not turn it into a
+    # self-deadlock report (only untimed blocking acquires can hang)
+    m = lockorder.LockOrderMonitor()
+    a = m.make_lock("A")
+    with a:
+        assert a.acquire(timeout=0.05) is False
+    assert m.snapshot_violations() == []
+    with a:  # held stack stayed consistent
+        pass
+    assert m.snapshot_violations() == []
+
+
+def test_trylock_records_no_ordering():
+    # a failed/non-blocking acquire cannot deadlock; it must not
+    # constrain the graph
+    m = lockorder.LockOrderMonitor()
+    a, b = m.make_lock("A"), m.make_lock("B")
+    with a:
+        assert b.acquire(blocking=False)
+        b.release()
+    with b:
+        with a:
+            pass
+    # the blocking order b->a is the only edge; no cycle
+    assert set(m.snapshot_edges()) == {("B", "A")}
+    assert m.snapshot_violations() == []
+
+
+def test_condition_on_tracked_lock_round_trips():
+    m = lockorder.LockOrderMonitor()
+    lk = m.make_lock("CVL")
+    cv = threading.Condition(lk)
+    hits = []
+
+    def waiter():
+        with cv:
+            while not hits:
+                cv.wait(timeout=5)
+            hits.append("woken")
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    with cv:
+        hits.append("posted")
+        cv.notify()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert hits == ["posted", "woken"]
+    assert m.snapshot_violations() == []
+
+
+def test_condition_on_tracked_rlock_waits_correctly():
+    """Condition prefers the lock's _is_owned/_release_save/
+    _acquire_restore; the proxy must forward them — without that, a
+    Condition on a tracked RLock raises 'cannot wait on un-acquired
+    lock' (the default _is_owned probe succeeds reentrantly), and a
+    doubly-held RLock would be only half-released across wait()."""
+    m = lockorder.LockOrderMonitor()
+    rl = m.make_lock("RCVL", reentrant=True)
+    cv = threading.Condition(rl)
+    hits = []
+
+    def waiter():
+        with cv:
+            with rl:  # doubly held across the wait
+                while not hits:
+                    cv.wait(timeout=5)
+                hits.append("woken")
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)  # let the waiter reach wait() holding two levels
+    with cv:
+        hits.append("posted")
+        cv.notify()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert hits == ["posted", "woken"]
+    assert m.snapshot_violations() == []
+    # the main thread's held stack fully drained (restore bookkeeping)
+    with rl:
+        pass
+    assert m.snapshot_violations() == []
+
+
+def test_release_out_of_order_keeps_held_stack_consistent():
+    m = lockorder.LockOrderMonitor()
+    a, b = m.make_lock("A"), m.make_lock("B")
+    a.acquire()
+    b.acquire()
+    a.release()  # hand-over-hand: release the outer first
+    b.release()
+    with b:
+        pass
+    assert m.snapshot_violations() == []
+
+
+# ---------------------------------------------------- the regression pin
+
+
+def _interesting(edges, needles):
+    return {(src, dst): site for (src, dst), site in edges.items()
+            if any(n in src or n in dst for n in needles)}
+
+
+def test_soak_graph_is_cycle_free_and_pinned():
+    """Drive the full concurrent surface — pipelined engine (cache_lock),
+    shared watch readiness (per-wait stats lock + watcher threads),
+    retry accounting, telemetry, chaos faults — then pin the observed
+    acquisition graph."""
+    monitor = lockorder.installed()
+    if monitor is None:
+        pytest.skip("lock-order monitor disabled (TPU_LOCKORDER=0)")
+    spec = specmod.default_spec()
+    groups = manifests.rollout_groups(spec)
+    tel = telemetry.Telemetry()
+    with FakeApiServer(auto_ready=True,
+                       chaos=standard_fault_script(0.03)) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY,
+                                  telemetry=tel)
+        kubeapply.apply_groups(client, groups, wait=True,
+                               stage_timeout=60, poll=0.02,
+                               max_inflight=8, watch_ready=True)
+        # warm re-apply exercises the cache_lock + _ssa_is_noop path on
+        # live state (the shared watcher + cache interplay)
+        kubeapply.apply_groups(client, groups, wait=True,
+                               stage_timeout=60, poll=0.02,
+                               max_inflight=8, watch_ready=True)
+        client.close()
+    tel.metrics.render()  # exporter path under the monitor too
+
+    violations = monitor.snapshot_violations()
+    assert violations == [], "\n".join(violations)
+
+    edges = monitor.snapshot_edges()
+    # the client/telemetry stack's pinned order: the ONLY lock ever held
+    # across another acquisition is the SSA probe lock, which by design
+    # (PR 5: one capability probe per client) stays held through the
+    # probing request's transport + telemetry work. Everything else is
+    # flat — at most one lock at a time. A new edge is a design change
+    # to review, and an edge INTO the probe lock would close a cycle.
+    flat_files = ("kubeapply.py", "telemetry.py", "verify.py",
+                  "lockorder.py", "conlint.py")
+    nested = _interesting(edges, flat_files)
+    probe = "kubeapply.py:Client._ssa_probe_lock"
+    unexpected = {e: s for e, s in nested.items() if e[0] != probe}
+    assert unexpected == {}, \
+        f"client-stack lock nesting appeared: {unexpected}"
+    allowed_under_probe = {
+        "kubeapply.py:Client._conns_lock",      # keep-alive transport
+        "kubeapply.py:Client._retry_lock",      # retry accounting
+        "telemetry.py:Tracer.lock",             # wire-attempt span
+        "telemetry.py:MetricsRegistry._lock",   # counter/histogram family
+        "telemetry.py:Counter._lock",
+        "telemetry.py:Histogram._lock",
+    }
+    under_probe = {e[1] for e in nested if e[0] == probe}
+    assert under_probe <= allowed_under_probe, \
+        f"new locks taken under the SSA probe lock: " \
+        f"{under_probe - allowed_under_probe}"
+    assert all(e[1] != probe for e in edges), \
+        "something acquired the SSA probe lock while holding another " \
+        "lock — that direction can close a deadlock cycle"
+
+    # the fake apiserver's single known edge: replying from inside the
+    # store lock (the SSA-create path) takes the audit lock second
+    fake_edges = _interesting(edges, ("fake_apiserver.py",))
+    allowed = {("fake_apiserver.py:FakeApiServer._lock",
+                "fake_apiserver.py:FakeApiServer._responses_lock")}
+    assert set(fake_edges) <= allowed, f"unexpected fake edges: {fake_edges}"
+    assert set(fake_edges) == allowed, \
+        "the pinned _lock -> _responses_lock edge never appeared " \
+        "(did the SSA create path stop replying under the store lock?)"
+
+
+def test_site_naming_is_stable_and_meaningful():
+    """Creation-site naming is the pin's foundation: a Client's locks
+    must land on kubeapply.py:Client.<attr> nodes regardless of line
+    drift."""
+    monitor = lockorder.installed()
+    if monitor is None:
+        pytest.skip("lock-order monitor disabled (TPU_LOCKORDER=0)")
+    client = kubeapply.Client("http://127.0.0.1:1")
+    lock = client._conns_lock
+    assert isinstance(lock, lockorder._TrackedLock)
+    assert lock.name == "kubeapply.py:Client._conns_lock"
+    probe = client._ssa_probe_lock
+    assert isinstance(probe, lockorder._TrackedLock)
+    assert probe.name == "kubeapply.py:Client._ssa_probe_lock"
+    assert probe.reentrant
+    client.close()

@@ -65,6 +65,51 @@ def test_grpcmin_selftest(native_build):
     subprocess.run([binpath(native_build, "grpcmin_selftest")], check=True)
 
 
+def test_concurrency_stress_selftest(native_build):
+    """The threaded hammer over the single-threaded-by-contract layers
+    (hpack/h2/minijson + the shared work queue). Plain build here — a
+    crash or CHECK failure means actual cross-thread corruption; the
+    full data-race detection runs under -DTPU_SANITIZE=thread in CI."""
+    out = subprocess.run(
+        [binpath(native_build, "concurrency_stress_selftest"),
+         "--threads=8", "--rounds=10"],
+        check=True, capture_output=True, text=True, timeout=120)
+    assert "all OK" in out.stdout
+
+
+def test_concurrency_stress_selftest_under_tsan(tmp_path):
+    """Build the stress selftest with -fsanitize=thread directly via g++
+    and run it — the local twin of the CI TSan job. Skipped when the
+    toolchain cannot link libtsan (not installed on every host)."""
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ on this host")
+    from conftest import _GXX_TARGETS  # one source list, no drift
+    native = os.path.join(REPO, "native")
+    srcs = [os.path.join(native, s)
+            for s in _GXX_TARGETS["concurrency_stress_selftest"]]
+    binary = os.path.join(tmp_path, "stress_tsan")
+    build = subprocess.run(
+        ["g++", "-std=c++17", "-O1", "-g", "-fsanitize=thread",
+         "-fno-omit-frame-pointer",
+         f"-I{native}/operator", f"-I{native}/common",
+         f"-I{native}/grpcmin", f"-I{native}/plugin",
+         "-o", binary, *srcs, "-pthread"],
+        capture_output=True, text=True, timeout=300)
+    if build.returncode != 0:
+        # only a missing-TSan-runtime toolchain may skip; an actual
+        # compile/link error in the sources must FAIL, not skip forever
+        err = build.stderr.lower()
+        if "tsan" in err and ("cannot find" in err or "no such file" in err
+                              or "not found" in err):
+            pytest.skip(f"libtsan unavailable: {build.stderr[-200:]}")
+        assert False, f"TSan stress build failed:\n{build.stderr[-2000:]}"
+    proc = subprocess.run([binary, "--threads=4", "--rounds=5"],
+                          capture_output=True, text=True, timeout=300)
+    assert "ThreadSanitizer" not in proc.stderr, proc.stderr[-4000:]
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "all OK" in proc.stdout
+
+
 def test_topology_golden_cpp_matches_python(native_build):
     """C++ and Python allocation policies pinned to the same golden file."""
     out = subprocess.run([binpath(native_build, "tpud"),

@@ -15,6 +15,10 @@ with rendered artifacts and an ordered, readiness-gated apply:
            the linter first (--lint=warn default, error blocks pre-request);
            applies via server-side apply by default (--apply-mode) with a
            sticky merge-patch fallback for pre-SSA apiservers
+  conlint  concurrency lint over the Python sources themselves —
+           '# guarded-by:' lock annotations enforced statically (rules
+           CL01-CL04), the dev-side twin of the runtime lock-order
+           monitor tier-1 runs under
   delete   remove everything a spec renders, reverse order
            (helm uninstall analog, reference README.md kind-script flow)
   verify   the executable acceptance runbook (BASELINE configs)
@@ -34,8 +38,8 @@ from typing import Dict
 
 import yaml
 
-from . import (kubeapply, lint as lintmod, spec as specmod, telemetry,
-               triage, verify)
+from . import (conlint as conlintmod, kubeapply, lint as lintmod,
+               spec as specmod, telemetry, triage, verify)
 from .render import jobs, kubeadm, manifests, nodeprep, operator_bundle
 
 
@@ -309,6 +313,16 @@ def cmd_lint(args) -> int:
     return 1 if failing else 0
 
 
+def cmd_conlint(args) -> int:
+    """Concurrency lint (dev surface): the guarded-by annotation checker
+    over Python sources — `tpuctl conlint` with no paths audits the
+    package plus tests/fake_apiserver.py, same as the CI gate."""
+    argv = list(args.paths)
+    if args.format != "table":
+        argv += ["--format", args.format]
+    return conlintmod.main(argv)
+
+
 def cmd_verify(args) -> int:
     spec = _load_spec(args.spec)
     names = (list(verify.CHECKS) if args.config == "all"
@@ -512,6 +526,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="remove the operator install set (CRD, policy CR, "
                         "bundle, controller) instead of the operands")
     p.set_defaults(fn=cmd_delete)
+
+    p = sub.add_parser(
+        "conlint", help="concurrency lint: enforce '# guarded-by:' lock "
+                        "annotations, thread-shared-state hygiene and "
+                        "explicit cross-thread span parents over Python "
+                        "sources (rules CL01-CL04)")
+    p.add_argument("paths", nargs="*",
+                   help="files/directories (default: the tpu_cluster "
+                        "package + tests/fake_apiserver.py)")
+    p.add_argument("--format", choices=("table", "json"), default="table",
+                   help="findings as lines (default) or one JSON "
+                        "document")
+    p.set_defaults(fn=cmd_conlint)
 
     p = sub.add_parser("verify", help="run the acceptance runbook")
     p.add_argument("--spec", default="")

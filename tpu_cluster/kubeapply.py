@@ -103,8 +103,9 @@ import urllib.error
 import urllib.parse
 import urllib.request
 from dataclasses import dataclass, field
+from types import TracebackType
 from typing import (Any, Callable, Dict, FrozenSet, List, Optional,
-                    Sequence, Set, Tuple)
+                    Protocol, Sequence, Set, Tuple)
 
 from . import telemetry as _telemetry
 
@@ -112,6 +113,27 @@ from . import telemetry as _telemetry
 # runner seam (``(argv, input_text=...) -> (rc, stdout, stderr)``).
 LogFn = Callable[[str], None]
 KubectlRunner = Callable[..., Tuple[int, str, str]]
+
+
+class LockLike(Protocol):
+    """The mutual-exclusion surface this module's lock parameters
+    actually use — the ``with lock:`` context-manager protocol.
+    ``threading.Lock``/``RLock`` instances satisfy it structurally, and
+    so do the lock-order monitor's tracked proxies
+    (tpu_cluster.lockorder), so instrumented tier-1 runs type
+    identically. Exists because typeshed < 3.13 models
+    ``threading.Lock`` as a FACTORY FUNCTION, so it cannot be used as a
+    parameter annotation — the PR-5 workaround typed these parameters
+    ``Any``, which silenced mypy ``--strict`` exactly where lock
+    discipline matters most."""
+
+    def __enter__(self) -> bool:
+        ...
+
+    def __exit__(self, exc_type: Optional["type[BaseException]"],
+                 exc: Optional[BaseException],
+                 tb: Optional[TracebackType], /) -> Optional[bool]:
+        ...
 
 # kind -> (api prefix builder, plural, cluster-scoped). Mirrors
 # native/operator/kubeapi.cc Plurals() — a lookup table so unsupported kinds
@@ -460,7 +482,11 @@ class Client:
     # first apply_ssa: None = unknown, True = the server accepted an
     # apply patch, False = it answered 415/400 (every later SSA attempt
     # short-circuits into SSAUnsupportedError without a round trip).
-    ssa_supported: Optional[bool] = None
+    # Written by whichever worker thread's request resolves capability,
+    # read by all of them — the probe lock (an RLock, so the probing
+    # thread that already holds it can record its answer) is the flag's
+    # guard, not just the probe's.
+    ssa_supported: Optional[bool] = None  # guarded-by: _ssa_probe_lock
     # Unified telemetry (tpu_cluster.telemetry): when set, every wire
     # attempt records a leaf span (cat "http") + per-verb/status counter
     # + latency histogram, retries bump tpuctl_retries_total, the
@@ -473,8 +499,9 @@ class Client:
     _conns: Any = field(default=None, repr=False, compare=False)
 
     def __post_init__(self) -> None:
-        self._local = threading.local()
-        self._conns = []  # every connection ever opened, for close()
+        self._local = threading.local()  # thread-owned (per-thread conn)
+        # every connection ever opened, for close()
+        self._conns = []  # guarded-by: _conns_lock
         self._conns_lock = threading.Lock()
         if self.retry is None:
             self.retry = RetryPolicy()
@@ -482,11 +509,14 @@ class Client:
         # were re-sent after a retryable failure, and the freshest
         # transport-level error detail (exception class preserved).
         self._retry_lock = threading.Lock()
-        self.retries = 0
-        self.last_transport_error: Optional[str] = None
+        self.retries = 0  # guarded-by: _retry_lock
+        self.last_transport_error: Optional[str] = None  # guarded-by: _retry_lock
         # Serializes the FIRST server-side-apply attempt while
-        # ssa_supported is unknown (the once-per-client capability probe).
-        self._ssa_probe_lock = threading.Lock()
+        # ssa_supported is unknown (the once-per-client capability probe)
+        # AND guards the sticky flag itself. Reentrant: the probing
+        # thread holds it across its round trip and then writes the
+        # answer through it.
+        self._ssa_probe_lock = threading.RLock()
 
     # ------------------------------------------------------------ transport
 
@@ -801,15 +831,21 @@ class Client:
         probe lock through its round trip, so a concurrent first tier
         cannot fan N probe requests at an apiserver that will 415 them
         all."""
-        if self.ssa_supported is None:
-            with self._ssa_probe_lock:
-                if self.ssa_supported is None:
-                    return self._apply_ssa_once(obj, force, manager)
+        with self._ssa_probe_lock:
+            if self.ssa_supported is None:
+                # capability unknown: probe while HOLDING the lock, so a
+                # concurrent first tier serializes on one probe request
+                return self._apply_ssa_once(obj, force, manager)
         return self._apply_ssa_once(obj, force, manager)
 
     def _apply_ssa_once(self, obj: Dict[str, Any], force: bool,
                         manager: str) -> Tuple[str, Dict[str, Any]]:
-        if self.ssa_supported is False:
+        # one flag read per call; the sticky-True fast path below skips
+        # the post-success write so the steady state costs the worker
+        # pool two brief uncontended acquisitions, not three
+        with self._ssa_probe_lock:
+            supported = self.ssa_supported
+        if supported is False:
             raise SSAUnsupportedError(
                 f"{self.base_url} does not support server-side apply "
                 "(previous apply patch answered 415/400)")
@@ -825,7 +861,8 @@ class Client:
             # re-sends the same object via POST/PATCH, which surfaces
             # the REAL 400 terminally; in strict ssa mode the error
             # below carries the server's message for triage.
-            self.ssa_supported = False
+            with self._ssa_probe_lock:
+                self.ssa_supported = False
             raise SSAUnsupportedError(
                 f"PATCH {path}: {code} "
                 f"{(resp or {}).get('message', resp)} — server-side "
@@ -843,7 +880,9 @@ class Client:
                 f"(another field manager owns contested fields): {detail}")
         if code not in (200, 201):
             raise ApplyError(f"SSA PATCH {path}: {code} {resp}")
-        self.ssa_supported = True
+        if supported is not True:
+            with self._ssa_probe_lock:
+                self.ssa_supported = True
         return ("created" if code == 201 else "patched"), resp
 
     def apply_ssa(self, obj: Dict[str, Any], force: bool = True,
@@ -985,8 +1024,8 @@ class Client:
     def _poll_ready(self, pending: List[Dict[str, Any]], deadline: float,
                     poll: float, allow_empty_daemonsets: bool,
                     stats: Dict[str, Any],
-                    lock: Any,  # threading.Lock (factory fn
-                                # in typeshed < 3.13)
+                    lock: LockLike,  # guards ``stats`` (shared with the
+                                     # per-collection watcher threads)
                     started: Optional[float] = None) -> None:
         """The tick loop shared by poll-mode wait_ready and the watch
         mode's per-collection degradation path."""
@@ -1088,7 +1127,7 @@ class Client:
                                 deadline: float, poll: float,
                                 allow_empty_daemonsets: bool,
                                 stats: Dict[str, Any],
-                                lock: Any,  # threading.Lock
+                                lock: LockLike,  # guards ``stats``
                                 started: Optional[float] = None) -> None:
         """Event-driven readiness for one collection: LIST once, then hold
         one watch stream from the LIST's resourceVersion until every
@@ -1719,17 +1758,36 @@ class _ModeState:
     pool. The only transition is the one-way sticky downgrade ssa ->
     merge when the server answers the first apply patch with 415/400;
     ``strict`` (apply_mode="ssa", or a journal resumed in ssa) forbids
-    even that — the SSAUnsupportedError surfaces instead."""
+    even that — the SSAUnsupportedError surfaces instead.
+
+    Shared MUTABLE state: the downgrade is decided on whichever worker
+    thread's apply hit the 415 while the rest of the tier reads the mode
+    concurrently, so the fields live behind a lock and callers go
+    through :meth:`current`/:meth:`downgrade`/:meth:`pop_downgrade`
+    (``strict`` is immutable after construction and stays bare)."""
 
     def __init__(self, mode: str, strict: bool) -> None:
-        self.mode = mode
+        self._lock = threading.Lock()
+        self._mode = mode  # guarded-by: _lock
         self.strict = strict
-        self.downgraded: Optional[str] = None  # reason, logged once
+        self._downgraded: Optional[str] = None  # guarded-by: _lock
+
+    def current(self) -> str:
+        with self._lock:
+            return self._mode
 
     def downgrade(self, reason: str) -> None:
-        self.mode = "merge"
-        if self.downgraded is None:
-            self.downgraded = reason
+        with self._lock:
+            self._mode = "merge"
+            if self._downgraded is None:
+                self._downgraded = reason
+
+    def pop_downgrade(self) -> Optional[str]:
+        """The pending downgrade reason, cleared — so the rollout logs
+        it exactly once."""
+        with self._lock:
+            reason, self._downgraded = self._downgraded, None
+            return reason
 
 
 def _resolve_apply_mode(client: Client, apply_mode: str,
@@ -1764,7 +1822,9 @@ def _resolve_apply_mode(client: Client, apply_mode: str,
     if apply_mode == "merge":
         return _ModeState("merge", strict=True)
     if apply_mode == "auto":
-        if client.ssa_supported is False:
+        with client._ssa_probe_lock:
+            known_unsupported = client.ssa_supported is False
+        if known_unsupported:
             return _ModeState("merge", strict=False)
         return _ModeState("ssa", strict=False)
     return _ModeState("ssa", strict=True)  # explicit ssa
@@ -1774,7 +1834,7 @@ def _apply_with_mode(client: Client, obj: Dict[str, Any],
                      state: _ModeState) -> str:
     """One object through the resolved mode: server-side apply, or the
     GET+merge-PATCH path (requested, or the sticky 415/400 fallback)."""
-    if state.mode == "ssa":
+    if state.current() == "ssa":
         try:
             return client.apply_ssa(obj)
         except SSAUnsupportedError as exc:
@@ -1786,10 +1846,10 @@ def _apply_with_mode(client: Client, obj: Dict[str, Any],
 
 def _log_downgrade_once(state: _ModeState,
                         log: Callable[[str], None]) -> None:
-    if state.downgraded is not None:
+    reason = state.pop_downgrade()
+    if reason is not None:
         log("server-side apply unavailable; this rollout continues via "
-            f"GET+merge-PATCH ({state.downgraded})")
-        state.downgraded = None
+            f"GET+merge-PATCH ({reason})")
 
 
 def apply_groups(client: Client, groups: Sequence[Sequence[Dict[str, Any]]],
@@ -1854,7 +1914,7 @@ def apply_groups(client: Client, groups: Sequence[Sequence[Dict[str, Any]]],
                 # connections must not outlive them in the Client's pool
                 client.reap_other_connections()
                 if rollout_span is not None:
-                    rollout_span.annotate("apply_mode", mode_state.mode)
+                    rollout_span.annotate("apply_mode", mode_state.current())
         for i, group in enumerate(groups):
             if journal is not None and journal.is_group_done(i):
                 log(f"group {i + 1}/{len(groups)} already complete "
@@ -1884,7 +1944,7 @@ def apply_groups(client: Client, groups: Sequence[Sequence[Dict[str, Any]]],
                         result.actions.append(f"{action} {name}")
                         log(f"{action} {name}")
                         if journal is not None:
-                            journal.set_mode(mode_state.mode)
+                            journal.set_mode(mode_state.current())
                             journal.object_done(obj, i)
                 result.timings["apply"] += time.monotonic() - t0
                 # CRD establishment is a correctness gate for the NEXT
@@ -1915,8 +1975,8 @@ def apply_groups(client: Client, groups: Sequence[Sequence[Dict[str, Any]]],
                 # records above still make that resume cheap)
                 journal.group_done(i)
         if rollout_span is not None:
-            rollout_span.annotate("apply_mode", mode_state.mode)
-    result.apply_mode = mode_state.mode
+            rollout_span.annotate("apply_mode", mode_state.current())
+    result.apply_mode = mode_state.current()
     return result
 
 
@@ -1939,7 +1999,7 @@ def _group_tiers(group: Sequence[Dict[str, Any]]
 
 def _apply_one_cached(client: Client, obj: Dict[str, Any],
                       cache: Dict[str, Dict[str, Dict[str, Any]]],
-                      cache_lock: Any,  # threading.Lock
+                      cache_lock: LockLike,  # guards ``cache``
                       mode_state: _ModeState,
                       parent_span: Optional[_telemetry.Span] = None) -> str:
     """Span-wrapped :func:`_apply_one_uncounted`: one "apply" span per
@@ -1958,13 +2018,13 @@ def _apply_one_cached(client: Client, obj: Dict[str, Any],
             tel.counter(_telemetry.UNCHANGED_TOTAL,
                         "re-applies skipped as provably no-op "
                         "(ssa = exact managedFields check)",
-                        mode=mode_state.mode).inc()
+                        mode=mode_state.current()).inc()
         return action
 
 
 def _apply_one_uncounted(client: Client, obj: Dict[str, Any],
                          cache: Dict[str, Dict[str, Dict[str, Any]]],
-                         cache_lock: Any,  # threading.Lock
+                         cache_lock: LockLike,  # guards ``cache``
                          mode_state: _ModeState) -> str:
     """Apply one object against the shared live-object cache.
 
@@ -1981,7 +2041,7 @@ def _apply_one_uncounted(client: Client, obj: Dict[str, Any],
     name = obj["metadata"]["name"]
     with cache_lock:
         live = cache.get(coll, {}).get(name)
-    if mode_state.mode == "ssa":
+    if mode_state.current() == "ssa":
         if live is not None and _ssa_is_noop(live, obj):
             return "unchanged"
         try:
@@ -2138,7 +2198,7 @@ def _apply_groups_pipelined(client: Client,
                                 result.actions.append(f"{action} {name}")
                                 log(f"{action} {name}")
                                 if journal is not None:
-                                    journal.set_mode(mode_state.mode)
+                                    journal.set_mode(mode_state.current())
                                     journal.object_done(obj, i)
                             if errors:
                                 # group barrier: nothing from group N+1
@@ -2185,5 +2245,5 @@ def _apply_groups_pipelined(client: Client,
                 # converged-only, like the sequential engine: submit
                 # without readiness must never be resumed as complete
                 journal.group_done(i)
-    result.apply_mode = mode_state.mode
+    result.apply_mode = mode_state.current()
     return result

@@ -20,13 +20,18 @@ from . import deviceplugin_pb2 as pb
 class FakeKubelet:
     def __init__(self, socket_path: str):
         self.socket_path = socket_path
-        self.requests: List[pb.RegisterRequest] = []
+        self._lock = threading.Lock()
+        # appended by the grpc server's worker threads, read by the test
+        # thread (after wait_for_register's Event synchronization — but
+        # the lock keeps a late duplicate Register from racing the read)
+        self.requests: List[pb.RegisterRequest] = []  # guarded-by: _lock
         self.event = threading.Event()
         self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
 
         def register(request_bytes, context):
             req = pb.RegisterRequest.FromString(request_bytes)
-            self.requests.append(req)
+            with self._lock:
+                self.requests.append(req)
             self.event.set()
             return pb.Empty()
 

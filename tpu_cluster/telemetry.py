@@ -112,7 +112,7 @@ class Counter:
 
     def __init__(self) -> None:
         self._lock: Any = threading.Lock()
-        self._value = 0.0
+        self._value = 0.0  # guarded-by: _lock
 
     def inc(self, n: float = 1.0) -> None:
         if n < 0:
@@ -131,7 +131,7 @@ class Gauge:
 
     def __init__(self) -> None:
         self._lock: Any = threading.Lock()
-        self._value = 0.0
+        self._value = 0.0  # guarded-by: _lock
 
     def set(self, v: float) -> None:
         with self._lock:
@@ -159,9 +159,10 @@ class Histogram:
                              f"{buckets}")
         self.buckets = tuple(float(b) for b in buckets)
         self._lock: Any = threading.Lock()
-        self.counts = [0] * (len(self.buckets) + 1)  # +1 = the +Inf bucket
-        self.sum = 0.0
-        self.count = 0
+        # +1 = the +Inf bucket
+        self.counts = [0] * (len(self.buckets) + 1)  # guarded-by: _lock
+        self.sum = 0.0  # guarded-by: _lock
+        self.count = 0  # guarded-by: _lock
 
     def observe(self, v: float) -> None:
         idx = len(self.buckets)  # +Inf unless a bound catches it
@@ -176,13 +177,19 @@ class Histogram:
 
     def cumulative(self) -> List[int]:
         """Cumulative per-bucket counts, ``le`` encoding (last == count)."""
+        return self.snapshot()[0]
+
+    def snapshot(self) -> Tuple[List[int], float]:
+        """(cumulative bucket counts, sum) read under ONE lock hold, so
+        a concurrent observe() cannot skew the rendered sum against the
+        rendered count (``cumulative[-1]`` IS the observation count)."""
         out: List[int] = []
         total = 0
         with self._lock:
             for c in self.counts:
                 total += c
                 out.append(total)
-        return out
+            return out, self.sum
 
 
 class _Family:
@@ -192,7 +199,11 @@ class _Family:
         self.mtype = mtype
         self.help = help_text
         self.buckets = buckets
-        self.children: Dict[LabelPairs, Any] = {}
+        # labeled children, created on demand under the OWNING
+        # registry's lock (a _Family never leaves its registry; named
+        # `series` so it cannot collide with Span.children's guarded-by
+        # discipline in this module)
+        self.series: Dict[LabelPairs, Any] = {}
 
 
 class MetricsRegistry:
@@ -200,7 +211,7 @@ class MetricsRegistry:
 
     def __init__(self) -> None:
         self._lock: Any = threading.Lock()
-        self._families: Dict[str, _Family] = {}
+        self._families: Dict[str, _Family] = {}  # guarded-by: _lock
 
     def _child(self, name: str, mtype: str, help_text: str,
                labels: Dict[str, str],
@@ -221,7 +232,7 @@ class MetricsRegistry:
                 raise ValueError(
                     f"histogram {name} already registered with buckets "
                     f"{fam.buckets}, not {tuple(buckets)}")
-            child = fam.children.get(key)
+            child = fam.series.get(key)
             if child is None:
                 if mtype == "counter":
                     child = Counter()
@@ -229,7 +240,7 @@ class MetricsRegistry:
                     child = Gauge()
                 else:
                     child = Histogram(fam.buckets)
-                fam.children[key] = child
+                fam.series[key] = child
             return child
 
     def counter(self, name: str, help_text: str = "",
@@ -261,14 +272,17 @@ class MetricsRegistry:
             fam = self._families.get(name)
             if fam is None:
                 return 0.0
-            children = list(fam.children.items())
+            children = list(fam.series.items())
         want = set(label_filter.items())
         out = 0.0
         for key, child in children:
             if not want <= set(key):
                 continue
             if isinstance(child, Histogram):
-                out += child.count
+                # observation count under the histogram's own lock —
+                # totals race with concurrent observe() otherwise
+                with child._lock:
+                    out += child.count
             else:
                 out += float(child.value)
         return out
@@ -283,12 +297,20 @@ class MetricsRegistry:
             if fam.help:
                 lines.append(f"# HELP {name} {fam.help}")
             lines.append(f"# TYPE {name} {fam.mtype}")
-            for key in sorted(fam.children):
-                child = fam.children[key]
+            with self._lock:
+                # the series dict grows under the registry lock; copy
+                # under it so a concurrent labeled-child creation cannot
+                # mutate the dict mid-iteration
+                series = sorted(fam.series.items())
+            for key, child in series:
                 label_text = ",".join(
                     f'{k}="{_escape(v)}"' for k, v in key)
                 if isinstance(child, Histogram):
-                    cum = child.cumulative()
+                    # one consistent snapshot per histogram: cumulative
+                    # buckets, sum and count must agree with each other
+                    # even while another thread observes
+                    cum, h_sum = child.snapshot()
+                    h_count = cum[-1]  # +Inf cumulative == total count
                     for bound, c in zip(child.buckets, cum):
                         b_labels = ",".join(filter(None, [
                             label_text, f'le="{_fmt(bound)}"']))
@@ -297,10 +319,10 @@ class MetricsRegistry:
                     inf_labels = ",".join(filter(None,
                                                  [label_text, 'le="+Inf"']))
                     lines.append(f"{name}_bucket{{{inf_labels}}} "
-                                 f"{child.count}")
+                                 f"{h_count}")
                     suffix = f"{{{label_text}}}" if label_text else ""
-                    lines.append(f"{name}_sum{suffix} {_fmt(child.sum)}")
-                    lines.append(f"{name}_count{suffix} {child.count}")
+                    lines.append(f"{name}_sum{suffix} {_fmt(h_sum)}")
+                    lines.append(f"{name}_count{suffix} {h_count}")
                 else:
                     suffix = f"{{{label_text}}}" if label_text else ""
                     lines.append(f"{name}{suffix} {_fmt(child.value)}")
@@ -324,12 +346,16 @@ class Span:
         self.name = name
         self.cat = cat
         self.parent = parent
-        self.args: Dict[str, Any] = dict(args)
+        # args/children/events mutate after publication (annotate() from
+        # the owning thread, child attachment from ANY thread via
+        # explicit parent=) — all three share the tracer's lock
+        self.args: Dict[str, Any] = dict(args)  # guarded-by: tracer.lock
         self.start_s = time.monotonic() - tracer.t0
         self.end_s: Optional[float] = None
         self.tid = threading.get_ident()
-        self.children: List[Span] = []
+        self.children: List[Span] = []  # guarded-by: tracer.lock
         # (name, offset_s, args) instant events within this span
+        # guarded-by: tracer.lock
         self.events: List[Tuple[str, float, Dict[str, Any]]] = []
 
     def annotate(self, key: str, value: Any) -> None:
@@ -387,8 +413,8 @@ class Tracer:
         # aligned on wall-clock time
         self.epoch = time.time()
         self.lock: Any = threading.Lock()
-        self.roots: List[Span] = []
-        self._tls = threading.local()
+        self.roots: List[Span] = []  # guarded-by: lock
+        self._tls = threading.local()  # thread-owned (per-thread stack)
 
     # ---------------------------------------------------- span lifecycle
 
@@ -419,10 +445,14 @@ class Tracer:
         if parent is None:
             parent = self.current()
         span = Span(self, name, cat, parent, args)
-        with self.lock:
-            if parent is not None:
+        if parent is not None:
+            # phrased receiver-locally (parent.tracer IS this tracer):
+            # child attachment happens under the lock guarding
+            # Span.children, whichever thread performs it
+            with parent.tracer.lock:
                 parent.children.append(span)
-            else:
+        else:
+            with self.lock:
                 self.roots.append(span)
         return span
 
@@ -455,7 +485,7 @@ class Tracer:
         while stack:
             span = stack.pop()
             yield span
-            with self.lock:
+            with span.tracer.lock:
                 stack.extend(span.children)
 
     def chrome_trace(self) -> Dict[str, Any]:
@@ -469,8 +499,13 @@ class Tracer:
         events: List[Dict[str, Any]] = []
         now = time.monotonic() - self.t0
         for span in self.walk():
+            # copy the mutable span state under its lock: exporting a
+            # LIVE trace (the failure path writes mid-rollout) must not
+            # race annotate()/event() on still-running spans
+            with span.tracer.lock:
+                args = dict(span.args)
+                span_events = list(span.events)
             end = span.end_s if span.end_s is not None else now
-            args = dict(span.args)
             if span.end_s is None:
                 args["unfinished"] = True
             events.append({
@@ -479,7 +514,7 @@ class Tracer:
                 "dur": round(max(0.0, end - span.start_s) * 1e6, 1),
                 "pid": 1, "tid": span.tid, "args": args,
             })
-            for ev_name, offset, ev_args in list(span.events):
+            for ev_name, offset, ev_args in span_events:
                 events.append({
                     "name": ev_name, "cat": span.cat, "ph": "i", "s": "t",
                     "ts": round(offset * 1e6, 1),

@@ -17,7 +17,7 @@ import subprocess
 import threading
 import time
 from dataclasses import dataclass
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .kubeapply import FIELD_MANAGER, OPERATOR_FIELD_MANAGER
 from .spec import ClusterSpec
@@ -74,8 +74,8 @@ class ClusterSnapshot:
                  registry: Optional[MetricsRegistry] = None):
         self._runner = runner
         self._lock = threading.Lock()
-        self._done: Dict[tuple, Tuple[int, str]] = {}
-        self._inflight: Dict[tuple, threading.Event] = {}
+        self._done: Dict[Tuple[str, ...], Tuple[int, str]] = {}  # guarded-by: _lock
+        self._inflight: Dict[Tuple[str, ...], threading.Event] = {}  # guarded-by: _lock
         self.registry = registry if registry is not None else \
             MetricsRegistry()
         self._fetch_counter = self.registry.counter(
@@ -97,9 +97,12 @@ class ClusterSnapshot:
                 event = self._inflight.get(key)
                 if event is None:
                     self._inflight[key] = threading.Event()
-                    self._fetch_counter.inc()
                     break
             event.wait()
+        # count OUTSIDE the snapshot lock: the counter has its own, and
+        # nesting the two would put the only lock-order edge in the
+        # runbook stack (the lock-order monitor pins it flat)
+        self._fetch_counter.inc()
         try:
             result = self._runner(list(argv))
         except BaseException:
@@ -139,14 +142,16 @@ class CheckResult:
         return f"[{'PASS' if self.ok else 'FAIL'}] {self.name}: {self.detail}"
 
 
-def _kubectl_json(runner: Runner, args: List[str]) -> Optional[dict]:
+def _kubectl_json(runner: Runner,
+                  args: List[str]) -> Optional[Dict[str, Any]]:
     rc, out = runner(["kubectl", *args, "-o", "json"])
     if rc != 0:
         return None
     try:
-        return json.loads(out)
+        doc = json.loads(out)
     except ValueError:
         return None
+    return doc if isinstance(doc, dict) else None
 
 
 def check_smoke(runner: Runner, spec: ClusterSpec) -> CheckResult:
@@ -257,7 +262,7 @@ def check_allocatable(runner: Runner, spec: ClusterSpec) -> CheckResult:
                        f"{resource}={want} on {sorted(good)}")
 
 
-def _trailing_json_object(text: str) -> Optional[dict]:
+def _trailing_json_object(text: str) -> Optional[Dict[str, Any]]:
     """Parse the JSON object at the tail of mixed pod logs: kubectl merges
     stdout with stderr warnings (JAX/absl), so scan column-0 '{' lines from
     the last one backwards until a parse succeeds."""
@@ -274,7 +279,7 @@ def _trailing_json_object(text: str) -> Optional[dict]:
     return None
 
 
-def _job_status(check: str, job: str, doc: dict) -> CheckResult:
+def _job_status(check: str, job: str, doc: Dict[str, Any]) -> CheckResult:
     want = (doc.get("spec") or {}).get("completions", 1)
     got = (doc.get("status") or {}).get("succeeded", 0)
     if got >= want:
@@ -419,7 +424,8 @@ def check_metrics(runner: Runner, spec: ClusterSpec) -> CheckResult:
     return CheckResult("metrics", True, line or "tpu_chips_total present")
 
 
-def fetch_policy(runner: Runner):
+def fetch_policy(
+        runner: Runner) -> Tuple[str, Optional[Dict[str, Any]]]:
     """Two-step TpuStackPolicy probe shared by :func:`check_policy` and
     ``triage`` — returns ``(state, cr)`` where state is ``"no-crd"`` /
     ``"no-cr"`` / ``"ok"`` / ``"error: ..."`` and cr is the parsed object
@@ -441,12 +447,15 @@ def fetch_policy(runner: Runner):
     if not out.strip():
         return "no-cr", None
     try:
-        return "ok", json.loads(out)
+        doc = json.loads(out)
     except ValueError:
         return "error: unparseable TpuStackPolicy JSON", None
+    if not isinstance(doc, dict):
+        return "error: unparseable TpuStackPolicy JSON", None
+    return "ok", doc
 
 
-def policy_disabled_operands(cr) -> List[str]:
+def policy_disabled_operands(cr: Optional[Dict[str, Any]]) -> List[str]:
     """Operand names the live CR's status reports as policy-disabled."""
     status = (cr or {}).get("status") or {}
     return sorted(name for name, op in (status.get("operands") or {}).items()
@@ -462,7 +471,7 @@ def policy_disabled_operands(cr) -> List[str]:
 POLICY_STATUS_GRACE_S = 300
 
 
-def _cr_age_seconds(cr) -> Optional[float]:
+def _cr_age_seconds(cr: Dict[str, Any]) -> Optional[float]:
     """Age from metadata.creationTimestamp (RFC3339 UTC); None if absent
     or unparseable."""
     ts = (cr.get("metadata") or {}).get("creationTimestamp")
@@ -493,6 +502,7 @@ def check_policy(runner: Runner, spec: ClusterSpec) -> CheckResult:
         return CheckResult("policy", True,
                            "CRD installed but 'default' CR absent — "
                            "operator fails open (all operands enabled)")
+    assert cr is not None  # state == "ok" guarantees a parsed CR
     st = cr.get("status") or {}
     gen = cr.get("metadata", {}).get("generation")
     observed = st.get("observedGeneration")
