@@ -1,0 +1,35 @@
+// promescape — Prometheus exposition-format label-value escaping,
+// shared by every native /metrics producer (the operator's status
+// server, the metrics exporter) and pinned against the Python twin
+// (tpu_cluster/telemetry.py `_escape`, tests/fake_apiserver.py
+// `prom_escape`) by native/operator/selftest.cc + tests.
+//
+// The exposition format requires backslash, double-quote and newline to
+// be escaped inside label VALUES; an unescaped dynamic value (a device
+// path, a request path) would let hostile bytes forge extra samples or
+// truncate the series identity.
+
+#ifndef TPU_NATIVE_COMMON_PROMESCAPE_H_
+#define TPU_NATIVE_COMMON_PROMESCAPE_H_
+
+#include <string>
+
+namespace promescape {
+
+inline std::string EscapeLabelValue(const std::string& v) {
+  std::string out;
+  out.reserve(v.size());
+  for (char c : v) {
+    switch (c) {
+      case '\\': out += "\\\\"; break;
+      case '"':  out += "\\\""; break;
+      case '\n': out += "\\n"; break;
+      default:   out += c;
+    }
+  }
+  return out;
+}
+
+}  // namespace promescape
+
+#endif  // TPU_NATIVE_COMMON_PROMESCAPE_H_

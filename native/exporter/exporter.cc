@@ -39,6 +39,7 @@
 #include <vector>
 
 #include "../common/devenum.h"
+#include "../common/promescape.h"
 #include "../common/promsources.h"
 #include "../common/httpread.h"
 #include "../plugin/topology.h"
@@ -297,8 +298,11 @@ std::string RenderMetrics(const Options& opt,
   os << "# HELP tpu_chip_present device node present (per chip)\n"
      << "# TYPE tpu_chip_present gauge\n";
   for (const auto& [idx, path] : chips)
-    os << "tpu_chip_present{chip=\"" << idx << "\",path=\"" << path
-       << "\"} 1\n";
+    // the path label is filesystem-controlled bytes: escape per the
+    // exposition format (promescape.h, the MetricsRegistry.render twin)
+    // so a hostile device-dir entry cannot forge extra samples
+    os << "tpu_chip_present{chip=\"" << idx << "\",path=\""
+       << promescape::EscapeLabelValue(path) << "\"} 1\n";
   if (acc) {
     os << "# HELP tpu_hbm_capacity_bytes HBM capacity per chip\n"
        << "# TYPE tpu_hbm_capacity_bytes gauge\n";

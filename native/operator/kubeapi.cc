@@ -1,6 +1,9 @@
 #include "kubeapi.h"
 
+#include <time.h>
+
 #include <map>
+#include <memory>
 
 namespace kubeapi {
 
@@ -137,6 +140,145 @@ const std::vector<std::string>& OperatorMetricNames() {
       "tpu_operator_sync_lag_seconds",
   };
   return *names;
+}
+
+const std::vector<std::string>& OperatorTraceEventNames() {
+  // Twin table of tpu_cluster/telemetry.py OPERATOR_TRACE_EVENTS (the
+  // OperatorMetricNames pattern: selftest.cc pins this side, a Python
+  // source-grep in tests/test_telemetry.py pins the equality, and CI
+  // greps the operator's emitted trace artifact for every name).
+  // operator_main.cc's trace emitter must use exactly these slice names.
+  static const auto* names = new std::vector<std::string>{
+      "reconcile-pass",
+      "apply-object",
+      "ready-wait",
+      "watch-sleep",
+      "drift-event",
+  };
+  return *names;
+}
+
+const char* TraceparentAnnotation() {
+  // Twin of tpu_cluster/telemetry.py TRACEPARENT_ANNOTATION (grep-pinned
+  // by tests; checked against selftest.cc). tpuctl stamps it on objects
+  // it mutates; renaming it here orphans the correlation the merged
+  // timeline exists for.
+  return "tpu-stack.dev/traceparent";
+}
+
+std::pair<std::string, std::string> ParseTraceparent(
+    const std::string& header) {
+  // 00-<32 hex>-<16 hex>-<2 hex>; anything malformed (or the reserved
+  // all-zero ids) parses to ("", "") — a server/operator must tolerate
+  // garbage headers and annotations.
+  auto fail = std::make_pair(std::string(), std::string());
+  size_t d1 = header.find('-');
+  if (d1 == std::string::npos) return fail;
+  size_t d2 = header.find('-', d1 + 1);
+  if (d2 == std::string::npos) return fail;
+  size_t d3 = header.find('-', d2 + 1);
+  if (d3 == std::string::npos) return fail;
+  if (header.find('-', d3 + 1) != std::string::npos) return fail;
+  std::string trace_id = header.substr(d1 + 1, d2 - d1 - 1);
+  std::string parent_id = header.substr(d2 + 1, d3 - d2 - 1);
+  if (trace_id.size() != 32 || parent_id.size() != 16) return fail;
+  bool trace_zero = true, parent_zero = true;
+  for (char c : trace_id) {
+    bool hex = (c >= '0' && c <= '9') || (c >= 'a' && c <= 'f') ||
+               (c >= 'A' && c <= 'F');
+    if (!hex) return fail;
+    if (c != '0') trace_zero = false;
+  }
+  for (char c : parent_id) {
+    bool hex = (c >= '0' && c <= '9') || (c >= 'a' && c <= 'f') ||
+               (c >= 'A' && c <= 'F');
+    if (!hex) return fail;
+    if (c != '0') parent_zero = false;
+  }
+  if (trace_zero || parent_zero) return fail;
+  return {trace_id, parent_id};
+}
+
+size_t HistogramBucketIndex(double value, const double* bounds, size_t n) {
+  // Cumulative `le` semantics, the Python twin's exact comparison
+  // (telemetry.Histogram.observe: `if v <= bound`): a value EQUAL to a
+  // bound lands in that bucket, so two processes observing the same
+  // boundary value render identical bucket lines.
+  for (size_t i = 0; i < n; ++i)
+    if (value <= bounds[i]) return i;
+  return n;  // +Inf
+}
+
+TraceEmitter::TraceEmitter() {
+  epoch_ = static_cast<double>(time(nullptr));
+  clock_gettime(CLOCK_MONOTONIC, &t0_);
+}
+
+double TraceEmitter::NowUs() const {
+  struct timespec now;
+  clock_gettime(CLOCK_MONOTONIC, &now);
+  return (now.tv_sec - t0_.tv_sec) * 1e6 + (now.tv_nsec - t0_.tv_nsec) / 1e3;
+}
+
+void TraceEmitter::AddComplete(const std::string& name,
+                               const std::string& cat, double ts_us,
+                               double dur_us, const Args& args) {
+  if (events_.size() >= kMaxEvents) {
+    // bounded ring: drop the oldest quarter in one move (amortized —
+    // erasing one front element per insert would be quadratic)
+    size_t drop = kMaxEvents / 4;
+    events_.erase(events_.begin(), events_.begin() + drop);
+    dropped_ += drop;
+  }
+  events_.push_back(Event{false, name, cat, ts_us < 0 ? 0 : ts_us,
+                          dur_us < 0 ? 0 : dur_us, args});
+}
+
+void TraceEmitter::AddInstant(const std::string& name,
+                              const std::string& cat, const Args& args) {
+  if (events_.size() >= kMaxEvents) {
+    size_t drop = kMaxEvents / 4;
+    events_.erase(events_.begin(), events_.begin() + drop);
+    dropped_ += drop;
+  }
+  events_.push_back(Event{true, name, cat, NowUs(), 0, args});
+}
+
+std::string TraceEmitter::DumpChromeJson() const {
+  using minijson::Value;
+  auto arr = Value::MakeArray();
+  for (const auto& e : events_) {
+    auto ev = Value::MakeObject();
+    ev->Set("name", std::make_shared<Value>(e.name));
+    ev->Set("cat", std::make_shared<Value>(e.cat));
+    ev->Set("ph", std::make_shared<Value>(
+        std::string(e.instant ? "i" : "X")));
+    ev->Set("ts", std::make_shared<Value>(e.ts_us));
+    if (e.instant) {
+      ev->Set("s", std::make_shared<Value>(std::string("t")));
+    } else {
+      ev->Set("dur", std::make_shared<Value>(e.dur_us));
+    }
+    ev->Set("pid", std::make_shared<Value>(1.0));
+    ev->Set("tid", std::make_shared<Value>(1.0));
+    auto args = Value::MakeObject();
+    for (const auto& kv : e.args)
+      args->Set(kv.first, std::make_shared<Value>(kv.second));
+    ev->Set("args", args);
+    arr->Append(ev);
+  }
+  auto root = Value::MakeObject();
+  root->Set("traceEvents", arr);
+  root->Set("displayTimeUnit",
+            std::make_shared<Value>(std::string("ms")));
+  auto other = Value::MakeObject();
+  other->Set("producer",
+             std::make_shared<Value>(std::string("tpu-operator")));
+  other->Set("epoch", std::make_shared<Value>(epoch_));
+  other->Set("dropped_events",
+             std::make_shared<Value>(static_cast<double>(dropped_)));
+  root->Set("otherData", other);
+  return root->Dump() + "\n";
 }
 
 const std::vector<std::string>& OperandWorkloadKinds() {

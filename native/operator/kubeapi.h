@@ -5,7 +5,10 @@
 #ifndef TPU_NATIVE_OPERATOR_KUBEAPI_H_
 #define TPU_NATIVE_OPERATOR_KUBEAPI_H_
 
+#include <time.h>
+
 #include <string>
+#include <utility>
 #include <vector>
 
 #include "minijson.h"
@@ -66,6 +69,86 @@ const char* FieldManager();
 // live scrape missing any of them. Renaming a family here without its
 // twin breaks the pin before it breaks a dashboard.
 const std::vector<std::string>& OperatorMetricNames();
+
+// Chrome trace-event slice names the operator's trace emitter uses
+// (reconcile-pass / apply-object / ready-wait / watch-sleep /
+// drift-event). The C++ half of a pinned twin table:
+// tpu_cluster/telemetry.py OPERATOR_TRACE_EVENTS names the same slices,
+// pinned by selftest.cc (compiler-side), a Python source-grep in
+// tests/test_telemetry.py (compiler-free), and a CI grep over the
+// operator's emitted trace artifact. Renaming a slice here without its
+// twin breaks the pin before it breaks a merged timeline.
+const std::vector<std::string>& OperatorTraceEventNames();
+
+// The object annotation carrying an apply's W3C trace context
+// ("tpu-stack.dev/traceparent"): tpuctl stamps it on objects it
+// mutates, and the operator reads it off live objects to tag its
+// reconcile slices with the originating trace id. Twin of
+// tpu_cluster/telemetry.py TRACEPARENT_ANNOTATION (selftest +
+// source-grep pinned, the FieldManager pattern).
+const char* TraceparentAnnotation();
+
+// (trace_id, parent_id) from a W3C traceparent header value; ("", "")
+// for absent/malformed input. Twin of telemetry.parse_traceparent.
+std::pair<std::string, std::string> ParseTraceparent(
+    const std::string& header);
+
+// Histogram bucket selection shared by every native histogram render:
+// the index of the FIRST bound with value <= bound (cumulative `le`
+// semantics — a value exactly equal to a bound lands IN that bucket,
+// matching tpu_cluster.telemetry.Histogram.observe), or n for the
+// implicit +Inf bucket. Pinned against the Python twin by selftest.cc
+// and the bucket-boundary parity test in tests/test_telemetry.py.
+size_t HistogramBucketIndex(double value, const double* bounds, size_t n);
+
+// Minimal Chrome trace-event emitter — the kubeapi twin of
+// tpu_cluster/telemetry.py's Tracer export schema: ph=X complete slices
+// and ph=i instant marks with microsecond offsets from construction,
+// dumped as the JSON-object form (`{"traceEvents": [...], "otherData":
+// {"producer": "tpu-operator", "epoch": ...}}`) that `tpuctl trace
+// merge` and Perfetto load directly. BOUNDED like the CLI's flight
+// recorder: at most kMaxEvents events are retained (oldest dropped,
+// drop count surfaced in otherData) so an operator running for months
+// cannot grow an unbounded trace. Single-threaded by contract, like the
+// daemon that owns it.
+class TraceEmitter {
+ public:
+  static constexpr size_t kMaxEvents = 4096;
+
+  TraceEmitter();
+
+  // Microseconds since construction (slice timestamps).
+  double NowUs() const;
+
+  using Args = std::vector<std::pair<std::string, std::string>>;
+
+  // One ph=X complete slice [ts_us, ts_us+dur_us).
+  void AddComplete(const std::string& name, const std::string& cat,
+                   double ts_us, double dur_us, const Args& args);
+
+  // One ph=i instant mark at NowUs().
+  void AddInstant(const std::string& name, const std::string& cat,
+                  const Args& args);
+
+  // The full Chrome trace JSON document (one line, trailing newline).
+  std::string DumpChromeJson() const;
+
+  size_t size() const { return events_.size(); }
+  size_t dropped() const { return dropped_; }
+
+ private:
+  struct Event {
+    bool instant;
+    std::string name, cat;
+    double ts_us, dur_us;
+    Args args;
+  };
+
+  double epoch_;           // wall clock at t0_ (merge alignment anchor)
+  struct timespec t0_;     // monotonic zero for every ts
+  std::vector<Event> events_;
+  size_t dropped_ = 0;
+};
 
 }  // namespace kubeapi
 

@@ -76,6 +76,12 @@ struct Options {
   bool once = false;
   bool allow_empty_daemonsets = false;
   bool insecure_skip_tls_verify = false;
+  // Chrome trace-event output (ISSUE 8): when set, the operator dumps
+  // its bounded trace ring (kubeapi::TraceEmitter) here ATOMICALLY
+  // (tmp + rename) after every reconcile pass and on shutdown, so a
+  // crashed/SIGTERM'd operator still leaves a parseable post-mortem
+  // timeline `tpuctl trace merge` can lay next to the CLI's.
+  std::string trace_out;
 };
 
 // The runtime feature-flag surface (ClusterPolicy analog, reference
@@ -87,6 +93,17 @@ const char kInstanceLabel[] = "tpu-stack.dev/instance";
 const char kDefaultEnabledAnnotation[] = "tpu-stack.dev/default-enabled";
 const char kPolicyPathPrefix[] =
     "/apis/tpu-stack.dev/v1alpha1/tpustackpolicies/";
+
+// The tpu-stack.dev/traceparent annotation off an object (watch-event
+// payloads, API response bodies); "" when absent. The key contains
+// dots, so walk explicitly — no dotted-path lookup.
+std::string AnnotationTraceparent(const minijson::Value& obj) {
+  minijson::ValuePtr meta = obj.Get("metadata");
+  minijson::ValuePtr anns = meta ? meta->Get("annotations") : nullptr;
+  minijson::ValuePtr tp =
+      anns ? anns->Get(kubeapi::TraceparentAnnotation()) : nullptr;
+  return tp && tp->is_string() ? tp->as_string() : "";
+}
 
 struct BundleObject {
   std::string file;
@@ -102,6 +119,11 @@ struct BundleObject {
   bool disabled = false;  // policy-gated off this pass
   std::string error;
   std::string uid;  // live object's metadata.uid (event correlation)
+  // the tpu-stack.dev/traceparent annotation observed on the live
+  // object (stamped by the tpuctl apply that last mutated it): the
+  // trace id the operator's apply/reconcile slices carry so a merged
+  // timeline shows WHICH rollout caused this reconcile
+  std::string traceparent;
   // live object's metadata.generation as last applied/observed: the
   // drift watch's filter — a MODIFIED event with a different generation
   // is an external spec edit, an unchanged one is status churn
@@ -297,6 +319,7 @@ class Operator {
   bool ReconcilePass() {
     struct timespec t0;
     clock_gettime(CLOCK_MONOTONIC, &t0);
+    double trace_ts = trace_.NowUs();
     bool ok = ReconcileObjects();
     if (ok) {
       consecutive_failures_ = 0;
@@ -315,6 +338,13 @@ class Operator {
       clock_gettime(CLOCK_MONOTONIC, &last_sync_);
       synced_ = true;
     }
+    // one slice per pass + an atomic dump: a SIGKILL between passes
+    // still leaves the last pass's complete timeline on disk
+    trace_.AddComplete("reconcile-pass", "reconcile", trace_ts,
+                       trace_.NowUs() - trace_ts,
+                       {{"pass", std::to_string(passes_)},
+                        {"ok", ok ? "true" : "false"}});
+    DumpTrace();
     return ok;
   }
 
@@ -360,7 +390,23 @@ class Operator {
           }
           continue;
         }
-        if (!ApplyObject(&bundle_[j])) {
+        double apply_ts = trace_.NowUs();
+        bool apply_ok = ApplyObject(&bundle_[j]);
+        kubeapi::TraceEmitter::Args apply_args = {
+            {"object", bundle_[j].file},
+            {"ok", apply_ok ? "true" : "false"}};
+        if (!bundle_[j].traceparent.empty()) {
+          // the annotation tpuctl stamped on the live object: this
+          // slice now names the rollout that caused the state we are
+          // reconciling (the merged-timeline correlation pin)
+          apply_args.push_back({"traceparent", bundle_[j].traceparent});
+          apply_args.push_back(
+              {"trace_id",
+               kubeapi::ParseTraceparent(bundle_[j].traceparent).first});
+        }
+        trace_.AddComplete("apply-object", "reconcile", apply_ts,
+                           trace_.NowUs() - apply_ts, apply_args);
+        if (!apply_ok) {
           fprintf(stderr, "tpu-operator: stage %s: apply %s failed: %s\n",
                   stage.c_str(), bundle_[j].file.c_str(),
                   bundle_[j].error.c_str());
@@ -373,6 +419,13 @@ class Operator {
       // gate on readiness of the stage's workload objects (helm --wait
       // analog, reference README.md:101); disabled objects don't gate
       time_t deadline = time(nullptr) + opt_.stage_timeout_s;
+      double gate_ts = trace_.NowUs();
+      auto gate_slice = [&](bool gate_ok) {
+        trace_.AddComplete("ready-wait", "reconcile", gate_ts,
+                           trace_.NowUs() - gate_ts,
+                           {{"stage", stage},
+                            {"ok", gate_ok ? "true" : "false"}});
+      };
       while (!g_stop) {
         bool all_ready = true;
         for (size_t j = i; j < stage_end; ++j) {
@@ -380,7 +433,10 @@ class Operator {
           if (!bundle_[j].ready && !CheckReady(&bundle_[j]))
             all_ready = false;
         }
-        if (all_ready) break;
+        if (all_ready) {
+          gate_slice(true);
+          break;
+        }
         if (time(nullptr) >= deadline) {
           for (size_t j = i; j < stage_end; ++j) {
             if (!bundle_[j].ready && !bundle_[j].disabled) {
@@ -396,6 +452,7 @@ class Operator {
                         bundle_[j]);
             }
           }
+          gate_slice(false);
           return false;
         }
         Sleep(opt_.poll_ms);
@@ -827,6 +884,8 @@ class Operator {
                 "reconciling now\n", name.c_str(),
                 it == live.end() ? "deleted mid-pass"
                                  : "generation changed mid-pass");
+        trace_.AddInstant("drift-event", "watch",
+                          {{"object", name}, {"via", "catch-up-list"}});
         return true;
       }
     }
@@ -963,6 +1022,9 @@ class Operator {
               if (!policy_missing_) {
                 fprintf(stderr, "tpu-operator: policy %s deleted (watch); "
                         "reconciling now\n", opt_.policy.c_str());
+                trace_.AddInstant("drift-event", "watch",
+                                  {{"object", opt_.policy},
+                                   {"via", "policy-watch"}});
                 return true;
               }
               break;
@@ -989,6 +1051,15 @@ class Operator {
                       "tpu-operator: policy %s changed (watch event, "
                       "generation %.0f -> %.0f); reconciling now\n",
                       opt_.policy.c_str(), policy_generation_, gen);
+              kubeapi::TraceEmitter::Args dargs = {
+                  {"object", opt_.policy}, {"via", "policy-watch"}};
+              std::string tp = obj ? AnnotationTraceparent(*obj) : "";
+              if (!tp.empty()) {
+                dargs.push_back({"traceparent", tp});
+                dargs.push_back(
+                    {"trace_id", kubeapi::ParseTraceparent(tp).first});
+              }
+              trace_.AddInstant("drift-event", "watch", dargs);
               return true;
             }
             break;
@@ -1060,6 +1131,9 @@ class Operator {
             fprintf(stderr,
                     "tpu-operator: operand drift (%s deleted, watch "
                     "event); reconciling now\n", name.c_str());
+            trace_.AddInstant("drift-event", "watch",
+                              {{"object", name},
+                               {"via", "operand-watch"}});
             return true;
           }
           double gen = ev->PathNumber("object.metadata.generation", 0);
@@ -1071,6 +1145,17 @@ class Operator {
                     "tpu-operator: operand drift (%s generation "
                     "%.0f -> %.0f, watch event); reconciling now\n",
                     name.c_str(), it->second, gen);
+            kubeapi::TraceEmitter::Args dargs = {
+                {"object", name}, {"via", "operand-watch"}};
+            std::string tp = AnnotationTraceparent(*obj);
+            if (!tp.empty()) {
+              // the spec edit's OWN trace context (a tpuctl re-apply):
+              // the repair attributes straight back to its cause
+              dargs.push_back({"traceparent", tp});
+              dargs.push_back(
+                  {"trace_id", kubeapi::ParseTraceparent(tp).first});
+            }
+            trace_.AddInstant("drift-event", "watch", dargs);
             return true;
           }
         }
@@ -1156,7 +1241,12 @@ class Operator {
     bool operand_stream = opt_.operand_watch && healthy_ &&
                           !OwnedWorkloadCollections().empty();
     if (policy_stream || operand_stream) {
-      if (SleepOnWatches(&left, bundle_fp, policy_stream)) return;
+      double ws_ts = trace_.NowUs();
+      bool handled = SleepOnWatches(&left, bundle_fp, policy_stream);
+      trace_.AddComplete("watch-sleep", "watch", ws_ts,
+                         trace_.NowUs() - ws_ts,
+                         {{"handled", handled ? "true" : "false"}});
+      if (handled) return;
       if (left <= 0 || g_stop) return;
     }
     while (left > 0 && !g_stop) {
@@ -1226,13 +1316,11 @@ class Operator {
       sizeof(kReconcileBucketsS) / sizeof(kReconcileBucketsS[0]);
 
   void ObserveReconcileSeconds(double secs) {
-    size_t idx = kReconcileBuckets;  // +Inf unless a bound catches it
-    for (size_t i = 0; i < kReconcileBuckets; ++i) {
-      if (secs <= kReconcileBucketsS[i]) {
-        idx = i;
-        break;
-      }
-    }
+    // shared bucket math (kubeapi::HistogramBucketIndex, selftest- and
+    // parity-pinned): a value EXACTLY equal to a bound lands in that
+    // bucket on both sides of the Python/C++ twin
+    size_t idx = kubeapi::HistogramBucketIndex(secs, kReconcileBucketsS,
+                                               kReconcileBuckets);
     ++reconcile_counts_[idx];
     reconcile_sum_s_ += secs;
     ++reconcile_count_;
@@ -1316,6 +1404,37 @@ class Operator {
 
   bool healthy() const { return healthy_; }
   void set_healthy(bool h) { healthy_ = h; }
+
+  // Atomically rewrite --trace-out from the bounded trace ring (tmp +
+  // rename, the journal's torn-tail discipline): a SIGKILL at any
+  // instant leaves the previous dump or the complete new one, never
+  // torn JSON. Best-effort — an unwritable path must not fail a pass.
+  void DumpTrace() {
+    if (opt_.trace_out.empty()) return;
+    // mkstemp, not a predictable ".tmp" sibling: a fixed scratch name
+    // in a shared directory is symlink-plantable (CWE-377) — the same
+    // discipline the Python twin's _atomic_write keeps
+    std::string tmp = opt_.trace_out + ".XXXXXX";
+    int fd = mkstemp(&tmp[0]);
+    if (fd < 0) return;
+    std::string doc = trace_.DumpChromeJson();
+    size_t off = 0;
+    bool ok = true;
+    while (off < doc.size()) {
+      ssize_t n = write(fd, doc.data() + off, doc.size() - off);
+      if (n <= 0) {
+        ok = false;
+        break;
+      }
+      off += static_cast<size_t>(n);
+    }
+    fsync(fd);
+    close(fd);
+    if (ok)
+      rename(tmp.c_str(), opt_.trace_out.c_str());
+    else
+      remove(tmp.c_str());
+  }
 
  private:
   // The /healthz body: "ok" when converged; otherwise the degraded-state
@@ -1560,6 +1679,10 @@ class Operator {
       if (!uid.empty()) bo->uid = uid;
       double gen = live->PathNumber("metadata.generation", 0);
       if (gen > 0) bo->generation = gen;
+      // the tpuctl-stamped trace context, if the live object carries
+      // one — this pass's apply-object slice names it
+      std::string tp = AnnotationTraceparent(*live);
+      if (!tp.empty()) bo->traceparent = tp;
     }
   }
 
@@ -1681,6 +1804,9 @@ class Operator {
   kubeclient::Config cfg_;
   std::vector<BundleObject> bundle_;
   StatusServer status_;
+  // trace emitter (ISSUE 8): reconcile/apply/gate/watch slices, bounded
+  // ring, dumped to --trace-out after each pass (see DumpTrace)
+  kubeapi::TraceEmitter trace_;
   // Sticky server-side-apply capability (probed by the first apply of
   // the process): once an apply PATCH answers 415/400, every later
   // ApplyObject uses the GET+merge-PATCH path without re-probing.
@@ -1740,6 +1866,7 @@ int main(int argc, char** argv) {
     if (FlagVal(a, "--token-file", &opt.token_file)) continue;
     if (FlagVal(a, "--ca-file", &opt.ca_file)) continue;
     if (FlagVal(a, "--bundle-dir", &opt.bundle_dir)) continue;
+    if (FlagVal(a, "--trace-out", &opt.trace_out)) continue;
     if (FlagVal(a, "--policy", &opt.policy)) continue;
     if (FlagVal(a, "--policy-poll-ms", &sval)) {
       opt.policy_poll_ms = atoi(sval.c_str());
@@ -1778,7 +1905,8 @@ int main(int argc, char** argv) {
             "tpu-operator: unknown flag %s\n"
             "usage: tpu-operator [--apiserver=URL] [--token-file=F] "
             "[--ca-file=F]\n"
-            "  [--bundle-dir=DIR] [--policy=NAME] [--policy-poll-ms=MS]\n"
+            "  [--bundle-dir=DIR] [--trace-out=PATH] [--policy=NAME]\n"
+            "  [--policy-poll-ms=MS]\n"
             "  [--no-policy-watch] [--no-operand-watch]\n"
             "  [--interval=SECS] [--stage-timeout=SECS]\n"
             "  [--poll-ms=MS] [--status-port=PORT] [--once]\n"
@@ -1835,9 +1963,13 @@ int main(int argc, char** argv) {
     op.set_healthy(ok);
     printf("%s", op.StatusJson().c_str());
     op.ReleaseLease();
+    op.DumpTrace();
     return ok ? 0 : 1;
   }
   op.RunForever();
   op.ReleaseLease();
+  // SIGTERM lands here (g_stop): the final dump carries the last
+  // watch-sleep/drift slices that no pass followed
+  op.DumpTrace();
   return 0;
 }

@@ -4,6 +4,7 @@
 #include <stdio.h>
 #include <string.h>
 
+#include "../common/promescape.h"
 #include "kubeapi.h"
 #include "kubeclient.h"
 #include "minijson.h"
@@ -278,6 +279,127 @@ static void TestOperatorMetricNamesTwinTable() {
   }
 }
 
+static void TestOperatorTraceEventNamesTwinTable() {
+  // Pinned twin table (OperatorMetricNames pattern): the Chrome
+  // trace-event slice names the operator's emitter uses —
+  // tpu_cluster/telemetry.py OPERATOR_TRACE_EVENTS names the same set,
+  // tests/test_telemetry.py greps THIS table out of kubeapi.cc, and CI
+  // greps the emitted trace artifact. A rename lands here before it
+  // lands on a broken merged timeline.
+  const auto& names = kubeapi::OperatorTraceEventNames();
+  CHECK(names.size() == 5);
+  auto has = [&](const char* want) {
+    for (const auto& n : names)
+      if (n == want) return true;
+    return false;
+  };
+  CHECK(has("reconcile-pass"));
+  CHECK(has("apply-object"));
+  CHECK(has("ready-wait"));
+  CHECK(has("watch-sleep"));
+  CHECK(has("drift-event"));
+  for (size_t i = 0; i < names.size(); ++i)
+    for (size_t j = i + 1; j < names.size(); ++j)
+      CHECK(names[i] != names[j]);
+  // every pinned name must appear in operator_main.cc's emitter calls —
+  // the Python grep re-checks this compiler-free
+}
+
+static void TestTraceparentTwinsAndParsing() {
+  // The annotation name twin (FieldManager pattern): tpuctl stamps it,
+  // the operator reads it — kubeapply/telemetry pin the same string.
+  CHECK(strcmp(kubeapi::TraceparentAnnotation(),
+               "tpu-stack.dev/traceparent") == 0);
+  // W3C traceparent parsing: twin of telemetry.parse_traceparent.
+  auto ok = kubeapi::ParseTraceparent(
+      "00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01");
+  CHECK(ok.first == "0af7651916cd43dd8448eb211c80319c");
+  CHECK(ok.second == "b7ad6b7169203331");
+  CHECK(kubeapi::ParseTraceparent("").first.empty());
+  CHECK(kubeapi::ParseTraceparent("garbage").first.empty());
+  CHECK(kubeapi::ParseTraceparent("00-short-b7ad6b7169203331-01")
+            .first.empty());
+  CHECK(kubeapi::ParseTraceparent(  // reserved all-zero trace id
+            "00-00000000000000000000000000000000-b7ad6b7169203331-01")
+            .first.empty());
+  CHECK(kubeapi::ParseTraceparent(  // non-hex bytes
+            "00-0af7651916cd43dd8448eb211c80319z-b7ad6b7169203331-01")
+            .first.empty());
+  CHECK(kubeapi::ParseTraceparent(  // trailing extra segment
+            "00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01-xx")
+            .first.empty());
+}
+
+static void TestHistogramBucketBoundary() {
+  // Bucket-boundary parity pin (the ISSUE 8 satellite): a value EXACTLY
+  // equal to a `le` bound lands IN that bucket — the same `v <= bound`
+  // comparison telemetry.Histogram.observe uses, so Python and C++
+  // renders of the same observations are bucket-for-bucket identical.
+  const double bounds[] = {0.01, 0.1, 1.0};
+  CHECK(kubeapi::HistogramBucketIndex(0.005, bounds, 3) == 0);
+  CHECK(kubeapi::HistogramBucketIndex(0.01, bounds, 3) == 0);   // == le
+  CHECK(kubeapi::HistogramBucketIndex(0.0100001, bounds, 3) == 1);
+  CHECK(kubeapi::HistogramBucketIndex(0.1, bounds, 3) == 1);    // == le
+  CHECK(kubeapi::HistogramBucketIndex(1.0, bounds, 3) == 2);    // == le
+  CHECK(kubeapi::HistogramBucketIndex(1.5, bounds, 3) == 3);    // +Inf
+  CHECK(kubeapi::HistogramBucketIndex(-1.0, bounds, 3) == 0);
+}
+
+static void TestPromEscapeLabelValue() {
+  // Seeded-hostile-label pin (exposition-format escaping; the
+  // MetricsRegistry.render twin): backslash, double quote and newline
+  // must escape, everything else passes through byte-identical.
+  CHECK(promescape::EscapeLabelValue("plain-value_1") == "plain-value_1");
+  CHECK(promescape::EscapeLabelValue("say \"hi\"") == "say \\\"hi\\\"");
+  CHECK(promescape::EscapeLabelValue("a\\b") == "a\\\\b");
+  CHECK(promescape::EscapeLabelValue("line1\nline2") == "line1\\nline2");
+  CHECK(promescape::EscapeLabelValue("\\\"\n") == "\\\\\\\"\\n");
+  CHECK(promescape::EscapeLabelValue("") == "");
+}
+
+static void TestTraceEmitter() {
+  // The kubeapi twin of telemetry.py's Chrome-JSON schema: slices and
+  // instants dump as a parseable trace-event document with the keys
+  // Perfetto / `tpuctl trace merge` need.
+  kubeapi::TraceEmitter t;
+  t.AddComplete("reconcile-pass", "reconcile", 100.0, 2500.0,
+                {{"pass", "1"}, {"ok", "true"}});
+  t.AddComplete("apply-object", "reconcile", 200.0, 30.0,
+                {{"object", "20-plugin--daemonset.json"},
+                 {"traceparent",
+                  "00-0af7651916cd43dd8448eb211c80319c-"
+                  "b7ad6b7169203331-01"}});
+  t.AddInstant("drift-event", "watch", {{"object", "tpud"}});
+  CHECK(t.size() == 3);
+  std::string err;
+  minijson::ValuePtr doc = minijson::Parse(t.DumpChromeJson(), &err);
+  CHECK(doc && err.empty());
+  minijson::ValuePtr events = doc->Get("traceEvents");
+  CHECK(events && events->is_array() && events->elements().size() == 3);
+  const auto& first = events->elements()[0];
+  CHECK(first->PathString("name") == "reconcile-pass");
+  CHECK(first->PathString("ph") == "X");
+  CHECK(first->PathNumber("ts", -1) == 100.0);
+  CHECK(first->PathNumber("dur", -1) == 2500.0);
+  CHECK(first->PathNumber("pid", 0) == 1);
+  CHECK(first->PathString("args.pass") == "1");
+  const auto& instant = events->elements()[2];
+  CHECK(instant->PathString("ph") == "i");
+  CHECK(instant->PathString("s") == "t");
+  CHECK(doc->PathString("otherData.producer") == "tpu-operator");
+  CHECK(doc->PathNumber("otherData.epoch", 0) > 0);
+  // bounded ring: overflowing kMaxEvents drops the oldest, keeps the
+  // newest, and surfaces the drop count
+  kubeapi::TraceEmitter full;
+  for (size_t i = 0; i < kubeapi::TraceEmitter::kMaxEvents + 10; ++i)
+    full.AddComplete("apply-object", "reconcile", double(i), 1.0, {});
+  CHECK(full.size() <= kubeapi::TraceEmitter::kMaxEvents);
+  CHECK(full.dropped() > 0);
+  minijson::ValuePtr doc2 = minijson::Parse(full.DumpChromeJson(), &err);
+  CHECK(doc2 != nullptr);
+  CHECK(doc2->PathNumber("otherData.dropped_events", 0) > 0);
+}
+
 static void TestWatchBackoff() {
   // Doubling from base, capped: the operand drift-watch reconnect
   // schedule. A persistently kClosed stream (each https open is a curl
@@ -306,6 +428,11 @@ int main() {
   TestOperandWorkloadTwinTable();
   TestFieldManagerTwin();
   TestOperatorMetricNamesTwinTable();
+  TestOperatorTraceEventNamesTwinTable();
+  TestTraceparentTwinsAndParsing();
+  TestHistogramBucketBoundary();
+  TestPromEscapeLabelValue();
+  TestTraceEmitter();
   TestWatchBackoff();
   if (g_failures) {
     fprintf(stderr, "operator_selftest: %d FAILURES\n", g_failures);

@@ -145,7 +145,8 @@ def _assert_audit_parity(tel, api) -> None:
 
 
 def run_arm(name: str, latency_s: float, passes: int,
-            max_inflight: int, trace_out: str = "") -> dict:
+            max_inflight: int, trace_out: str = "",
+            collect: dict = None) -> dict:
     """One fresh fake apiserver; install + `passes` steady-state re-applies.
     Returns wall clock, apiserver request count, and per-phase timings —
     requests and phases DERIVED FROM THE SPAN TREE (audit-parity checked
@@ -167,6 +168,12 @@ def run_arm(name: str, latency_s: float, passes: int,
         wall = time.monotonic() - t0
         client.close()
         _assert_audit_parity(tel, api)
+        if collect is not None:
+            # both halves of this arm's timeline, for the merged
+            # Perfetto artifact: the CLI's span tree and the fake's own
+            # server-side spans (shared trace ids — ISSUE 8)
+            collect["cli"] = tel.chrome_trace()
+            collect["server"] = api.fake_trace()
     if trace_out:
         tel.write_trace(trace_out)
     return {
@@ -351,12 +358,15 @@ def _operator_binary() -> str:
     return ""
 
 
-def drift_arm(latency_s: float, watch: bool):
+def drift_arm(latency_s: float, watch: bool, trace_out: str = ""):
     """Drift→repaired through the real C++ operator: delete an owned
     DaemonSet via the apiserver, time its re-creation. The watch arm runs
     --interval=120 so repair can ONLY come from the operand watch event;
     the poll arm runs --no-operand-watch --interval=2 so repair waits for
-    the next interval pass. None when no operator binary is built."""
+    the next interval pass. None when no operator binary is built.
+    ``trace_out`` passes the operator its own --trace-out: the emitted
+    Chrome trace (reconcile/apply/watch/drift slices) joins the merged
+    Perfetto artifact and is what CI greps for the pinned slice names."""
     binary = _operator_binary()
     if not binary:
         return None
@@ -370,6 +380,8 @@ def drift_arm(latency_s: float, watch: bool):
             "tpu-node-status-exporter")
     interval = 120 if watch else 2
     extra = [] if watch else ["--no-operand-watch"]
+    if trace_out:
+        extra = extra + [f"--trace-out={trace_out}"]
     with tempfile.TemporaryDirectory() as d:
         operator_bundle.write_bundle(specmod.default_spec(), d)
         with FakeApiServer(auto_ready=True, latency_s=latency_s) as api:
@@ -432,13 +444,27 @@ def main(argv=None) -> int:
                          "trace-event JSON (the same format tpuctl apply "
                          "--trace-out emits; CI uploads it as an "
                          "artifact)")
+    ap.add_argument("--merged-trace-out", default="", metavar="PATH",
+                    help="write the MERGED Perfetto timeline: the "
+                         "pipelined arm's CLI trace + the fake "
+                         "apiserver's server-side spans + (when the "
+                         "native binary is built) the operator's trace "
+                         "from the drift arm — per-process tracks, "
+                         "shared trace ids (tpuctl trace merge format)")
+    ap.add_argument("--operator-trace-out", default="", metavar="PATH",
+                    help="where the drift arm's operator writes its own "
+                         "Chrome trace (the file CI greps for the "
+                         "pinned kubeapi::OperatorTraceEventNames "
+                         "slices); empty = a temp file when "
+                         "--merged-trace-out needs it")
     args = ap.parse_args(argv)
 
     latency_s = args.latency_ms / 1000.0
+    collect = {} if args.merged_trace_out else None
     seq = run_arm("sequential", latency_s, args.passes, max_inflight=1)
     pipe = run_arm("pipelined", latency_s, args.passes,
                    max_inflight=args.max_inflight,
-                   trace_out=args.trace_out)
+                   trace_out=args.trace_out, collect=collect)
     ssa = ssa_arm(latency_s, args.passes, args.max_inflight)
     ready_watch = readiness_arm(latency_s, watch=True)
     ready_poll = readiness_arm(latency_s, watch=False)
@@ -452,6 +478,14 @@ def main(argv=None) -> int:
                  "faulted": faults_arm(latency_s, watch=False,
                                        faulted=True)},
     }
+
+    op_trace_path = args.operator_trace_out
+    if args.merged_trace_out and not op_trace_path and _operator_binary():
+        import tempfile
+        op_trace_path = os.path.join(
+            tempfile.gettempdir(), f"bench_operator_trace_{os.getpid()}.json")
+    drift_watch = drift_arm(latency_s, watch=True, trace_out=op_trace_path)
+    drift_poll = drift_arm(latency_s, watch=False)
 
     spec = specmod.default_spec()
     groups = full_stack_groups(spec)
@@ -471,9 +505,10 @@ def main(argv=None) -> int:
             "watch": ready_watch,
             "poll": ready_poll,
             # drift→repaired through the real operator (null when the
-            # native binary isn't built on this host)
-            "drift_watch": drift_arm(latency_s, watch=True),
-            "drift_poll": drift_arm(latency_s, watch=False),
+            # native binary isn't built on this host); the watch arm
+            # also emits the operator's own trace when asked
+            "drift_watch": drift_watch,
+            "drift_poll": drift_poll,
         },
         # Robustness column: the full bundle under the standard fault
         # script vs clean, both readiness modes — wall time, request
@@ -485,6 +520,26 @@ def main(argv=None) -> int:
         "ssa": ssa,
     }
     print(json.dumps(doc, separators=(",", ":")))
+
+    if args.merged_trace_out and collect:
+        # The merged Perfetto artifact (ISSUE 8): the pipelined arm's CLI
+        # trace + the fake's server-side spans share trace ids; the
+        # operator trace (its own fake, earlier on the wall clock) rides
+        # as a third process track when the binary ran.
+        inputs = [collect["cli"], collect["server"]]
+        if op_trace_path and os.path.exists(op_trace_path):
+            try:
+                with open(op_trace_path, encoding="utf-8") as f:
+                    inputs.append(json.load(f))
+            except ValueError:
+                print("bench_rollout: operator trace unparseable; "
+                      "merging without it", file=sys.stderr)
+        merged = telemetry.merge_traces(inputs)
+        telemetry.validate_chrome_trace(merged)
+        telemetry.write_json(args.merged_trace_out, merged)
+        print(f"bench_rollout: merged trace "
+              f"({len(inputs)} process(es)) -> {args.merged_trace_out}",
+              file=sys.stderr)
 
     if args.check:
         ok = (doc["request_ratio"] >= REQUEST_RATIO_TARGET

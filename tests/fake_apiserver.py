@@ -32,6 +32,33 @@ from typing import Any, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs
 
 
+def prom_escape(value: str) -> str:
+    """Prometheus label-value escaping (backslash, double quote,
+    newline) — the exposition format requires it, and the audit's path
+    labels are client-controlled bytes. Twin of telemetry._escape and
+    the C++ promescape.h helper."""
+    return (value.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def parse_traceparent(header: str) -> Tuple[str, str]:
+    """``(trace_id, parent_id)`` from a W3C traceparent header —
+    ``("", "")`` for absent/malformed input (a server must tolerate
+    garbage headers). Kept dependency-free like the rest of this fake
+    (no tpu_cluster import), shape-pinned against
+    telemetry.parse_traceparent by tests/test_trace_correlation.py."""
+    parts = (header or "").strip().split("-")
+    if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+        return "", ""
+    hexdigits = set("0123456789abcdefABCDEF")
+    for field in (parts[1], parts[2]):
+        # strict digit check, like the C++ twin: int(x, 16) would accept
+        # '0x' prefixes / signs / whitespace the other parsers reject
+        if not set(field) <= hexdigits or set(field) == {"0"}:
+            return "", ""
+    return parts[1], parts[2]
+
+
 def merge_patch(target: Any, patch: Any) -> Any:
     """RFC 7386 JSON merge patch."""
     if not isinstance(patch, dict):
@@ -429,6 +456,20 @@ class FakeApiServer:
         # accounting assertions. Scrapes of /__fake_metrics itself are
         # excluded from BOTH (the observer must not move the needle).
         self.responses: Dict[Tuple[str, str, int], int] = {}  # guarded-by: _responses_lock
+        # Server-side SPANS (ISSUE 8): one record per handled request —
+        # same coverage contract as `responses` (normal replies, watch
+        # streams with their full stream duration, chaos injections,
+        # drops as status 0) — tagged with the trace/parent ids parsed
+        # from the inbound W3C traceparent header, published as a Chrome
+        # trace by /__fake_trace so `tpuctl trace merge` can lay the
+        # server's timeline next to the CLI's with shared ids.
+        self.spans: List[Dict[str, Any]] = []  # guarded-by: _responses_lock
+        # epoch + monotonic anchor pair: span ts values are offsets from
+        # _t0_mono, and `epoch` names the wall-clock instant the anchor
+        # was taken so merged timelines align across processes (both
+        # set once at construction, read-only after)
+        self.epoch = time.time()
+        self._t0_mono = time.monotonic()
         # own lock: _reply fires inside handlers that already hold _lock
         # (which is non-reentrant), so the audit cannot share it —
         # tests/test_lockorder.py pins the resulting _lock ->
@@ -465,6 +506,7 @@ class FakeApiServer:
             def _reply(self, code: int, obj: Any = None):
                 fake._note_response(self.command,
                                     self.path.partition("?")[0], code)
+                self._span(code)
                 body = json.dumps(obj if obj is not None else {}).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
@@ -475,9 +517,22 @@ class FakeApiServer:
             def _record(self):
                 if fake.latency_s > 0:
                     time.sleep(fake.latency_s)
+                # span anchor + inbound trace context, captured before
+                # any handling so the server span covers service time
+                self._span_t0 = time.monotonic()
+                self._rx_traceparent = self.headers.get("traceparent", "")
                 with fake._lock:
                     fake.log.append((self.command, self.path))
                     fake.headers_seen.append(dict(self.headers))
+
+            def _span(self, status: int, **extra: Any):
+                """One server-side span for THIS request (same one-entry
+                coverage contract as the `responses` audit)."""
+                fake._note_span(self.command,
+                                self.path.partition("?")[0], status,
+                                getattr(self, "_span_t0", None),
+                                getattr(self, "_rx_traceparent", ""),
+                                **extra)
 
             def _chaos(self, is_watch: bool = False,
                        is_ssa: bool = False) -> bool:
@@ -498,6 +553,7 @@ class FakeApiServer:
                     # the connection die mid-request (RemoteDisconnected /
                     # reset), i.e. transport status 0
                     fake._note_response(self.command, path, 0)
+                    self._span(0, chaos="drop")
                     self.close_connection = True
                     try:
                         self.connection.shutdown(socket.SHUT_RDWR)
@@ -506,6 +562,7 @@ class FakeApiServer:
                     return True
                 _, status, headers, body = act
                 fake._note_response(self.command, path, status)
+                self._span(status, chaos="status")
                 payload = json.dumps(body).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
@@ -618,17 +675,23 @@ class FakeApiServer:
                     pass  # watcher went away; nothing to clean up
 
             def do_GET(self):
-                if self.path.partition("?")[0] == "/__fake_metrics":
-                    # The audit-log-as-metrics endpoint (ISSUE 6): the
-                    # server's own request accounting in Prometheus text,
-                    # so tests can assert client-side and server-side
-                    # counts agree. Served OUTSIDE _record/_chaos — the
-                    # observer is not part of the audit, and chaos must
-                    # not black-hole it.
-                    body = fake.fake_metrics_text().encode()
+                introspect = self.path.partition("?")[0]
+                if introspect in ("/__fake_metrics", "/__fake_trace"):
+                    # Introspection endpoints (ISSUEs 6/8): the server's
+                    # own request accounting as Prometheus text, and its
+                    # span log as a Chrome trace. Served OUTSIDE
+                    # _record/_chaos — the observer is not part of the
+                    # audit, and chaos must not black-hole it.
+                    if introspect == "/__fake_metrics":
+                        body = fake.fake_metrics_text().encode()
+                        ctype = "text/plain; version=0.0.4"
+                    else:
+                        body = json.dumps(
+                            fake.fake_trace(),
+                            separators=(",", ":")).encode()
+                        ctype = "application/json"
                     self.send_response(200)
-                    self.send_header("Content-Type",
-                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Type", ctype)
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
@@ -640,7 +703,12 @@ class FakeApiServer:
                 if self._chaos(is_watch):
                     return
                 if is_watch:
-                    self._serve_watch(path, q)
+                    try:
+                        self._serve_watch(path, q)
+                    finally:
+                        # the stream's span covers its whole lifetime —
+                        # open to window end / invalidation / client gone
+                        self._span(200, watch=True)
                     return
                 with fake._lock:
                     obj = fake.store.get(path)
@@ -980,20 +1048,66 @@ class FakeApiServer:
         with self._responses_lock:
             self.responses[key] = self.responses.get(key, 0) + 1
 
+    def _note_span(self, method: str, path: str, status: int,
+                   t_start: Optional[float], traceparent: str,
+                   **extra: Any) -> None:
+        """One server-side span per handled request (see ``spans``):
+        start/duration from the handler's anchor, trace/parent ids from
+        the inbound traceparent header (empty when the client sent
+        none — telemetry-off clients stay uncorrelated, not broken)."""
+        now = time.monotonic()
+        start = t_start if t_start is not None else now
+        trace_id, parent_id = parse_traceparent(traceparent)
+        rec = {"name": f"{method} {path}", "verb": method, "path": path,
+               "status": status,
+               "ts_s": max(0.0, start - self._t0_mono),
+               "dur_s": max(0.0, now - start),
+               "tid": threading.get_ident(),
+               "trace_id": trace_id, "parent_id": parent_id}
+        rec.update(extra)
+        with self._responses_lock:
+            self.spans.append(rec)
+
+    def fake_trace(self) -> Dict[str, Any]:
+        """The `/__fake_trace` body: every server-side span as a Chrome
+        trace-event document (cat "server", one ph=X event per handled
+        request, args carrying verb/path/status and the inbound
+        trace/parent ids) — the middle track of a `tpuctl trace merge`
+        timeline."""
+        with self._responses_lock:
+            spans = [dict(s) for s in self.spans]
+        events = []
+        for s in spans:
+            args = {k: v for k, v in s.items()
+                    if k not in ("name", "ts_s", "dur_s", "tid")}
+            events.append({
+                "name": s["name"], "cat": "server", "ph": "X",
+                "ts": round(s["ts_s"] * 1e6, 1),
+                "dur": round(s["dur_s"] * 1e6, 1),
+                "pid": 1, "tid": s["tid"], "args": args,
+            })
+        events.sort(key=lambda e: e["ts"])
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "otherData": {"producer": "fake-apiserver",
+                              "epoch": self.epoch}}
+
     def fake_metrics_text(self) -> str:
         """The `/__fake_metrics` body: the request audit as Prometheus
         text — `fake_apiserver_requests_total{verb,path,code}` (one
         sample per distinct triple; dropped connections are code="0"),
         plus `fake_apiserver_chaos_faults_total{kind}` from the chaos
         engine's fired list. Label order is fixed and families sorted so
-        scrapes are byte-stable for equal state."""
+        scrapes are byte-stable for equal state. Path labels are
+        CLIENT-CONTROLLED bytes and escaped per the exposition format
+        (backslash, quote, newline) — a hostile request path must not be
+        able to forge extra samples into the scrape."""
         with self._responses_lock:
             rows = sorted(self.responses.items())
         lines = ["# TYPE fake_apiserver_requests_total counter"]
         for (method, path, status), n in rows:
             lines.append(
-                f'fake_apiserver_requests_total{{verb="{method}",'
-                f'path="{path}",code="{status}"}} {n}')
+                f'fake_apiserver_requests_total{{verb="{prom_escape(method)}",'
+                f'path="{prom_escape(path)}",code="{status}"}} {n}')
         fired: Dict[str, int] = {}
         if self.chaos is not None:
             for status, _m, _p in self.chaos.fired_snapshot():

@@ -93,6 +93,67 @@ def test_prometheus_label_escaping():
     line = [ln for ln in reg.render().splitlines()
             if ln.startswith("esc_total{")][0]
     assert '\\"hi\\"' in line and "\\n" in line and "\\\\slash" in line
+    # a hostile value must not be able to FORGE a second sample line
+    reg2 = telemetry.MetricsRegistry()
+    reg2.counter("seed_total",
+                 path='x"} 1\nforged_total{path="y').inc()
+    rendered = reg2.render()
+    samples = [ln for ln in rendered.splitlines()
+               if ln and not ln.startswith("#")]
+    assert len(samples) == 1, rendered  # still ONE sample line
+    # the hostile bytes stay INSIDE the quoted label value — no line
+    # begins with the forged family name
+    assert not any(ln.startswith("forged_total")
+                   for ln in rendered.splitlines())
+
+
+def test_fake_metrics_label_escaping_hostile_path():
+    """The fake's /__fake_metrics twin escapes its client-controlled
+    path labels the same way (the C++ side is pinned by
+    TestPromEscapeLabelValue in native/operator/selftest.cc)."""
+    from fake_apiserver import FakeApiServer, prom_escape
+    api = FakeApiServer(auto_ready=True)
+    hostile = 'p"ath\nwith\\specials'
+    api._note_response("GET", hostile, 200)
+    text = api.fake_metrics_text()
+    api._server.server_close()
+    assert f'path="{prom_escape(hostile)}"' in text
+    # every sample line stays one line and parseable: name{labels} value
+    for ln in text.splitlines():
+        if ln.startswith("#") or not ln:
+            continue
+        assert re.match(r'^[a-z_]+\{.*\} \d+$', ln), ln
+    assert prom_escape("a\\b\"c\nd") == 'a\\\\b\\"c\\nd'
+
+
+def test_histogram_bucket_boundary_parity_pin():
+    """Bucket-boundary parity (the ISSUE 8 satellite): a value EXACTLY
+    equal to a `le` bound lands IN that bucket in the Python histogram,
+    and the C++ side must use the same `value <= bound` selection —
+    pinned via kubeapi::HistogramBucketIndex (selftest-checked) plus a
+    source grep proving the operator's histogram routes through it."""
+    h = telemetry.Histogram(buckets=(0.01, 0.1, 1.0))
+    for v in (0.01, 0.1, 1.0):  # all exactly ON a bound
+        h.observe(v)
+    assert h.counts == [1, 1, 1, 0]  # each in ITS bucket, none in +Inf
+    assert h.cumulative() == [1, 2, 3, 3]
+    with open(os.path.join(REPO, "native", "operator", "kubeapi.cc"),
+              encoding="utf-8") as f:
+        kubeapi_src = f.read()
+    # the C++ twin's comparison is the same <=
+    m = re.search(r"size_t HistogramBucketIndex.*?\n\}", kubeapi_src,
+                  re.S)
+    assert m, "HistogramBucketIndex not found in kubeapi.cc"
+    assert "value <= bounds[i]" in m.group(0)
+    with open(os.path.join(REPO, "native", "operator",
+                           "operator_main.cc"), encoding="utf-8") as f:
+        main_src = f.read()
+    assert "kubeapi::HistogramBucketIndex" in main_src, \
+        "operator histogram no longer routes through the shared bucket math"
+    with open(os.path.join(REPO, "native", "operator", "selftest.cc"),
+              encoding="utf-8") as f:
+        selftest_src = f.read()
+    assert "HistogramBucketIndex" in selftest_src
 
 
 # ------------------------------------------------------------- tracing
@@ -307,6 +368,39 @@ def test_operator_metric_names_twin_pins_cpp_source():
         verify.check_operator_metrics)
 
 
+def test_operator_trace_event_names_twin_pins_cpp_source():
+    """The trace-slice twin table (same pattern as the metric names):
+    kubeapi::OperatorTraceEventNames() must equal
+    telemetry.OPERATOR_TRACE_EVENTS, every pinned slice must be emitted
+    by operator_main.cc and re-pinned in selftest.cc, and the
+    traceparent annotation string must twin too."""
+    with open(os.path.join(REPO, "native", "operator", "kubeapi.cc"),
+              encoding="utf-8") as f:
+        src = f.read()
+    m = re.search(r"OperatorTraceEventNames\(\)\s*\{.*?"
+                  r"new std::vector<std::string>\s*\{(.*?)\};", src, re.S)
+    assert m, "kubeapi.cc OperatorTraceEventNames() initializer not found"
+    cpp_names = tuple(re.findall(r'"([^"]+)"', m.group(1)))
+    assert cpp_names == telemetry.OPERATOR_TRACE_EVENTS
+    with open(os.path.join(REPO, "native", "operator",
+                           "operator_main.cc"), encoding="utf-8") as f:
+        main_src = f.read()
+    with open(os.path.join(REPO, "native", "operator", "selftest.cc"),
+              encoding="utf-8") as f:
+        selftest_src = f.read()
+    for name in telemetry.OPERATOR_TRACE_EVENTS:
+        assert f'"{name}"' in main_src, \
+            f"{name} not emitted by operator_main.cc"
+        assert f'"{name}"' in selftest_src, f"{name} not selftest-pinned"
+    # the traceparent annotation twin (kubeapply re-exports telemetry's)
+    ann = re.search(r'TraceparentAnnotation\(\)\s*\{.*?return\s+"([^"]+)"',
+                    src, re.S)
+    assert ann, "kubeapi.cc TraceparentAnnotation() not found"
+    assert ann.group(1) == telemetry.TRACEPARENT_ANNOTATION
+    assert kubeapply.TRACEPARENT_ANNOTATION == \
+        telemetry.TRACEPARENT_ANNOTATION
+
+
 # ------------------------------------------------------------ tpuctl top
 
 
@@ -345,6 +439,60 @@ def test_tpuctl_top_renders_breakdown(tmp_path, spec):
         assert proc.returncode == 2, (path, proc.stderr)
         assert want in proc.stderr, (path, proc.stderr)
         assert "Traceback" not in proc.stderr, (path, proc.stderr)
+
+
+FIXTURES = os.path.join(REPO, "tests", "fixtures")
+
+
+def test_tpuctl_top_golden_output_over_checked_in_fixture():
+    """Golden-output pin for `tpuctl top` (the ISSUE 8 satellite): the
+    checked-in trace fixture must render EXACTLY the checked-in
+    breakdown — per-phase totals, verb/status table, retries, slowest
+    spans. A renderer change that moves a number must move the golden
+    file with it, reviewably."""
+    fixture = os.path.join(FIXTURES, "rollout_trace.json")
+    golden = open(os.path.join(FIXTURES, "rollout_trace.top.txt"),
+                  encoding="utf-8").read()
+    doc = json.load(open(fixture))
+    assert telemetry.summarize_trace(doc, limit=5) + "\n" == golden
+    # and through the real CLI
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_cluster", "top", fixture,
+         "--limit", "5"],
+        capture_output=True, text=True, timeout=60, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stdout == golden
+
+
+def test_tpuctl_top_over_merged_multiprocess_trace(tmp_path):
+    """`top` over a merged CLI+server fixture trace: the per-process
+    track listing appears, and the single-process numbers (phases,
+    requests) survive the merge unchanged."""
+    cli = json.load(open(os.path.join(FIXTURES, "rollout_trace.json")))
+    server = json.load(open(os.path.join(FIXTURES, "server_trace.json")))
+    merged = telemetry.merge_traces([cli, server])
+    telemetry.validate_chrome_trace(merged)
+    # the 0.25s epoch gap shifts the server track right, never left
+    server_events = [e for e in merged["traceEvents"]
+                     if e.get("pid") == 2 and e.get("ph") == "X"]
+    assert server_events and all(e["ts"] >= 250000.0
+                                 for e in server_events)
+    path = tmp_path / "merged.json"
+    path.write_text(json.dumps(merged))
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_cluster", "top", str(path),
+         "--limit", "5"],
+        capture_output=True, text=True, timeout=60, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr
+    out = proc.stdout
+    assert "processes (merged trace):" in out
+    assert "pid 1: tpuctl" in out and "pid 2: fake-apiserver" in out
+    # the CLI-side numbers are unchanged by the merge
+    assert "requests: 6 (GET 2, PATCH 3, POST 1)" in out
+    assert "retries: 1" in out
+    # every server span kept its correlation ids through the merge
+    for e in server_events:
+        assert e["args"]["trace_id"] == cli["otherData"]["trace_id"]
 
 
 # ------------------------------------------------- instrumentation detail

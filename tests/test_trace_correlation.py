@@ -1,0 +1,496 @@
+"""Cluster-wide trace correlation (ISSUE 8): W3C traceparent propagation
+from the CLI, server-side spans at the fake apiserver, the C++ operator's
+trace emitter, `tpuctl trace merge`, and the flight recorder.
+
+THE acceptance pin lives here: a full-bundle `apply --parallel --watch`
+under the standard chaos script yields a merged trace where every CLI
+wire-attempt span has exactly one fake-apiserver server span naming it as
+parent (chaos drops excepted), and an operator reconcile slice carries a
+trace id originating from a tpuctl apply.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+from collections import Counter
+
+import pytest
+
+from fake_apiserver import FakeApiServer, standard_fault_script
+from fake_apiserver import parse_traceparent as fake_parse
+from tpu_cluster import kubeapply, telemetry
+from tpu_cluster import spec as specmod
+from tpu_cluster.render import manifests, operator_bundle
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NS = "tpu-system"
+FAST_RETRY = kubeapply.RetryPolicy(attempts=8, base_s=0.02, cap_s=0.3)
+
+
+@pytest.fixture()
+def spec():
+    return specmod.default_spec()
+
+
+def full_stack_groups(spec):
+    return (list(operator_bundle.operator_install_groups(spec))
+            + list(manifests.rollout_groups(spec)))
+
+
+def _http_spans(doc):
+    return [e for e in doc["traceEvents"]
+            if e.get("ph") == "X" and e.get("cat") == "http"]
+
+
+# --------------------------------------------------- header propagation
+
+
+def test_traceparent_header_on_every_wire_attempt(spec):
+    """With telemetry armed, EVERY request the client sends — applies,
+    readiness reads, watch opens — carries a well-formed traceparent
+    whose trace id is the tracer's."""
+    groups = operator_bundle.operator_install_groups(spec)
+    tel = telemetry.Telemetry()
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url, telemetry=tel)
+        kubeapply.apply_groups(client, groups, wait=True, stage_timeout=30,
+                               poll=0.02, max_inflight=8, watch_ready=True)
+        client.close()
+        headers = list(api.headers_seen)
+    assert headers
+    for h in headers:
+        tp = h.get("traceparent")
+        assert tp, f"request without traceparent: {sorted(h)}"
+        parsed = telemetry.parse_traceparent(tp)
+        assert parsed is not None, tp
+        assert parsed[0] == tel.tracer.trace_id
+    # distinct span id per wire attempt (the parent-id is the attempt)
+    parents = [telemetry.parse_traceparent(h["traceparent"])[1]
+               for h in headers]
+    assert len(set(parents)) == len(parents)
+
+
+def test_traceparent_parser_twins_agree():
+    """telemetry.parse_traceparent and the fake's dependency-free twin
+    accept/reject the same vectors (the RetryableStatus pattern, shape
+    edition)."""
+    good = "00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01"
+    vectors = [
+        good,
+        "",
+        "garbage",
+        "00-short-b7ad6b7169203331-01",
+        "00-00000000000000000000000000000000-b7ad6b7169203331-01",
+        "00-0af7651916cd43dd8448eb211c80319c-0000000000000000-01",
+        "00-0af7651916cd43dd8448eb211c80319z-b7ad6b7169203331-01",
+        # int(x, 16) would tolerate these; the strict check (and the C++
+        # twin) must not
+        "00-0x" + "a" * 30 + "-b7ad6b7169203331-01",
+        "00- " + "a" * 31 + "-b7ad6b7169203331-01",
+        "00-+" + "a" * 31 + "-b7ad6b7169203331-01",
+    ]
+    for v in vectors:
+        ours = telemetry.parse_traceparent(v)
+        theirs = fake_parse(v)
+        if ours is None:
+            assert theirs == ("", ""), v
+        else:
+            assert theirs == ours, v
+    assert telemetry.parse_traceparent(good) == (
+        "0af7651916cd43dd8448eb211c80319c", "b7ad6b7169203331")
+
+
+# ------------------------------------------------- THE correlation pin
+
+
+def test_merged_trace_pairs_every_cli_attempt_with_one_server_span(spec):
+    """ACCEPTANCE PIN: full-bundle `apply --parallel --watch` under the
+    standard chaos script — in the merged trace, every CLI wire-attempt
+    span has exactly one fake-apiserver server span naming it as parent
+    and sharing its trace id (parity with api.log; chaos drops — client
+    attempts the server never saw — excepted)."""
+    groups = full_stack_groups(spec)
+    tel = telemetry.Telemetry()
+    with FakeApiServer(auto_ready=True,
+                       chaos=standard_fault_script(0.03)) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY, telemetry=tel)
+        kubeapply.apply_groups(client, groups, wait=True, stage_timeout=60,
+                               poll=0.02, max_inflight=8, watch_ready=True)
+        client.close()
+        assert client.retries > 0, "the fault script never fired"
+        server = api.fake_trace()
+        audit = len(api.log)
+    cli = tel.chrome_trace()
+    # server-side coverage contract: one span per handled request, same
+    # as the audit log (watch streams, chaos injections, drops included)
+    assert len(server["traceEvents"]) == audit
+    http = _http_spans(cli)
+    parent_count = Counter(e["args"]["parent_id"]
+                           for e in server["traceEvents"])
+    client_ids = {e["args"]["span_id"] for e in http}
+    for e in http:
+        n = parent_count.get(e["args"]["span_id"], 0)
+        if e["args"]["status"] != 0:
+            # a non-dropped attempt pairs with EXACTLY one server span
+            assert n == 1, (e["name"], e["args"], n)
+        else:
+            # chaos drop / stale socket: the server logged it 0 or 1
+            # times depending on whether the request reached a handler
+            assert n <= 1, (e["name"], e["args"], n)
+    # every server span resolves to a real client attempt, with our id
+    for e in server["traceEvents"]:
+        assert e["args"]["parent_id"] in client_ids, e["args"]
+        assert e["args"]["trace_id"] == tel.tracer.trace_id
+    # chaos visible server-side too
+    assert any(e["args"].get("chaos") for e in server["traceEvents"])
+    # and the merged document is a valid timeline of both processes
+    merged = telemetry.merge_traces([cli, server])
+    telemetry.validate_chrome_trace(merged)
+    pids = {e["pid"] for e in merged["traceEvents"]}
+    assert pids == {1, 2}
+    assert tel.tracer.trace_id in merged["otherData"]["trace_ids"]
+
+
+def test_clean_run_pairs_bijectively(spec):
+    """No chaos: the pairing is a BIJECTION — every attempt has its
+    server span and vice versa (the span==audit parity of PR 6, upgraded
+    from counts to ids)."""
+    groups = operator_bundle.operator_install_groups(spec)
+    tel = telemetry.Telemetry()
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url, telemetry=tel)
+        kubeapply.apply_groups(client, groups, wait=True, stage_timeout=30,
+                               poll=0.02, max_inflight=8)
+        client.close()
+        server = api.fake_trace()
+    client_ids = sorted(e["args"]["span_id"]
+                        for e in _http_spans(tel.chrome_trace()))
+    server_parents = sorted(e["args"]["parent_id"]
+                            for e in server["traceEvents"])
+    assert client_ids == server_parents
+
+
+# --------------------------------------- operator slice attribution pin
+
+
+def test_operator_reconcile_slice_carries_cli_trace_id(native_build,
+                                                       tmp_path, spec):
+    """ACCEPTANCE PIN (operator half): objects applied by a telemetry-on
+    tpuctl apply carry the traceparent annotation; a real C++ operator
+    reconciling the same store emits apply-object slices whose trace_id
+    IS the CLI tracer's — and the three traces merge into one validated
+    timeline that `tpuctl top` can summarize."""
+    binary = os.path.join(native_build, "tpu-operator")
+    if not os.path.exists(binary):
+        pytest.skip("tpu-operator binary not built")
+    groups = list(manifests.rollout_groups(spec))
+    tel = telemetry.Telemetry()
+    bundle_dir = tmp_path / "bundle"
+    bundle_dir.mkdir()
+    operator_bundle.write_bundle(spec, str(bundle_dir))
+    op_trace = tmp_path / "operator_trace.json"
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url, telemetry=tel)
+        kubeapply.apply_groups(client, groups, wait=True, stage_timeout=30,
+                               poll=0.02, max_inflight=8, apply_mode="ssa")
+        client.close()
+        proc = subprocess.run(
+            [binary, f"--apiserver={api.url}",
+             f"--bundle-dir={bundle_dir}", "--once", "--status-port=0",
+             "--poll-ms=20", "--stage-timeout=30",
+             f"--trace-out={op_trace}"],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr
+        server = api.fake_trace()
+    op_doc = json.load(open(op_trace))
+    telemetry.validate_chrome_trace(op_doc)
+    names = {e["name"] for e in op_doc["traceEvents"]}
+    assert {"reconcile-pass", "apply-object", "ready-wait"} <= names
+    applies = [e for e in op_doc["traceEvents"]
+               if e["name"] == "apply-object"]
+    assert any(e["args"].get("trace_id") == tel.tracer.trace_id
+               for e in applies), \
+        "no operator apply slice carries the CLI rollout's trace id"
+    # three-process merge through the REAL CLI + `tpuctl top` over it
+    cli_trace = tmp_path / "cli.json"
+    srv_trace = tmp_path / "server.json"
+    tel.write_trace(str(cli_trace))
+    srv_trace.write_text(json.dumps(server))
+    merged_path = tmp_path / "merged.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_cluster", "trace", "merge",
+         "-o", str(merged_path), str(cli_trace), str(srv_trace),
+         str(op_trace)],
+        capture_output=True, text=True, timeout=60, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert tel.tracer.trace_id in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_cluster", "trace", "validate",
+         str(merged_path)],
+        capture_output=True, text=True, timeout=60, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_cluster", "top", str(merged_path)],
+        capture_output=True, text=True, timeout=60, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr
+    assert "processes (merged trace):" in proc.stdout
+    for producer in ("tpuctl", "fake-apiserver", "tpu-operator"):
+        assert producer in proc.stdout
+
+
+# ------------------------------------------- telemetry-off zero overhead
+
+
+def test_telemetry_off_sends_no_traceparent_and_no_annotation(spec):
+    """Client.telemetry=None (the library default) stays byte-identical
+    on the wire: no traceparent header, no annotation on stored objects
+    (the 'overhead pinned ~ zero' acceptance criterion)."""
+    groups = operator_bundle.operator_install_groups(spec)
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        kubeapply.apply_groups(client, groups, wait=True, stage_timeout=30,
+                               poll=0.02, max_inflight=8)
+        client.close()
+        assert all("traceparent" not in h for h in api.headers_seen)
+        for path in list(api.store):
+            anns = (api.get(path).get("metadata") or {}).get(
+                "annotations") or {}
+            assert telemetry.TRACEPARENT_ANNOTATION not in anns, path
+        # server spans still recorded, just uncorrelated
+        for e in api.fake_trace()["traceEvents"]:
+            assert e["args"]["trace_id"] == ""
+
+
+def test_warm_ssa_zero_mutations_with_annotations_present(spec):
+    """The annotation is per-mutation plumbing, not intent: a cold
+    telemetry-on apply stamps it (under the tpuctl manager), and a warm
+    telemetry-on re-apply still skips EVERY object with zero mutations —
+    the exact no-op check strips the annotation's field path."""
+    groups = full_stack_groups(spec)
+    with FakeApiServer(auto_ready=True) as api:
+        cold = kubeapply.Client(api.url, telemetry=telemetry.Telemetry())
+        kubeapply.apply_groups(cold, groups, wait=True, stage_timeout=30,
+                               poll=0.02, max_inflight=8, apply_mode="ssa")
+        cold.close()
+        # the annotation IS on the stored objects
+        dep = api.get(f"/apis/apps/v1/namespaces/{NS}/deployments/"
+                      f"{operator_bundle.OPERATOR_NAME}")
+        assert telemetry.TRACEPARENT_ANNOTATION in \
+            dep["metadata"]["annotations"]
+        tel = telemetry.Telemetry()
+        warm = kubeapply.Client(api.url, telemetry=tel)
+        mark = len(api.log)
+        kubeapply.apply_groups(warm, groups, wait=True, stage_timeout=30,
+                               poll=0.02, max_inflight=8, apply_mode="ssa")
+        warm.close()
+        mutations = [(m, p) for m, p in api.log[mark:]
+                     if m in ("POST", "PATCH", "PUT", "DELETE")]
+    assert mutations == [], mutations
+    objects = sum(len(g) for g in groups)
+    assert tel.metrics.total(telemetry.UNCHANGED_TOTAL,
+                             mode="ssa") == objects
+
+
+def test_empty_annotations_intent_still_noops_after_stamp():
+    """Regression (code review): an intent that declares an explicit
+    empty ``metadata.annotations: {}`` must still pass the exact no-op
+    check after a telemetry-on apply stamped the traceparent — the
+    normalization drops empty f:annotations from BOTH sides, so owning
+    an empty map compares equal to owning nothing."""
+    obj = {"apiVersion": "v1", "kind": "ConfigMap",
+           "metadata": {"name": "cm-empty-anns", "namespace": "default",
+                        "annotations": {}},
+           "data": {"k": "v"}}
+    tel = telemetry.Telemetry()
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url, telemetry=tel)
+        with tel.span("rollout", "rollout"):
+            client.apply_ssa(obj)
+        client.close()
+        live = api.get(kubeapply.object_path(obj))
+    assert telemetry.TRACEPARENT_ANNOTATION in \
+        live["metadata"]["annotations"]
+    assert kubeapply._ssa_is_noop(live, obj)
+
+
+# ------------------------------------------------------ flight recorder
+
+
+def test_flight_recorder_ring_is_bounded_and_flushes_atomically(tmp_path):
+    path = str(tmp_path / "flight.json")
+    rec = telemetry.FlightRecorder(path, capacity=8, flush_every=4)
+    tel = telemetry.Telemetry(recorder=rec)
+    with tel.span("rollout", "rollout"):
+        for i in range(30):
+            tel.leaf(f"GET /x{i}", "http", 0.001, status=200, verb="GET")
+    # between periodic flushes the file may trail by < flush_every
+    # records; the explicit flush (what the CLI's finally does on every
+    # exit path) brings it current
+    rec.flush()
+    doc = json.load(open(path))
+    assert doc["otherData"]["flight_recorder"] is True
+    assert doc["otherData"]["trace_id"] == tel.tracer.trace_id
+    assert len(doc["traceEvents"]) <= 8
+    telemetry.validate_chrome_trace(doc)
+    # the ring keeps the NEWEST records (the rollout end + last leaves)
+    names = [e["name"] for e in doc["traceEvents"]]
+    assert "GET /x29" in names and "rollout" in names
+    assert "GET /x0" not in names
+
+
+def test_flight_recorder_flushes_urgently_on_instant_events(tmp_path):
+    """A retry event must hit the disk immediately (not wait out
+    flush_every): the whole point is surviving a SIGKILL right after."""
+    path = str(tmp_path / "flight.json")
+    rec = telemetry.FlightRecorder(path, capacity=64, flush_every=1000)
+    tel = telemetry.Telemetry(recorder=rec)
+    with tel.span("rollout", "rollout") as sp:
+        sp.event("retry", code=503, attempt=1, backoff_s=0.1)
+        # no flush_every threshold reached, no explicit flush — the
+        # instant event alone must have rewritten the dump
+        doc = json.load(open(path))
+    instants = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+    assert [e["name"] for e in instants] == ["retry"]
+    assert instants[0]["args"]["code"] == 503
+
+
+def _wait(predicate, timeout=30):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return predicate()
+
+
+def test_sigkill_mid_rollout_leaves_parseable_dumps(tmp_path, spec):
+    """SIGKILL the real CLI mid-rollout (retries in flight): the flight
+    recorder dump exists, parses, and carries the retry events; the
+    --trace-out path is either absent or complete valid JSON — never
+    torn (the atomic-write satellite)."""
+    fr = str(tmp_path / "flight.json")
+    tr = str(tmp_path / "trace.json")
+    # unbounded 503s on the plugin DaemonSet: the rollout reaches group
+    # 2 and retries forever — a stable mid-rollout window to kill in
+    chaos = [{"status": 503, "match": "daemonsets", "retry_after": 0.05}]
+    with FakeApiServer(auto_ready=True, chaos=chaos) as api:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tpu_cluster", "apply",
+             "--apiserver", api.url, "--parallel", "--poll", "0.05",
+             "--stage-timeout", "60", "--retry-attempts", "100",
+             "--retry-base", "0.05",
+             "--trace-out", tr, "--flight-recorder", fr],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            cwd=REPO)
+        try:
+            assert _wait(lambda: api.chaos is not None
+                         and len(api.chaos.fired_snapshot()) >= 3), \
+                "chaos never fired"
+            # give the recorder's urgent flush a beat past the retries
+            assert _wait(lambda: os.path.exists(fr))
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+    doc = json.load(open(fr))  # parses, or the test fails loudly
+    telemetry.validate_chrome_trace(doc)
+    retries = [e for e in doc["traceEvents"]
+               if e["ph"] == "i" and e["name"] == "retry"]
+    assert retries, "flight dump lost the retry events"
+    assert all(e["args"]["code"] == 503 for e in retries)
+    assert len(doc["traceEvents"]) <= doc["otherData"]["capacity"]
+    # --trace-out: absent (never written) or complete valid JSON — a
+    # SIGKILL mid-rewrite may orphan a .tmp scratch file, but the TARGET
+    # path is never torn (that's the rename's whole job)
+    if os.path.exists(tr):
+        telemetry.validate_chrome_trace(json.load(open(tr)))
+
+
+def test_chaos_failure_leaves_flight_dump_with_retries(tmp_path, spec):
+    """A rollout that FAILS under chaos (retries exhausted) exits 1 and
+    names a parseable flight dump carrying the retry events — the
+    post-mortem path when --trace-out wasn't passed."""
+    fr = str(tmp_path / "flight.json")
+    chaos = [{"status": 503, "retry_after": 0.01}]  # everything 503s
+    with FakeApiServer(auto_ready=True, chaos=chaos) as api:
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpu_cluster", "apply",
+             "--apiserver", api.url, "--operator",
+             "--poll", "0.05", "--stage-timeout", "10",
+             "--retry-attempts", "3", "--retry-base", "0.02",
+             "--flight-recorder", fr],
+            capture_output=True, text=True, timeout=60, cwd=REPO)
+    assert proc.returncode == 1
+    assert "flight recorder dump" in proc.stderr
+    assert fr in proc.stderr
+    doc = json.load(open(fr))
+    telemetry.validate_chrome_trace(doc)
+    retries = [e for e in doc["traceEvents"]
+               if e["ph"] == "i" and e["name"] == "retry"]
+    assert retries and all(e["args"]["code"] == 503 for e in retries)
+
+
+def test_flight_recorder_off_restores_zero_overhead_cli_path(spec):
+    """`--flight-recorder off` with no --trace-out/--metrics-out is a
+    FULL telemetry opt-out: the CLI must take the Client.telemetry=None
+    path — no traceparent headers, no annotations, no span tree held in
+    memory for nothing."""
+    with FakeApiServer(auto_ready=True) as api:
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpu_cluster", "apply",
+             "--apiserver", api.url, "--operator",
+             "--poll", "0.05", "--stage-timeout", "30",
+             "--flight-recorder", "off"],
+            capture_output=True, text=True, timeout=120, cwd=REPO)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert all("traceparent" not in h for h in api.headers_seen)
+
+
+def test_intent_declared_traceparent_annotation_is_respected():
+    """An intent that already carries the traceparent annotation (a
+    manifest exported from a live cluster) keeps ITS value — stamping
+    over it would hold live != intent forever."""
+    tp = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+    obj = {"apiVersion": "v1", "kind": "ConfigMap",
+           "metadata": {"name": "cm-declared", "namespace": "default",
+                        "annotations": {
+                            telemetry.TRACEPARENT_ANNOTATION: tp}},
+           "data": {"k": "v"}}
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url, telemetry=telemetry.Telemetry())
+        client.apply_ssa(obj)
+        client.close()
+        live = api.get(kubeapply.object_path(obj))
+    assert live["metadata"]["annotations"][
+        telemetry.TRACEPARENT_ANNOTATION] == tp
+    assert kubeapply._ssa_is_noop(live, obj)
+
+
+def test_fake_trace_endpoint_serves_server_spans(spec):
+    """/__fake_trace over HTTP: valid Chrome trace, observer-neutral
+    (fetching it adds no span/audit entries)."""
+    groups = operator_bundle.operator_install_groups(spec)
+    tel = telemetry.Telemetry()
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url, telemetry=tel)
+        kubeapply.apply_groups(client, groups, wait=True, stage_timeout=30,
+                               poll=0.02)
+        client.close()
+        with urllib.request.urlopen(api.url + "/__fake_trace") as r:
+            doc = json.loads(r.read().decode())
+        with urllib.request.urlopen(api.url + "/__fake_trace") as r:
+            doc2 = json.loads(r.read().decode())
+        assert len(doc2["traceEvents"]) == len(doc["traceEvents"])
+        assert len(doc["traceEvents"]) == len(api.log)
+    telemetry.validate_chrome_trace(doc)
+    assert doc["otherData"]["producer"] == "fake-apiserver"
+    assert doc["otherData"]["epoch"] > 0
+    for e in doc["traceEvents"]:
+        assert e["cat"] == "server"
+        assert e["args"]["trace_id"] == tel.tracer.trace_id

@@ -27,12 +27,17 @@ with rendered artifacts and an ordered, readiness-gated apply:
            with `apply --trace-out` (spans: rollout -> group -> tier ->
            object -> HTTP attempt; docs/GUIDE.md "reading a rollout
            trace")
+  trace    merge per-process traces (CLI + fake apiserver + C++
+           operator) into one Perfetto timeline with shared trace ids,
+           or validate a trace against the Chrome trace-event schema
+           (docs/GUIDE.md "one rollout, three processes")
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import Dict
 
@@ -147,12 +152,59 @@ def _lint_external(args):
         frozenset(getattr(args, "allow_external", None) or [])
 
 
+def _flight_recorder_path(args) -> str:
+    """Where the always-on flight recorder dumps: the explicit flag, or
+    a stable PER-USER file in the system temp dir ('' = disabled via
+    --flight-recorder=off). Per-user (uid suffix) on purpose: a shared
+    well-known name in a world-writable directory would let one user's
+    dump collide with — or be squatted by — another's; the atomic
+    writer's mkstemp scratch file covers the symlink half."""
+    if args.flight_recorder == "off":
+        return ""
+    if args.flight_recorder:
+        return args.flight_recorder
+    import tempfile
+    uid = os.getuid() if hasattr(os, "getuid") else 0
+    return os.path.join(tempfile.gettempdir(),
+                        f"tpuctl-flight-{uid}.json")
+
+
 def cmd_apply(args) -> int:
     spec, groups = _spec_groups(args)
-    # Telemetry is opt-in per invocation: either output flag arms the
-    # span tree + metrics registry for the whole rollout (REST backend).
-    tel = (telemetry.Telemetry()
-           if (args.trace_out or args.metrics_out) else None)
+    # REST backend: telemetry is ALWAYS armed — the bounded flight
+    # recorder rides on it, so a crashed rollout leaves a post-mortem
+    # trace even when --trace-out wasn't passed (ISSUE 8). The kubectl
+    # backend delegates the wire to kubectl, so telemetry stays opt-in
+    # there (the spans would be empty anyway — see the note below).
+    # Library callers are untouched: Client.telemetry defaults to None,
+    # zero overhead.
+    recorder = None
+    rest_mode = bool(args.apiserver)
+    fr_path = _flight_recorder_path(args) if rest_mode else ""
+    if fr_path:
+        recorder = telemetry.FlightRecorder(fr_path)
+    # armed only when SOMETHING consumes it: the recorder (on by
+    # default, --flight-recorder=off disables) or an output flag — an
+    # explicit full opt-out must get the telemetry=None zero-overhead
+    # path, not an unconsumed span tree
+    tel = (telemetry.Telemetry(recorder=recorder)
+           if (recorder is not None or args.trace_out or args.metrics_out)
+           else None)
+    if rest_mode:
+        # SIGTERM must dump, like a crash: raising SystemExit lets the
+        # finally block below flush the recorder and write --trace-out
+        # before the process dies with the conventional 143. (A SIGKILL
+        # can't be caught — that's what the recorder's incremental
+        # atomic flushes are for.)
+        import signal as _signal
+
+        def _on_sigterm(signum, frame):  # pragma: no cover - signal path
+            raise SystemExit(128 + signum)
+
+        try:
+            _signal.signal(_signal.SIGTERM, _on_sigterm)
+        except ValueError:
+            pass  # not the main thread (embedded use): no handler
     if args.max_inflight is not None and not args.parallel:
         print("apply: note: --max-inflight has no effect without "
               "--parallel", file=sys.stderr)
@@ -239,10 +291,18 @@ def cmd_apply(args) -> int:
                 lint_external=_lint_external(args))
     except kubeapply.ApplyError as exc:
         print(f"apply failed: {exc}", file=sys.stderr)
+        if recorder is not None:
+            print(f"apply: flight recorder dump (last "
+                  f"{recorder.capacity} spans/retries): {recorder.path}",
+                  file=sys.stderr)
         return 1
     finally:
         if journal is not None:
             journal.close()
+        if recorder is not None:
+            # final flush on EVERY exit path — converged, ApplyError,
+            # SIGTERM's SystemExit — so the on-disk ring is current
+            recorder.flush()
         # written even when the rollout FAILED: a crashed rollout's trace
         # (unfinished spans marked, retries annotated) is the one worth
         # reading. An unwritable output path must not crash a converged
@@ -360,6 +420,72 @@ def cmd_verify(args) -> int:
 def cmd_triage(args) -> int:
     spec = _load_spec(args.spec)
     print(triage.run_triage(spec).text())
+    return 0
+
+
+def cmd_trace(args) -> int:
+    """Trace-file tooling for the cluster-wide correlation layer:
+
+    - ``tpuctl trace merge -o OUT IN...`` assembles per-process Chrome
+      traces (``tpuctl apply --trace-out``, the fake apiserver's
+      ``/__fake_trace``, the C++ operator's ``--trace-out``, a flight-
+      recorder dump) into ONE Perfetto timeline: per-process tracks,
+      epoch-aligned time axis, trace/span ids left intact for
+      correlation.
+    - ``tpuctl trace validate FILE`` checks a trace (merged or single)
+      against the Chrome trace-event schema — the CI artifact gate.
+    """
+    def load(path: str):
+        try:
+            with open(path, encoding="utf-8") as f:
+                return json.load(f)
+        except OSError as exc:
+            print(f"trace: cannot read {path}: {exc}", file=sys.stderr)
+            return None
+        except ValueError as exc:
+            print(f"trace: {path} is not JSON: {exc}", file=sys.stderr)
+            return None
+
+    if args.trace_cmd == "validate":
+        doc = load(args.trace)
+        if doc is None:
+            return 2
+        try:
+            complete = telemetry.validate_chrome_trace(doc)
+        except ValueError as exc:
+            print(f"trace: {args.trace} is not a valid Chrome trace: "
+                  f"{exc}", file=sys.stderr)
+            return 1
+        total = len(doc.get("traceEvents", []))
+        print(f"trace: {args.trace} valid — {total} event(s), "
+              f"{complete} complete span(s)")
+        return 0
+
+    docs = []
+    for path in args.inputs:
+        doc = load(path)
+        if doc is None:
+            return 2
+        docs.append(doc)
+    try:
+        merged = telemetry.merge_traces(docs)
+    except ValueError as exc:
+        print(f"trace merge: {exc}", file=sys.stderr)
+        return 1
+    try:
+        telemetry.write_json(args.out, merged)
+    except OSError as exc:
+        print(f"trace merge: cannot write {args.out}: {exc}",
+              file=sys.stderr)
+        return 2
+    other = merged["otherData"]
+    shared = other["trace_ids"]
+    print(f"trace: merged {len(docs)} trace(s) "
+          f"({', '.join(other['merged_from'])}) -> {args.out} "
+          f"({len(merged['traceEvents'])} events"
+          + (f"; shared trace ids: {', '.join(shared)}" if shared else "")
+          + "); open in ui.perfetto.dev or summarize with "
+          f"`tpuctl top {args.out}`")
     return 0
 
 
@@ -493,6 +619,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "Prometheus text: per-verb/status request "
                         "counters, latency and time-to-ready histograms, "
                         "retry/skip/reconnect counters")
+    p.add_argument("--flight-recorder", default="", metavar="PATH|off",
+                   help="always-on bounded post-mortem trace (REST "
+                        "backend): a ring of the last spans/retry events, "
+                        "atomically rewritten as the rollout runs, so a "
+                        "crashed/SIGKILL'd apply leaves a parseable dump "
+                        "even without --trace-out. Default: "
+                        "tpuctl-flight-<uid>.json in the system temp "
+                        "dir (per-user; concurrent applies share it — "
+                        "last writer wins); 'off' disables")
     p.set_defaults(fn=cmd_apply)
 
     p = sub.add_parser(
@@ -553,6 +688,29 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("triage", help="run the troubleshooting runbook")
     p.add_argument("--spec", default="")
     p.set_defaults(fn=cmd_triage)
+
+    p = sub.add_parser(
+        "trace", help="merge per-process Chrome traces into one "
+                      "Perfetto timeline, or validate one against the "
+                      "trace-event schema")
+    tsub = p.add_subparsers(dest="trace_cmd", required=True)
+    tp = tsub.add_parser(
+        "merge", help="assemble CLI + fake-apiserver + operator traces "
+                      "into one timeline with per-process tracks and "
+                      "shared trace ids")
+    tp.add_argument("inputs", nargs="+", metavar="TRACE",
+                    help="Chrome trace JSON files (tpuctl apply "
+                         "--trace-out, /__fake_trace captures, "
+                         "tpu-operator --trace-out, flight-recorder "
+                         "dumps)")
+    tp.add_argument("-o", "--out", required=True, metavar="PATH",
+                    help="write the merged timeline here (atomic)")
+    tp.set_defaults(fn=cmd_trace)
+    tp = tsub.add_parser(
+        "validate", help="check a trace file against the Chrome "
+                         "trace-event schema (the CI artifact gate)")
+    tp.add_argument("trace", help="trace JSON to validate")
+    tp.set_defaults(fn=cmd_trace)
 
     p = sub.add_parser(
         "top", help="summarize a saved rollout trace (tpuctl apply "

@@ -87,6 +87,18 @@ stale-socket fast retry and watch stream opens), so a clean rollout's
 summed http spans equal the apiserver's own request count exactly.
 ``telemetry=None`` (default) is zero-overhead and behaviorally
 identical.
+
+TRACE CORRELATION (ISSUE 8): with telemetry armed, every wire attempt
+carries a W3C ``traceparent`` header whose parent-id IS the attempt's
+leaf-span id (generated before the request), so a server recording its
+own spans can pair each one with the exact client attempt that caused
+it. Mutating applies additionally stamp the object with the
+``tpu-stack.dev/traceparent`` annotation (:data:`TRACEPARENT_ANNOTATION`)
+— the breadcrumb the C++ operator reads off live objects to attribute
+its reconcile slices to the rollout that caused them. The annotation is
+per-mutation plumbing, NOT intent: the exact SSA no-op check strips its
+field path, so the warm zero-mutation steady state holds with telemetry
+on.
 """
 
 from __future__ import annotations
@@ -406,6 +418,43 @@ def _patch_is_noop(live: Dict[str, Any], desired: Dict[str, Any]) -> bool:
     return _merge_patch(live, desired) == live
 
 
+# The annotation carrying an apply's trace context onto the object it
+# mutated (ISSUE 8): the operator reads it off live objects and stamps
+# its reconcile slices with the originating trace id. One name, defined
+# in telemetry (next to its C++ twin pin) and re-exported here where the
+# apply paths stamp it.
+TRACEPARENT_ANNOTATION = _telemetry.TRACEPARENT_ANNOTATION
+
+
+def _strip_tp_fields(fields: Dict[str, Any]) -> Dict[str, Any]:
+    """A fieldsV1 ownership descriptor NORMALIZED for the no-op check:
+    the traceparent annotation's leaf path removed, and an empty
+    ``f:annotations`` dropped outright. The steady-state check must
+    compare ownership of the INTENT — the annotation is per-rollout
+    plumbing stamped at mutation time, and leaving it in would turn
+    every warm re-apply into a PATCH just to refresh a trace id.
+    Applied to BOTH sides of the comparison: dropping an empty
+    ``f:annotations`` from both makes an intent that declares a bare
+    ``annotations: {}`` equivalent to one whose only annotation was the
+    stripped traceparent (owning an empty map is owning nothing)."""
+    meta = fields.get("f:metadata")
+    if not isinstance(meta, dict):
+        return fields
+    anns = meta.get("f:annotations")
+    if not isinstance(anns, dict):
+        return fields
+    anns = {k: v for k, v in anns.items()
+            if k != f"f:{TRACEPARENT_ANNOTATION}"}
+    meta = dict(meta)
+    if anns:
+        meta["f:annotations"] = anns
+    else:
+        del meta["f:annotations"]
+    out = dict(fields)
+    out["f:metadata"] = meta
+    return out
+
+
 def _fields_v1(obj: Any) -> Dict[str, Any]:
     """fieldsV1-style ownership descriptor for one applied intent: nested
     ``{"f:<key>": {...}}`` dicts mirroring the object's dict structure,
@@ -451,7 +500,17 @@ def _ssa_is_noop(live: Optional[Dict[str, Any]], desired: Dict[str, Any],
     mine = next((e for e in entries
                  if e.get("manager") == manager
                  and e.get("operation") == "Apply"), None)
-    if mine is None or mine.get("fieldsV1") != _fields_v1(desired):
+    if mine is None:
+        return False
+    # the traceparent annotation is stamped at MUTATION time (telemetry
+    # on), so the manager's recorded field set may carry it while the
+    # bare intent never does — NORMALIZE both sides (strip the
+    # annotation path, drop an empty f:annotations) before comparing,
+    # or every warm re-apply would PATCH just to refresh a trace id.
+    # The live VALUE comparison below is unaffected: the intent never
+    # mentions the annotation, so apply-merge leaves it untouched.
+    if _strip_tp_fields(mine.get("fieldsV1") or {}) != \
+            _strip_tp_fields(_fields_v1(desired)):
         return False
     grafts = {k: desired[k] for k in ("kind", "apiVersion")
               if k in desired and k not in live}
@@ -604,7 +663,8 @@ class Client:
     def __exit__(self, *exc: object) -> None:
         self.close()
 
-    def _headers(self, has_body: bool, content_type: str) -> Dict[str, str]:
+    def _headers(self, has_body: bool, content_type: str,
+                 traceparent: Optional[str] = None) -> Dict[str, str]:
         # User-Agent doubles as the default field-manager name real
         # apiservers record for NON-apply writes (POST/merge-PATCH, the
         # fallback path) — without it the merge fallback's fields would
@@ -616,10 +676,27 @@ class Client:
             headers["Authorization"] = f"Bearer {self.token}"
         if has_body:
             headers["Content-Type"] = content_type
+        if traceparent:
+            headers["traceparent"] = traceparent
         return headers
 
+    def _attempt_context(self) -> Tuple[Optional[str], Optional[str]]:
+        """``(span_id, traceparent header)`` for ONE wire attempt, or
+        ``(None, None)`` with telemetry off. Each attempt gets its OWN
+        span id — generated BEFORE the request so the header can carry
+        it, then recorded on the attempt's leaf span — which is what
+        makes a server-side span resolvable to the exact attempt that
+        caused it (the W3C parent-id contract)."""
+        tel = self.telemetry
+        if tel is None:
+            return None, None
+        span_id = _telemetry.new_span_id()
+        return span_id, _telemetry.format_traceparent(
+            tel.tracer.trace_id, span_id)
+
     def _note_attempt(self, method: str, path: str, status: int,
-                      dt: float, **extra: Any) -> None:
+                      dt: float, span_id: Optional[str] = None,
+                      **extra: Any) -> None:
         """Record ONE wire attempt in the telemetry (leaf span, cat
         "http", under the calling thread's open span; per-verb/status
         request counter; latency histogram). One note per request that
@@ -632,8 +709,8 @@ class Client:
         if tel is None:
             return
         short = path.partition("?")[0]
-        tel.leaf(f"{method} {short}", "http", dt, verb=method,
-                 status=status, **extra)
+        tel.leaf(f"{method} {short}", "http", dt, span_id=span_id,
+                 verb=method, status=status, **extra)
         tel.counter(_telemetry.REQUESTS_TOTAL,
                     "apiserver wire attempts by verb and status",
                     verb=method, code=str(status)).inc()
@@ -654,11 +731,15 @@ class Client:
         base_path = urllib.parse.urlsplit(self.base_url).path.rstrip("/")
         for attempt in (0, 1):
             conn = self._connection()
+            # fresh traceparent per attempt: the stale-socket retry is a
+            # DISTINCT wire attempt and must pair with its own server span
+            span_id, tp = self._attempt_context()
             t0 = time.monotonic()
             try:
                 conn.request(method, base_path + path, body=data,
                              headers=self._headers(data is not None,
-                                                   content_type))
+                                                   content_type,
+                                                   traceparent=tp))
                 resp = conn.getresponse()
                 payload = resp.read()  # drains so the connection can reuse
                 retry_after = _retry_after_s(resp.getheader("Retry-After"))
@@ -668,7 +749,7 @@ class Client:
                     parsed = {"message":
                               payload.decode(errors="replace")[:200]}
                 self._note_attempt(method, path, resp.status,
-                                   time.monotonic() - t0)
+                                   time.monotonic() - t0, span_id=span_id)
                 return resp.status, parsed, retry_after
             except (http.client.HTTPException, OSError) as exc:
                 self._drop_connection()
@@ -680,9 +761,11 @@ class Client:
                     # attempt the server may have seen (chaos drops reply
                     # with a closed socket AFTER logging the request)
                     self._note_attempt(method, path, 0,
-                                       time.monotonic() - t0, stale=True)
+                                       time.monotonic() - t0,
+                                       span_id=span_id, stale=True)
                     continue
-                self._note_attempt(method, path, 0, time.monotonic() - t0)
+                self._note_attempt(method, path, 0, time.monotonic() - t0,
+                                   span_id=span_id)
                 return 0, _transport_error(exc), None
         raise AssertionError("unreachable: both attempts return")
 
@@ -690,18 +773,21 @@ class Client:
             self, method: str, path: str, data: Optional[bytes],
             content_type: str
     ) -> Tuple[int, Dict[str, Any], Optional[float]]:
+        span_id, tp = self._attempt_context()
         t0 = time.monotonic()
         code, parsed, retry_after = self._request_oneshot_raw(
-            method, path, data, content_type)
-        self._note_attempt(method, path, code, time.monotonic() - t0)
+            method, path, data, content_type, traceparent=tp)
+        self._note_attempt(method, path, code, time.monotonic() - t0,
+                           span_id=span_id)
         return code, parsed, retry_after
 
     def _request_oneshot_raw(
             self, method: str, path: str, data: Optional[bytes],
-            content_type: str
+            content_type: str, traceparent: Optional[str] = None
     ) -> Tuple[int, Dict[str, Any], Optional[float]]:
         req = urllib.request.Request(self.base_url + path, method=method)
-        for k, v in self._headers(data is not None, content_type).items():
+        for k, v in self._headers(data is not None, content_type,
+                                  traceparent=traceparent).items():
             req.add_header(k, v)
         ctx = self._tls_context()
         try:
@@ -783,9 +869,42 @@ class Client:
                 f"LIST {path}: {code} {(resp or {}).get('message', resp)}")
         return _index_items(resp)
 
+    def _annotated(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        """The object as sent on a MUTATING apply: with telemetry armed,
+        a ``tpu-stack.dev/traceparent`` annotation carrying this
+        tracer's trace id and the innermost open span (the object-apply
+        span) as parent — the breadcrumb the C++ operator reads off live
+        objects to attribute its reconcile slices to the rollout that
+        caused them. Stamped ONLY on actual mutations (the no-op skip
+        checks run against the bare intent first), and not at all with
+        telemetry off — zero overhead, byte-identical payloads."""
+        tel = self.telemetry
+        if tel is None:
+            return obj
+        meta_in = obj.get("metadata") or {}
+        anns_in = meta_in.get("annotations") or {}
+        if TRACEPARENT_ANNOTATION in anns_in:
+            # the intent DECLARES a trace context (e.g. a manifest
+            # exported from a live cluster): the declared value is the
+            # intent, and overwriting it would keep live != intent
+            # forever — every warm re-apply would mutate just to swap
+            # trace ids
+            return obj
+        cur = tel.current()
+        span_id = cur.span_id if cur is not None else _telemetry.new_span_id()
+        out = dict(obj)
+        meta = dict(meta_in)
+        anns = dict(anns_in)
+        anns[TRACEPARENT_ANNOTATION] = _telemetry.format_traceparent(
+            tel.tracer.trace_id, span_id)
+        meta["annotations"] = anns
+        out["metadata"] = meta
+        return out
+
     def apply(self, obj: Dict[str, Any]) -> str:
         """Create-or-patch one object; returns 'created' | 'patched'."""
         path = object_path(obj)
+        obj = self._annotated(obj)
         code, resp = self.get(path)
         if code == 0:
             msg = resp.get("message", "transport failure")
@@ -851,7 +970,7 @@ class Client:
                 "(previous apply patch answered 415/400)")
         path = (f"{object_path(obj)}?fieldManager={manager}"
                 f"&force={'true' if force else 'false'}")
-        code, resp = self._request("PATCH", path, obj,
+        code, resp = self._request("PATCH", path, self._annotated(obj),
                                    "application/apply-patch+yaml")
         if code in (415, 400):
             # 400 is ambiguous: pre-SSA apiservers answered apply
@@ -1089,6 +1208,7 @@ class Client:
         keep-alive transport). Returns ``(conn, resp)`` on 200; raises
         :class:`_WatchDenied` on any other status or transport failure."""
         url = urllib.parse.urlsplit(self.base_url)
+        span_id, tp = self._attempt_context()
         t0 = time.monotonic()
         try:
             if url.scheme == "https":
@@ -1104,14 +1224,15 @@ class Client:
             if resource_version:
                 query += f"&resourceVersion={resource_version}"
             conn.request("GET", url.path.rstrip("/") + coll + query,
-                         headers=self._headers(False, ""))
+                         headers=self._headers(False, "", traceparent=tp))
             resp = conn.getresponse()
         except (http.client.HTTPException, OSError) as exc:
             self._note_attempt("GET", coll, 0, time.monotonic() - t0,
-                               watch=True)
+                               span_id=span_id, watch=True)
             raise _WatchDenied(0, f"transport error: {exc}")
         self._note_attempt("GET", coll, resp.status,
-                           time.monotonic() - t0, watch=True)
+                           time.monotonic() - t0, span_id=span_id,
+                           watch=True)
         if resp.status != 200:
             try:
                 body = json.loads(resp.read() or b"{}")
@@ -2057,7 +2178,7 @@ def _apply_one_uncounted(client: Client, obj: Dict[str, Any],
     if live is not None and _patch_is_noop(live, obj):
         return "unchanged"
     if live is None:
-        code, resp = client._request("POST", coll, obj)
+        code, resp = client._request("POST", coll, client._annotated(obj))
         if code in (200, 201, 202):
             with cache_lock:
                 cache.setdefault(coll, {})[name] = resp
@@ -2066,7 +2187,7 @@ def _apply_one_uncounted(client: Client, obj: Dict[str, Any],
             raise ApplyError(f"POST {path}: {code} {resp}")
         # AlreadyExists despite the cache: created outside this rollout
         # (or the fresh-install probe skipped the LIST) — patch it.
-    code, resp = client._request("PATCH", path, obj,
+    code, resp = client._request("PATCH", path, client._annotated(obj),
                                  "application/merge-patch+json")
     if code != 200:
         raise ApplyError(f"PATCH {path}: {code} {resp}")

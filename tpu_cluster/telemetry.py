@@ -40,14 +40,30 @@ compiler-only, and ``tpuctl verify --config operator-metrics`` FAILs a
 live scrape that lacks any pinned family. The fleet-scale and
 informer/workqueue roadmap items land on this already-instrumented
 baseline.
+
+TRACE CORRELATION (ISSUE 8) — every :class:`Tracer` owns a W3C trace id
+and every span a span id; ``kubeapply.Client`` sends a ``traceparent``
+header per wire attempt (the attempt's leaf span is the parent context),
+the fake apiserver records server-side spans tagged with the inbound
+trace/parent ids, and the C++ operator emits the twin Chrome-JSON schema
+(:data:`OPERATOR_TRACE_EVENTS` pins its slice names the way
+OPERATOR_METRIC_NAMES pins its metric families). ``merge_traces``
+assembles the three processes into ONE Perfetto timeline — per-process
+tracks, epoch-aligned, shared trace ids — and :class:`FlightRecorder`
+keeps a bounded always-on ring of the last spans/retry events,
+atomically flushed so a SIGKILL'd rollout still leaves a post-mortem
+trace even when ``--trace-out`` wasn't passed.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import random
 import threading
 import time
-from typing import Any, Dict, Iterator, List, Optional, Tuple, Union
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple, \
+    Union
 
 # --------------------------------------------------------------------------
 # Pinned metric names.
@@ -68,6 +84,21 @@ OPERATOR_METRIC_NAMES: Tuple[str, ...] = (
     "tpu_operator_sync_lag_seconds",
 )
 
+# Chrome trace-event slice names the C++ operator's trace emitter must
+# use (kubeapi::OperatorTraceEventNames(), native/operator/kubeapi.cc) —
+# pinned the same three ways as OPERATOR_METRIC_NAMES: selftest.cc pins
+# the C++ table compiler-only, tests/test_telemetry.py source-greps the
+# equality, and CI greps the operator's emitted trace artifact for them.
+# A rename lands on these pins before it lands on a broken merged
+# timeline.
+OPERATOR_TRACE_EVENTS: Tuple[str, ...] = (
+    "reconcile-pass",   # one full ReconcilePass (apply + gates + status)
+    "apply-object",     # one bundle object through ApplyObject
+    "ready-wait",       # one stage's readiness gate
+    "watch-sleep",      # one event-driven sleep holding watch streams
+    "drift-event",      # instant: a watch event that triggers reconcile
+)
+
 # The Python client/rollout family names (one place so instrumentation
 # sites and assertions cannot drift on spelling).
 REQUESTS_TOTAL = "tpuctl_requests_total"
@@ -85,7 +116,61 @@ DEFAULT_BUCKETS: Tuple[float, ...] = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
     1.0, 2.5, 5.0, 10.0)
 
+# The annotation the CLI stamps on objects it MUTATES (never on a no-op
+# skip) when telemetry is armed, carrying the apply's traceparent so the
+# operator can attribute its reconcile slices to the rollout that caused
+# them. Twin of kubeapi::TraceparentAnnotation() (native/operator/
+# kubeapi.cc), pinned by selftest.cc + a source-grep in tests.
+TRACEPARENT_ANNOTATION = "tpu-stack.dev/traceparent"
+
 LabelPairs = Tuple[Tuple[str, str], ...]
+
+
+# --------------------------------------------------------------------------
+# W3C Trace Context (traceparent) helpers.
+#
+# The wire format is `00-<32 hex trace-id>-<16 hex parent-id>-<2 hex
+# flags>` (https://www.w3.org/TR/trace-context/). One Tracer = one trace
+# id; every wire attempt gets its own span id, sent as the parent-id so
+# the server's span nests under the exact attempt that caused it.
+
+def new_trace_id() -> str:
+    """128-bit random trace id, 32 lowercase hex chars (never all-zero —
+    the spec reserves it as invalid)."""
+    return f"{random.getrandbits(128) or 1:032x}"
+
+
+def new_span_id() -> str:
+    """64-bit random span id, 16 lowercase hex chars (never all-zero)."""
+    return f"{random.getrandbits(64) or 1:016x}"
+
+
+def format_traceparent(trace_id: str, span_id: str) -> str:
+    return f"00-{trace_id}-{span_id}-01"
+
+
+_HEX_DIGITS = frozenset("0123456789abcdefABCDEF")
+
+
+def _hex_field(value: str, width: int) -> bool:
+    """Exactly ``width`` hex digits, not all zero — a STRICT check
+    (int(x, 16) would tolerate '0x' prefixes, signs and whitespace,
+    which the pinned C++ twin kubeapi::ParseTraceparent rejects; the
+    three parsers must agree byte-for-byte on what correlates)."""
+    return (len(value) == width and set(value) <= _HEX_DIGITS
+            and set(value) != {"0"})
+
+
+def parse_traceparent(header: str) -> Optional[Tuple[str, str]]:
+    """``(trace_id, parent_id)`` from a traceparent header, or None for
+    anything malformed (a server must tolerate garbage headers)."""
+    parts = header.strip().split("-")
+    if len(parts) != 4:
+        return None
+    _version, trace_id, parent_id, _flags = parts
+    if not _hex_field(trace_id, 32) or not _hex_field(parent_id, 16):
+        return None
+    return trace_id, parent_id
 
 
 def _label_pairs(labels: Dict[str, str]) -> LabelPairs:
@@ -341,11 +426,16 @@ class Span:
 
     def __init__(self, tracer: "Tracer", name: str, cat: str,
                  parent: Optional["Span"],
-                 args: Dict[str, Any]) -> None:
+                 args: Dict[str, Any],
+                 span_id: Optional[str] = None) -> None:
         self.tracer = tracer
         self.name = name
         self.cat = cat
         self.parent = parent
+        # W3C span id: pre-generated by the transport for wire attempts
+        # (the traceparent header must carry it BEFORE the attempt
+        # completes), random otherwise
+        self.span_id = span_id or new_span_id()
         # args/children/events mutate after publication (annotate() from
         # the owning thread, child attachment from ANY thread via
         # explicit parent=) — all three share the tracer's lock
@@ -366,10 +456,33 @@ class Span:
         offset = time.monotonic() - self.tracer.t0
         with self.tracer.lock:
             self.events.append((name, offset, dict(args)))
+        rec = self.tracer.recorder
+        if rec is not None:
+            # instant events (retry/backoff/chaos marks) are the flight
+            # recorder's most valuable cargo: flushed urgently so a
+            # SIGKILL right after a retry still leaves it on disk
+            rec.record({"ph": "i", "name": name, "cat": self.cat,
+                        "ts_s": round(offset, 6), "tid": self.tid,
+                        "args": dict(args)}, urgent=True)
 
     def end(self) -> None:
         if self.end_s is None:
             self.end_s = time.monotonic() - self.tracer.t0
+            self._record_end()
+
+    def _record_end(self) -> None:
+        """Feed the flight recorder one completed-span record (called
+        from end() and from Tracer.leaf, which sets end_s directly)."""
+        rec = self.tracer.recorder
+        if rec is None or self.end_s is None:
+            return
+        with self.tracer.lock:
+            args = dict(self.args)
+        rec.record({"ph": "X", "name": self.name, "cat": self.cat,
+                    "ts_s": round(self.start_s, 6),
+                    "dur_s": round(self.end_s - self.start_s, 6),
+                    "tid": self.tid, "span_id": self.span_id,
+                    "args": args})
 
     @property
     def duration_s(self) -> float:
@@ -412,6 +525,14 @@ class Tracer:
         # epoch anchor so two traces (or a trace and a server log) can be
         # aligned on wall-clock time
         self.epoch = time.time()
+        # one trace id per tracer: every traceparent this process sends
+        # (and every annotation it stamps) carries it, which is what lets
+        # `tpuctl trace merge` correlate three processes' timelines
+        self.trace_id = new_trace_id()
+        # optional FlightRecorder fed on span end / instant events; set
+        # once before instrumentation starts (the Telemetry constructor),
+        # read by every recording thread
+        self.recorder: Optional["FlightRecorder"] = None
         self.lock: Any = threading.Lock()
         self.roots: List[Span] = []  # guarded-by: lock
         self._tls = threading.local()  # thread-owned (per-thread stack)
@@ -438,13 +559,13 @@ class Tracer:
             stack.pop()
 
     def start(self, name: str, cat: str, parent: Optional[Span] = None,
-              **args: Any) -> Span:
+              span_id: Optional[str] = None, **args: Any) -> Span:
         """Create (and attach) a span; caller must ``end()`` it. Parent
         resolution: explicit ``parent`` wins (thread boundaries), else the
         calling thread's innermost open span, else a new root."""
         if parent is None:
             parent = self.current()
-        span = Span(self, name, cat, parent, args)
+        span = Span(self, name, cat, parent, args, span_id=span_id)
         if parent is not None:
             # phrased receiver-locally (parent.tracer IS this tracer):
             # child attachment happens under the lock guarding
@@ -461,12 +582,16 @@ class Tracer:
         return _SpanScope(self, self.start(name, cat, parent, **args))
 
     def leaf(self, name: str, cat: str, duration_s: float,
-             parent: Optional[Span] = None, **args: Any) -> Span:
+             parent: Optional[Span] = None,
+             span_id: Optional[str] = None, **args: Any) -> Span:
         """Record an already-completed leaf span ending NOW (wire attempts
-        are timed by the transport and reported after the fact)."""
-        span = self.start(name, cat, parent, **args)
+        are timed by the transport and reported after the fact;
+        ``span_id`` is the id the transport already sent in the attempt's
+        traceparent header, so server-side spans can name it)."""
+        span = self.start(name, cat, parent, span_id=span_id, **args)
         span.start_s = max(0.0, span.start_s - max(0.0, duration_s))
         span.end_s = span.start_s + max(0.0, duration_s)
+        span._record_end()
         return span
 
     def event(self, name: str, **args: Any) -> None:
@@ -508,6 +633,10 @@ class Tracer:
             end = span.end_s if span.end_s is not None else now
             if span.end_s is None:
                 args["unfinished"] = True
+            # every span exports its W3C span id: the server-side spans'
+            # parent_id values resolve against these (the traceparent
+            # parity pin in tests/test_trace_correlation.py)
+            args["span_id"] = span.span_id
             events.append({
                 "name": span.name, "cat": span.cat, "ph": "X",
                 "ts": round(span.start_s * 1e6, 1),
@@ -523,15 +652,138 @@ class Tracer:
         events.sort(key=lambda e: (e["ts"], e["ph"] != "X"))
         return {"traceEvents": events, "displayTimeUnit": "ms",
                 "otherData": {"producer": "tpuctl",
+                              "trace_id": self.trace_id,
                               "epoch": self.epoch}}
 
 
-class Telemetry:
-    """The facade instrumented code holds: one tracer + one registry."""
+def _atomic_write(path: str, text: str) -> None:
+    """Write ``text`` to ``path`` via temp-file + rename, so a SIGKILL at
+    any instant leaves either the previous file or the complete new one —
+    never torn JSON (the journal's torn-tail discipline, applied to every
+    telemetry output). The scratch file comes from ``tempfile.mkstemp``
+    (O_CREAT|O_EXCL, random name, 0600): a predictable temp name in a
+    shared directory would be symlink-plantable (CWE-377), and the
+    flight recorder's default lives in exactly such a directory."""
+    import tempfile
+    directory = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(
+        prefix=f".{os.path.basename(path)}.", suffix=".tmp",
+        dir=directory)
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            f.write(text)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
-    def __init__(self) -> None:
+
+def write_json(path: str, doc: Dict[str, Any]) -> None:
+    """Atomically write one JSON document (compact, trailing newline) —
+    the public face of :func:`_atomic_write` for trace files."""
+    _atomic_write(path, json.dumps(doc, separators=(",", ":")) + "\n")
+
+
+class FlightRecorder:
+    """Bounded always-on post-mortem trace: a ring of the last
+    ``capacity`` span/instant-event records, rewritten ATOMICALLY to
+    ``path`` — urgently on every instant event (retries are the cargo a
+    post-mortem needs), else every ``flush_every`` records. Because the
+    on-disk file is replaced via rename, a SIGKILL at any instant leaves
+    a parseable dump (at worst ``flush_every`` spans stale); crash /
+    SIGTERM / chaos-failure paths flush explicitly. The dump is a Chrome
+    trace-event document (``otherData.flight_recorder: true``) so the
+    same tools — Perfetto, ``tpuctl top``, ``tpuctl trace merge`` — read
+    it."""
+
+    def __init__(self, path: str, trace_id: str = "",
+                 capacity: int = 256, flush_every: int = 16) -> None:
+        self.path = path
+        self.trace_id = trace_id
+        self.capacity = max(1, capacity)
+        self.flush_every = max(1, flush_every)
+        self.epoch = time.time()
+        self._lock: Any = threading.Lock()
+        self._ring: List[Dict[str, Any]] = []  # guarded-by: _lock
+        self._since_flush = 0  # guarded-by: _lock
+        self._dropped = 0  # guarded-by: _lock
+
+    def record(self, rec: Dict[str, Any], urgent: bool = False) -> None:
+        with self._lock:
+            self._ring.append(rec)
+            overflow = len(self._ring) - self.capacity
+            if overflow > 0:
+                del self._ring[:overflow]
+                self._dropped += overflow
+            self._since_flush += 1
+            flush = urgent or self._since_flush >= self.flush_every
+        if flush:
+            self.flush()
+
+    def document(self) -> Dict[str, Any]:
+        """The ring as a Chrome trace-event document (best-effort times:
+        ts/dur come from the recorded offsets)."""
+        with self._lock:
+            ring = list(self._ring)
+            dropped = self._dropped
+        events: List[Dict[str, Any]] = []
+        for rec in ring:
+            ev: Dict[str, Any] = {
+                "name": rec.get("name", "?"), "cat": rec.get("cat", "?"),
+                "ph": rec.get("ph", "X"),
+                "ts": round(float(rec.get("ts_s", 0.0)) * 1e6, 1),
+                "pid": 1, "tid": rec.get("tid", 0),
+                "args": dict(rec.get("args") or {}),
+            }
+            if ev["ph"] == "X":
+                ev["dur"] = round(float(rec.get("dur_s", 0.0)) * 1e6, 1)
+                if "span_id" in rec:
+                    ev["args"]["span_id"] = rec["span_id"]
+            else:
+                ev["s"] = "t"
+            events.append(ev)
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "otherData": {"producer": "tpuctl-flight-recorder",
+                              "flight_recorder": True,
+                              "trace_id": self.trace_id,
+                              "capacity": self.capacity,
+                              # same key as the C++ twin emitter's
+                              # otherData (kubeapi::TraceEmitter):
+                              # records evicted from the bounded ring
+                              "dropped_events": dropped,
+                              "epoch": self.epoch}}
+
+    def flush(self) -> None:
+        """Atomically rewrite the on-disk dump from the current ring.
+        Best-effort by design: an unwritable path must never fail the
+        rollout the recorder exists to debug."""
+        try:
+            _atomic_write(self.path,
+                          json.dumps(self.document(),
+                                     separators=(",", ":")) + "\n")
+        except OSError:
+            pass
+        with self._lock:
+            self._since_flush = 0
+
+
+class Telemetry:
+    """The facade instrumented code holds: one tracer + one registry
+    (+ optionally one flight recorder fed by the tracer)."""
+
+    def __init__(self, recorder: Optional[FlightRecorder] = None) -> None:
         self.tracer = Tracer()
         self.metrics = MetricsRegistry()
+        self.recorder = recorder
+        if recorder is not None:
+            if not recorder.trace_id:
+                recorder.trace_id = self.tracer.trace_id
+            self.tracer.recorder = recorder
 
     # tracing delegates
     def span(self, name: str, cat: str, parent: Optional[Span] = None,
@@ -539,8 +791,10 @@ class Telemetry:
         return self.tracer.span(name, cat, parent, **args)
 
     def leaf(self, name: str, cat: str, duration_s: float,
-             parent: Optional[Span] = None, **args: Any) -> Span:
-        return self.tracer.leaf(name, cat, duration_s, parent, **args)
+             parent: Optional[Span] = None,
+             span_id: Optional[str] = None, **args: Any) -> Span:
+        return self.tracer.leaf(name, cat, duration_s, parent,
+                                span_id=span_id, **args)
 
     def current(self) -> Optional[Span]:
         return self.tracer.current()
@@ -562,18 +816,18 @@ class Telemetry:
         return self.metrics.histogram(name, help_text, buckets=buckets,
                                       **labels)
 
-    # export
+    # export — both writes are ATOMIC (temp + rename): a SIGKILL mid-dump
+    # must leave the previous file or the complete new one, never torn
+    # JSON/exposition text (the journal's torn-tail discipline)
     def chrome_trace(self) -> Dict[str, Any]:
         return self.tracer.chrome_trace()
 
     def write_trace(self, path: str) -> None:
-        with open(path, "w", encoding="utf-8") as f:
-            json.dump(self.chrome_trace(), f, separators=(",", ":"))
-            f.write("\n")
+        _atomic_write(path, json.dumps(self.chrome_trace(),
+                                       separators=(",", ":")) + "\n")
 
     def write_metrics(self, path: str) -> None:
-        with open(path, "w", encoding="utf-8") as f:
-            f.write(self.metrics.render())
+        _atomic_write(path, self.metrics.render())
 
 
 def maybe_span(tel: Optional[Telemetry], name: str, cat: str,
@@ -623,11 +877,26 @@ def request_events(trace: Dict[str, Any]) -> List[Dict[str, Any]]:
 def summarize_trace(trace: Dict[str, Any], limit: int = 10) -> str:
     """Human breakdown of a saved rollout trace: per-phase totals,
     request counts by verb/status, retry marks, and the slowest object /
-    request spans — the `tpuctl top` renderer."""
+    request spans — the `tpuctl top` renderer. Merged multi-process
+    traces (`tpuctl trace merge`) list their per-process tracks first."""
     complete = _complete_events(trace)
     if not complete:
         raise ValueError("trace has no complete (ph=X) span events")
     lines: List[str] = []
+    processes = {e.get("pid"): e.get("args", {}).get("name", "?")
+                 for e in trace.get("traceEvents", [])
+                 if isinstance(e, dict) and e.get("ph") == "M"
+                 and e.get("name") == "process_name"}
+    if processes:
+        by_pid: Dict[Any, int] = {}
+        for e in complete:
+            by_pid[e.get("pid")] = by_pid.get(e.get("pid"), 0) + 1
+        lines.append("processes (merged trace):")
+        for pid, name in sorted(processes.items(),
+                                key=lambda kv: str(kv[0])):
+            lines.append(f"  pid {pid}: {name} "
+                         f"({by_pid.get(pid, 0)} span(s))")
+        lines.append("")
     rollouts = [e for e in complete if e.get("cat") == "rollout"]
     for r in rollouts:
         lines.append(f"rollout: {r.get('dur', 0.0) / 1e6:.3f}s "
@@ -668,3 +937,94 @@ def summarize_trace(trace: Dict[str, Any], limit: int = 10) -> str:
         lines.append(f"  {float(e.get('dur', 0.0)) / 1e6:8.3f}s  "
                      f"{e.get('cat', '?'):<6} {e.get('name', '?')}{suffix}")
     return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------
+# Multi-process trace assembly (`tpuctl trace merge`) + schema validation.
+
+
+def validate_chrome_trace(trace: Any) -> int:
+    """Validate a document against the Chrome trace-event JSON object
+    format (the subset every producer in this repo emits): a dict with a
+    ``traceEvents`` list of event dicts, each carrying string ``name`` /
+    ``ph`` and numeric ``ts``; ``X`` events need a numeric non-negative
+    ``dur``; ``pid``/``tid`` must be ints where present. Raises
+    ValueError naming the first offending event; returns the event count
+    (the CI artifact gate calls this on the merged file)."""
+    events = _complete_events(trace)  # raises on non-dict / no traceEvents
+    all_events = trace["traceEvents"]
+    for i, e in enumerate(all_events):
+        if not isinstance(e, dict):
+            raise ValueError(f"traceEvents[{i}] is not an object")
+        where = f"traceEvents[{i}] ({e.get('name')!r})"
+        if not isinstance(e.get("name"), str):
+            raise ValueError(f"{where}: name is not a string")
+        if not isinstance(e.get("ph"), str) or not e["ph"]:
+            raise ValueError(f"{where}: ph is not a string")
+        if not isinstance(e.get("ts"), (int, float)):
+            raise ValueError(f"{where}: ts is not a number")
+        for key in ("pid", "tid"):
+            if key in e and not isinstance(e[key], int):
+                raise ValueError(f"{where}: {key} is not an int")
+        if e["ph"] == "X":
+            dur = e.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                raise ValueError(f"{where}: X event without a "
+                                 "non-negative numeric dur")
+        if "args" in e and not isinstance(e["args"], dict):
+            raise ValueError(f"{where}: args is not an object")
+    return len(events)
+
+
+def merge_traces(docs: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """Assemble several single-process Chrome traces (the CLI's
+    ``--trace-out``, the fake apiserver's ``/__fake_trace``, the C++
+    operator's ``--trace-out``) into ONE Perfetto timeline:
+
+    - each input becomes its own process track (pid = input index + 1)
+      named by its ``otherData.producer`` via a ``process_name`` metadata
+      event;
+    - timelines are aligned on the producers' ``otherData.epoch`` anchors
+      (each trace's ts values are offsets from its own start): everything
+      is shifted onto the EARLIEST epoch so "what was the server doing
+      while the CLI retried" reads straight off the time axis;
+    - trace ids are NOT rewritten — correlation is the ids' job
+      (``args.trace_id`` / ``args.span_id`` / ``args.parent_id``), and
+      ``otherData.trace_ids`` lists every input's primary id.
+    """
+    if not docs:
+        raise ValueError("merge_traces: no input traces")
+    epochs: List[float] = []
+    for doc in docs:
+        other = doc.get("otherData") or {}
+        epochs.append(float(other.get("epoch") or 0.0))
+    known = [e for e in epochs if e > 0]
+    base = min(known) if known else 0.0
+    out_events: List[Dict[str, Any]] = []
+    producers: List[str] = []
+    trace_ids: List[str] = []
+    for i, doc in enumerate(docs):
+        pid = i + 1
+        other = doc.get("otherData") or {}
+        producer = str(other.get("producer") or f"process-{pid}")
+        producers.append(producer)
+        tid = str(other.get("trace_id") or "")
+        if tid:
+            trace_ids.append(tid)
+        shift_us = ((epochs[i] - base) * 1e6
+                    if epochs[i] > 0 and base > 0 else 0.0)
+        out_events.append({"name": "process_name", "ph": "M", "pid": pid,
+                           "tid": 0, "ts": 0,
+                           "args": {"name": producer}})
+        validate_chrome_trace(doc)
+        for e in doc["traceEvents"]:
+            ev = dict(e)
+            ev["pid"] = pid
+            ev["ts"] = round(float(e.get("ts", 0.0)) + shift_us, 1)
+            out_events.append(ev)
+    out_events.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0.0)))
+    return {"traceEvents": out_events, "displayTimeUnit": "ms",
+            "otherData": {"producer": "tpuctl trace merge",
+                          "merged_from": producers,
+                          "trace_ids": sorted(set(trace_ids)),
+                          "epoch": base}}
