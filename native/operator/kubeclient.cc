@@ -217,29 +217,11 @@ Response PlainHttp(const Config& cfg, const Url& url,
   resp.retry_after_ms = ParseRetryAfterMs(headers);
   if (headers.find("transfer-encoding: chunked") != std::string::npos) {
     std::string decoded;
-    size_t pos = 0;
-    bool terminated = false;
-    while (pos < resp.body.size()) {
-      size_t nl = resp.body.find("\r\n", pos);
-      if (nl == std::string::npos) break;
-      // strtol returns 0 for both a real "0" terminator and an unparseable
-      // size line — distinguish via endptr so a corrupted chunk header is a
-      // truncation error, not a silently-empty 200 body.
-      char* end = nullptr;
-      long chunk = strtol(resp.body.c_str() + pos, &end, 16);
-      if (end == resp.body.c_str() + pos || chunk < 0) break;
-      if (chunk == 0) {
-        terminated = true;
-        break;
-      }
-      if (nl + 2 + chunk > resp.body.size()) break;  // truncated data
-      decoded += resp.body.substr(nl + 2, chunk);
-      pos = nl + 2 + chunk + 2;
-    }
-    if (!terminated) {
-      // A chunked body that ends without the 0-length chunk was cut off
-      // mid-stream; silently returning the prefix would hand truncated JSON
-      // to the reconciler.
+    if (!DecodeChunkedBody(resp.body, &decoded)) {
+      // A chunked body that ends without the 0-length chunk (or whose
+      // size lines are garbage) was cut off mid-stream; silently
+      // returning the prefix would hand truncated JSON to the
+      // reconciler.
       resp.status = 0;
       resp.body.clear();
       resp.error = "truncated chunked HTTP body";
@@ -400,6 +382,27 @@ bool RetryableStatus(int status) {
     default:
       return false;  // success, or a terminal 4xx retries cannot fix
   }
+}
+
+bool DecodeChunkedBody(const std::string& body, std::string* decoded) {
+  decoded->clear();
+  size_t pos = 0;
+  while (pos < body.size()) {
+    size_t nl = body.find("\r\n", pos);
+    if (nl == std::string::npos) return false;  // size line cut off
+    // strtol returns 0 for both a real "0" terminator and an unparseable
+    // size line — distinguish via endptr so a corrupted chunk header is a
+    // truncation error, not a silently-empty 200 body.
+    char* end = nullptr;
+    long chunk = strtol(body.c_str() + pos, &end, 16);
+    if (end == body.c_str() + pos || chunk < 0) return false;  // garbage
+    if (chunk == 0) return true;  // the terminator: complete stream
+    if (nl + 2 + static_cast<size_t>(chunk) > body.size())
+      return false;  // truncated chunk data
+    decoded->append(body, nl + 2, static_cast<size_t>(chunk));
+    pos = nl + 2 + static_cast<size_t>(chunk) + 2;
+  }
+  return false;  // ran out of bytes before the 0-length terminator
 }
 
 int ParseRetryAfterMs(const std::string& lowered_headers) {

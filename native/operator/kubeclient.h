@@ -45,6 +45,20 @@ bool RetryableStatus(int status);
 // Fractional seconds are accepted (test servers use them); clamped to 1h.
 int ParseRetryAfterMs(const std::string& lowered_headers);
 
+// Decode one COMPLETE chunked transfer-encoded payload into *decoded.
+// Returns true only when the stream TERMINATED (the 0-length final chunk
+// was present); false = truncated or garbage — an unparseable size line,
+// a negative size, chunk data cut off mid-stream, or EOF before the
+// terminator. A false return means the caller must classify the reply as
+// transport status 0 ("truncated chunked HTTP body"), never hand the
+// decoded prefix to a JSON parser as a silently-short 200 — the TRUNCATE
+// fault class a slow/dying apiserver produces. The hostile byte-vector
+// table in operator_selftest (kHostileChunkVectors) is the shared
+// Python<->C++ pin: tests/test_slowpath.py greps it and drives the same
+// vectors through the Python client's transport (RetryableStatus
+// pattern).
+bool DecodeChunkedBody(const std::string& body, std::string* decoded);
+
 struct Response {
   int status = 0;          // HTTP status; 0 = transport failure
   std::string body;

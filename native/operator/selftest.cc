@@ -400,6 +400,84 @@ static void TestTraceEmitter() {
   CHECK(doc2->PathNumber("otherData.dropped_events", 0) > 0);
 }
 
+// Hostile chunked-transfer byte vectors (ISSUE 9): the shared
+// Python<->C++ table for the TRUNCATE/GARBAGE fault classes
+// (RetryableStatus pattern — tests/test_slowpath.py greps THESE raw
+// strings out of this file and drives the identical bytes through the
+// Python client's transport over a raw socket, asserting the same
+// accept/reject verdicts). `ok` = the stream terminated cleanly and
+// `decoded` is the payload; !ok = truncated/garbage, which the clients
+// must classify as transport status 0, never as a short 200.
+struct ChunkVector {
+  const char* name;
+  const char* raw;
+  bool ok;
+  const char* decoded;
+};
+static const ChunkVector kHostileChunkVectors[] = {
+    {"clean", "2\r\n{}\r\n0\r\n\r\n", true, "{}"},
+    {"clean-multi", "5\r\nhello\r\n6\r\n world\r\n0\r\n\r\n", true,
+     "hello world"},
+    {"empty-terminated", "0\r\n\r\n", true, ""},
+    {"no-terminator", "5\r\nhello\r\n", false, ""},
+    {"truncated-data", "40\r\n{\"type\":\"MODIFIED\",\"object\":{\"kind",
+     false, ""},
+    {"garbage-size", "zz\r\nhello\r\n0\r\n\r\n", false, ""},
+    {"negative-size", "-5\r\nhello\r\n0\r\n\r\n", false, ""},
+    {"empty", "", false, ""},
+    {"bare-crlf", "\r\n", false, ""},
+};
+
+static void TestChunkedDecodeHostileVectors() {
+  // Table-driven verdicts: every vector decodes (or is rejected) exactly
+  // as pinned — the same verdicts the Python twin asserts over a live
+  // socket.
+  for (const auto& v : kHostileChunkVectors) {
+    std::string out;
+    bool ok = kubeclient::DecodeChunkedBody(v.raw, &out);
+    if (ok != v.ok) {
+      fprintf(stderr, "FAIL chunk vector %s: ok=%d want %d\n", v.name, ok,
+              v.ok);
+      ++g_failures;
+    }
+    if (ok && out != v.decoded) {
+      fprintf(stderr, "FAIL chunk vector %s: decoded %s want %s\n", v.name,
+              out.c_str(), v.decoded);
+      ++g_failures;
+    }
+  }
+  // Truncation fuzz: EVERY byte-prefix of every vector must decode
+  // without crashing or over-reading, and a truncated CLEAN stream must
+  // never report terminated with the wrong payload — cutting a valid
+  // stream anywhere before its final chunk's size line yields !ok or a
+  // strict prefix of the full payload.
+  for (const auto& v : kHostileChunkVectors) {
+    std::string raw = v.raw;
+    for (size_t cut = 0; cut < raw.size(); ++cut) {
+      std::string out;
+      bool ok = kubeclient::DecodeChunkedBody(raw.substr(0, cut), &out);
+      if (ok && v.ok) {
+        std::string full = v.decoded;
+        CHECK(out.size() <= full.size() &&
+              full.compare(0, out.size(), out) == 0);
+      }
+    }
+  }
+  // Garbage fuzz: hostile filler bytes in place of sizes/payloads never
+  // crash the decoder and never terminate a stream that lacks the
+  // 0-length chunk. Explicit lengths so embedded NULs actually reach
+  // the decoder (a const char* would strlen-truncate at the first one).
+  const std::string fillers[] = {std::string("\x00\x01\x02", 3),
+                                 std::string("\xff\xfe", 2),
+                                 "GET / HTTP/1.1", "{\"json\":",
+                                 "99999999999999999999\r\nx"};
+  for (const std::string& f : fillers) {
+    std::string out;
+    CHECK(!kubeclient::DecodeChunkedBody(f, &out));
+    CHECK(!kubeclient::DecodeChunkedBody(f + "\r\n", &out));
+  }
+}
+
 static void TestWatchBackoff() {
   // Doubling from base, capped: the operand drift-watch reconnect
   // schedule. A persistently kClosed stream (each https open is a curl
@@ -433,6 +511,7 @@ int main() {
   TestHistogramBucketBoundary();
   TestPromEscapeLabelValue();
   TestTraceEmitter();
+  TestChunkedDecodeHostileVectors();
   TestWatchBackoff();
   if (g_failures) {
     fprintf(stderr, "operator_selftest: %d FAILURES\n", g_failures);

@@ -38,6 +38,15 @@ steady-state re-applies through FRESH clients: the exact managedFields
 no-op check must converge on reads alone — zero POST/PATCH mutations —
 which the merge path's conservative heuristic could not promise.
 
+A fourth axis (the slow-path chaos round): ``faults.slow`` — the full
+bundle under ``slow_fault_script`` (stall/trickle/truncate/garbage, the
+apiserver that is SLOW rather than failing fast) with the deadline
+discipline armed: per-attempt wall + hedged reads. Reported per
+readiness mode: wall, requests, retries, hedges, and
+``attempts_over_deadline`` — gated at ZERO by --check (no wire attempt
+may outlive deadline+grace; per-socket-op timeouts alone cannot promise
+that against a trickle).
+
 EVERY number in the JSON line is derived from the telemetry span tree
 (tpu_cluster.telemetry — the same spans `tpuctl apply --trace-out` hands
 a user), not from private counters: per-phase timings come from phase
@@ -71,7 +80,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.join(REPO, "tests"))
 
-from fake_apiserver import FakeApiServer, standard_fault_script  # noqa: E402
+from fake_apiserver import (FakeApiServer, slow_fault_script,  # noqa: E402
+                            standard_fault_script)
 from tpu_cluster import kubeapply  # noqa: E402
 from tpu_cluster import spec as specmod  # noqa: E402
 from tpu_cluster import telemetry  # noqa: E402
@@ -92,6 +102,16 @@ FAULT_UNIT_S = 0.03
 # Retries under faults use a bench-scaled policy: same taxonomy, faster
 # clock (production default is base 0.1s / cap 5s).
 FAULT_RETRY = kubeapply.RetryPolicy(attempts=8, base_s=0.02, cap_s=0.3)
+# The slow-fault arm (ISSUE 9): slow_fault_script timing unit, the
+# per-attempt wall the client arms against it, the hedge threshold for
+# idempotent reads, and the scheduling/IO grace the span-duration gate
+# allows past the wall. The --check contract: the rollout converges AND
+# zero wire attempts outlive deadline+grace — the whole-attempt wall is
+# what makes stalls/trickles survivable.
+SLOW_FAULT_UNIT_S = 0.05
+SLOW_ATTEMPT_DEADLINE_S = 0.25
+SLOW_HEDGE_S = 0.1
+SLOW_DEADLINE_GRACE_S = 0.2
 
 
 def full_stack_groups(spec):
@@ -347,6 +367,55 @@ def faults_arm(latency_s: float, watch: bool, faulted: bool) -> dict:
             "retries": retries, "converged": True}
 
 
+def attempts_over_deadline(trace: dict, bound_s: float) -> int:
+    """Wire-attempt spans (cat "http") whose duration exceeded
+    ``bound_s`` — the slow arm's acceptance is that this is ZERO: with
+    the whole-attempt wall armed, no stall/trickle can hold an attempt
+    past deadline+grace."""
+    return sum(1 for e in telemetry.request_events(trace)
+               if float(e.get("dur", 0.0)) / 1e6 > bound_s)
+
+
+def slow_faults_arm(latency_s: float, watch: bool) -> dict:
+    """One fresh full-bundle install under :func:`slow_fault_script` —
+    a stalled request, a trickled GET body, truncated chunked replies
+    (plain + watch) and garbage 200s — with the ISSUE 9 deadline
+    discipline armed: a per-attempt wall
+    (``attempt_deadline_s=SLOW_ATTEMPT_DEADLINE_S``) and hedged
+    idempotent reads (``hedge_s=SLOW_HEDGE_S``). Convergence is the
+    baseline contract; the sharper one is that EVERY wire-attempt span
+    stayed within deadline+grace (the wall held against the trickle,
+    which per-op timeouts cannot bound) and the stalled first read was
+    rescued by exactly the hedging machinery (``hedges`` counts it)."""
+    spec = specmod.default_spec()
+    groups = full_stack_groups(spec)
+    tel = telemetry.Telemetry()
+    with FakeApiServer(auto_ready=True, latency_s=latency_s,
+                       chaos=slow_fault_script(SLOW_FAULT_UNIT_S)) as api:
+        client = kubeapply.Client(
+            api.url, retry=FAULT_RETRY, telemetry=tel,
+            attempt_deadline_s=SLOW_ATTEMPT_DEADLINE_S,
+            hedge_s=SLOW_HEDGE_S)
+        t0 = time.monotonic()
+        kubeapply.apply_groups(client, groups, wait=True, stage_timeout=60,
+                               poll=0.05, max_inflight=8, watch_ready=watch)
+        wall = time.monotonic() - t0
+        fired_kinds = sorted({k for k, _m, _p
+                              in api.chaos.fired_snapshot()})
+        client.close()
+    retries = int(tel.metrics.total(telemetry.RETRIES_TOTAL))
+    hedges = int(tel.metrics.total(telemetry.HEDGES_TOTAL))
+    if hedges != client.hedges:
+        raise SystemExit(f"bench_rollout: hedge count mismatch — registry "
+                         f"{hedges} vs client {client.hedges}")
+    over = attempts_over_deadline(
+        tel.chrome_trace(), SLOW_ATTEMPT_DEADLINE_S + SLOW_DEADLINE_GRACE_S)
+    return {"wall_s": round(wall, 3), "requests": _trace_requests(tel),
+            "retries": retries, "hedges": hedges,
+            "attempts_over_deadline": over,
+            "fired_kinds": fired_kinds, "converged": True}
+
+
 def _operator_binary() -> str:
     """The C++ operator, if a native build tree already has it (conftest /
     CI build it; this bench never builds — the drift column is reported
@@ -477,6 +546,18 @@ def main(argv=None) -> int:
         "poll": {"clean": faults_arm(latency_s, watch=False, faulted=False),
                  "faulted": faults_arm(latency_s, watch=False,
                                        faulted=True)},
+        # The SLOW-path column (ISSUE 9): stall/trickle/truncate/garbage
+        # under whole-attempt deadlines + hedged reads — wall, requests,
+        # retries, hedges, and the zero-attempts-over-deadline contract.
+        "slow": {
+            "script": "stall+trickle+truncate+garbage",
+            "unit_s": SLOW_FAULT_UNIT_S,
+            "attempt_deadline_s": SLOW_ATTEMPT_DEADLINE_S,
+            "grace_s": SLOW_DEADLINE_GRACE_S,
+            "hedge_s": SLOW_HEDGE_S,
+            "watch": slow_faults_arm(latency_s, watch=True),
+            "poll": slow_faults_arm(latency_s, watch=False),
+        },
     }
 
     op_trace_path = args.operator_trace_out
@@ -571,6 +652,20 @@ def main(argv=None) -> int:
                     and faulted["requests"] >= clean["requests"]):
                 print(f"bench_rollout: FAIL — faulted {mode} arm "
                       f"{faulted} vs clean {clean}", file=sys.stderr)
+                return 1
+        # slow-path chaos: both readiness modes must converge under the
+        # slow script WITH the deadline discipline holding — zero wire
+        # attempts past deadline+grace (the wall beat the stall AND the
+        # trickle), retries visible, and the stalled first GET rescued
+        # by at least one hedge
+        for mode in ("watch", "poll"):
+            slow = faults["slow"][mode]
+            if not (slow["converged"] and slow["retries"] > 0
+                    and slow["hedges"] >= 1
+                    and slow["attempts_over_deadline"] == 0):
+                print(f"bench_rollout: FAIL — slow {mode} arm {slow} "
+                      f"(need converged, retries>0, hedges>=1, "
+                      f"attempts_over_deadline==0)", file=sys.stderr)
                 return 1
         # server-side apply: the cold install must cost >=40% fewer
         # requests than the GET-then-merge cold path, and the warm

@@ -242,6 +242,29 @@ class ChaosEngine:
                                                         # are 410-invalidated
                                                         # (FakeApiServer.flap)
 
+    SLOW-PATH faults (ISSUE 9) — the server that is slow rather than
+    failing fast; all four honor ``for``/``count`` like status faults:
+
+      {"stall": 2.0}            # accept the request, send NOTHING for
+                                # 2 s, then sever the connection — only
+                                # a whole-attempt wall deadline (never a
+                                # per-socket-op timeout on a silent
+                                # socket longer than the stall) gets the
+                                # client unstuck
+      {"trickle": 30}           # 200 + full headers at once, then the
+                                # body dribbled at 30 bytes/second —
+                                # DEFEATS per-socket-op timeouts by
+                                # design (every recv succeeds); "body"
+                                # overrides the dribbled JSON document
+      {"truncate": True}        # 200 + Transfer-Encoding: chunked that
+                                # promises more bytes than it sends and
+                                # EOFs mid-chunk — mid-body for plain
+                                # requests, mid-event for watch streams
+      {"garbage": True}         # 200 whose body is half-JSON — a
+                                # healthy-looking reply the client must
+                                # classify as transport garbage, not
+                                # parse; "body" (a raw string) overrides
+
     Optional keys on any fault: ``at`` (seconds after start(), default 0),
     ``match`` (path substring; ``exact: True`` for equality), ``method``
     (exact HTTP method), ``watch`` (True = only ``?watch=1`` GETs),
@@ -294,10 +317,25 @@ class ChaosEngine:
         with self._lock:
             return list(self.fired)
 
+    @staticmethod
+    def _consume(f: Dict[str, Any]) -> bool:
+        """Window/count bookkeeping shared by every fault kind: a fault
+        with a ``for`` window fires on every match inside it; otherwise
+        ``count`` bounds total firings (absent = every match until
+        clear())."""
+        if f.get("for") is None and "count" in f:
+            left = f.setdefault("_left", f["count"])
+            if left <= 0:
+                return False
+            f["_left"] = left - 1
+        return True
+
     def intercept(self, method: str, path: str, is_watch: bool,
                   is_ssa: bool = False):
-        """None (pass through) | ("drop",) | ("status", code, headers,
-        body) for one request."""
+        """None (pass through) | ("drop",) | ("stall", secs) |
+        ("trickle", bytes_per_sec, body) | ("truncate",) |
+        ("garbage", raw_body) | ("status", code, headers, body) for one
+        request."""
         with self._lock:
             now = (0.0 if self._t0 is None
                    else time.monotonic() - self._t0)
@@ -326,14 +364,31 @@ class ChaosEngine:
                     f["_left"] = left - 1
                     self.fired.append(("drop", method, path))
                     return ("drop",)
+                if "stall" in f:
+                    if not self._consume(f):
+                        continue
+                    self.fired.append(("stall", method, path))
+                    return ("stall", float(f["stall"]))
+                if "trickle" in f:
+                    if not self._consume(f):
+                        continue
+                    self.fired.append(("trickle", method, path))
+                    return ("trickle", float(f["trickle"]), f.get("body"))
+                if f.get("truncate"):
+                    if not self._consume(f):
+                        continue
+                    self.fired.append(("truncate", method, path))
+                    return ("truncate",)
+                if f.get("garbage"):
+                    if not self._consume(f):
+                        continue
+                    self.fired.append(("garbage", method, path))
+                    return ("garbage", f.get("body"))
                 status = f.get("status")
                 if status is None:
                     continue
-                if dur is None and "count" in f:
-                    left = f.setdefault("_left", f["count"])
-                    if left <= 0:
-                        continue
-                    f["_left"] = left - 1
+                if not self._consume(f):
+                    continue
                 headers = {}
                 if f.get("retry_after") is not None:
                     headers["Retry-After"] = str(f["retry_after"])
@@ -355,6 +410,33 @@ def standard_fault_script(unit: float = 0.05) -> List[Dict[str, Any]]:
         {"at": 0.0, "for": 3 * unit, "status": 503, "retry_after": unit},
         {"at": 3 * unit, "drop": 2},
         {"at": 5 * unit, "flap": True},
+    ]
+
+
+def slow_fault_script(unit: float = 0.05) -> List[Dict[str, Any]]:
+    """The SLOW-PATH sibling of :func:`standard_fault_script` (ISSUE 9):
+    instead of failing fast, the apiserver goes quiet — one STALLED
+    request (accepted, nothing ever sent), one TRICKLED GET body (headers
+    at once, then a dribble that defeats per-socket-op timeouts), one
+    TRUNCATED chunked watch stream plus one truncated plain reply, and
+    two GARBAGE half-JSON 200s. Every fault is count-bounded so a client
+    with whole-attempt deadline discipline converges on retries; without
+    one, the stall and the trickle park it for ~8*unit each — exactly
+    the failure the deadline layer exists for. ``unit`` scales the stall
+    duration and the trickle rate the way it scales the standard
+    script's windows."""
+    trickle_body = {"kind": "Status", "code": 200, "reason": "Chaos",
+                    "message": "trickled"}
+    body_len = len(json.dumps(trickle_body))
+    return [
+        {"at": 0.0, "count": 1, "stall": 8 * unit},
+        # rate chosen so the full dribble takes ~8*unit — far past any
+        # sane per-attempt deadline at that unit
+        {"at": 0.0, "count": 1, "method": "GET",
+         "trickle": max(1.0, body_len / (8 * unit)), "body": trickle_body},
+        {"at": 0.0, "count": 1, "truncate": True, "watch": True},
+        {"at": unit, "count": 1, "truncate": True},
+        {"at": unit, "count": 2, "garbage": True},
     ]
 
 
@@ -560,6 +642,14 @@ class FakeApiServer:
                     except OSError:
                         pass
                     return True
+                if act[0] == "stall":
+                    return self._chaos_stall(path, act[1])
+                if act[0] == "trickle":
+                    return self._chaos_trickle(path, act[1], act[2])
+                if act[0] == "truncate":
+                    return self._chaos_truncate(path)
+                if act[0] == "garbage":
+                    return self._chaos_garbage(path, act[1])
                 _, status, headers, body = act
                 fake._note_response(self.command, path, status)
                 self._span(status, chaos="status")
@@ -571,6 +661,116 @@ class FakeApiServer:
                 self.send_header("Content-Length", str(len(payload)))
                 self.end_headers()
                 self.wfile.write(payload)
+                return True
+
+            # --------------------------------------------- slow-path faults
+            # (ISSUE 9): the server that is SLOW, not failing fast. Each
+            # helper sends (or withholds) bytes itself, records exactly one
+            # `responses` audit entry, and spans the request in
+            # /__fake_trace with the chaos kind — the span covers the whole
+            # slow window, so a merged timeline shows the client attempt
+            # and the server dawdling side by side.
+
+            def _chaos_stall(self, path: str, secs: float) -> bool:
+                """Accept the request and send NOTHING for ``secs``, then
+                sever. A per-socket-op timeout longer than the stall never
+                fires (no byte ever arrives to reset it early, none to
+                satisfy it) — only a whole-attempt wall deadline gets the
+                client unstuck before the stall ends."""
+                fake._note_response(self.command, path, 0)
+                end = time.monotonic() + secs
+                while True:
+                    left = end - time.monotonic()
+                    if left <= 0:
+                        break
+                    time.sleep(min(0.05, left))
+                self._span(0, chaos="stall")
+                self.close_connection = True
+                try:
+                    self.connection.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                return True
+
+            def _chaos_trickle(self, path: str, bytes_per_sec: float,
+                               body: Any) -> bool:
+                """200 with full headers at once, then the body dribbled
+                one byte at a time at ``bytes_per_sec``. DEFEATS
+                per-socket-op timeouts by design: every recv succeeds
+                within the op timeout, yet the whole body takes
+                len/rate seconds — the fault class the whole-attempt
+                deadline exists for. A client that hangs up mid-dribble
+                (its deadline fired) is the expected outcome."""
+                payload = json.dumps(body if body is not None else {
+                    "kind": "Status", "code": 200, "reason": "Chaos",
+                    "message": "trickled body"}).encode()
+                fake._note_response(self.command, path, 200)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.send_header("Connection", "close")
+                self.end_headers()
+                self.close_connection = True
+                delay = 1.0 / max(1e-6, bytes_per_sec)
+                try:
+                    for i in range(len(payload)):
+                        self.wfile.write(payload[i:i + 1])
+                        self.wfile.flush()
+                        time.sleep(delay)
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass  # the client gave up — the point of the fault
+                self._span(200, chaos="trickle")
+                return True
+
+            def _chaos_truncate(self, path: str) -> bool:
+                """200 + ``Transfer-Encoding: chunked`` that declares a
+                bigger chunk than it delivers, then EOFs: mid-chunked-body
+                for plain requests, mid-watch-event for streams. The
+                client must classify the cut-off as transport failure
+                (IncompleteRead / truncated-chunked), never hand the
+                prefix to a JSON parser as a short 200."""
+                fake._note_response(self.command, path, 200)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                try:
+                    # a 0x40-byte chunk, half an event delivered, EOF
+                    self.wfile.write(
+                        b"40\r\n" + b'{"type":"MODIFIED","object":{"kind')
+                    self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass
+                self._span(200, chaos="truncate")
+                self.close_connection = True
+                try:
+                    self.connection.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                return True
+
+            def _chaos_garbage(self, path: str, body: Any) -> bool:
+                """200 whose body is half-JSON (or any raw override) with
+                a CORRECT Content-Length: the framing is healthy, the
+                payload is not — the client must classify it into the
+                transport-0 retry family, not crash or treat it as a
+                parsed object."""
+                if body is None:
+                    payload = b'{"kind": "Status", "code": 200, "half": '
+                elif isinstance(body, bytes):
+                    payload = body
+                else:
+                    payload = str(body).encode()
+                fake._note_response(self.command, path, 200)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                try:
+                    self.wfile.write(payload)
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass
+                self._span(200, chaos="garbage")
                 return True
 
             def _serve_watch(self, path: str, q: Dict[str, list]):

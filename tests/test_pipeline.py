@@ -1255,6 +1255,14 @@ def test_bench_rollout_json_line_meets_targets():
         assert faulted["converged"] and clean["converged"]
         assert faulted["retries"] > 0, (mode, faulted)
         assert faulted["requests"] >= clean["requests"], (mode, doc["faults"])
+    # the slow-path column (ISSUE 9): stall/trickle/truncate/garbage
+    # under the deadline discipline — converged, zero wire attempts past
+    # deadline+grace, the stalled idempotent read hedged
+    for mode in ("watch", "poll"):
+        slow = doc["faults"]["slow"][mode]
+        assert slow["converged"], (mode, slow)
+        assert slow["attempts_over_deadline"] == 0, (mode, slow)
+        assert slow["retries"] > 0 and slow["hedges"] >= 1, (mode, slow)
     # the server-side-apply column (ISSUE 5 acceptance): cold install
     # >=40% fewer requests than the GET-then-merge cold path, and the
     # warm steady-state converge is reads-only — zero mutations — while

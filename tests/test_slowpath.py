@@ -1,0 +1,724 @@
+"""Slow-path chaos + deadline discipline (ISSUE 9).
+
+PR 3 hardened the stack against FAST-FAIL faults (5xx bursts, clean
+connection drops, flaps); this suite covers the far more dangerous
+production failure — the apiserver that is SLOW: accepts the connection
+and never answers (stall), dribbles the body a byte per timeout window
+(trickle — defeats per-socket-op timeouts by design), cuts a chunked
+reply mid-stream (truncate), or 200s half-JSON (garbage).
+
+Three layers under test, plus their pins:
+
+- the fake's ChaosEngine slow fault kinds + ``slow_fault_script()``
+  (fired-kind labels on ``fake_apiserver_chaos_faults_total``);
+- the client's WHOLE-ATTEMPT wall (the Python twin of the C++
+  ``timeout_ms bounds the WHOLE response`` contract), the rollout-wide
+  :class:`DeadlineBudget` with its typed :class:`DeadlineExceeded`, and
+  HEDGED idempotent reads (``tpuctl_hedges_total``);
+- stall/trickle/truncate/garbage classifying into the EXISTING
+  transport-0 retry family, in Python here and in C++ via the
+  hostile-chunk-vector table shared with operator_selftest
+  (kHostileChunkVectors — source-grep pinned below, the RetryableStatus
+  twin pattern).
+
+Acceptance soaks: the full bundle under ``slow_fault_script()``
+converges with store parity vs a clean install and every wire-attempt
+span stays within deadline+grace; the no-deadline/no-telemetry hot path
+stays byte-identical in request and mutation count (zero-overhead pin).
+"""
+
+import os
+import re
+import socket
+import threading
+import time
+
+import pytest
+
+from fake_apiserver import FakeApiServer, slow_fault_script
+from tpu_cluster import kubeapply, telemetry
+from tpu_cluster import spec as specmod
+from tpu_cluster.render import manifests, operator_bundle
+
+NS_PATH = "/api/v1/namespaces/tpu-system"
+
+# Bench-speed retry policy: same taxonomy as production, faster clock.
+FAST_RETRY = kubeapply.RetryPolicy(attempts=8, base_s=0.02, cap_s=0.3)
+
+# The soak's deadline discipline: per-attempt wall, hedge threshold, and
+# the scheduling/IO grace the span-duration pin allows past the wall.
+SOAK_UNIT = 0.03
+SOAK_WALL = 0.15
+SOAK_HEDGE = 0.06
+SOAK_GRACE = 0.3
+
+
+def full_stack_groups():
+    spec = specmod.default_spec()
+    return (list(operator_bundle.operator_install_groups(spec))
+            + list(manifests.rollout_groups(spec)))
+
+
+# ------------------------------------------------------------ fault kinds
+
+
+def test_stall_classifies_transport_zero_and_retries():
+    """An accepted-but-silent request: the per-op timeout (clamped to
+    the attempt wall) fires, classifies status 0, and the retry lands
+    once the scripted stall is consumed."""
+    with FakeApiServer(auto_ready=True,
+                       chaos=[{"stall": 2.0, "count": 1}]) as api:
+        client = kubeapply.Client(api.url, timeout=0.3, retry=FAST_RETRY)
+        t0 = time.monotonic()
+        code, _ = client.get(NS_PATH)
+        elapsed = time.monotonic() - t0
+        assert code == 404  # the store is empty; the READ got through
+        assert client.retries >= 1
+        assert elapsed < 1.5, elapsed  # never waited out the 2s stall
+        assert ("stall", "GET", NS_PATH) in api.chaos.fired_snapshot()
+        client.close()
+
+
+def test_trickle_defeats_per_op_timeout_but_not_the_wall():
+    """The defining slow fault: every socket op succeeds (one byte per
+    turn), so only the WHOLE-ATTEMPT wall can cut the attempt off. With
+    the wall at its default (= timeout), the attempt aborts and
+    classifies AttemptDeadline; with the wall widened, the dribble
+    finishes and proves per-op timeouts alone never fire."""
+    with FakeApiServer(auto_ready=True,
+                       chaos=[{"trickle": 20, "count": 1,
+                               "method": "GET"}]) as api:
+        client = kubeapply.Client(api.url, timeout=0.4, retry=FAST_RETRY)
+        t0 = time.monotonic()
+        code, _ = client.get(NS_PATH)
+        elapsed = time.monotonic() - t0
+        assert code == 404 and client.retries >= 1
+        assert elapsed < 1.5, elapsed
+        assert "deadline" in (client.last_transport_error or "")
+        client.close()
+    # counterfactual: a wide wall lets the dribble complete — each op
+    # succeeds within the 0.2s per-op timeout even though the whole body
+    # takes ~0.5s (this is WHY per-socket-op timeouts cannot bound it)
+    with FakeApiServer(auto_ready=True,
+                       chaos=[{"trickle": 30, "count": 1, "method": "GET",
+                               "body": {"ok": 1}}]) as api:
+        client = kubeapply.Client(api.url, timeout=0.2,
+                                  attempt_deadline_s=10.0,
+                                  retry=FAST_RETRY)
+        t0 = time.monotonic()
+        code, obj = client.get(NS_PATH)
+        elapsed = time.monotonic() - t0
+        assert code == 200 and obj == {"ok": 1}
+        assert client.retries == 0
+        assert elapsed > 0.25, elapsed  # it really was dribbled
+        client.close()
+
+
+def test_truncate_mid_chunk_classifies_transport_zero():
+    """A chunked reply cut off mid-chunk must surface as transport
+    status 0 (http.client's IncompleteRead), never as a short 200."""
+    with FakeApiServer(auto_ready=True,
+                       chaos=[{"truncate": True, "count": 1}]) as api:
+        client = kubeapply.Client(api.url, timeout=0.5, retry=FAST_RETRY)
+        code, _ = client.get(NS_PATH)
+        assert code == 404 and client.retries >= 1
+        assert ("truncate", "GET", NS_PATH) in api.chaos.fired_snapshot()
+        client.close()
+
+
+@pytest.mark.parametrize("keep_alive", [True, False])
+def test_garbage_200_classifies_transport_zero(keep_alive):
+    """A 200 whose body is half-JSON: healthy framing, junk payload —
+    the object's true state is unknown, so it classifies into the
+    transport-0 retry family on BOTH transports (never handed to the
+    caller as a parsed object, never a crash)."""
+    with FakeApiServer(auto_ready=True,
+                       chaos=[{"garbage": True, "count": 1}]) as api:
+        client = kubeapply.Client(api.url, timeout=0.5, retry=FAST_RETRY,
+                                  keep_alive=keep_alive)
+        code, _ = client.get(NS_PATH)
+        assert code == 404 and client.retries >= 1
+        assert "garbage" in (client.last_transport_error or "").lower() \
+            or "GarbageBody" in (client.last_transport_error or "")
+        client.close()
+
+
+def test_slow_faults_are_retryable_in_the_taxonomy():
+    """The classification pin: all four slow faults surface as status 0,
+    and 0 is in the SHARED retryable family (RETRYABLE_STATUSES — the
+    C++ twin kubeclient::RetryableStatus pins the same set)."""
+    policy = kubeapply.RetryPolicy()
+    assert policy.classify(0) == "retryable"
+    assert 0 in kubeapply.RETRYABLE_STATUSES
+
+
+def test_fake_metrics_exports_slow_fault_kind_labels():
+    """Every fired slow-fault kind lands as a ``kind`` label on
+    ``fake_apiserver_chaos_faults_total`` — the scrape-side audit CI
+    asserts too."""
+    chaos = [{"stall": 0.1, "count": 1}, {"trickle": 500, "count": 1},
+             {"truncate": True, "count": 1}, {"garbage": True, "count": 1}]
+    with FakeApiServer(auto_ready=True, chaos=chaos) as api:
+        client = kubeapply.Client(api.url, timeout=0.3, retry=FAST_RETRY)
+        for _ in range(6):
+            client.get(NS_PATH)
+        text = api.fake_metrics_text()
+        client.close()
+    for kind in ("stall", "trickle", "truncate", "garbage"):
+        assert (f'fake_apiserver_chaos_faults_total{{kind="{kind}"}}'
+                in text), text
+
+
+def test_slow_fault_script_shape():
+    """The script is the shared soak/bench artifact: all four kinds,
+    every one count-bounded (an unbounded stall would hang any client),
+    unit-scaled stall."""
+    script = slow_fault_script(0.05)
+    kinds = set()
+    for fault in script:
+        assert "count" in fault, fault
+        kinds |= {k for k in ("stall", "trickle", "truncate", "garbage")
+                  if k in fault}
+    assert kinds == {"stall", "trickle", "truncate", "garbage"}
+    assert slow_fault_script(0.1)[0]["stall"] == \
+        2 * slow_fault_script(0.05)[0]["stall"]
+
+
+# ------------------------------------------------- whole-attempt deadline
+
+
+def test_attempt_spans_bounded_by_wall_under_stall():
+    """The span-duration half of the contract: under a stall, the
+    recorded wire-attempt span never outlives the attempt wall plus
+    grace (what the bench's attempts_over_deadline gate counts)."""
+    tel = telemetry.Telemetry()
+    with FakeApiServer(auto_ready=True,
+                       chaos=[{"stall": 3.0, "count": 2}]) as api:
+        client = kubeapply.Client(api.url, timeout=5.0,
+                                  attempt_deadline_s=0.2,
+                                  retry=FAST_RETRY, telemetry=tel)
+        code, _ = client.get(NS_PATH)
+        assert code == 404
+        client.close()
+    events = telemetry.request_events(tel.chrome_trace())
+    assert events
+    for e in events:
+        assert float(e.get("dur", 0.0)) / 1e6 <= 0.2 + SOAK_GRACE, e
+
+
+def _serve_header_trickle(byte_interval_s: float):
+    """A raw 'server' that answers with HEADER bytes dribbled one at a
+    time forever — the per-op blind spot getresponse() is exposed to
+    (every recv succeeds; the status line never completes)."""
+    srv = socket.create_server(("127.0.0.1", 0))
+
+    def run() -> None:
+        try:
+            conn, _ = srv.accept()
+        except OSError:
+            return
+        conn.settimeout(5)
+        try:
+            conn.recv(65536)
+            for ch in (b"HTTP/1.1 200 OK\r\nx-padding: "
+                       + b"y" * 10_000):
+                conn.sendall(bytes([ch]))
+                time.sleep(byte_interval_s)
+        except OSError:
+            pass
+        finally:
+            conn.close()
+            srv.close()
+
+    threading.Thread(target=run, daemon=True).start()
+    host, port = srv.getsockname()
+    return f"http://{host}:{port}"
+
+
+def test_header_trickle_bounded_by_watchdog_when_deadline_armed():
+    """A server trickling HEADER bytes defeats per-op timeouts inside
+    getresponse() exactly like a body trickle defeats them in the body —
+    with deadline discipline armed, the header watchdog severs the
+    attempt at the wall and it classifies transport-0 AS A DEADLINE hit:
+    exactly one wire attempt, annotated deadline (a sever that
+    masqueraded as a stale socket would trigger the fast retry and
+    silently double the wall)."""
+    tel = telemetry.Telemetry()
+    url = _serve_header_trickle(0.05)
+    client = kubeapply.Client(url, timeout=5.0, attempt_deadline_s=0.3,
+                              retry=kubeapply.NO_RETRY, telemetry=tel)
+    t0 = time.monotonic()
+    code, body = client.get(NS_PATH)
+    elapsed = time.monotonic() - t0
+    client.close()
+    assert code == 0
+    assert elapsed < 2.0, elapsed  # the wall, not the 500s dribble
+    assert "deadline" in (body or {}).get("message", "")
+    events = telemetry.request_events(tel.chrome_trace())
+    assert len(events) == 1, events  # no stale-retry double send
+    assert events[0]["args"].get("deadline") is True, events[0]
+
+
+# ------------------------------------------------------- deadline budget
+
+
+def test_budget_exhaustion_raises_typed_with_slowest_attempts():
+    """DeadlineExceeded is typed (an ApplyError subclass) and carries
+    the slowest telemetry attempts — the triage pointer to WHERE the
+    wall time went."""
+    tel = telemetry.Telemetry()
+    with FakeApiServer(auto_ready=True, chaos=[{"stall": 0.5}]) as api:
+        client = kubeapply.Client(
+            api.url, timeout=0.3,
+            retry=kubeapply.RetryPolicy(attempts=50, base_s=0.02),
+            budget=kubeapply.DeadlineBudget(0.7), telemetry=tel)
+        t0 = time.monotonic()
+        with pytest.raises(kubeapply.DeadlineExceeded) as err:
+            client.get(NS_PATH)
+        elapsed = time.monotonic() - t0
+        client.close()
+    assert elapsed < 2.5, elapsed
+    assert isinstance(err.value, kubeapply.ApplyError)
+    assert "slowest attempts" in str(err.value)
+    assert err.value.slowest_attempts
+
+
+def test_budget_clamps_backoff_sleeps():
+    """A generous backoff schedule must not overshoot a small budget:
+    the clamp turns a would-be multi-second sleep into the remainder."""
+    with FakeApiServer(auto_ready=True, chaos=[{"status": 503}]) as api:
+        client = kubeapply.Client(
+            api.url, timeout=0.5,
+            retry=kubeapply.RetryPolicy(attempts=10, base_s=2.0,
+                                        cap_s=5.0, jitter=0.0),
+            budget=kubeapply.DeadlineBudget(0.5))
+        t0 = time.monotonic()
+        with pytest.raises(kubeapply.DeadlineExceeded):
+            client.get(NS_PATH)
+        assert time.monotonic() - t0 < 2.0
+        client.close()
+
+
+def test_budget_bounds_readiness_wait_with_typed_error():
+    """wait_ready spends from the rollout budget like every phase: an
+    exhausted budget surfaces AS DeadlineExceeded, not a generic
+    readiness timeout, in both poll and watch modes."""
+    ds = {"apiVersion": "apps/v1", "kind": "DaemonSet",
+          "metadata": {"name": "slow-ds", "namespace": "tpu-system"},
+          "spec": {"template": {"spec": {}}}}
+    for watch in (False, True):
+        with FakeApiServer(auto_ready=False) as api:
+            client = kubeapply.Client(api.url, retry=FAST_RETRY,
+                                      budget=kubeapply.DeadlineBudget(0.3))
+            client.apply(ds)  # stored unready (auto_ready off)
+            t0 = time.monotonic()
+            with pytest.raises(kubeapply.DeadlineExceeded):
+                client.wait_ready([ds], timeout=30, poll=0.05, watch=watch)
+            assert time.monotonic() - t0 < 3.0
+            client.close()
+
+
+def test_wait_crd_established_clamps_sleep_to_deadline_remainder():
+    """The satellite fix: a poll interval far larger than the remaining
+    deadline must not overshoot it — the sleep clamps to the remainder
+    (the ``_poll_ready`` clamp, applied to the CRD wait)."""
+    crd_path = ("/apis/apiextensions.k8s.io/v1/"
+                "customresourcedefinitions/foo.example.com")
+    with FakeApiServer(auto_ready=False) as api:
+        api.store[crd_path] = {"kind": "CustomResourceDefinition",
+                               "metadata": {"name": "foo.example.com"}}
+        client = kubeapply.Client(api.url, retry=kubeapply.NO_RETRY)
+        t0 = time.monotonic()
+        with pytest.raises(kubeapply.ApplyError, match="timed out"):
+            client.wait_crd_established("foo.example.com", timeout=0.3,
+                                        poll=30.0)
+        assert time.monotonic() - t0 < 2.0
+        client.close()
+
+
+def test_wait_crd_established_budget_raises_typed():
+    crd_path = ("/apis/apiextensions.k8s.io/v1/"
+                "customresourcedefinitions/foo.example.com")
+    with FakeApiServer(auto_ready=False) as api:
+        api.store[crd_path] = {"kind": "CustomResourceDefinition",
+                               "metadata": {"name": "foo.example.com"}}
+        client = kubeapply.Client(api.url, retry=kubeapply.NO_RETRY,
+                                  budget=kubeapply.DeadlineBudget(0.2))
+        with pytest.raises(kubeapply.DeadlineExceeded):
+            client.wait_crd_established("foo.example.com", timeout=30,
+                                        poll=0.05)
+        client.close()
+
+
+# ------------------------------------------------------- kubectl backend
+
+
+def test_kubectl_kill_timer_clamps_to_budget():
+    """The satellite fix: the kubectl subprocess kill timer honors the
+    caller's remaining rollout time instead of the fixed
+    stage_timeout+120 default (and floors at 1s so the rc=124 verdict
+    can still be reached)."""
+    assert kubeapply._kubectl_timeout(600, None) == 720
+    assert kubeapply._kubectl_timeout(600, kubeapply.DeadlineBudget(30)) \
+        <= 30
+    assert kubeapply._kubectl_timeout(
+        600, kubeapply.DeadlineBudget(0.0)) == 1.0
+
+
+def test_kubectl_rc124_retry_stops_at_budget_exhaustion():
+    """A kubectl killed after its timeout (rc=124) is retryable — but
+    never past the rollout deadline: exhaustion raises the typed error
+    instead of burning the remaining retry attempts."""
+    calls = []
+
+    def runner(argv, input_text=None):
+        calls.append(list(argv))
+        return 124, "", "killed after timeout"
+
+    groups = [[{"apiVersion": "v1", "kind": "Namespace",
+                "metadata": {"name": "x"}}]]
+    with pytest.raises(kubeapply.DeadlineExceeded):
+        kubeapply.apply_groups_kubectl(
+            groups, wait=False, runner=runner,
+            retry=kubeapply.RetryPolicy(attempts=5, base_s=0.01),
+            budget=kubeapply.DeadlineBudget(0.0))
+    assert len(calls) == 1  # no retry after the budget ran out
+
+
+# ----------------------------------------------------------- hedged reads
+
+
+def test_stalled_idempotent_read_triggers_exactly_one_hedge():
+    """The acceptance pin: a stall on an idempotent GET fires EXACTLY
+    one backup attempt past the hedge threshold; the backup wins and
+    completes the attempt fast (no waiting out the stall), counted in
+    tpuctl_hedges_total and annotated on the attempt spans."""
+    tel = telemetry.Telemetry()
+    with FakeApiServer(auto_ready=True,
+                       chaos=[{"stall": 3.0, "count": 1,
+                               "method": "GET"}]) as api:
+        # the threshold sits WELL under the stall but WELL above a
+        # healthy round trip, so a loaded host can neither miss the
+        # hedge nor fire a spurious one on the follow-up read
+        client = kubeapply.Client(api.url, timeout=2.0, retry=FAST_RETRY,
+                                  hedge_s=0.3, telemetry=tel)
+        t0 = time.monotonic()
+        code, _ = client.get(NS_PATH)
+        elapsed = time.monotonic() - t0
+        assert code == 404
+        assert client.hedges == 1
+        assert elapsed < 1.5, elapsed  # the winner, not the stall
+        # a second, healthy read: no further hedges
+        client.get(NS_PATH)
+        assert client.hedges == 1
+        client.close()
+    assert tel.metrics.total(telemetry.HEDGES_TOTAL) == 1
+    events = telemetry.request_events(tel.chrome_trace())
+    roles = [e["args"].get("hedge") for e in events
+             if e["args"].get("hedge")]
+    assert "backup" in roles, roles
+
+
+def test_failed_backup_never_cancels_a_succeeding_primary():
+    """A transport error must never beat an answer in flight: the
+    primary read is trickling but WILL complete inside its wall; the
+    backup fires and is dropped immediately — the hedged read must
+    still return the primary's 200, not the backup's failure."""
+    body = {"ok": 1}
+    chaos = [
+        # the primary's GET: dribbled, completing at ~0.5s (inside wall)
+        {"count": 1, "method": "GET", "trickle": 20, "body": body},
+        # the backup's GET: connection dropped — a fast transport failure
+        {"count": 1, "method": "GET", "drop": 1},
+    ]
+    with FakeApiServer(auto_ready=True, chaos=chaos) as api:
+        client = kubeapply.Client(api.url, timeout=2.0,
+                                  attempt_deadline_s=1.5,
+                                  retry=kubeapply.NO_RETRY, hedge_s=0.1)
+        code, obj = client.get(NS_PATH)
+        assert client.hedges == 1
+        assert (code, obj) == (200, body)
+        client.close()
+
+
+def test_hedging_never_touches_mutations():
+    """Mutations are never hedged (a duplicated in-flight write is not
+    idempotent): a stalled POST waits out the wall and retries — zero
+    hedges."""
+    with FakeApiServer(auto_ready=True,
+                       chaos=[{"stall": 1.0, "count": 1,
+                               "method": "POST"}]) as api:
+        # a generous threshold: the POST path must ignore hedge_s
+        # entirely, and the apply's preliminary healthy GET must not
+        # spuriously hedge on a loaded host
+        client = kubeapply.Client(api.url, timeout=0.3, retry=FAST_RETRY,
+                                  hedge_s=0.25)
+        ns = {"apiVersion": "v1", "kind": "Namespace",
+              "metadata": {"name": "hedgeless"}}
+        assert client.apply(ns) == "created"
+        assert client.hedges == 0
+        assert client.retries >= 1
+        client.close()
+
+
+def test_clean_rollout_with_hedging_armed_fires_no_hedges():
+    """Hedging must be inert against a healthy server: the threshold is
+    never crossed, so no hedges and no extra requests."""
+    groups = full_stack_groups()
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url, hedge_s=0.5,
+                                  budget=kubeapply.DeadlineBudget(300))
+        kubeapply.apply_groups(client, groups, wait=True, stage_timeout=30,
+                               poll=0.02, max_inflight=8)
+        assert client.hedges == 0
+        client.close()
+
+
+# ------------------------------------------------------------- soak pins
+
+
+def _rollout_log(api, **client_kwargs):
+    groups = full_stack_groups()
+    client = kubeapply.Client(api.url, **client_kwargs)
+    kubeapply.apply_groups(client, groups, wait=True, stage_timeout=60,
+                           poll=0.02, max_inflight=8, watch_ready=True)
+    client.close()
+    return [(m, p.partition("?")[0]) for m, p in api.log]
+
+
+MUTATING = ("POST", "PATCH", "PUT", "DELETE")
+
+
+def test_zero_overhead_pin_request_and_mutation_parity():
+    """With no deadline/hedge and telemetry=None the hot path is the
+    PR 8 hot path — and ARMING the discipline against a healthy server
+    changes neither the request count nor the mutation count (the
+    armed client's warm re-apply also keeps the SSA zero-mutation
+    steady state)."""
+    with FakeApiServer(auto_ready=True) as api:
+        baseline = _rollout_log(api)
+    with FakeApiServer(auto_ready=True) as api:
+        armed = _rollout_log(api, attempt_deadline_s=5.0, hedge_s=0.5,
+                             budget=kubeapply.DeadlineBudget(300))
+        mutations_cold = sum(1 for m, _ in armed if m in MUTATING)
+        # warm pass through a FRESH armed client: reads only
+        fresh = kubeapply.Client(api.url, attempt_deadline_s=5.0,
+                                 hedge_s=0.5,
+                                 budget=kubeapply.DeadlineBudget(300))
+        kubeapply.apply_groups(fresh, full_stack_groups(), wait=True,
+                               stage_timeout=60, poll=0.02, max_inflight=8,
+                               watch_ready=True)
+        fresh.close()
+        warm_mutations = sum(
+            1 for m, _ in api.log if m in MUTATING) - mutations_cold
+    assert len(baseline) == len(armed), (len(baseline), len(armed))
+    assert sorted(baseline) == sorted(armed)
+    assert warm_mutations == 0
+
+
+def test_slow_soak_converges_with_store_parity_and_bounded_attempts():
+    """THE acceptance soak: full bundle, --parallel --watch, under
+    slow_fault_script — converges with zero manual intervention to the
+    same store as a clean install, every wire-attempt span within the
+    per-attempt deadline + grace, the stalled first read hedged, and
+    all four fired kinds on the server's own audit."""
+    groups = full_stack_groups()
+    with FakeApiServer(auto_ready=True) as clean_api:
+        client = kubeapply.Client(clean_api.url)
+        kubeapply.apply_groups(client, groups, wait=True, stage_timeout=60,
+                               poll=0.02, max_inflight=8)
+        client.close()
+        clean_store = set(clean_api.snapshot())
+    tel = telemetry.Telemetry()
+    with FakeApiServer(auto_ready=True, latency_s=0.005,
+                       chaos=slow_fault_script(SOAK_UNIT)) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY, telemetry=tel,
+                                  attempt_deadline_s=SOAK_WALL,
+                                  hedge_s=SOAK_HEDGE,
+                                  budget=kubeapply.DeadlineBudget(120))
+        result = kubeapply.apply_groups(client, groups, wait=True,
+                                        stage_timeout=60, poll=0.02,
+                                        max_inflight=8, watch_ready=True)
+        assert client.retries > 0, "the slow script never bit"
+        assert client.hedges >= 1, "the stalled read was never hedged"
+        fired_kinds = {k for k, _m, _p in api.chaos.fired_snapshot()}
+        metrics_text = api.fake_metrics_text()
+        assert set(api.snapshot()) == clean_store
+        client.close()
+    assert result.apply_mode == "ssa"
+    assert {"stall", "trickle", "garbage"} <= fired_kinds, fired_kinds
+    for kind in fired_kinds:
+        assert (f'fake_apiserver_chaos_faults_total{{kind="{kind}"}}'
+                in metrics_text)
+    # the span-duration pin: no wire attempt outlived deadline+grace
+    bound = SOAK_WALL + SOAK_GRACE
+    for e in telemetry.request_events(tel.chrome_trace()):
+        assert float(e.get("dur", 0.0)) / 1e6 <= bound, e
+
+
+def test_slow_soak_deadline_exceeded_propagates_typed_from_engine():
+    """A budget too small for the bundle surfaces the TYPED error out of
+    apply_groups (the pipelined engine must not launder it into a
+    per-object aggregate)."""
+    groups = full_stack_groups()
+    with FakeApiServer(auto_ready=True, chaos=[{"stall": 0.5}]) as api:
+        client = kubeapply.Client(
+            api.url, timeout=0.3, retry=FAST_RETRY,
+            budget=kubeapply.DeadlineBudget(0.6))
+        with pytest.raises(kubeapply.DeadlineExceeded):
+            kubeapply.apply_groups(client, groups, wait=True,
+                                   stage_timeout=30, poll=0.02,
+                                   max_inflight=8)
+        client.close()
+
+
+# --------------------------------------- hostile chunk vectors (C++ twin)
+
+# The shared Python<->C++ table: name, raw chunked payload, whether the
+# C++ DecodeChunkedBody accepts it (terminated stream), and the status
+# the PYTHON client must classify when a server replies with exactly
+# these bytes (200 only when the decoded payload is also valid JSON —
+# a clean decode of junk is the GARBAGE class, transport 0). The C++
+# side of the table lives in native/operator/selftest.cc
+# (kHostileChunkVectors) and is source-grep pinned below.
+CHUNK_VECTORS = [
+    ("clean", b"2\r\n{}\r\n0\r\n\r\n", True, 200),
+    ("clean-multi", b"5\r\nhello\r\n6\r\n world\r\n0\r\n\r\n", True, 0),
+    ("empty-terminated", b"0\r\n\r\n", True, 200),
+    ("no-terminator", b"5\r\nhello\r\n", False, 0),
+    ("truncated-data",
+     b'40\r\n{"type":"MODIFIED","object":{"kind', False, 0),
+    ("garbage-size", b"zz\r\nhello\r\n0\r\n\r\n", False, 0),
+    ("negative-size", b"-5\r\nhello\r\n0\r\n\r\n", False, 0),
+    ("empty", b"", False, 0),
+    ("bare-crlf", b"\r\n", False, 0),
+]
+
+_SELFTEST_CC = os.path.join(os.path.dirname(__file__), os.pardir,
+                            "native", "operator", "selftest.cc")
+
+
+def _c_escape(raw: bytes) -> str:
+    return (raw.decode("latin-1").replace("\\", "\\\\")
+            .replace('"', '\\"').replace("\r", "\\r").replace("\n", "\\n"))
+
+
+def test_chunk_vector_table_pins_cpp_selftest_source():
+    """The twin-table pin (RetryableStatus pattern): every vector here —
+    name, raw bytes, accept/reject verdict — appears verbatim in the
+    C++ kHostileChunkVectors table, so the two languages can never
+    drift on what counts as a truncated chunked stream."""
+    with open(_SELFTEST_CC, encoding="utf-8") as f:
+        source = re.sub(r"\s+", " ", f.read())
+    assert "kHostileChunkVectors" in source
+    for name, raw, cpp_ok, _py_status in CHUNK_VECTORS:
+        entry = f'{{"{name}", "{_c_escape(raw)}", {str(cpp_ok).lower()}'
+        assert entry in source, f"vector {name!r} not pinned in selftest.cc"
+    # and the C++ table carries nothing this table doesn't
+    assert source.count('{"', source.index("kHostileChunkVectors")) >= \
+        len(CHUNK_VECTORS)
+
+
+def _serve_raw_once(payload: bytes):
+    """A one-connection raw HTTP 'server': reads the request head, writes
+    ``payload`` byte-for-byte, closes. Returns its base URL."""
+    srv = socket.create_server(("127.0.0.1", 0))
+
+    def run():
+        try:
+            conn, _ = srv.accept()
+        except OSError:
+            return
+        conn.settimeout(5)
+        try:
+            conn.recv(65536)
+            conn.sendall(payload)
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            conn.close()
+            srv.close()
+
+    threading.Thread(target=run, daemon=True).start()
+    host, port = srv.getsockname()
+    return f"http://{host}:{port}"
+
+
+@pytest.mark.parametrize(
+    "name,raw,cpp_ok,py_status",
+    CHUNK_VECTORS, ids=[v[0] for v in CHUNK_VECTORS])
+def test_chunk_vectors_drive_python_transport(name, raw, cpp_ok,
+                                              py_status):
+    """The behavior half of the twin: a server replying with each
+    vector's exact bytes (chunked 200) yields the pinned classification
+    from the Python client — clean JSON streams parse, everything else
+    (truncated, garbage-size, junk payload) classifies transport 0."""
+    head = (b"HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+            b"Transfer-Encoding: chunked\r\nConnection: close\r\n\r\n")
+    url = _serve_raw_once(head + raw)
+    client = kubeapply.Client(url, timeout=2.0, retry=kubeapply.NO_RETRY)
+    code, _body = client.get("/api/v1/namespaces/x")
+    client.close()
+    assert code == py_status, (name, code)
+
+
+def test_cpp_selftest_passes_with_chunk_vectors():
+    """Run the compiled operator_selftest (the conftest g++ fallback
+    builds it on toolchain-less hosts): the hostile-vector table and its
+    truncation/garbage fuzz must hold on the C++ side too."""
+    import subprocess
+    binary = os.path.join(os.path.dirname(__file__), os.pardir,
+                          "native", "build", "operator_selftest")
+    if not os.path.exists(binary):
+        pytest.skip("operator_selftest not built on this host")
+    proc = subprocess.run([binary], capture_output=True, text=True,
+                          timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ----------------------------------------------------------------- CLI
+
+
+def test_cli_apply_deadline_and_hedge_flags():
+    """`tpuctl apply --deadline --hedge` end-to-end under the slow
+    script: converges, reports hedged reads, exit 0."""
+    from tpu_cluster import __main__ as cli
+    with FakeApiServer(auto_ready=True,
+                       chaos=slow_fault_script(0.02)) as api:
+        rc = cli.main(["apply", "--apiserver", api.url, "--parallel",
+                       "--watch", "--stage-timeout", "30",
+                       "--poll", "0.05", "--deadline", "60",
+                       "--hedge", "0.1", "--retry-attempts", "8",
+                       "--retry-base", "0.02", "--flight-recorder", "off"])
+    assert rc == 0
+
+
+def test_cli_apply_deadline_exhaustion_fails_with_message(capsys):
+    from tpu_cluster import __main__ as cli
+    with FakeApiServer(auto_ready=True, chaos=[{"stall": 0.5}]) as api:
+        rc = cli.main(["apply", "--apiserver", api.url, "--parallel",
+                       "--stage-timeout", "10", "--poll", "0.05",
+                       "--deadline", "1.0", "--retry-attempts", "20",
+                       "--retry-base", "0.02",
+                       "--flight-recorder", "off"])
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "deadline" in err
+
+
+def test_bench_slow_arm_json_shape():
+    """The bench grew the gated `slow` variant: one arm, fast unit, all
+    reported fields present and the zero-overdeadline contract holding
+    at bench scale."""
+    import scripts.bench_rollout as bench
+    arm = bench.slow_faults_arm(0.001, watch=True)
+    assert arm["converged"]
+    assert arm["retries"] > 0
+    assert arm["hedges"] >= 1
+    assert arm["attempts_over_deadline"] == 0
+    assert set(arm["fired_kinds"]) >= {"stall", "trickle", "garbage"}
+    assert arm["requests"] > 0 and arm["wall_s"] > 0

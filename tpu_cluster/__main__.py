@@ -232,10 +232,17 @@ def cmd_apply(args) -> int:
         elif args.resume:
             print("apply: resuming from journal "
                   f"{args.journal} (completed groups will be skipped)")
+    # The rollout-wide deadline budget (--deadline): armed HERE, before
+    # the first request, so render/lint time already spent counts too on
+    # the kubectl path's clamps; both backends thread it through.
+    budget = (kubeapply.DeadlineBudget(args.deadline)
+              if args.deadline is not None else None)
     try:
         client = _rest_client(args)
         if client is not None:
             client.telemetry = tel
+            client.budget = budget
+            client.hedge_s = args.hedge
             try:
                 result = kubeapply.apply_groups(
                     client, groups, wait=args.wait,
@@ -251,6 +258,9 @@ def cmd_apply(args) -> int:
             if client.retries:
                 print(f"apply: retried {client.retries} request(s) "
                       "against a flaky apiserver")
+            if client.hedges:
+                print(f"apply: hedged {client.hedges} slow idempotent "
+                      "read(s) with backup attempts")
             if args.wait:
                 print(f"rollout phases: {result.timings_line()}")
         else:
@@ -275,6 +285,10 @@ def cmd_apply(args) -> int:
                 print("apply: note: --poll has no effect on the kubectl "
                       "backend (kubectl rollout status does its own "
                       "polling)", file=sys.stderr)
+            if args.hedge is not None:
+                print("apply: note: --hedge has no effect on the kubectl "
+                      "backend (kubectl owns its own transport); pass "
+                      "--apiserver for hedged reads", file=sys.stderr)
             if tel is not None:
                 print("apply: note: --trace-out/--metrics-out instrument "
                       "the REST engine's requests; the kubectl backend "
@@ -288,7 +302,7 @@ def cmd_apply(args) -> int:
                 allow_empty_daemonsets=args.allow_empty_daemonsets,
                 log=lambda msg: print(msg), retry=_retry_policy(args),
                 journal=journal, lint_mode=args.lint, lint_spec=spec,
-                lint_external=_lint_external(args))
+                lint_external=_lint_external(args), budget=budget)
     except kubeapply.ApplyError as exc:
         print(f"apply failed: {exc}", file=sys.stderr)
         if recorder is not None:
@@ -584,6 +598,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "server-side apply; merge forces the legacy path. "
                         "--resume refuses a journal recorded in a "
                         "different explicit mode")
+    p.add_argument("--deadline", type=float, default=None, metavar="SECS",
+                   help="whole-rollout wall-clock budget: the remaining "
+                        "budget caps every per-attempt timeout, retry "
+                        "backoff, CRD/readiness wait and (on the kubectl "
+                        "backend) the subprocess kill timer, so a STALLED "
+                        "or TRICKLING apiserver cannot make the rollout "
+                        "outlive it; exhaustion fails with a typed "
+                        "DeadlineExceeded naming the slowest attempts")
+    p.add_argument("--hedge", type=float, default=None, metavar="SECS",
+                   help="hedge threshold for idempotent reads (REST "
+                        "backend): a GET/LIST attempt still unanswered "
+                        "after SECS fires ONE backup attempt on a fresh "
+                        "connection and the first response wins — "
+                        "tail-tolerant reads ('The Tail at Scale'); "
+                        "counted in tpuctl_hedges_total; mutations are "
+                        "never hedged")
     p.add_argument("--allow-empty-daemonsets", action="store_true",
                    help="treat DaemonSets with no matching nodes as ready")
     p.add_argument("--journal", default="",

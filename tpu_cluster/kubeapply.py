@@ -99,6 +99,37 @@ its reconcile slices to the rollout that caused them. The annotation is
 per-mutation plumbing, NOT intent: the exact SSA no-op check strips its
 field path, so the warm zero-mutation steady state holds with telemetry
 on.
+
+DEADLINE DISCIPLINE (ISSUE 9): the dangerous production failure is the
+apiserver that is SLOW, not down — accepts the connection and never
+answers (stall), dribbles the body a byte per timeout window (trickle),
+cuts a chunked reply mid-stream (truncate), or 200s half-JSON (garbage).
+Three layers handle it:
+
+- WHOLE-ATTEMPT WALL: every wire attempt — connect, request, headers,
+  full body — is bounded by one wall clock (``Client.timeout`` unless
+  ``attempt_deadline_s`` narrows it), the twin of the C++ client's
+  ``timeout_ms bounds the WHOLE response`` contract
+  (native/operator/kubeclient.cc). The body is drained via bounded
+  ``read1`` turns with the wall checked between them, which is what
+  defeats a trickle: per-socket-op timeouts alone cannot (every op
+  succeeds). Stall/trickle/truncate/garbage all classify into the
+  existing transport-0 retry family.
+- DEADLINE BUDGET (:class:`DeadlineBudget`, ``tpuctl apply
+  --deadline``): one wall budget for the WHOLE rollout, threaded through
+  retries (backoff sleeps clamp to the remainder), per-attempt walls,
+  CRD-establish and readiness waits, and the kubectl backend's
+  subprocess kill timer. Exhaustion raises the typed
+  :class:`DeadlineExceeded` carrying the slowest wire attempts from
+  telemetry — the triage pointer straight to the slow path.
+- HEDGED READS (``Client.hedge_s``, ``tpuctl apply --hedge``): an
+  idempotent GET/LIST attempt still unanswered after the hedge
+  threshold fires ONE backup attempt on a fresh connection; the first
+  response wins and the loser's socket is closed ("The Tail at Scale"
+  shape). Counted in ``tpuctl_hedges_total``, marked as a "hedge"
+  instant event on the open span (flight-recorder cargo). Mutations are
+  never hedged. All three layers default OFF the hot path:
+  ``budget=None, hedge_s=None`` is byte-identical request traffic.
 """
 
 from __future__ import annotations
@@ -108,6 +139,7 @@ import http.client
 import json
 import os
 import random
+import socket
 import ssl
 import threading
 import time
@@ -202,6 +234,67 @@ class SSAUnsupportedError(ApplyError):
     type). The client's ``ssa_supported`` flag is already flipped sticky
     when this raises; ``apply_mode="auto"`` catches it and downgrades the
     rollout to merge-patch, ``apply_mode="ssa"`` surfaces it."""
+
+
+class DeadlineExceeded(ApplyError):
+    """The rollout's wall-clock budget (:class:`DeadlineBudget`,
+    ``tpuctl apply --deadline``) ran out. Typed so callers can tell
+    "the deadline we asked for expired" from an ordinary apply failure;
+    ``slowest_attempts`` carries the telemetry-derived worst wire
+    attempts (name, status, duration) — the triage pointer to WHERE the
+    time went."""
+
+    def __init__(self, message: str,
+                 slowest_attempts: Optional[List[str]] = None) -> None:
+        super().__init__(message)
+        self.slowest_attempts: List[str] = list(slowest_attempts or [])
+
+
+class DeadlineBudget:
+    """Wall-clock budget for one WHOLE rollout (``tpuctl apply
+    --deadline``): armed once, then every layer spends from the same
+    remainder — per-attempt walls, retry backoff sleeps, CRD-establish
+    and readiness waits, the kubectl backend's subprocess kill timer.
+    Read-only after construction (monotonic arithmetic only), so the
+    worker pool shares it without a lock."""
+
+    def __init__(self, total_s: float) -> None:
+        self.total_s = float(total_s)
+        self._t0 = time.monotonic()
+
+    def remaining(self) -> float:
+        return self.total_s - (time.monotonic() - self._t0)
+
+    def exhausted(self) -> bool:
+        return self.remaining() <= 0.0
+
+    def clamp(self, seconds: float) -> float:
+        """``seconds`` capped to the remaining budget (floor 0)."""
+        return max(0.0, min(seconds, self.remaining()))
+
+
+class _AttemptDeadline(Exception):
+    """Internal: one wire attempt outlived its whole-attempt wall (the
+    transport classifies it as status 0 — the retry family)."""
+
+
+def _attempt_deadline_error(wall_s: float) -> Dict[str, Any]:
+    """Status-0 body for an attempt that outlived its wall — a stalled
+    or trickling server. Retryable: the next attempt may land on a
+    healthy replica (and the rollout budget bounds how long we try)."""
+    return {"message": f"whole-attempt deadline exceeded after "
+                       f"{wall_s:.2f}s (stalled or trickling apiserver)",
+            "errorClass": "AttemptDeadline"}
+
+
+def _garbage_error(status: int, payload: bytes) -> Dict[str, Any]:
+    """Status-0 body for a 2xx reply whose payload is not JSON — the
+    GARBAGE fault class (half-JSON body behind healthy framing).
+    Classified into the transport-0 retry family: the object's true
+    state is unknown, exactly like a dropped connection."""
+    return {"message": f"garbage body on HTTP {status}: not JSON "
+                       f"({payload[:80]!r})",
+            "errorClass": "GarbageBody"}
 
 
 class _WatchDenied(Exception):
@@ -524,7 +617,33 @@ class Client:
     base_url: str
     token: str = ""
     ca_file: Optional[str] = None
+    # Per-socket-op timeout AND (by default) the whole-attempt wall: one
+    # wire attempt's BODY is drained under this wall clock whatever the
+    # per-op progress — the twin of the C++ client's `timeout_ms bounds
+    # the WHOLE response` contract (native/operator/kubeclient.cc), so a
+    # server that TRICKLES body bytes (every socket op succeeds) can no
+    # longer stall an apply forever. The response-HEADER phase is per-op
+    # bounded by default and wall-bounded too once deadline discipline
+    # is armed (attempt_deadline_s or budget — see _header_watchdog).
+    # Watch STREAMS are exempt: deliberate long reads bounded by their
+    # own window.
     timeout: float = 10.0
+    # Narrower whole-attempt wall than `timeout` when set (seconds): the
+    # per-op timeout stays `timeout`, but the attempt as a whole is cut
+    # off here — what the slow-fault bench/soak arm to keep tail
+    # attempts bounded. None = the wall IS `timeout`.
+    attempt_deadline_s: Optional[float] = None
+    # Rollout-wide wall budget (tpuctl apply --deadline): when set, the
+    # remaining budget caps every per-attempt wall and backoff sleep,
+    # and exhaustion raises the typed DeadlineExceeded. Read-only after
+    # construction — shared across the worker pool without a lock.
+    budget: Optional[DeadlineBudget] = None
+    # Hedge threshold for idempotent reads (seconds): a GET with no body
+    # still unanswered after this long fires ONE backup attempt on a
+    # fresh connection; first response wins, the loser's socket is
+    # closed. None (default) = no hedging — no threads, no extra
+    # requests (the zero-overhead contract).
+    hedge_s: Optional[float] = None
     # Without a ca_file, https requests FAIL unless this is set: sending a
     # bearer ServiceAccount token over unverified TLS hands cluster-admin-ish
     # credentials to any MITM, so disabling verification must be an explicit
@@ -570,6 +689,10 @@ class Client:
         self._retry_lock = threading.Lock()
         self.retries = 0  # guarded-by: _retry_lock
         self.last_transport_error: Optional[str] = None  # guarded-by: _retry_lock
+        # hedged-read accounting (the CLI and bench report it): how many
+        # idempotent reads fired a backup attempt past the hedge
+        # threshold
+        self.hedges = 0  # guarded-by: _retry_lock
         # Serializes the FIRST server-side-apply attempt while
         # ssa_supported is unknown (the once-per-client capability probe)
         # AND guards the sticky flag itself. Reentrant: the probing
@@ -598,6 +721,18 @@ class Client:
             ctx.verify_mode = ssl.CERT_NONE
         return ctx
 
+    def _new_connection(self) -> http.client.HTTPConnection:
+        """A fresh, UNPOOLED connection (the hedged-read attempts use
+        these so the orchestrator holds a close() handle for loser
+        cancellation; the pooled per-thread transport wraps this)."""
+        url = urllib.parse.urlsplit(self.base_url)
+        if url.scheme == "https":
+            return http.client.HTTPSConnection(
+                url.hostname, url.port or 443, timeout=self.timeout,
+                context=self._tls_context())
+        return http.client.HTTPConnection(
+            url.hostname, url.port or 80, timeout=self.timeout)
+
     def _connection(self) -> http.client.HTTPConnection:
         """The calling thread's persistent connection (created on demand).
         One per thread, never shared: http.client connections aren't
@@ -605,18 +740,139 @@ class Client:
         conn = getattr(self._local, "conn", None)
         if conn is not None:
             return conn
-        url = urllib.parse.urlsplit(self.base_url)
-        if url.scheme == "https":
-            conn = http.client.HTTPSConnection(
-                url.hostname, url.port or 443, timeout=self.timeout,
-                context=self._tls_context())
-        else:
-            conn = http.client.HTTPConnection(
-                url.hostname, url.port or 80, timeout=self.timeout)
+        conn = self._new_connection()
         self._local.conn = conn
         with self._conns_lock:
             self._conns.append(conn)
         return conn
+
+    def _attempt_wall(self) -> float:
+        """The whole-attempt wall for ONE wire attempt: the configured
+        attempt deadline (default: ``timeout``), further capped by the
+        rollout budget's remainder when one is armed (a rollout 0.3s
+        from its deadline must not start a 10s attempt)."""
+        wall = (self.attempt_deadline_s
+                if self.attempt_deadline_s is not None else self.timeout)
+        budget = self.budget
+        if budget is not None:
+            # floor: exhaustion is raised by the caller, not by handing
+            # the socket layer a zero/negative timeout
+            wall = min(wall, max(0.05, budget.remaining()))
+        return wall
+
+    def _header_watchdog(self, conn: Any, deadline: float,
+                         severed: List[bool]
+                         ) -> Optional[threading.Timer]:
+        """Bound the response-HEADER phase by the attempt wall: a timer
+        that severs the connection at the wall, so a server trickling
+        HEADER bytes (each recv succeeds — the same per-op blind spot
+        as a body trickle, which ``getresponse`` is exposed to) cannot
+        hold the attempt past it. shutdown() (not close()) because a
+        concurrently-blocked recv is only reliably unblocked by a
+        shutdown. ``severed`` is marked BEFORE the shutdown so the
+        transport can classify the resulting socket error as a DEADLINE
+        hit — without it the sever looks exactly like a stale pooled
+        socket and the fast retry would re-send for a second full wall.
+        Armed ONLY when deadline discipline was explicitly requested
+        (``attempt_deadline_s`` or a budget): a timer thread per request
+        is the wrong default cost for the healthy hot path, whose header
+        phase stays per-op bounded as before."""
+        if self.attempt_deadline_s is None and self.budget is None:
+            return None
+
+        def sever() -> None:
+            severed.append(True)
+            sock = getattr(conn, "sock", None)
+            if sock is not None:
+                try:
+                    sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+
+        timer = threading.Timer(max(0.0, deadline - time.monotonic()),
+                                sever)
+        timer.daemon = True
+        timer.start()
+        return timer
+
+    def _perform_attempt(self, conn: Any, method: str, path: str,
+                         data: Optional[bytes], content_type: str,
+                         wall: float, traceparent: Optional[str]
+                         ) -> Tuple[int, bytes, Optional[float]]:
+        """ONE wire attempt on ``conn`` under the whole-attempt wall:
+        send, header watchdog around ``getresponse`` (the phase where
+        the wall cannot be checked between reads), wall-checked body
+        drain. Returns ``(status, payload, retry_after_s)``; raises
+        :class:`_AttemptDeadline` when the wall cut the attempt
+        (including a watchdog sever, which otherwise masquerades as a
+        dead socket) and lets transport exceptions propagate for the
+        caller's classification — the pooled transport may stale-retry,
+        the hedge backup never does. Shared by both so the deadline /
+        garbage subtleties cannot drift between them."""
+        base_path = urllib.parse.urlsplit(self.base_url).path.rstrip("/")
+        t0 = time.monotonic()
+        conn.timeout = min(self.timeout, wall)
+        if conn.sock is not None:
+            conn.sock.settimeout(min(self.timeout, wall))
+        conn.request(method, base_path + path, body=data,
+                     headers=self._headers(data is not None, content_type,
+                                           traceparent=traceparent))
+        severed: List[bool] = []
+        watchdog = self._header_watchdog(conn, t0 + wall, severed)
+        try:
+            resp = conn.getresponse()
+            payload = self._read_body(resp, conn, t0 + wall)
+        except (http.client.HTTPException, OSError):
+            if severed:
+                raise _AttemptDeadline()
+            raise
+        finally:
+            if watchdog is not None:
+                watchdog.cancel()
+        return (resp.status, payload,
+                _retry_after_s(resp.getheader("Retry-After")))
+
+    @staticmethod
+    def _classify_payload(status: int, payload: bytes
+                          ) -> Tuple[int, Dict[str, Any], bool]:
+        """Parse one reply body: ``(code, parsed, garbage)``. A 2xx
+        whose body is not JSON is the GARBAGE fault class — the object's
+        true state is unknown, so it classifies into the transport-0
+        retry family instead of handing callers the junk; non-2xx error
+        bodies keep their status with the raw text as the message."""
+        try:
+            return status, json.loads(payload or b"{}"), False
+        except ValueError:
+            if 200 <= status < 300:
+                return 0, _garbage_error(status, payload), True
+            return (status,
+                    {"message": payload.decode(errors="replace")[:200]},
+                    False)
+
+    def _read_body(self, resp: Any, conn: Any, deadline: float) -> bytes:
+        """Drain one response body under a WALL deadline. ``read1`` caps
+        each loop turn at one buffered socket read (itself bounded by the
+        per-op timeout), and the wall check BETWEEN turns is what defeats
+        a trickling server — per-op timeouts alone cannot, because every
+        op succeeds. Raises :class:`_AttemptDeadline` when the wall
+        passes mid-body."""
+        chunks: List[bytes] = []
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise _AttemptDeadline()
+            sock = getattr(conn, "sock", None) if conn is not None else None
+            if sock is not None:
+                sock.settimeout(min(self.timeout, max(remaining, 0.001)))
+            chunk = resp.read1(65536)
+            if not chunk:
+                # read1 drains the body but (unlike read()) never marks a
+                # length-framed response CLOSED at exhaustion — close it
+                # here or the keep-alive connection refuses its next
+                # request as "previous response still open"
+                resp.close()
+                return b"".join(chunks)
+            chunks.append(chunk)
 
     def _drop_connection(self) -> None:
         conn = getattr(self._local, "conn", None)
@@ -696,21 +952,24 @@ class Client:
 
     def _note_attempt(self, method: str, path: str, status: int,
                       dt: float, span_id: Optional[str] = None,
+                      parent: Optional[_telemetry.Span] = None,
                       **extra: Any) -> None:
         """Record ONE wire attempt in the telemetry (leaf span, cat
         "http", under the calling thread's open span; per-verb/status
         request counter; latency histogram). One note per request that
         actually hit the wire — including the keep-alive stale-socket
-        fast retry and watch stream opens — so summed http spans equal
-        the apiserver's audit count on a clean run (the pinned trace
-        test; only a request that died before the server saw it can
-        diverge, and only under chaos)."""
+        fast retry, watch stream opens, and hedged backup attempts — so
+        summed http spans equal the apiserver's audit count on a clean
+        run (the pinned trace test; only a request that died before the
+        server saw it can diverge, and only under chaos). ``parent``
+        pins the span across thread boundaries (the hedge attempts run
+        on helper threads with no span stack)."""
         tel = self.telemetry
         if tel is None:
             return
         short = path.partition("?")[0]
         tel.leaf(f"{method} {short}", "http", dt, span_id=span_id,
-                 verb=method, status=status, **extra)
+                 parent=parent, verb=method, status=status, **extra)
         tel.counter(_telemetry.REQUESTS_TOTAL,
                     "apiserver wire attempts by verb and status",
                     verb=method, code=str(status)).inc()
@@ -720,37 +979,51 @@ class Client:
 
     def _request_keepalive(
             self, method: str, path: str, data: Optional[bytes],
-            content_type: str
+            content_type: str,
+            conn_holder: Optional[List[Any]] = None
     ) -> Tuple[int, Dict[str, Any], Optional[float]]:
         """One request over the thread's persistent connection, returning
         ``(status, parsed, retry_after_s)``. A stale keep-alive socket
         (server restarted, idle timeout) surfaces as RemoteDisconnected /
         reset on the FIRST attempt only — retried once on a fresh
         connection immediately; every further retry belongs to the
-        RetryPolicy loop in ``_request`` (with backoff)."""
-        base_path = urllib.parse.urlsplit(self.base_url).path.rstrip("/")
+        RetryPolicy loop in ``_request`` (with backoff). The WHOLE
+        attempt — send, headers, full body — is bounded by the attempt
+        wall (see :meth:`_perform_attempt`); outliving it classifies as
+        transport status 0, like the C++ twin's "read timeout".
+        ``conn_holder``, when given, always names the attempt's LIVE
+        connection (refreshed across the stale retry) — the hedge
+        orchestrator's sever handle."""
+        wall = self._attempt_wall()
         for attempt in (0, 1):
             conn = self._connection()
+            if conn_holder is not None:
+                conn_holder[:] = [conn]
             # fresh traceparent per attempt: the stale-socket retry is a
             # DISTINCT wire attempt and must pair with its own server span
             span_id, tp = self._attempt_context()
             t0 = time.monotonic()
             try:
-                conn.request(method, base_path + path, body=data,
-                             headers=self._headers(data is not None,
-                                                   content_type,
-                                                   traceparent=tp))
-                resp = conn.getresponse()
-                payload = resp.read()  # drains so the connection can reuse
-                retry_after = _retry_after_s(resp.getheader("Retry-After"))
-                try:
-                    parsed = json.loads(payload or b"{}")
-                except ValueError:
-                    parsed = {"message":
-                              payload.decode(errors="replace")[:200]}
-                self._note_attempt(method, path, resp.status,
+                status, payload, retry_after = self._perform_attempt(
+                    conn, method, path, data, content_type, wall, tp)
+                code, parsed, garbage = self._classify_payload(status,
+                                                               payload)
+                if garbage:
+                    self._drop_connection()
+                    self._note_attempt(method, path, 0,
+                                       time.monotonic() - t0,
+                                       span_id=span_id, garbage=True)
+                    return 0, parsed, None
+                self._note_attempt(method, path, status,
                                    time.monotonic() - t0, span_id=span_id)
-                return resp.status, parsed, retry_after
+                return status, parsed, retry_after
+            except _AttemptDeadline:
+                # the attempt outlived its wall (stall/trickle): the
+                # connection is mid-body and unusable — sever it
+                self._drop_connection()
+                self._note_attempt(method, path, 0, time.monotonic() - t0,
+                                   span_id=span_id, deadline=True)
+                return 0, _attempt_deadline_error(wall), None
             except (http.client.HTTPException, OSError) as exc:
                 self._drop_connection()
                 if attempt == 0 and isinstance(
@@ -790,13 +1063,42 @@ class Client:
                                   traceparent=traceparent).items():
             req.add_header(k, v)
         ctx = self._tls_context()
+        wall = self._attempt_wall()
+        deadline = time.monotonic() + wall
         try:
-            with urllib.request.urlopen(req, data=data, timeout=self.timeout,
+            with urllib.request.urlopen(req, data=data,
+                                        timeout=min(self.timeout, wall),
                                         context=ctx) as resp:
-                return (resp.status, json.loads(resp.read() or b"{}"),
-                        _retry_after_s(resp.headers.get("Retry-After")))
+                # same whole-attempt wall as the keep-alive transport:
+                # the body is drained in bounded read1 turns (urllib
+                # hides the socket, so the per-op timeout stays fixed —
+                # worst case one extra op of grace past the wall)
+                payload = self._read_body(resp, None, deadline)
+                status = resp.status
+                retry_after = _retry_after_s(
+                    resp.headers.get("Retry-After"))
+            try:
+                parsed = json.loads(payload or b"{}")
+            except ValueError:
+                if 200 <= status < 300:
+                    return 0, _garbage_error(status, payload), None
+                parsed = {"message": payload.decode(errors="replace")[:200]}
+            return status, parsed, retry_after
+        except _AttemptDeadline:
+            return 0, _attempt_deadline_error(wall), None
         except urllib.error.HTTPError as exc:
-            payload = exc.read()
+            # the ERROR body rides the same wall as a success body — a
+            # trickled 500 payload is still the trickle fault class
+            try:
+                fp = exc.fp
+                if fp is not None and hasattr(fp, "read1"):
+                    payload = self._read_body(fp, None, deadline)
+                else:
+                    payload = exc.read()
+            except _AttemptDeadline:
+                return 0, _attempt_deadline_error(wall), None
+            except (http.client.HTTPException, OSError):
+                payload = b""
             try:
                 parsed = json.loads(payload or b"{}")
             except ValueError:
@@ -819,13 +1121,26 @@ class Client:
         honoring Retry-After; the final (or first non-retryable) answer is
         returned as ``(status, parsed)``. Safe for POST too: a create whose
         response was lost re-POSTs into 409 AlreadyExists, which the apply
-        paths resolve as re-GET-then-re-PATCH."""
+        paths resolve as re-GET-then-re-PATCH.
+
+        With a rollout budget armed, every backoff sleep clamps to the
+        remainder and an exhausted budget raises the typed
+        :class:`DeadlineExceeded` instead of starting another attempt.
+        With hedging armed, idempotent reads (GET, no body) route
+        through :meth:`_request_hedged`."""
         data = json.dumps(body).encode() if body is not None else None
         policy = self.retry or NO_RETRY
+        budget = self.budget
         attempt = 0
         while True:
             attempt += 1
-            if self.keep_alive:
+            if budget is not None and budget.exhausted():
+                raise self._deadline_error(f"{method} {path}")
+            if self.hedge_s is not None and method == "GET" \
+                    and data is None:
+                code, parsed, retry_after = self._request_hedged(
+                    method, path)
+            elif self.keep_alive:
                 code, parsed, retry_after = self._request_keepalive(
                     method, path, data, content_type)
             else:
@@ -838,6 +1153,8 @@ class Client:
                 if code == 0:
                     self.last_transport_error = (parsed or {}).get("message")
             backoff = policy.backoff_s(attempt, retry_after)
+            if budget is not None:
+                backoff = budget.clamp(backoff)
             if self.telemetry is not None:
                 # the PR-3 taxonomy, annotated: which status triggered the
                 # retry, which attempt this was, how long we back off —
@@ -852,6 +1169,159 @@ class Client:
                     classification=policy.classify(code),
                     backoff_s=round(backoff, 4))
             time.sleep(backoff)
+
+    def _deadline_error(self, context: str) -> DeadlineExceeded:
+        """The typed budget-exhaustion error, carrying the slowest wire
+        attempts from telemetry (when armed) — a DeadlineExceeded that
+        cannot say WHERE the wall time went is half a diagnosis."""
+        budget = self.budget
+        total = budget.total_s if budget is not None else 0.0
+        slowest: List[str] = []
+        tel = self.telemetry
+        if tel is not None:
+            events = _telemetry.request_events(tel.chrome_trace())
+            events.sort(key=lambda e: -float(e.get("dur", 0.0)))
+            slowest = [
+                f"{e.get('name', '?')} "
+                f"[{e.get('args', {}).get('status', '?')}] "
+                f"{float(e.get('dur', 0.0)) / 1e6:.2f}s"
+                for e in events[:3]]
+        hint = (f"; slowest attempts: {', '.join(slowest)}"
+                if slowest else "")
+        return DeadlineExceeded(
+            f"rollout deadline ({total:.1f}s) exhausted during "
+            f"{context}{hint}", slowest_attempts=slowest)
+
+    def _hedge_attempt(self, conn: http.client.HTTPConnection,
+                       method: str, path: str, wall: float,
+                       parent: Optional[_telemetry.Span]
+                       ) -> Tuple[int, Dict[str, Any], Optional[float]]:
+        """The BACKUP wire attempt of a hedged read, over a dedicated
+        connection on the hedge helper thread (``parent`` pins its leaf
+        span under the caller's open span — helper threads have no span
+        stack). Never raises: every failure classifies as transport
+        status 0, exactly like the pooled transport."""
+        span_id, tp = self._attempt_context()
+        t0 = time.monotonic()
+        try:
+            status, payload, retry_after = self._perform_attempt(
+                conn, method, path, None, "", wall, tp)
+            code, parsed, garbage = self._classify_payload(status, payload)
+            if garbage:
+                self._note_attempt(method, path, 0,
+                                   time.monotonic() - t0,
+                                   span_id=span_id, parent=parent,
+                                   garbage=True, hedge="backup")
+                return 0, parsed, None
+            self._note_attempt(method, path, status,
+                               time.monotonic() - t0, span_id=span_id,
+                               parent=parent, hedge="backup")
+            return status, parsed, retry_after
+        except _AttemptDeadline:
+            self._note_attempt(method, path, 0, time.monotonic() - t0,
+                               span_id=span_id, parent=parent,
+                               deadline=True, hedge="backup")
+            return 0, _attempt_deadline_error(wall), None
+        except (http.client.HTTPException, OSError) as exc:
+            self._note_attempt(method, path, 0, time.monotonic() - t0,
+                               span_id=span_id, parent=parent,
+                               hedge="backup")
+            return 0, _transport_error(exc), None
+
+    def _request_hedged(self, method: str, path: str
+                        ) -> Tuple[int, Dict[str, Any], Optional[float]]:
+        """One logical idempotent read with tail-tolerant hedging ("The
+        Tail at Scale" shape). The PRIMARY attempt runs in the calling
+        thread over the normal pooled transport — against a healthy
+        server the armed-but-idle cost is one helper thread parked on an
+        Event, no extra socket, no TLS handshake. The helper fires ONE
+        backup attempt on a fresh connection if the primary is still
+        unanswered past ``hedge_s``; a SUCCESSFUL backup severs the
+        primary's socket so the caller stops waiting (a failed backup
+        cancels nothing — a transport error must never beat an answer in
+        flight). The primary's answer wins whenever it has one; only a
+        failed primary falls through to the backup's. Worst case the
+        read costs two attempt walls (the severed primary's stale-socket
+        fast retry may re-send once); typical hedged latency is the
+        backup's round trip. Only reachable for GET-without-body:
+        mutations are never hedged (a duplicated in-flight PATCH is not
+        idempotent under SSA conflicts)."""
+        hedge_s = self.hedge_s
+        assert hedge_s is not None and method == "GET"
+        tel = self.telemetry
+        parent = tel.current() if tel is not None else None
+        wall = self._attempt_wall()
+        primary_done = threading.Event()
+        backup_done = threading.Event()
+        fired: List[bool] = []  # appended once if the backup launches
+        backup_out: List[Tuple[int, Dict[str, Any], Optional[float]]] = []
+        # always the primary's LIVE connection: _request_keepalive
+        # refreshes it across its stale-socket fast retry, so a sever
+        # hits the socket the caller is actually blocked on (a stale
+        # handle captured up front would no-op exactly when it matters)
+        primary_conn: List[Any] = []
+
+        def backup() -> None:
+            if primary_done.wait(hedge_s):
+                return  # answered in time: no hedge, no socket
+            fired.append(True)
+            with self._retry_lock:
+                self.hedges += 1
+            if tel is not None:
+                tel.counter(_telemetry.HEDGES_TOTAL,
+                            "idempotent reads hedged with a backup "
+                            "attempt", verb=method).inc()
+            if parent is not None:
+                # instant event on the CALLER's open span (this thread
+                # has no span stack) — flight-recorder cargo, like
+                # retries
+                parent.event("hedge", path=path.partition("?")[0],
+                             threshold_s=hedge_s)
+            try:
+                conn = self._new_connection()
+            except ApplyError as exc:  # TLS config refusal
+                backup_out.append((0, _transport_error(exc), None))
+                backup_done.set()
+                return
+            out = self._hedge_attempt(conn, method, path, wall, parent)
+            try:
+                conn.close()
+            except OSError:
+                pass
+            backup_out.append(out)
+            backup_done.set()
+            if out[0] != 0 and not primary_done.is_set():
+                # the backup ANSWERED while the primary still hangs:
+                # sever the primary's socket (shutdown unblocks a
+                # concurrently-blocked recv; close does not) so the
+                # caller takes this answer now instead of at the wall
+                live = primary_conn[-1] if primary_conn else None
+                sock = getattr(live, "sock", None)
+                if sock is not None:
+                    try:
+                        sock.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+
+        helper = threading.Thread(target=backup, daemon=True)
+        helper.start()
+        try:
+            if self.keep_alive:
+                code, parsed, retry_after = self._request_keepalive(
+                    method, path, None, "", conn_holder=primary_conn)
+            else:
+                code, parsed, retry_after = self._request_oneshot(
+                    method, path, None, "")
+        finally:
+            primary_done.set()
+        if code != 0 or not fired:
+            return code, parsed, retry_after
+        # the primary failed after a hedge fired: prefer the backup's
+        # ANSWER (bounded — the backup's own wall expires it)
+        backup_done.wait(wall + self.timeout + 5.0)
+        if backup_out and backup_out[0][0] != 0:
+            return backup_out[0]
+        return code, parsed, retry_after
 
     def get(self, path: str) -> Tuple[int, Dict[str, Any]]:
         return self._request("GET", path)
@@ -1017,9 +1487,16 @@ class Client:
                              poll: float = 1.0) -> None:
         """Block until a just-applied CRD reports Established — the window
         where the apiserver doesn't yet serve the CRD's endpoints, during
-        which creating a CR of that kind 404s."""
+        which creating a CR of that kind 404s. The wait honors the
+        rollout budget (it cannot outlive ``--deadline``), and each poll
+        sleep clamps to the deadline remainder — a 5s poll interval must
+        not overshoot a 0.3s remaining deadline (the ``_poll_ready``
+        clamp, applied here too)."""
         path = ("/apis/apiextensions.k8s.io/v1/"
                 f"customresourcedefinitions/{name}")
+        budget = self.budget
+        if budget is not None:
+            timeout = min(timeout, max(0.0, budget.remaining()))
         deadline = time.monotonic() + timeout
         last_err: Optional[str] = None
         while True:
@@ -1032,11 +1509,13 @@ class Client:
             last_err = (None if code == 200 else
                         f"GET -> {code} {(live or {}).get('message', live)}")
             if time.monotonic() >= deadline:
+                if budget is not None and budget.exhausted():
+                    raise self._deadline_error(f"CRD {name} establishment")
                 hint = f" (last error: {last_err})" if last_err else ""
                 raise ApplyError(
                     f"timed out waiting for CRD {name} to be "
                     f"Established{hint}")
-            time.sleep(poll)
+            time.sleep(min(poll, max(0.0, deadline - time.monotonic())))
 
     def wait_ready(self, objs: Sequence[Dict[str, Any]], timeout: float,
                    poll: float = 1.0,
@@ -1067,6 +1546,11 @@ class Client:
             stats = {}
         stats.setdefault("requests", 0)
         stats["mode"] = "watch" if watch else "poll"
+        budget = self.budget
+        if budget is not None:
+            # the readiness wait spends from the rollout budget like
+            # every other phase — it cannot outlive --deadline
+            timeout = min(timeout, max(0.0, budget.remaining()))
         started = time.monotonic()
         deadline = started + timeout
         pending = [o for o in objs if o.get("kind") in WORKLOAD_KINDS]
@@ -1092,6 +1576,9 @@ class Client:
         tel = self.telemetry
         parent = tel.current() if tel is not None else None
 
+        # typed-exception flag shared with the watcher threads
+        deadline_hit: List[DeadlineExceeded] = []  # guarded-by: lock
+
         def run(coll: str, members: List[Dict[str, Any]],
                 drop_conn: bool = False) -> None:
             try:
@@ -1105,6 +1592,11 @@ class Client:
             except ApplyError as exc:
                 with lock:
                     failures.append(str(exc))
+                    if isinstance(exc, DeadlineExceeded):
+                        # preserve the type across the thread join: a
+                        # budget-killed wait must surface AS the typed
+                        # error, not a generic readiness timeout
+                        deadline_hit.append(exc)
             finally:
                 if drop_conn:
                     # this worker thread is about to die: its thread-local
@@ -1127,6 +1619,10 @@ class Client:
             for t in threads:
                 t.join()
         if failures:
+            if deadline_hit:
+                raise DeadlineExceeded(
+                    "; ".join(sorted(failures)),
+                    slowest_attempts=deadline_hit[0].slowest_attempts)
             raise ApplyError("; ".join(sorted(failures)))
         return stats
 
@@ -1193,12 +1689,17 @@ class Client:
             if not pending:
                 return
             if time.monotonic() >= deadline:
+                budget = self.budget
+                if budget is not None and budget.exhausted():
+                    raise self._deadline_error("readiness wait")
                 names = [o["metadata"]["name"] for o in pending]
                 hint = (f" (collection reads failing — "
                         f"{last_list_err})" if last_list_err else "")
                 raise ApplyError(
                     f"timed out waiting for readiness: {names}{hint}")
-            time.sleep(poll)
+            # clamp to the deadline remainder: a long poll interval must
+            # not overshoot a short remaining deadline
+            time.sleep(min(poll, max(0.0, deadline - time.monotonic())))
 
     def _open_watch(self, coll: str, resource_version: str,
                     window_s: int) -> Tuple[Any, Any]:
@@ -1387,6 +1888,9 @@ class Client:
                 # reopen at the poll tick — never a tight request loop
                 time.sleep(min(poll, max(0.0, deadline - time.monotonic())))
         if pending:
+            budget = self.budget
+            if budget is not None and budget.exhausted():
+                raise self._deadline_error(f"readiness watch on {coll}")
             names = sorted(pending)
             raise ApplyError(
                 f"timed out waiting for readiness: {names} "
@@ -1577,6 +2081,20 @@ def kubectl_runner(argv: Sequence[str], input_text: Optional[str] = None,
     return proc.returncode, proc.stdout, proc.stderr
 
 
+def _kubectl_timeout(stage_timeout: float,
+                     budget: Optional[DeadlineBudget]) -> float:
+    """The kill timer for ONE kubectl invocation: generous past the
+    stage timeout (kubectl runs its own waits inside), but NEVER past
+    the rollout budget's remainder — a stalled kubectl (apiserver gone
+    quiet under it) must not outlive ``--deadline``. Floor 1s so an
+    almost-exhausted budget still launches the process that gets the
+    rc=124 verdict instead of hanging on a zero timeout."""
+    kill_after = stage_timeout + 120
+    if budget is not None:
+        kill_after = min(kill_after, max(1.0, budget.remaining()))
+    return kill_after
+
+
 def apply_groups_kubectl(groups: Sequence[Sequence[Dict[str, Any]]],
                          wait: bool = True, stage_timeout: float = 600,
                          runner: Optional[KubectlRunner] = None,
@@ -1586,7 +2104,8 @@ def apply_groups_kubectl(groups: Sequence[Sequence[Dict[str, Any]]],
                          journal: Optional[RolloutJournal] = None,
                          lint_mode: str = "off",
                          lint_spec: Optional[Any] = None,
-                         lint_external: Optional[FrozenSet[str]] = None
+                         lint_external: Optional[FrozenSet[str]] = None,
+                         budget: Optional[DeadlineBudget] = None
                          ) -> GroupResult:
     """The kubectl-CLI twin of :func:`apply_groups` for hosts where only
     kubectl (not a proxied apiserver URL) is available — the common case on
@@ -1604,7 +2123,13 @@ def apply_groups_kubectl(groups: Sequence[Sequence[Dict[str, Any]]],
 
     ``lint_mode``/``lint_spec`` run the same pre-apply static gate as the
     REST path — ``--lint=error`` blocks before the first kubectl
-    invocation."""
+    invocation.
+
+    ``budget`` (``tpuctl apply --deadline``) is the rollout-wide wall
+    budget: every kubectl invocation's kill timer clamps to its
+    remainder (:func:`_kubectl_timeout` — a stalled kubectl cannot
+    outlive the rollout deadline), the rc=124 retry backoff clamps too,
+    and exhaustion raises the typed :class:`DeadlineExceeded`."""
     import json as jsonmod
 
     import yaml
@@ -1634,10 +2159,16 @@ def apply_groups_kubectl(groups: Sequence[Sequence[Dict[str, Any]]],
         journal.set_mode("kubectl")
 
     if runner is None:
-        def runner(argv: Sequence[str], input_text: Optional[str] = None,
-                   _t: float = stage_timeout + 120  # outlive kubectl's own
-                   ) -> Tuple[int, str, str]:      # timeout
-            return kubectl_runner(argv, input_text, timeout=_t)
+        def runner(argv: Sequence[str],
+                   input_text: Optional[str] = None
+                   ) -> Tuple[int, str, str]:
+            # the kill timer is computed PER INVOCATION: the remaining
+            # rollout budget shrinks as the rollout runs, and the timer
+            # must shrink with it (a fixed default would let one stalled
+            # kubectl eat the whole deadline)
+            return kubectl_runner(argv, input_text,
+                                  timeout=_kubectl_timeout(stage_timeout,
+                                                           budget))
 
     retry = retry or RetryPolicy()
     result = GroupResult()
@@ -1651,10 +2182,18 @@ def apply_groups_kubectl(groups: Sequence[Sequence[Dict[str, Any]]],
             rc, out, err = runner(["kubectl", "apply", "-f", "-"], text)
             if rc != 124 or attempt >= retry.attempts:
                 break
+            if budget is not None and budget.exhausted():
+                raise DeadlineExceeded(
+                    f"rollout deadline ({budget.total_s:.1f}s) exhausted "
+                    f"during kubectl apply (group {i + 1}): the last "
+                    f"invocation was killed after its timeout (rc=124)")
             log(f"kubectl apply (group {i + 1}) killed after timeout "
                 f"(rc=124) — retryable; attempt "
                 f"{attempt}/{retry.attempts - 1}")
-            time.sleep(retry.backoff_s(attempt))
+            backoff = retry.backoff_s(attempt)
+            if budget is not None:
+                backoff = budget.clamp(backoff)
+            time.sleep(backoff)
         if rc != 0:
             detail = (out + err)[-400:]
             if rc == 124:
@@ -1670,11 +2209,21 @@ def apply_groups_kubectl(groups: Sequence[Sequence[Dict[str, Any]]],
             if obj.get("kind") != "CustomResourceDefinition":
                 continue
             name = obj["metadata"]["name"]
+            crd_wait = stage_timeout
+            if budget is not None:
+                crd_wait = min(crd_wait, max(1.0, budget.remaining()))
             rc, out, err = runner(
                 ["kubectl", "wait", "--for=condition=established",
-                 f"--timeout={max(1, int(stage_timeout))}s",
+                 f"--timeout={max(1, int(crd_wait))}s",
                  f"customresourcedefinition/{name}"])
             if rc != 0:
+                if budget is not None and budget.exhausted():
+                    # the budget killed the wait, not the CRD: surface
+                    # the TYPED error, as every other exhaustion path
+                    raise DeadlineExceeded(
+                        f"rollout deadline ({budget.total_s:.1f}s) "
+                        f"exhausted waiting for CRD {name} to be "
+                        "Established (kubectl wait)")
                 raise ApplyError(
                     f"CRD {name} not Established: {(out + err)[-400:]}")
         if not wait:
@@ -1682,9 +2231,13 @@ def apply_groups_kubectl(groups: Sequence[Sequence[Dict[str, Any]]],
             # gate passed, and wait=False never gates (re-applying it on
             # resume is idempotent and cheap — one kubectl apply)
             continue
-        # stage_timeout bounds the WHOLE group (matching the REST path):
+        # stage_timeout bounds the WHOLE group (matching the REST path),
+        # clamped to the rollout budget's remainder when one is armed:
         # each sequential gate gets only the remaining budget.
-        group_deadline = time.monotonic() + stage_timeout
+        stage_budget = stage_timeout
+        if budget is not None:
+            stage_budget = min(stage_budget, max(1.0, budget.remaining()))
+        group_deadline = time.monotonic() + stage_budget
         for obj in group:
             kind = obj.get("kind")
             if kind not in WORKLOAD_KINDS:
@@ -1701,6 +2254,11 @@ def apply_groups_kubectl(groups: Sequence[Sequence[Dict[str, Any]]],
                        f"{kind.lower()}/{name}", "-n", ns, timeout_arg]
             rc, out, err = runner(cmd)
             if rc != 0:
+                if budget is not None and budget.exhausted():
+                    raise DeadlineExceeded(
+                        f"rollout deadline ({budget.total_s:.1f}s) "
+                        f"exhausted during the readiness gate for "
+                        f"{kind}/{name} (kubectl)")
                 combined = out + err
                 reason = ("timed out waiting for readiness"
                           if rc == 124 or "timed out" in combined
@@ -2311,6 +2869,13 @@ def _apply_groups_pipelined(client: Client,
                                     # without SSA aborts the rollout AS a
                                     # capability error, not a per-object
                                     # failure
+                                    raise
+                                except DeadlineExceeded:
+                                    # the rollout budget is GLOBAL: one
+                                    # exhausted attempt means every
+                                    # sibling is out of time too —
+                                    # surface the typed error, never a
+                                    # per-object aggregate
                                     raise
                                 except ApplyError as exc:
                                     errors.append(str(exc))

@@ -104,6 +104,7 @@ OPERATOR_TRACE_EVENTS: Tuple[str, ...] = (
 REQUESTS_TOTAL = "tpuctl_requests_total"
 REQUEST_SECONDS = "tpuctl_request_duration_seconds"
 RETRIES_TOTAL = "tpuctl_retries_total"
+HEDGES_TOTAL = "tpuctl_hedges_total"
 UNCHANGED_TOTAL = "tpuctl_apply_unchanged_total"
 READY_SECONDS = "tpuctl_ready_seconds"
 WATCH_RECONNECTS_TOTAL = "tpuctl_watch_reconnects_total"
