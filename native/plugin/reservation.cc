@@ -1,0 +1,131 @@
+// Gang-reservation table parsing + Allocate enforcement (see reservation.h).
+
+#include "reservation.h"
+
+#include <algorithm>
+#include <set>
+
+#include "../operator/minijson.h"
+
+namespace tpud {
+
+// Contract constants — twins of tpu_cluster/admission.py
+// (RESERVATION_CONFIGMAP / RESERVATION_KEY / RESERVATION_SCHEMA_VERSION /
+// GANG_ANNOTATION). tests/test_admission.py greps these literals; a rename
+// here without the Python twin fails that pin before it fails a cluster.
+const char* ReservationConfigMapName() { return "tpu-gang-reservations"; }
+const char* ReservationKey() { return "reservations.json"; }
+int ReservationSchemaVersion() { return 1; }
+const char* GangAnnotation() { return "tpu-stack.dev/gang"; }
+
+bool ParseReservations(const std::string& json_text, ReservationTable* table,
+                       std::string* err) {
+  // fail closed as a unit: any error leaves *table EMPTY, never
+  // half-loaded (Allocate enforcement keys on the whole table)
+  *table = ReservationTable();
+  ReservationTable out;
+  std::string parse_err;
+  minijson::ValuePtr doc = minijson::Parse(json_text, &parse_err);
+  if (!doc || !doc->is_object()) {
+    *err = "reservations: not a JSON object" +
+           (parse_err.empty() ? "" : " (" + parse_err + ")");
+    return false;
+  }
+  int version = static_cast<int>(doc->PathNumber("version", -1));
+  if (version != ReservationSchemaVersion()) {
+    *err = "reservations: unsupported schema version " +
+           std::to_string(version) + " (want " +
+           std::to_string(ReservationSchemaVersion()) + ")";
+    return false;
+  }
+  out.version = version;
+  minijson::ValuePtr gangs = doc->Get("gangs");
+  if (!gangs) {  // empty table: nothing admitted
+    *table = std::move(out);
+    return true;
+  }
+  if (!gangs->is_object()) {
+    *err = "reservations: 'gangs' is not an object";
+    return false;
+  }
+  for (const auto& item : gangs->items()) {
+    GangReservation res;
+    res.gang = item.first;
+    if (!item.second || !item.second->is_object()) {
+      *err = "reservations: gang '" + item.first + "' is not an object";
+      return false;
+    }
+    res.accelerator = item.second->PathString("accelerator");
+    res.priority = static_cast<int>(item.second->PathNumber("priority", 0));
+    minijson::ValuePtr hosts = item.second->Get("hosts");
+    if (hosts && hosts->is_object()) {
+      for (const auto& h : hosts->items()) {
+        if (!h.second || !h.second->is_array()) {
+          *err = "reservations: gang '" + item.first + "' host '" +
+                 h.first + "' chip list is not an array";
+          return false;
+        }
+        std::vector<int> ids;
+        for (const auto& v : h.second->elements()) {
+          if (!v || !v->is_number()) {
+            *err = "reservations: gang '" + item.first +
+                   "' has a non-numeric chip id";
+            return false;
+          }
+          ids.push_back(static_cast<int>(v->as_number()));
+        }
+        std::sort(ids.begin(), ids.end());
+        res.hosts[h.first] = std::move(ids);
+      }
+    }
+    out.gangs[res.gang] = std::move(res);
+  }
+  *table = std::move(out);
+  return true;
+}
+
+bool CheckAllocation(const ReservationTable& table, const std::string& host,
+                     const std::vector<int>& device_ids, std::string* gang,
+                     std::string* reason) {
+  gang->clear();
+  std::set<int> want(device_ids.begin(), device_ids.end());
+  if (want.size() != device_ids.size()) {
+    *reason = "duplicate device ids in allocation request";
+    return false;
+  }
+  bool host_reserved = false;
+  for (const auto& entry : table.gangs) {
+    const GangReservation& res = entry.second;
+    auto it = res.hosts.find(host);
+    if (it == res.hosts.end()) continue;
+    host_reserved = true;
+    std::set<int> reserved(it->second.begin(), it->second.end());
+    if (reserved == want) {
+      *gang = res.gang;
+      *reason = "admitted gang '" + res.gang + "'";
+      return true;
+    }
+    if (!want.empty() &&
+        std::includes(reserved.begin(), reserved.end(), want.begin(),
+                      want.end())) {
+      // The failure this layer exists for: seating a FRACTION of an
+      // admitted gang's host group. Name it so the pod event says
+      // "partial", not just "denied".
+      *reason = "partial allocation of gang '" + res.gang + "' on host '" +
+                host + "': requested " + std::to_string(want.size()) +
+                " of " + std::to_string(reserved.size()) +
+                " reserved chip(s); gangs are seated whole or not at all";
+      return false;
+    }
+  }
+  if (host_reserved) {
+    *reason = "device set does not match any admitted gang reservation on "
+              "host '" + host + "'";
+  } else {
+    *reason = "no admitted gang reservation covers host '" + host +
+              "'; the admission loop has not granted this job chips";
+  }
+  return false;
+}
+
+}  // namespace tpud

@@ -1,0 +1,56 @@
+// Gang-reservation table: the Python admission loop's published contract,
+// enforced at the device plugin's Allocate.
+//
+// The admission controller (tpu_cluster/admission.py) arbitrates contending
+// multi-host gangs all-or-nothing and publishes the resulting reservation
+// table as a ConfigMap (name/key pinned below). tpud loads the table (the
+// ConfigMap is projected to a file, --reservations=PATH) and rejects any
+// Allocate whose device set is not EXACTLY one admitted gang's per-host
+// reservation — the kubelet can never seat a partial gang. Contract twin of
+// the Python constants/checker in tpu_cluster/admission.py, pinned by
+// native/plugin/selftest.cc (compiler-only) and a source-grep in
+// tests/test_admission.py (the RetryableStatus pattern).
+#pragma once
+
+#include <map>
+#include <string>
+#include <vector>
+
+namespace tpud {
+
+// ---- contract constants (twin of tpu_cluster/admission.py; keep literal
+// initializers greppable — tests regex them out of reservation.cc).
+const char* ReservationConfigMapName();   // ConfigMap metadata.name
+const char* ReservationKey();             // data key holding the JSON table
+int ReservationSchemaVersion();           // "version" field the parser accepts
+const char* GangAnnotation();             // workload annotation naming a gang
+
+struct GangReservation {
+  std::string gang;
+  std::string accelerator;
+  int priority = 0;
+  // host -> reserved chip ids (sorted)
+  std::map<std::string, std::vector<int>> hosts;
+};
+
+struct ReservationTable {
+  int version = 0;
+  // gang name -> reservation, insertion-ordered by name (std::map)
+  std::map<std::string, GangReservation> gangs;
+};
+
+// Parse the reservations.json document. False on malformed JSON, a wrong
+// schema version, or non-integer chip ids; *err names the reason.
+bool ParseReservations(const std::string& json_text, ReservationTable* table,
+                       std::string* err);
+
+// The Allocate() enforcement: true iff `device_ids` is EXACTLY the chip set
+// some admitted gang reserves on `host` (order-insensitive, duplicates
+// rejected). On success *gang names the matching gang; on failure *reason
+// says why — a proper subset of a reservation is called out as a PARTIAL
+// gang seat (the failure mode this whole layer exists to prevent).
+bool CheckAllocation(const ReservationTable& table, const std::string& host,
+                     const std::vector<int>& device_ids, std::string* gang,
+                     std::string* reason);
+
+}  // namespace tpud

@@ -1,0 +1,224 @@
+// plugin_selftest — unit checks for the gang-reservation contract
+// (reservation.h) plus a --check-reservations CLI mode so CI can replay a
+// LIVE table produced by the Python admission loop through the C++
+// enforcement (the "tpud selftest twin" of the e2e scenario).
+//
+// Protobuf-free on purpose: tpud itself needs protoc for the kubelet
+// DevicePlugin proto, but the reservation contract must stay provable on
+// hosts (and driver containers) that only have g++ — the same reasoning as
+// the operator's g++-fallback targets in tests/conftest.py.
+
+#include <stdio.h>
+#include <string.h>
+
+#include <fstream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "reservation.h"
+#include "topology.h"
+
+static int g_failures = 0;
+
+#define CHECK(cond)                                                    \
+  do {                                                                 \
+    if (!(cond)) {                                                     \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+      ++g_failures;                                                    \
+    }                                                                  \
+  } while (0)
+
+// The canonical reservation table the vector cases run against. Twin-read
+// by tests/test_admission.py: the Python test greps this literal out of
+// the selftest source, parses it with admission.parse_table, and replays
+// kReservationVectors through admission.check_allocation — same verdicts,
+// same matched gangs, or the twin pin fails.
+static const char kReservationTableJson[] =
+    "{\"version\": 1, \"gangs\": {"
+    "\"train-a\": {\"accelerator\": \"v5e-16\", \"priority\": 10,"
+    " \"hosts\": {\"node-a\": [0,1,2,3,4,5,6,7],"
+    " \"node-b\": [0,1,2,3,4,5,6,7]}},"
+    "\"probe\": {\"accelerator\": \"v5p-16\", \"priority\": 0,"
+    " \"hosts\": {\"node-c\": [0,1,2,3]}}}}";
+
+struct ReservationCase {
+  const char* host;
+  const char* ids;  // comma-separated chip ids, "" = empty request
+  bool ok;
+  const char* gang;  // expected match on ok, "" otherwise
+};
+
+// Shared verdict vectors (grep-pinned by tests/test_admission.py; keep one
+// initializer per line — the Python side parses them positionally).
+static const ReservationCase kReservationVectors[] = {
+    {"node-a", "0,1,2,3,4,5,6,7", true, "train-a"},
+    {"node-b", "0,1,2,3,4,5,6,7", true, "train-a"},
+    {"node-c", "0,1,2,3", true, "probe"},
+    {"node-a", "0,1,2,3", false, ""},
+    {"node-a", "4,5,6,7", false, ""},
+    {"node-a", "0", false, ""},
+    {"node-b", "0,1,2,3,4,5,6", false, ""},
+    {"node-c", "0,1,2,3,4,5,6,7", false, ""},
+    {"node-d", "0,1,2,3,4,5,6,7", false, ""},
+    {"node-a", "0,0,1,2,3,4,5,6", false, ""},
+    {"node-a", "", false, ""},
+};
+
+static std::vector<int> ParseIds(const char* csv) {
+  std::vector<int> out;
+  if (!*csv) return out;
+  std::stringstream ss(csv);
+  std::string tok;
+  while (std::getline(ss, tok, ',')) out.push_back(atoi(tok.c_str()));
+  return out;
+}
+
+static void TestContractConstants() {
+  // Compiler-only half of the twin pin (the Python source-grep is the
+  // other half): the wire contract is these exact strings.
+  CHECK(strcmp(tpud::ReservationConfigMapName(), "tpu-gang-reservations")
+        == 0);
+  CHECK(strcmp(tpud::ReservationKey(), "reservations.json") == 0);
+  CHECK(tpud::ReservationSchemaVersion() == 1);
+  CHECK(strcmp(tpud::GangAnnotation(), "tpu-stack.dev/gang") == 0);
+}
+
+static void TestParse() {
+  tpud::ReservationTable table;
+  std::string err;
+  CHECK(tpud::ParseReservations(kReservationTableJson, &table, &err));
+  CHECK(err.empty());
+  CHECK(table.version == 1);
+  CHECK(table.gangs.size() == 2);
+  CHECK(table.gangs.at("train-a").accelerator == "v5e-16");
+  CHECK(table.gangs.at("train-a").priority == 10);
+  CHECK(table.gangs.at("train-a").hosts.size() == 2);
+  CHECK(table.gangs.at("probe").hosts.at("node-c") ==
+        (std::vector<int>{0, 1, 2, 3}));
+  // chip ids are normalised sorted regardless of published order
+  tpud::ReservationTable scrambled;
+  CHECK(tpud::ParseReservations(
+      "{\"version\": 1, \"gangs\": {\"g\": {\"accelerator\": \"v4-8\","
+      " \"hosts\": {\"h\": [3,1,0,2]}}}}", &scrambled, &err));
+  CHECK(scrambled.gangs.at("g").hosts.at("h") ==
+        (std::vector<int>{0, 1, 2, 3}));
+  // empty table (nothing admitted) parses fine
+  tpud::ReservationTable empty;
+  CHECK(tpud::ParseReservations("{\"version\": 1, \"gangs\": {}}", &empty,
+                                &err));
+  CHECK(empty.gangs.empty());
+  CHECK(tpud::ParseReservations("{\"version\": 1}", &empty, &err));
+}
+
+static void TestParseRejects() {
+  tpud::ReservationTable table;
+  std::string err;
+  CHECK(!tpud::ParseReservations("not json", &table, &err));
+  CHECK(!err.empty());
+  CHECK(!tpud::ParseReservations("{\"version\": 2, \"gangs\": {}}", &table,
+                                 &err));
+  CHECK(err.find("version") != std::string::npos);
+  CHECK(!tpud::ParseReservations("{\"gangs\": {}}", &table, &err));
+  CHECK(!tpud::ParseReservations(
+      "{\"version\": 1, \"gangs\": {\"g\": {\"hosts\": {\"h\": [\"x\"]}}}}",
+      &table, &err));
+  // a failed parse leaves the table EMPTY (fail closed at Allocate, never
+  // half-loaded)
+  CHECK(table.gangs.empty() && table.version == 0);
+}
+
+static void TestCheckAllocationVectors() {
+  tpud::ReservationTable table;
+  std::string err;
+  CHECK(tpud::ParseReservations(kReservationTableJson, &table, &err));
+  for (const auto& c : kReservationVectors) {
+    std::string gang, reason;
+    bool ok = tpud::CheckAllocation(table, c.host, ParseIds(c.ids), &gang,
+                                    &reason);
+    if (ok != c.ok || gang != c.gang) {
+      fprintf(stderr, "FAIL reservation vector host=%s ids=[%s]: "
+              "got ok=%d gang='%s' (%s), want ok=%d gang='%s'\n",
+              c.host, c.ids, ok ? 1 : 0, gang.c_str(), reason.c_str(),
+              c.ok ? 1 : 0, c.gang);
+      ++g_failures;
+    }
+  }
+  // the partial-seat refusal NAMES the fraction — that string reaches the
+  // pod event, it must say what actually went wrong
+  std::string gang, reason;
+  CHECK(!tpud::CheckAllocation(table, "node-a", {0, 1, 2, 3}, &gang,
+                               &reason));
+  CHECK(reason.find("partial") != std::string::npos);
+  CHECK(reason.find("4 of 8") != std::string::npos);
+  CHECK(!tpud::CheckAllocation(table, "node-z", {0}, &gang, &reason));
+  CHECK(reason.find("no admitted gang") != std::string::npos);
+}
+
+static void TestTopologyStillAgrees() {
+  // Sanity coupling with the catalogue: every vector's accepted set is a
+  // whole host group of its accelerator (gang reservations are whole-host
+  // by construction in the admission loop).
+  const tpud::AcceleratorType* v5e16 = tpud::FindAccelerator("v5e-16");
+  CHECK(v5e16 != nullptr && v5e16->chips_per_host == 8);
+  const tpud::AcceleratorType* v5p16 = tpud::FindAccelerator("v5p-16");
+  CHECK(v5p16 != nullptr && v5p16->chips_per_host == 4);
+  std::string reason;
+  CHECK(tpud::ValidateAllocation(*v5e16, {0, 1, 2, 3, 4, 5, 6, 7},
+                                 &reason));
+  CHECK(tpud::ValidateAllocation(*v5p16, {0, 1, 2, 3}, &reason));
+}
+
+// --check-reservations FILE --host H --devices 0,1,... : replay a live
+// table (e.g. the ConfigMap payload the admission loop just published)
+// through the C++ enforcement. Exit 0 admitted (gang on stdout), 3 denied
+// (reason on stderr), 2 usage/parse error.
+static int CheckReservationsCli(int argc, char** argv) {
+  std::string file, host, devices;
+  for (int i = 2; i + 1 < argc; i += 2) {
+    if (strcmp(argv[i], "--host") == 0) host = argv[i + 1];
+    else if (strcmp(argv[i], "--devices") == 0) devices = argv[i + 1];
+    else { fprintf(stderr, "unknown flag %s\n", argv[i]); return 2; }
+  }
+  file = argv[1] + strlen("--check-reservations=");
+  std::ifstream in(file);
+  if (!in) {
+    fprintf(stderr, "cannot read %s\n", file.c_str());
+    return 2;
+  }
+  std::stringstream buf;
+  buf << in.rdbuf();
+  tpud::ReservationTable table;
+  std::string err;
+  if (!tpud::ParseReservations(buf.str(), &table, &err)) {
+    fprintf(stderr, "%s\n", err.c_str());
+    return 2;
+  }
+  std::string gang, reason;
+  if (tpud::CheckAllocation(table, host, ParseIds(devices.c_str()), &gang,
+                            &reason)) {
+    printf("%s\n", gang.c_str());
+    return 0;
+  }
+  fprintf(stderr, "%s\n", reason.c_str());
+  return 3;
+}
+
+int main(int argc, char** argv) {
+  if (argc > 1 &&
+      strncmp(argv[1], "--check-reservations=",
+              strlen("--check-reservations=")) == 0) {
+    return CheckReservationsCli(argc, argv);
+  }
+  TestContractConstants();
+  TestParse();
+  TestParseRejects();
+  TestCheckAllocationVectors();
+  TestTopologyStillAgrees();
+  if (g_failures) {
+    fprintf(stderr, "plugin_selftest: %d failure(s)\n", g_failures);
+    return 1;
+  }
+  printf("plugin_selftest: all checks passed\n");
+  return 0;
+}

@@ -32,6 +32,7 @@
 #include "deviceplugin.pb.h"
 #include "../common/devenum.h"
 #include "../grpcmin/grpc.h"
+#include "reservation.h"
 #include "topology.h"
 
 namespace {
@@ -47,6 +48,12 @@ struct Options {
   std::string kubelet_dir = "/var/lib/kubelet/device-plugins";
   std::string endpoint = "tpud.sock";
   std::string devfs_root;          // re-roots device_glob (tests)
+  // Gang admission (ISSUE 10): path of the reservation table the Python
+  // admission loop publishes (the tpu-gang-reservations ConfigMap,
+  // projected to a file). Empty = enforcement off, Allocate behaves
+  // exactly as before — the no-gangs hot path is byte-identical.
+  std::string reservations_path;
+  std::string node_name;           // this host's Node name (reservation key)
   int fake_devices = -1;           // >=0: synthesise N chips, no device files
   bool do_register = true;
   bool print_topology_golden = false;
@@ -125,6 +132,13 @@ class Plugin {
     fprintf(stderr, "tpud: serving %s on %s (%zu chips, accelerator=%s)\n",
             opt_.resource.c_str(), socket_path_.c_str(), devices_.size(),
             acc_.name.c_str());
+    if (!opt_.reservations_path.empty()) {
+      ReloadReservations();
+      fprintf(stderr,
+              "tpud: gang admission armed (reservations=%s node=%s): "
+              "Allocate only seats whole admitted gangs\n",
+              opt_.reservations_path.c_str(), opt_.node_name.c_str());
+    }
     RegisterMethods();
     return true;
   }
@@ -137,6 +151,7 @@ class Plugin {
       if (now - last_rescan >= opt_.rescan_interval_s) {
         last_rescan = now;
         Rescan();
+        if (!opt_.reservations_path.empty()) ReloadReservations();
       }
       if (now - last_reg_check >= 2) {
         last_reg_check = now;
@@ -215,7 +230,25 @@ class Plugin {
               // unaligned requests (SURVEY.md §7 hard-part #2).
               return Status{StatusCode::kInvalidArgument, reason};
             }
-            FillContainerResponse(ids, resp_pb.add_container_responses());
+            // Gang enforcement (ISSUE 10): with a reservation table armed,
+            // the device set must be EXACTLY one admitted gang's host
+            // group — the kubelet cannot seat a fraction of a gang, and a
+            // job the admission loop never admitted gets nothing. Fails
+            // CLOSED on a missing/unparseable table (chips held back
+            // beat chips double-booked).
+            std::string gang;
+            if (!opt_.reservations_path.empty()) {
+              if (!res_ok_) {
+                return Status{StatusCode::kUnavailable,
+                              "gang reservations unavailable: " + res_err_};
+              }
+              if (!tpud::CheckAllocation(reservations_, opt_.node_name, ids,
+                                         &gang, &reason)) {
+                return Status{StatusCode::kPermissionDenied, reason};
+              }
+            }
+            FillContainerResponse(ids, gang,
+                                  resp_pb.add_container_responses());
           }
           resp_pb.SerializeToString(resp);
           return Status::Ok();
@@ -230,6 +263,7 @@ class Plugin {
   }
 
   void FillContainerResponse(const std::vector<int>& ids,
+                             const std::string& gang,
                              v1beta1::ContainerAllocateResponse* cresp) {
     std::vector<int> sorted_ids(ids);
     std::sort(sorted_ids.begin(), sorted_ids.end());
@@ -310,7 +344,66 @@ class Plugin {
       m->set_read_only(true);
       envs["TPU_LIBRARY_PATH"] = opt_.libtpu_path;
     }
+    if (!gang.empty()) {
+      // the seated gang's identity, visible to the workload (JAX-side
+      // diagnostics) and on the container (kubectl describe)
+      envs["TPU_GANG_NAME"] = gang;
+      (*cresp->mutable_annotations())[tpud::GangAnnotation()] = gang;
+    }
     (*cresp->mutable_annotations())["tpu.native/allocation"] = visible;
+  }
+
+  // Load/refresh the admission loop's reservation table (mtime-gated; a
+  // vanished or unparseable file flips res_ok_ false so Allocate fails
+  // closed instead of enforcing a stale half-table).
+  void ReloadReservations() {
+    struct stat st;
+    if (stat(opt_.reservations_path.c_str(), &st) != 0) {
+      if (res_ok_ || res_err_.empty()) {
+        fprintf(stderr, "tpud: reservations file %s missing; Allocate "
+                "fails closed until it returns\n",
+                opt_.reservations_path.c_str());
+      }
+      res_ok_ = false;
+      res_err_ = "reservations file missing: " + opt_.reservations_path;
+      res_mtim_ = {0, 0};
+      res_size_ = -1;
+      return;
+    }
+    // nanosecond mtime + size: a sub-second admission loop can rewrite
+    // the table twice within one st_mtime second — whole-second
+    // comparison would enforce the stale table indefinitely
+    if (res_ok_ && st.st_mtim.tv_sec == res_mtim_.tv_sec &&
+        st.st_mtim.tv_nsec == res_mtim_.tv_nsec &&
+        st.st_size == res_size_) {
+      return;  // unchanged
+    }
+    FILE* f = fopen(opt_.reservations_path.c_str(), "r");
+    if (!f) {
+      res_ok_ = false;
+      res_err_ = "cannot open reservations file";
+      return;
+    }
+    std::string text;
+    char buf[4096];
+    size_t n;
+    while ((n = fread(buf, 1, sizeof(buf), f)) > 0) text.append(buf, n);
+    fclose(f);
+    std::string err;
+    tpud::ReservationTable table;
+    if (!tpud::ParseReservations(text, &table, &err)) {
+      fprintf(stderr, "tpud: %s; Allocate fails closed\n", err.c_str());
+      res_ok_ = false;
+      res_err_ = err;
+      return;
+    }
+    reservations_ = std::move(table);
+    res_ok_ = true;
+    res_err_.clear();
+    res_mtim_ = st.st_mtim;
+    res_size_ = st.st_size;
+    fprintf(stderr, "tpud: loaded %zu gang reservation(s)\n",
+            reservations_.gangs.size());
   }
 
   // ---------------------------------------------------------- devices
@@ -425,6 +518,11 @@ class Plugin {
   grpcmin::Server server_;
   std::string socket_path_;
   std::vector<ChipDevice> devices_;
+  tpud::ReservationTable reservations_;
+  bool res_ok_ = false;
+  std::string res_err_;
+  struct timespec res_mtim_ = {0, 0};
+  off_t res_size_ = -1;
   std::set<grpcmin::ServerStream*> watchers_;
   bool registered_ = false;
   ino_t kubelet_ino_ = 0;
@@ -454,6 +552,8 @@ int main(int argc, char** argv) {
     if (ParseFlag(a, "--kubelet-dir", &opt.kubelet_dir)) continue;
     if (ParseFlag(a, "--endpoint", &opt.endpoint)) continue;
     if (ParseFlag(a, "--devfs-root", &opt.devfs_root)) continue;
+    if (ParseFlag(a, "--reservations", &opt.reservations_path)) continue;
+    if (ParseFlag(a, "--node-name", &opt.node_name)) continue;
     if (ParseFlag(a, "--fake-devices", &sval)) {
       opt.fake_devices = atoi(sval.c_str());
       continue;
@@ -477,6 +577,7 @@ int main(int argc, char** argv) {
             "            [--fake-devices=N] [--libtpu-path=PATH]\n"
             "            [--kubelet-dir=DIR] [--endpoint=tpud.sock]\n"
             "            [--rescan-interval=SECS] [--no-register]\n"
+            "            [--reservations=PATH] [--node-name=NAME]\n"
             "            [--print-topology-golden]\n",
             a);
     return 2;
@@ -485,6 +586,19 @@ int main(int argc, char** argv) {
   if (opt.print_topology_golden) {
     printf("%s\n", tpud::GoldenJson().c_str());
     return 0;
+  }
+
+  if (!opt.reservations_path.empty() && opt.node_name.empty()) {
+    // reservation tables are keyed by Node name; a real deployment
+    // injects it via the downward API, and the hostname is the sane
+    // default on self-managed nodes (kubeadm registers nodes by it)
+    char host[256] = {0};
+    if (gethostname(host, sizeof(host) - 1) == 0) opt.node_name = host;
+    if (opt.node_name.empty()) {
+      fprintf(stderr, "tpud: --reservations needs --node-name (hostname "
+              "lookup failed)\n");
+      return 2;
+    }
   }
 
   const tpud::AcceleratorType* acc = tpud::FindAccelerator(opt.accelerator);

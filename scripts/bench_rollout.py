@@ -82,6 +82,7 @@ sys.path.insert(0, os.path.join(REPO, "tests"))
 
 from fake_apiserver import (FakeApiServer, slow_fault_script,  # noqa: E402
                             standard_fault_script)
+from tpu_cluster import admission  # noqa: E402
 from tpu_cluster import kubeapply  # noqa: E402
 from tpu_cluster import spec as specmod  # noqa: E402
 from tpu_cluster import telemetry  # noqa: E402
@@ -416,6 +417,60 @@ def slow_faults_arm(latency_s: float, watch: bool) -> dict:
             "fired_kinds": fired_kinds, "converged": True}
 
 
+def gang_arm(latency_s: float) -> dict:
+    """The gang-admission column (ISSUE 10): the three ROADMAP item-4
+    behaviors as bench numbers. Two v5e-16 gangs race for one 2-host
+    slice (exactly one admission; the wall from submission to the
+    reservation table landing is the admission latency), a
+    higher-priority gang preempts the winner whole, and the kubelet
+    seat check never accepts a partial host group
+    (``partial_allocations`` is gated at ZERO)."""
+    ns = "tpu-system"
+    hosts_chips = {"bench-a": 8, "bench-b": 8}
+    tel = telemetry.Telemetry()
+    with FakeApiServer(auto_ready=True, latency_s=latency_s) as api:
+        client = kubeapply.Client(api.url, telemetry=tel)
+        for h in hosts_chips:
+            client.apply(admission.node_manifest(h, "v5e-8"))
+        for g in ("race-a", "race-b"):
+            client.apply(admission.gang_job_manifest(g, "v5e-16", ns))
+        ctrl = admission.AdmissionController(client, ns, telemetry=tel)
+        t0 = time.monotonic()
+        first = ctrl.step()
+        admission_latency = time.monotonic() - t0
+        client.apply(admission.gang_job_manifest("preemptor", "v5e-16", ns,
+                                                 priority=10))
+        second = ctrl.step()
+        cm = api.get(f"/api/v1/namespaces/{ns}/configmaps/"
+                     f"{admission.RESERVATION_CONFIGMAP}")
+        table = admission.parse_table(
+            json.loads(cm["data"][admission.RESERVATION_KEY]))
+        # the kubelet seat check: full host groups admit, EVERY proper
+        # subset is refused — the zero-partial-allocations contract
+        partial_accepted = 0
+        full_admitted = 0
+        for host, chips in hosts_chips.items():
+            ok, _ = admission.check_allocation(table, host,
+                                               list(range(chips)))
+            full_admitted += int(ok)
+            for k in range(1, chips):
+                ok, _ = admission.check_allocation(table, host,
+                                                   list(range(k)))
+                partial_accepted += int(ok)
+        client.close()
+    return {
+        "race_admitted": len(first.admitted),
+        "race_queued": len(first.queued),
+        "admission_latency_s": round(admission_latency, 4),
+        "preemptions": len(second.preempted),
+        "preemptor_admitted": "preemptor" in second.admitted,
+        "full_host_groups_admitted": full_admitted,
+        "partial_allocations": partial_accepted,
+        "admissions_total": int(
+            tel.metrics.total(telemetry.ADMISSIONS_TOTAL)),
+    }
+
+
 def _operator_binary() -> str:
     """The C++ operator, if a native build tree already has it (conftest /
     CI build it; this bench never builds — the drift column is reported
@@ -535,6 +590,7 @@ def main(argv=None) -> int:
                    max_inflight=args.max_inflight,
                    trace_out=args.trace_out, collect=collect)
     ssa = ssa_arm(latency_s, args.passes, args.max_inflight)
+    gang = gang_arm(latency_s)
     ready_watch = readiness_arm(latency_s, watch=True)
     ready_poll = readiness_arm(latency_s, watch=False)
     faults = {
@@ -599,6 +655,10 @@ def main(argv=None) -> int:
         # default GET-then-merge engine's two-requests-per-object cold
         # path, and the warm zero-mutation steady state.
         "ssa": ssa,
+        # Gang admission (ISSUE 10): race -> exactly one admission (and
+        # its latency), whole-gang preemption count, and the
+        # zero-partial-allocations contract at the kubelet seat check.
+        "gang": gang,
     }
     print(json.dumps(doc, separators=(",", ":")))
 
@@ -678,6 +738,19 @@ def main(argv=None) -> int:
             print(f"bench_rollout: FAIL — ssa column {ssa} (target "
                   f"cold_reduction >= {SSA_COLD_REDUCTION_TARGET:g}, "
                   f"warm mutations == 0)", file=sys.stderr)
+            return 1
+        # gang admission: the race admits EXACTLY one gang, the
+        # preemptor displaces a whole gang, and the kubelet seat check
+        # accepted ZERO partial host groups — a single partial seat is
+        # the deadlock this subsystem exists to prevent
+        if not (gang["race_admitted"] == 1 and gang["preemptions"] >= 1
+                and gang["preemptor_admitted"]
+                and gang["partial_allocations"] == 0
+                and gang["full_host_groups_admitted"] == 2):
+            print(f"bench_rollout: FAIL — gang column {gang} (need "
+                  "race_admitted==1, preemptions>=1, preemptor admitted, "
+                  "partial_allocations==0, full_host_groups_admitted==2)",
+                  file=sys.stderr)
             return 1
     return 0
 

@@ -52,6 +52,8 @@ _GXX_TARGETS = {
                              "common/devenum.cc"],
     "grpcmin_selftest": ["grpcmin/selftest.cc", "grpcmin/hpack.cc",
                          "grpcmin/h2.cc", "grpcmin/grpc.cc"],
+    "plugin_selftest": ["plugin/selftest.cc", "plugin/reservation.cc",
+                        "plugin/topology.cc", "operator/minijson.cc"],
     "concurrency_stress_selftest": [
         "grpcmin/stress_selftest.cc", "grpcmin/hpack.cc",
         "grpcmin/h2.cc", "grpcmin/grpc.cc"] + _OPERATOR_CORE,

@@ -218,6 +218,11 @@ def _filter_selector(items, query: str):
     return out
 
 
+# Timer-driven node-lifecycle fault kinds (ISSUE 10) — the chaos-script
+# spellings of the FakeApiServer node hooks below.
+_NODE_FAULT_KINDS = ("node_not_ready", "node_ready", "evict_pods")
+
+
 class ChaosEngine:
     """Scripted fault injection for the fake apiserver — the promotion of
     the old ad-hoc ``reject_posts``/``reject_watch`` hooks (which are now
@@ -241,6 +246,24 @@ class ChaosEngine:
                                                         # compacts, streams
                                                         # are 410-invalidated
                                                         # (FakeApiServer.flap)
+
+    NODE-LIFECYCLE faults (ISSUE 10) — the failure-domain events the
+    gang-admission loop must recover from; timer-driven like flap, fired
+    once at ``at`` and recorded with their kind string:
+
+      {"node_not_ready": "node-a", "at": 1.0}  # flip the Node's Ready
+                                               # condition False
+                                               # (FakeApiServer
+                                               # .set_node_ready)
+      {"node_ready": "node-a", "at": 2.0}      # ...and back — the
+                                               # recovery half of a
+                                               # drain/re-admit script
+      {"evict_pods": "node-a", "at": 1.1}      # delete every Pod bound
+                                               # to the node (spec
+                                               # .nodeName), emitting
+                                               # watch DELETED events —
+                                               # what the eviction API
+                                               # does to a drained node
 
     SLOW-PATH faults (ISSUE 9) — the server that is slow rather than
     failing fast; all four honor ``for``/``count`` like status faults:
@@ -298,6 +321,15 @@ class ChaosEngine:
                 t.daemon = True
                 t.start()
                 self._timers.append(t)
+                continue
+            kind = next((k for k in _NODE_FAULT_KINDS if k in f), None)
+            if kind is not None:
+                t = threading.Timer(
+                    max(0.0, f.get("at", 0.0)), self._fire_node_fault,
+                    args=(server, kind, str(f[kind])))
+                t.daemon = True
+                t.start()
+                self._timers.append(t)
 
     def stop(self) -> None:
         for t in self._timers:
@@ -316,6 +348,25 @@ class ChaosEngine:
         threads append concurrently while /__fake_metrics renders."""
         with self._lock:
             return list(self.fired)
+
+    def _fire_node_fault(self, server: "FakeApiServer", kind: str,
+                         node: str) -> None:
+        """Timer body of one node-lifecycle fault: apply the lifecycle
+        hook to ``server`` and record the firing under the engine's kind
+        string (exported as a kind label on
+        fake_apiserver_chaos_faults_total)."""
+        path = f"/api/v1/nodes/{node}"
+        try:
+            if kind == "node_not_ready":
+                server.set_node_ready(node, ready=False)
+            elif kind == "node_ready":
+                server.set_node_ready(node, ready=True)
+            else:
+                server.evict_pods(node)
+        except KeyError:
+            return  # no such node: the fault never fired, don't count it
+        with self._lock:
+            self.fired.append((kind, "CHAOS", path))
 
     @staticmethod
     def _consume(f: Dict[str, Any]) -> bool:
@@ -340,7 +391,7 @@ class ChaosEngine:
             now = (0.0 if self._t0 is None
                    else time.monotonic() - self._t0)
             for f in self._faults:
-                if f.get("flap"):
+                if f.get("flap") or any(k in f for k in _NODE_FAULT_KINDS):
                     continue  # timer-driven, never per-request
                 at = f.get("at", 0.0)
                 if now < at:
@@ -1405,6 +1456,46 @@ class FakeApiServer:
         with self._lock:
             if self.store.pop(path, None) is not None:
                 self._note_change(path)
+
+    # ------------------------------------------------- node lifecycle
+    # (ISSUE 10): the failure-domain hooks the gang-admission scenarios
+    # script — also reachable from a chaos schedule as the
+    # node_not_ready / node_ready / evict_pods fault kinds.
+
+    def set_node_ready(self, name: str, ready: bool = True) -> None:
+        """Flip a Node's Ready condition (NotReady = the kubelet went
+        dark; the admission loop must drain every gang reservation
+        touching the host). Raises KeyError for an unknown node."""
+        path = f"/api/v1/nodes/{name}"
+        with self._lock:
+            obj = self.store[path]
+            status = obj.setdefault("status", {})
+            conds = [c for c in status.get("conditions") or []
+                     if not (isinstance(c, dict)
+                             and c.get("type") == "Ready")]
+            conds.append({"type": "Ready",
+                          "status": "True" if ready else "False"})
+            status["conditions"] = conds
+            self._note_change(path)
+
+    def evict_pods(self, node_name: str) -> List[str]:
+        """Evict (delete) every Pod bound to ``node_name``
+        (spec.nodeName), emitting watch DELETED events — what the
+        eviction API does when a NotReady node is drained. Returns the
+        deleted pod paths. Raises KeyError for an unknown node (an
+        eviction against nothing is a script bug, not a no-op)."""
+        node_path = f"/api/v1/nodes/{node_name}"
+        with self._lock:
+            if node_path not in self.store:
+                raise KeyError(node_path)
+            victims = [
+                p for p, o in self.store.items()
+                if isinstance(o, dict) and o.get("kind") == "Pod"
+                and (o.get("spec") or {}).get("nodeName") == node_name]
+            for p in victims:
+                self.store.pop(p, None)
+                self._note_change(p)
+        return victims
 
     def creation_order(self) -> List[str]:
         with self._lock:

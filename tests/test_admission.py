@@ -1,0 +1,673 @@
+"""Gang-admission scenario suite (ISSUE 10, ROADMAP item 4).
+
+The three ROADMAP scenarios as pinned tier-1 tests — two jobs racing for
+one slice (exactly one admitted), a gang-admitted job converging under
+the standard chaos script with ZERO partial allocations observed at the
+(simulated) kubelet seat check, and drain → re-admission on host
+failure — plus preemption ordering, the no-partial-Allocate pin, the
+Python↔C++ reservation-contract twin pins (source-grep + shared verdict
+vectors + the built plugin_selftest when available), and the hot-path
+parity pin (an armed-but-idle admission loop adds no mutation to a
+rollout and only GET reads to the wire)."""
+
+import json
+import os
+import re
+import subprocess
+import threading
+import time
+
+import pytest
+
+from fake_apiserver import FakeApiServer, standard_fault_script
+from tpu_cluster import admission, kubeapply, telemetry
+from tpu_cluster.render import manifests
+from tpu_cluster import spec as specmod
+
+NS = "tpu-system"
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RESERVATION_CC = os.path.join(REPO, "native", "plugin", "reservation.cc")
+PLUGIN_SELFTEST_CC = os.path.join(REPO, "native", "plugin", "selftest.cc")
+TPUD_CC = os.path.join(REPO, "native", "plugin", "tpud.cc")
+
+FAST_RETRY = kubeapply.RetryPolicy(attempts=8, base_s=0.02, cap_s=0.3)
+
+CM_PATH = (f"/api/v1/namespaces/{NS}/configmaps/"
+           f"{admission.RESERVATION_CONFIGMAP}")
+
+MUTATING = ("POST", "PATCH", "PUT", "DELETE")
+
+
+def seed_hosts(client, names, accelerator="v5e-8"):
+    for n in names:
+        client.apply(admission.node_manifest(n, accelerator))
+
+
+def submit_gang(client, gang, accelerator="v5e-16", priority=0):
+    client.apply(admission.gang_job_manifest(gang, accelerator, NS,
+                                             priority=priority))
+
+
+def published_table(api):
+    cm = api.get(CM_PATH)
+    if cm is None:
+        return None
+    raw = (cm.get("data") or {}).get(admission.RESERVATION_KEY) or ""
+    return admission.parse_table(json.loads(raw))
+
+
+def kubelet_seat_check(table, hosts_chips):
+    """Simulated kubelet seats for every host: count how many PARTIAL
+    device sets the enforcement twin would accept (must always be 0) and
+    how many full host groups it admits."""
+    partial_accepted = 0
+    full_admitted = 0
+    for host, chips in hosts_chips.items():
+        full = list(range(chips))
+        ok, _ = admission.check_allocation(table, host, full)
+        if ok:
+            full_admitted += 1
+        for k in range(1, chips):
+            sub_ok, _ = admission.check_allocation(table, host, full[:k])
+            if sub_ok:
+                partial_accepted += 1
+    return full_admitted, partial_accepted
+
+
+# --------------------------------------------------------------- scenarios
+
+
+def test_race_exactly_one_admission():
+    """ROADMAP scenario 1: two v5e-16 gangs race for the single 2-host
+    slice — exactly one is admitted (all hosts reserved atomically), the
+    loser is queued with a reason, and both decisions land on the Jobs
+    as annotations."""
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        seed_hosts(client, ("node-a", "node-b"))
+        submit_gang(client, "alpha")
+        submit_gang(client, "beta")
+        ctrl = admission.AdmissionController(client, NS)
+        result = ctrl.step()
+        assert len(result.admitted) == 1
+        assert len(result.queued) == 1
+        winner = result.admitted[0]
+        loser = result.queued[0]
+        assert {winner, loser} == {"alpha", "beta"}
+        table = published_table(api)
+        assert set(table) == {winner}
+        # the winner holds BOTH hosts, whole chip groups
+        assert table[winner].hosts == (
+            ("node-a", tuple(range(8))), ("node-b", tuple(range(8))))
+        # decisions annotated on the Jobs with reasons
+        lose_job = api.get(f"/apis/batch/v1/namespaces/{NS}/jobs/"
+                           f"gang-{loser}")
+        anns = lose_job["metadata"]["annotations"]
+        assert anns[admission.GANG_STATUS_ANNOTATION] == "queued"
+        assert "eligible host(s) free" in anns[admission.GANG_REASON_ANNOTATION]
+        win_job = api.get(f"/apis/batch/v1/namespaces/{NS}/jobs/"
+                          f"gang-{winner}")
+        assert win_job["metadata"]["annotations"][
+            admission.GANG_STATUS_ANNOTATION] == "admitted"
+        # a second pass is a no-op: stable queue, no extra mutations
+        mutations = [e for e in api.log if e[0] in MUTATING]
+        ctrl.step()
+        assert [e for e in api.log if e[0] in MUTATING] == mutations
+        client.close()
+
+
+def test_all_or_nothing_never_holds_partial():
+    """A v5e-32 gang (4 hosts) over a 3-host pool stays queued and holds
+    NOTHING — no partial reservation exists in any published table (the
+    ConfigMap is never even created: nothing was admitted)."""
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        seed_hosts(client, ("n1", "n2", "n3"))
+        submit_gang(client, "big", accelerator="v5e-32")
+        ctrl = admission.AdmissionController(client, NS)
+        result = ctrl.step()
+        assert result.admitted == []
+        assert result.queued == ["big"]
+        assert api.get(CM_PATH) is None, \
+            "nothing admitted, yet a reservation table was published"
+        # the 4th host arrives: the SAME gang admits whole
+        seed_hosts(client, ("n4",))
+        result = ctrl.step()
+        assert result.admitted == ["big"]
+        table = published_table(api)
+        assert [h for h, _ in table["big"].hosts] == ["n1", "n2", "n3", "n4"]
+        client.close()
+
+
+def test_priority_preemption_evicts_whole_lowest_gang():
+    """Preemption ordering: a higher-priority newcomer displaces the
+    LOWEST-priority admitted gang — whole gangs on both sides, and the
+    higher-priority bystander keeps its exact reservation."""
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        seed_hosts(client, ("h1", "h2", "h3", "h4"))
+        submit_gang(client, "mid", priority=1)
+        submit_gang(client, "low", priority=0)
+        ctrl = admission.AdmissionController(client, NS)
+        result = ctrl.step()
+        assert sorted(result.admitted) == ["low", "mid"]
+        mid_hosts = published_table(api)["mid"].hosts
+        submit_gang(client, "vip", priority=5)
+        result = ctrl.step()
+        assert sorted(result.admitted) == ["mid", "vip"]
+        assert result.preempted == [("low", "vip")]
+        table = published_table(api)
+        # the bystander's reservation is untouched; the victim holds zero
+        assert table["mid"].hosts == mid_hosts
+        assert "low" not in table
+        low_job = api.get(f"/apis/batch/v1/namespaces/{NS}/jobs/gang-low")
+        anns = low_job["metadata"]["annotations"]
+        assert anns[admission.GANG_STATUS_ANNOTATION] == "preempted"
+        assert "vip" in anns[admission.GANG_REASON_ANNOTATION]
+        client.close()
+
+
+def test_drain_and_readmission_on_host_failure():
+    """ROADMAP scenario 3: a host going NotReady (chaos node-fault
+    hooks) drains the victim gang's reservation COMPLETELY and re-queues
+    it; the node's pods are evicted with watch DELETE events; recovery
+    re-admits the gang. No deadlock, no half-dead gang holding chips."""
+    chaos = [
+        {"node_not_ready": "node-b", "at": 0.4},
+        {"evict_pods": "node-b", "at": 0.45},
+        {"node_ready": "node-b", "at": 1.0},
+    ]
+    with FakeApiServer(auto_ready=True, chaos=chaos) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY)
+        seed_hosts(client, ("node-a", "node-b"))
+        submit_gang(client, "train")
+        # a pod of the gang bound to the failing node (eviction target)
+        client.apply({"apiVersion": "v1", "kind": "Pod",
+                      "metadata": {"name": "gang-train-1", "namespace": NS},
+                      "spec": {"nodeName": "node-b"}})
+        ctrl = admission.AdmissionController(client, NS)
+        # phase 1 (synchronous, before the 0.4s fault): admitted while
+        # both hosts are healthy
+        assert "train" in ctrl.step().admitted
+        stop = threading.Event()
+        t = threading.Thread(
+            target=ctrl.run, kwargs={"interval": 0.03, "stop": stop})
+        t.start()
+        try:
+            deadline = time.monotonic() + 10.0
+            # phase 2: the node fault drains the WHOLE reservation
+            while time.monotonic() < deadline:
+                if "train" not in ctrl.admitted_snapshot():
+                    break
+                time.sleep(0.01)
+            assert "train" not in ctrl.admitted_snapshot(), \
+                "NotReady host never drained the gang"
+            decision = ctrl.decisions_snapshot()["train"]
+            assert "drained" in decision.reason
+            assert "node-b" in decision.reason
+            # no half-dead gang holding chips: the published table drains
+            # to empty (state flips first, the ConfigMap write lands a
+            # beat later — poll for it)
+            while time.monotonic() < deadline:
+                if published_table(api) == {} \
+                        or "train" in ctrl.admitted_snapshot():
+                    break
+                time.sleep(0.01)
+            # (either we caught the drained window, or the node already
+            # recovered and the gang re-admitted — but a HALF-drained
+            # table must never appear)
+            table_now = published_table(api)
+            assert table_now == {} or set(
+                table_now.get("train").host_names()) == {"node-a",
+                                                         "node-b"}
+            # the eviction hook (fires moments after the NotReady flip)
+            # deletes the pod — watch DELETE semantics are the store
+            # removal + change feed
+            pod_path = f"/api/v1/namespaces/{NS}/pods/gang-train-1"
+            while time.monotonic() < deadline:
+                if api.get(pod_path) is None:
+                    break
+                time.sleep(0.01)
+            assert api.get(pod_path) is None, "drained node never evicted"
+            # phase 3: recovery -> re-admission, automatically
+            while time.monotonic() < deadline:
+                if "train" in ctrl.admitted_snapshot():
+                    break
+                time.sleep(0.01)
+            assert "train" in ctrl.admitted_snapshot(), \
+                "gang never re-admitted after host recovery (deadlock)"
+        finally:
+            stop.set()
+            t.join(timeout=5)
+        table = published_table(api)
+        assert set(table["train"].host_names()) == {"node-a", "node-b"}
+        fired = {k for k, _m, _p in api.chaos.fired_snapshot()}
+        assert {"node_not_ready", "node_ready", "evict_pods"} <= fired
+        text = api.fake_metrics_text()
+        for kind in ("node_not_ready", "node_ready", "evict_pods"):
+            assert (f'fake_apiserver_chaos_faults_total{{kind="{kind}"}}'
+                    in text)
+        client.close()
+
+
+def test_gang_survives_chaos_soak_with_zero_partial_allocations():
+    """ROADMAP scenario 2: the admission loop + a full operand rollout
+    converge under standard_fault_script (503 burst, drops, flap) and at
+    EVERY observation the kubelet seat check admits only whole host
+    groups — zero partial allocations, ever."""
+    spec = specmod.default_spec()
+    groups = manifests.rollout_groups(spec)
+    hosts_chips = {"node-a": 8, "node-b": 8}
+    with FakeApiServer(auto_ready=True,
+                       chaos=standard_fault_script(0.03)) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY)
+        seed_hosts(client, hosts_chips)
+        submit_gang(client, "soak")
+        ctrl = admission.AdmissionController(client, NS)
+        partials = 0
+        admitted_seen = False
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            try:
+                ctrl.step()
+            except kubeapply.ApplyError:
+                continue  # the chaos window outlasted the retry budget
+            table = published_table(api)
+            if table is not None:
+                full, partial = kubelet_seat_check(table, hosts_chips)
+                partials += partial
+                if full == len(hosts_chips) and "soak" in table:
+                    admitted_seen = True
+                    break
+            time.sleep(0.02)
+        assert admitted_seen, "gang never admitted under chaos"
+        assert partials == 0
+        # the rollout itself also converges under the same chaos engine
+        kubeapply.apply_groups(client, groups, wait=True, stage_timeout=60,
+                               poll=0.02, max_inflight=8, watch_ready=True)
+        # and the admission state holds after the storm
+        result = ctrl.step()
+        assert result.admitted == ["soak"]
+        full, partial = kubelet_seat_check(published_table(api),
+                                           hosts_chips)
+        assert (full, partial) == (2, 0)
+        client.close()
+
+
+def test_failed_publish_is_retried_on_the_next_pass():
+    """A reservation-table write that never landed must not be latched
+    as published: the written-state memo commits only after the I/O
+    succeeds, so the next pass re-sends the SAME table (review finding:
+    pre-commit would have suppressed the republish forever)."""
+    chaos = [{"status": 403, "method": "POST", "match": "configmaps",
+              "count": 1,
+              "body": {"kind": "Status", "code": 403,
+                       "reason": "Forbidden"}}]
+    with FakeApiServer(auto_ready=True, chaos=chaos) as api:
+        client = kubeapply.Client(api.url)
+        seed_hosts(client, ("node-a", "node-b"))
+        submit_gang(client, "persist")
+        ctrl = admission.AdmissionController(client, NS)
+        with pytest.raises(kubeapply.ApplyError):
+            ctrl.step()  # the CM create is denied (non-retryable 403)
+        assert api.get(CM_PATH) is None
+        # fault consumed: the same admitted state publishes now
+        result = ctrl.step()
+        assert result.published, "failed publish was latched as done"
+        assert set(published_table(api)) == {"persist"}
+        client.close()
+
+
+def test_controller_restart_recovers_published_reservations():
+    """A restarted admission loop bootstraps from the ConfigMap its
+    predecessor published: it neither double-books held hosts nor
+    forgets to drain a dead host's gang (the crash-restartable
+    controller contract; also what makes `tpuctl admission --once`
+    composable across invocations)."""
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        seed_hosts(client, ("node-a", "node-b"))
+        submit_gang(client, "first")
+        admission.AdmissionController(client, NS).step()
+        assert set(published_table(api)) == {"first"}
+        # a FRESH controller (process restart): a rival gang must not
+        # steal the held slice
+        submit_gang(client, "rival")
+        ctrl2 = admission.AdmissionController(client, NS)
+        result = ctrl2.step()
+        assert result.admitted == ["first"]
+        assert result.newly_admitted == []  # recovered, not re-admitted
+        assert result.queued == ["rival"]
+        # and yet ANOTHER fresh controller still drains on host failure
+        api.set_node_ready("node-b", ready=False)
+        ctrl3 = admission.AdmissionController(client, NS)
+        result = ctrl3.step()
+        assert result.drained == ["first"]
+        assert published_table(api) == {}
+        client.close()
+
+
+# --------------------------------------------------------- enforcement pins
+
+
+def test_no_partial_allocate_pin():
+    """The enforcement twin rejects EVERY proper subset and every
+    cross-host confusion of an admitted reservation — the kubelet
+    cannot seat a partial gang."""
+    table = admission.parse_table({
+        "version": 1,
+        "gangs": {"g": {"accelerator": "v5e-16", "priority": 0,
+                        "hosts": {"h1": list(range(8)),
+                                  "h2": list(range(8))}}}})
+    ok, gang = admission.check_allocation(table, "h1", range(8))
+    assert ok and gang == "g"
+    import itertools
+    for k in range(1, 8):
+        for combo in itertools.combinations(range(8), k):
+            ok, reason = admission.check_allocation(table, "h1", combo)
+            assert not ok
+            assert "partial" in reason or "does not match" in reason
+    # unreserved host, duplicate ids, empty table
+    ok, reason = admission.check_allocation(table, "h3", range(8))
+    assert not ok and "no admitted gang" in reason
+    ok, reason = admission.check_allocation(table, "h1", [0, 0, 1, 2])
+    assert not ok and "duplicate" in reason
+    ok, reason = admission.check_allocation({}, "h1", range(8))
+    assert not ok
+
+
+def test_parse_table_fails_closed():
+    with pytest.raises(ValueError):
+        admission.parse_table({"version": 2, "gangs": {}})
+    with pytest.raises(ValueError):
+        admission.parse_table({"version": 1, "gangs": {"g": {
+            "hosts": {"h": ["x"]}}}})
+    assert admission.parse_table({"version": 1}) == {}
+
+
+# --------------------------------------------------------------- twin pins
+
+
+def _cc(path):
+    with open(path, encoding="utf-8") as f:
+        return f.read()
+
+
+def test_reservation_contract_constants_twin_pinned():
+    """Source-grep half of the RetryableStatus-pattern pin: the C++
+    contract literals in reservation.cc must equal the Python constants
+    (the selftest pins the C++ side compiler-only)."""
+    src = _cc(RESERVATION_CC)
+
+    def grep(fn):
+        m = re.search(fn + r"\(\)\s*\{\s*return\s+\"([^\"]+)\"\s*;", src)
+        assert m, f"{fn}() literal not found in reservation.cc"
+        return m.group(1)
+
+    assert grep("ReservationConfigMapName") == \
+        admission.RESERVATION_CONFIGMAP
+    assert grep("ReservationKey") == admission.RESERVATION_KEY
+    assert grep("GangAnnotation") == admission.GANG_ANNOTATION
+    m = re.search(r"ReservationSchemaVersion\(\)\s*\{\s*return\s+(\d+)\s*;",
+                  src)
+    assert m and int(m.group(1)) == admission.RESERVATION_SCHEMA_VERSION
+    # tpud.cc actually consumes the contract (the enforcement point):
+    tpud = _cc(TPUD_CC)
+    for needle in ("CheckAllocation", "ParseReservations",
+                   "GangAnnotation()"):
+        assert needle in tpud, f"tpud.cc no longer references {needle}"
+    # telemetry's pinned family names exist (spelling single-sourced)
+    assert telemetry.ADMISSIONS_TOTAL == "tpuctl_admissions_total"
+    assert telemetry.PREEMPTIONS_TOTAL == "tpuctl_preemptions_total"
+    assert telemetry.GANG_WAIT_SECONDS == "tpuctl_gang_wait_seconds"
+    # the eligibility label is the feature-discovery TYPE label — the
+    # admission loop reads what the labeler publishes
+    from tpu_cluster.discovery import labels as dlabels
+    assert admission.ACCELERATOR_LABEL == dlabels.TYPE
+
+
+def _selftest_vectors():
+    """The shared verdict vectors, grepped out of plugin/selftest.cc
+    (same technique as the slow-path chunk-vector pin)."""
+    src = _cc(PLUGIN_SELFTEST_CC)
+    m = re.search(
+        r"kReservationTableJson\[\]\s*=\s*((?:\s*\"(?:\\.|[^\"\\])*\")+)",
+        src)
+    assert m, "kReservationTableJson not found"
+    table_json = "".join(
+        re.findall(r"\"((?:\\.|[^\"\\])*)\"", m.group(1))
+    ).replace('\\"', '"')
+    m = re.search(r"kReservationVectors\[\]\s*=\s*\{(.*?)\n\};", src, re.S)
+    assert m, "kReservationVectors not found"
+    cases = []
+    for cm in re.finditer(
+            r'\{"([^"]+)",\s*"([^"]*)",\s*(true|false),\s*"([^"]*)"\}',
+            m.group(1)):
+        host, ids, ok, gang = cm.groups()
+        cases.append((host,
+                      [int(x) for x in ids.split(",")] if ids else [],
+                      ok == "true", gang))
+    assert len(cases) >= 8, "reservation vector table went missing"
+    return table_json, cases
+
+
+def test_reservation_verdicts_twin_pinned_via_shared_vectors():
+    """Replay the C++ selftest's exact vectors through the Python twin:
+    same table, same verdicts, same matched gangs."""
+    table_json, cases = _selftest_vectors()
+    table = admission.parse_table(json.loads(table_json))
+    for host, ids, want_ok, want_gang in cases:
+        ok, detail = admission.check_allocation(table, host, ids)
+        assert ok == want_ok, (host, ids, detail)
+        if want_ok:
+            assert detail == want_gang, (host, ids, detail)
+
+
+def test_plugin_selftest_binary_agrees(native_build, tmp_path):
+    """The built C++ checker (g++-fallback target, protobuf-free) passes
+    its own vectors AND agrees with the Python twin on a LIVE table the
+    admission loop published — the CI e2e's tpud twin, runnable in
+    tier-1."""
+    binary = os.path.join(native_build, "plugin_selftest")
+    if not os.path.exists(binary):
+        pytest.fail(f"plugin_selftest not built at {binary}")
+    out = subprocess.run([binary], capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    # live table from an actual admission pass
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        seed_hosts(client, ("node-a", "node-b"))
+        submit_gang(client, "cross")
+        admission.AdmissionController(client, NS).step()
+        cm = api.get(CM_PATH)
+        client.close()
+    res_file = tmp_path / "reservations.json"
+    res_file.write_text(cm["data"][admission.RESERVATION_KEY])
+    full = subprocess.run(
+        [binary, f"--check-reservations={res_file}", "--host", "node-a",
+         "--devices", "0,1,2,3,4,5,6,7"], capture_output=True, text=True)
+    assert full.returncode == 0 and full.stdout.strip() == "cross", full
+    part = subprocess.run(
+        [binary, f"--check-reservations={res_file}", "--host", "node-a",
+         "--devices", "0,1,2,3"], capture_output=True, text=True)
+    assert part.returncode == 3, part
+    assert "partial" in part.stderr
+    # Python twin verdicts on the same bytes
+    table = admission.parse_table(
+        json.loads(res_file.read_text()))
+    assert admission.check_allocation(table, "node-a", range(8)) == \
+        (True, "cross")
+    ok, reason = admission.check_allocation(table, "node-a", range(4))
+    assert not ok and "partial" in reason
+
+
+# ------------------------------------------------------------ telemetry
+
+
+def test_admission_telemetry_spans_and_metrics():
+    """tpuctl_admissions_total / tpuctl_preemptions_total /
+    tpuctl_gang_wait_seconds land in the registry and every pass is an
+    admission-pass span in the trace (mergeable into the cluster-wide
+    timeline)."""
+    tel = telemetry.Telemetry()
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        seed_hosts(client, ("h1", "h2"))
+        submit_gang(client, "one", priority=0)
+        ctrl = admission.AdmissionController(client, NS, telemetry=tel)
+        ctrl.step()
+        submit_gang(client, "two", priority=9)
+        ctrl.step()
+        client.close()
+    text = tel.metrics.render()
+    assert 'tpuctl_admissions_total{accelerator="v5e-16"} 2' in text
+    assert "tpuctl_preemptions_total 1" in text
+    assert "tpuctl_gang_wait_seconds_count 2" in text
+    trace = tel.chrome_trace()
+    passes = [e for e in trace["traceEvents"]
+              if e.get("ph") == "X" and e.get("name") == "admission-pass"]
+    assert len(passes) == 2
+    results = [e for e in trace["traceEvents"]
+               if e.get("ph") == "i" and e.get("name") == "admission-result"]
+    assert len(results) == 2
+    assert results[-1]["args"]["preempted"] == 1
+
+
+# ------------------------------------------------------------- hot path
+
+
+def test_hot_path_parity_with_idle_admission_loop():
+    """The zero-overhead pin (PR 9 discipline): a rollout on a cluster
+    with NO gangs configured has a byte-identical request+mutation
+    multiset whether or not an admission controller is polling — the
+    controller contributes only its own GET reads and publishes
+    nothing."""
+    spec = specmod.default_spec()
+    groups = manifests.rollout_groups(spec)
+
+    def rollout(api):
+        client = kubeapply.Client(api.url)
+        kubeapply.apply_groups(client, groups, wait=True, stage_timeout=60,
+                               poll=0.02, max_inflight=8, watch_ready=True)
+        client.close()
+        return [(m, p.partition("?")[0]) for m, p in api.log]
+
+    with FakeApiServer(auto_ready=True) as api:
+        baseline = rollout(api)
+    with FakeApiServer(auto_ready=True) as api:
+        ctl_client = kubeapply.Client(api.url)
+        ctrl = admission.AdmissionController(ctl_client, NS)
+        stop = threading.Event()
+        t = threading.Thread(
+            target=ctrl.run, kwargs={"interval": 0.01, "stop": stop})
+        t.start()
+        try:
+            log = rollout(api)
+        finally:
+            stop.set()
+            t.join(timeout=5)
+            ctl_client.close()
+        assert api.get(CM_PATH) is None, \
+            "idle admission loop published a reservation table"
+    from collections import Counter
+    controller_reads = {
+        ("GET", admission.NODES_PATH),
+        ("GET", f"/apis/batch/v1/namespaces/{NS}/jobs"),
+        ("GET", CM_PATH),  # the one-time crash-recovery bootstrap read
+    }
+    extra = Counter(log)
+    extra.subtract(Counter(baseline))
+    missing = {e: n for e, n in extra.items() if n < 0}
+    assert missing == {}, f"rollout requests disappeared: {missing}"
+    surplus = {e for e, n in extra.items() if n > 0}
+    assert surplus <= controller_reads, \
+        f"the idle controller added non-read traffic: {surplus}"
+    assert sorted(e for e in log if e[0] in MUTATING) == \
+        sorted(e for e in baseline if e[0] in MUTATING)
+
+
+# ---------------------------------------------------------------- surfaces
+
+
+def test_queue_cli_lists_and_describes(capsys):
+    from tpu_cluster.__main__ import main as cli_main
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        seed_hosts(client, ("node-a", "node-b"))
+        submit_gang(client, "show", priority=3)
+        admission.AdmissionController(client, NS).step()
+        client.close()
+        rc = cli_main(["queue", "--apiserver", api.url,
+                       "--namespace", NS])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "show" in out and "admitted" in out
+        rc = cli_main(["queue", "--apiserver", api.url, "--namespace", NS,
+                       "show"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "node-a: chips 0,1,2,3,4,5,6,7" in out
+        rc = cli_main(["queue", "--apiserver", api.url, "--namespace", NS,
+                       "--json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["gangs"][0]["name"] == "show"
+        assert doc["gangs"][0]["priority"] == 3
+        rc = cli_main(["queue", "--apiserver", api.url, "--namespace", NS,
+                       "absent"])
+        assert rc == 1
+        capsys.readouterr()
+        # --json with a positional gang filters to it (and keeps the
+        # not-found exit code)
+        rc = cli_main(["queue", "--apiserver", api.url, "--namespace", NS,
+                       "show", "--json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0 and [g["name"] for g in doc["gangs"]] == ["show"]
+        rc = cli_main(["queue", "--apiserver", api.url, "--namespace", NS,
+                       "absent", "--json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 1 and doc["gangs"] == []
+
+
+def test_admission_cli_once_writes_metrics(tmp_path, capsys):
+    from tpu_cluster.__main__ import main as cli_main
+    mpath = tmp_path / "adm.prom"
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        seed_hosts(client, ("node-a", "node-b"))
+        submit_gang(client, "cli")
+        client.close()
+        rc = cli_main(["admission", "--apiserver", api.url,
+                       "--namespace", NS, "--once",
+                       "--metrics-out", str(mpath)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "1 admitted" in out
+        assert published_table(api)["cli"]
+    text = mpath.read_text()
+    assert "tpuctl_admissions_total" in text
+    assert "tpuctl_gang_wait_seconds_bucket" in text
+
+
+def test_rendered_multihost_jobs_carry_gang_annotations():
+    """A rendered multi-host slice Job opts into gang admission (and
+    the gang helper's shape satisfies lint R07)."""
+    from tpu_cluster.render import jobs as jobsmod
+    spec = specmod.load(
+        "tpu:\n  accelerator: v5e-16\n")
+    objs = jobsmod.render_validation_jobs(spec, multihost_hosts=2)
+    gang_jobs = [o for o in objs if o.get("kind") == "Job"
+                 and admission.GANG_ANNOTATION
+                 in (o["metadata"].get("annotations") or {})]
+    assert gang_jobs, "no rendered multi-host Job carries the gang annotation"
+    for j in gang_jobs:
+        anns = j["metadata"]["annotations"]
+        assert anns[admission.GANG_ACCELERATOR_ANNOTATION] == "v5e-16"
+        g = admission.gang_of_job(j)
+        assert g is not None and g.accelerator == "v5e-16"
+    # single-host specs opt nothing in
+    objs = jobsmod.render_validation_jobs(specmod.default_spec(),
+                                          multihost_hosts=2)
+    for o in objs:
+        anns = (o.get("metadata") or {}).get("annotations") or {}
+        if o.get("kind") == "Job" and "multihost" not in o["metadata"]["name"]:
+            assert admission.GANG_ANNOTATION not in anns

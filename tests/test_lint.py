@@ -305,6 +305,79 @@ def test_r05_host_access_audit_warns_and_allow_annotation():
     assert rules_of(fs) == {"R05"} and fs[0].severity == "warn"
 
 
+def _gang_job(workers, chips, parallelism=None, indexed=True, name="gang"):
+    res = {"requests": {"google.com/tpu": str(chips)},
+           "limits": {"google.com/tpu": str(chips)}}
+    job = mk_workload(kind="Job", name=name, pod={"containers": [
+        {"name": "c", "image": "img:1", "resources": res}]})
+    job["spec"]["completions"] = workers
+    job["spec"]["parallelism"] = (workers if parallelism is None
+                                  else parallelism)
+    if indexed:
+        job["spec"]["completionMode"] = "Indexed"
+    return job
+
+
+def test_r07_worker_count_must_tile_a_catalogue_slice():
+    """The deadlock-by-construction bundle: a 3-worker v5e Job matches
+    no catalogue slice (v5e tiles 2/4/8 hosts) — its gang can never be
+    fully admitted. R07 catches it before any request."""
+    spec = specmod.default_spec()  # v5e-8 hosts (2x4, 8 chips)
+    fs = lint.lint_groups([[_gang_job(3, 8)]], spec=spec)
+    assert rules_of(fs) == {"R07"}
+    [f] = fs
+    assert f.path == ".spec.completions"
+    assert "deadlock by construction" in f.message
+    assert "2=v5e-16" in f.message and "4=v5e-32" in f.message
+    # 2 workers DO tile v5e-16: clean
+    assert lint.lint_groups([[_gang_job(2, 8)]], spec=spec) == []
+    # so do 4 (v5e-32) and 8 (v5e-64)
+    assert lint.lint_groups([[_gang_job(4, 8)]], spec=spec) == []
+    assert lint.lint_groups([[_gang_job(8, 8)]], spec=spec) == []
+
+
+def test_r07_parallelism_must_equal_completions():
+    spec = specmod.default_spec()
+    fs = lint.lint_groups([[_gang_job(2, 8, parallelism=1)]], spec=spec)
+    assert rules_of(fs) == {"R07"}
+    [f] = fs
+    assert f.path == ".spec.parallelism"
+    assert "every worker running at once" in f.message
+
+
+def test_r07_multi_worker_needs_whole_host_groups_and_indexed():
+    spec = specmod.default_spec()
+    # 4 chips/worker on 8-chip hosts: a partially-held host deadlocks
+    fs = lint.lint_groups([[_gang_job(2, 4)]], spec=spec)
+    assert rules_of(fs) == {"R07"}
+    assert "whole host groups" in fs[0].message
+    # non-Indexed multi-worker TPU Job: workers cannot rank themselves
+    fs = lint.lint_groups([[_gang_job(2, 8, indexed=False)]], spec=spec)
+    assert rules_of(fs) == {"R07"}
+    assert fs[0].path == ".spec.completionMode"
+
+
+def test_r07_ignores_single_worker_and_non_tpu_jobs():
+    spec = specmod.default_spec()
+    # single-worker TPU Job: R05's aligned-size check is the authority
+    single = _gang_job(1, 8)
+    assert lint.lint_groups([[single]], spec=spec) == []
+    # multi-worker Job with no TPU request: none of R07's business
+    plain = mk_workload(kind="Job", name="cpu-batch")
+    plain["spec"]["completions"] = 3
+    plain["spec"]["parallelism"] = 3
+    assert lint.lint_groups([[plain]], spec=spec) == []
+
+
+def test_r07_rendered_multihost_jobs_are_clean():
+    """The shipped multi-host validation Jobs (which now opt into gang
+    admission via annotations) satisfy their own gate."""
+    spec = specmod.load("tpu:\n  accelerator: v5e-16\n")
+    groups = [jobs.render_validation_jobs(spec, multihost_hosts=2)]
+    assert [f for f in lint.lint_groups(groups, spec=spec)
+            if f.rule == "R07"] == []
+
+
 def test_r06_image_pins():
     for image in ("repo/app", "repo/app:latest"):
         fs = lint.lint_groups([[mk_namespace(), mk_workload(image=image)]])

@@ -229,7 +229,7 @@ def test_soak_graph_is_cycle_free_and_pinned():
     # flat — at most one lock at a time. A new edge is a design change
     # to review, and an edge INTO the probe lock would close a cycle.
     flat_files = ("kubeapply.py", "telemetry.py", "verify.py",
-                  "lockorder.py", "conlint.py")
+                  "lockorder.py", "conlint.py", "admission.py")
     nested = _interesting(edges, flat_files)
     probe = "kubeapply.py:Client._ssa_probe_lock"
     unexpected = {e: s for e, s in nested.items() if e[0] != probe}
@@ -265,6 +265,40 @@ def test_soak_graph_is_cycle_free_and_pinned():
     assert set(fake_edges) == allowed, \
         "the pinned _lock -> _responses_lock edge never appeared " \
         "(did the SSA create path stop replying under the store lock?)"
+
+
+def test_admission_lock_stays_leaf_only():
+    """The gang-admission loop's lock discipline (ISSUE 10): state under
+    ``_lock``, apiserver I/O outside it — so the admission lock never
+    holds across a client/telemetry acquisition and contributes ZERO
+    outgoing edges to the process graph. (The soak pin's flat_files also
+    names admission.py, so a future nesting fails that pin too; this
+    test drives the controller explicitly so the edge set is populated
+    even when run alone.)"""
+    monitor = lockorder.installed()
+    if monitor is None:
+        pytest.skip("lock-order monitor disabled (TPU_LOCKORDER=0)")
+    from tpu_cluster import admission
+    tel = telemetry.Telemetry()
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url, telemetry=tel)
+        for n in ("lk-a", "lk-b"):
+            client.apply(admission.node_manifest(n, "v5e-8"))
+        client.apply(admission.gang_job_manifest(
+            "locky", "v5e-16", "tpu-system"))
+        ctrl = admission.AdmissionController(client, "tpu-system",
+                                             telemetry=tel)
+        ctrl.step()
+        api.set_node_ready("lk-b", ready=False)
+        ctrl.step()
+        api.set_node_ready("lk-b", ready=True)
+        ctrl.step()
+        client.close()
+    edges = monitor.snapshot_edges()
+    outgoing = {e: s for e, s in edges.items()
+                if "admission.py" in e[0]}
+    assert outgoing == {}, \
+        f"admission lock held across another acquisition: {outgoing}"
 
 
 def test_site_naming_is_stable_and_meaningful():

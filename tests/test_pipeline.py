@@ -1272,5 +1272,14 @@ def test_bench_rollout_json_line_meets_targets():
     assert ssa["warm"]["mutations"] == 0, ssa
     assert ssa["warm"]["requests"] > 0, ssa
     assert ssa["cold"]["requests"] < ssa["merge_cold"]["requests"], ssa
+    # the gang-admission column (ISSUE 10): the race admits exactly one
+    # gang, preemption displaces a whole gang, and the kubelet seat
+    # check accepted ZERO partial host groups
+    gang = doc["gang"]
+    assert gang["race_admitted"] == 1 and gang["race_queued"] == 1, gang
+    assert gang["preemptions"] >= 1 and gang["preemptor_admitted"], gang
+    assert gang["partial_allocations"] == 0, gang
+    assert gang["full_host_groups_admitted"] == 2, gang
+    assert gang["admission_latency_s"] > 0, gang
     # the recorded line for the round artifacts / triage summary
     print(f"BENCH_ROLLOUT {json.dumps(doc, separators=(',', ':'))}")

@@ -7,7 +7,7 @@ with rendered artifacts and an ordered, readiness-gated apply:
   render   cluster-spec -> node-prep / kubeadm scripts, operand manifests,
            validation Jobs, operator install, operator bundle
   lint     static cross-object analysis of the rendered bundle (rules
-           R01-R06: duplicates, dangling refs, selectors, apply order,
+           R01-R07: duplicates, dangling refs, selectors, apply order,
            TPU resource sanity, image pins) — catches at render time what
            the runbook only discovered at apply time
   apply    rollout against the apiserver, gating each group on readiness
@@ -21,6 +21,14 @@ with rendered artifacts and an ordered, readiness-gated apply:
            monitor tier-1 runs under
   delete   remove everything a spec renders, reverse order
            (helm uninstall analog, reference README.md kind-script flow)
+  admission
+           the gang-admission control loop (ROADMAP item 4): all-or-
+           nothing arbitration of multi-host slice workloads — FIFO +
+           priority queue, whole-gang preemption, drain/re-admission on
+           host failure, reservation table published for the device
+           plugin's Allocate enforcement
+  queue    list/describe the gang queue (admitted, queued, preempted —
+           with reasons and reserved hosts)
   verify   the executable acceptance runbook (BASELINE configs)
   triage   the executable troubleshooting runbook
   top      per-phase/per-object breakdown of a rollout trace captured
@@ -39,12 +47,13 @@ import argparse
 import json
 import os
 import sys
+import time
 from typing import Dict
 
 import yaml
 
-from . import (conlint as conlintmod, kubeapply, lint as lintmod,
-               spec as specmod, telemetry, triage, verify)
+from . import (admission as admissionmod, conlint as conlintmod, kubeapply,
+               lint as lintmod, spec as specmod, telemetry, triage, verify)
 from .render import jobs, kubeadm, manifests, nodeprep, operator_bundle
 
 
@@ -397,6 +406,101 @@ def cmd_conlint(args) -> int:
     return conlintmod.main(argv)
 
 
+def cmd_queue(args) -> int:
+    """The gang queue, read-side: gang-annotated Jobs joined with the
+    published reservation ConfigMap. `tpuctl queue GANG` prints one
+    gang's detail block (reserved hosts + chip ids)."""
+    if not args.apiserver:
+        print("queue: --apiserver URL required (the gang queue lives on "
+              "the cluster)", file=sys.stderr)
+        return 2
+    spec = _load_spec(args.spec)
+    ns = args.namespace or spec.tpu.namespace
+    client = _rest_client(args)
+    assert client is not None
+    try:
+        views = admissionmod.fetch_queue(client, ns)
+    finally:
+        client.close()
+    if args.gang:
+        found = [v for v in views if v.name == args.gang]
+        if args.json:
+            import dataclasses
+            print(json.dumps({"namespace": ns, "gangs": [
+                dataclasses.asdict(v) for v in found]}))
+        else:
+            print(admissionmod.describe_gang(views, args.gang))
+        return 0 if found else 1
+    if args.json:
+        import dataclasses
+        print(json.dumps({"namespace": ns,
+                          "gangs": [dataclasses.asdict(v) for v in views]}))
+        return 0
+    print(admissionmod.format_queue(views))
+    return 0
+
+
+def cmd_admission(args) -> int:
+    """Run the gang-admission control loop (one pass with --once, else
+    poll at --interval until interrupted). Writes the reservation
+    ConfigMap and per-Job decision annotations as it goes."""
+    if not args.apiserver:
+        print("admission: --apiserver URL required (the admission loop "
+              "is a REST controller)", file=sys.stderr)
+        return 2
+    spec = _load_spec(args.spec)
+    ns = args.namespace or spec.tpu.namespace
+    tel = (telemetry.Telemetry()
+           if (args.trace_out or args.metrics_out) else None)
+    client = _rest_client(args)
+    assert client is not None
+    client.telemetry = tel
+    ctrl = admissionmod.AdmissionController(client, ns, telemetry=tel)
+    rc = 0
+    try:
+        if args.once:
+            print(ctrl.step().line())
+        else:
+            print(f"admission: arbitrating gangs in namespace {ns} every "
+                  f"{args.interval:g}s (ctrl-c to stop)")
+            while True:
+                try:
+                    result = ctrl.step()
+                except kubeapply.ApplyError as exc:
+                    # a long-running controller must outlive apiserver
+                    # outages: the loop is the outer retry (same
+                    # discipline as AdmissionController.run) — report
+                    # and keep arbitrating
+                    print(f"admission: pass failed ({exc}); retrying",
+                          file=sys.stderr)
+                else:
+                    if (result.newly_admitted or result.preempted
+                            or result.drained):
+                        print(result.line())
+                time.sleep(args.interval)
+    except KeyboardInterrupt:
+        print("admission: stopped")
+    except kubeapply.ApplyError as exc:
+        # --once: one failed pass IS the result
+        print(f"admission: {exc}", file=sys.stderr)
+        rc = 1
+    finally:
+        client.close()
+        if tel is not None and args.trace_out:
+            try:
+                tel.write_trace(args.trace_out)
+            except OSError as exc:
+                print(f"admission: cannot write trace: {exc}",
+                      file=sys.stderr)
+        if tel is not None and args.metrics_out:
+            try:
+                tel.write_metrics(args.metrics_out)
+            except OSError as exc:
+                print(f"admission: cannot write metrics: {exc}",
+                      file=sys.stderr)
+    return rc
+
+
 def cmd_verify(args) -> int:
     spec = _load_spec(args.spec)
     names = (list(verify.CHECKS) if args.config == "all"
@@ -628,7 +732,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lint", choices=("off", "warn", "error"),
                    default="warn",
                    help="pre-apply static analysis of the rendered bundle "
-                        "(tpuctl lint rules R01-R06): warn reports "
+                        "(tpuctl lint rules R01-R07): warn reports "
                         "findings and proceeds (default); error blocks "
                         "the rollout BEFORE the first apiserver request "
                         "when any error-severity finding exists")
@@ -704,6 +808,46 @@ def build_parser() -> argparse.ArgumentParser:
                    help="findings as lines (default) or one JSON "
                         "document")
     p.set_defaults(fn=cmd_conlint)
+
+    p = sub.add_parser(
+        "queue", help="list/describe the gang-admission queue "
+                      "(admitted, queued, preempted gangs with reasons "
+                      "and reserved hosts)", parents=[conn])
+    p.add_argument("gang", nargs="?", default="",
+                   help="describe one gang (reserved hosts + chip ids) "
+                        "instead of listing all")
+    p.add_argument("--namespace", default="",
+                   help="namespace of the gang Jobs + reservation "
+                        "ConfigMap (default: the spec's TPU namespace)")
+    p.add_argument("--json", action="store_true",
+                   help="one machine-readable JSON document instead of "
+                        "the table")
+    p.set_defaults(fn=cmd_queue)
+
+    p = sub.add_parser(
+        "admission", help="run the gang-admission control loop: "
+                          "all-or-nothing arbitration of multi-host "
+                          "slice workloads with priority preemption and "
+                          "drain/re-admission on host failure",
+        parents=[conn])
+    p.add_argument("--namespace", default="",
+                   help="namespace to arbitrate (gang Jobs + reservation "
+                        "ConfigMap; default: the spec's TPU namespace)")
+    p.add_argument("--once", action="store_true",
+                   help="one admission pass, print the summary, exit "
+                        "(CI/scripting mode)")
+    p.add_argument("--interval", type=float, default=1.0,
+                   help="seconds between admission passes (default 1)")
+    p.add_argument("--trace-out", default="", metavar="PATH",
+                   help="write the admission spans as Chrome trace-event "
+                        "JSON (merge with rollout traces via `tpuctl "
+                        "trace merge`)")
+    p.add_argument("--metrics-out", default="", metavar="PATH",
+                   help="dump the admission metrics registry "
+                        "(tpuctl_admissions_total, "
+                        "tpuctl_preemptions_total, "
+                        "tpuctl_gang_wait_seconds) as Prometheus text")
+    p.set_defaults(fn=cmd_admission)
 
     p = sub.add_parser("verify", help="run the acceptance runbook")
     p.add_argument("--spec", default="")

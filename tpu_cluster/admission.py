@@ -1,0 +1,845 @@
+"""Topology-aware gang admission: all-or-nothing arbitration of multi-host
+TPU workloads (ROADMAP item 4).
+
+The problem (Borg's task-group scheduling, Verma et al. EuroSys '15;
+Kueue/JobSet in today's Kubernetes): a multi-host slice workload — say a
+v5e-16 Indexed Job spanning 2 hosts — deadlocks if its workers seat on
+chips one host at a time while a competing job grabs the rest. Nothing in
+a stock device-plugin stack arbitrates; first-come is
+first-DEADLOCKED.
+
+This module is the control-plane half of the fix:
+
+- **Gangs.** A workload opts in by annotating its Job with
+  :data:`GANG_ANNOTATION` (the gang name), the slice it needs
+  (:data:`GANG_ACCELERATOR_ANNOTATION`, a topology-catalogue name like
+  ``v5e-16``) and an optional integer
+  :data:`GANG_PRIORITY_ANNOTATION`.
+- **All-or-nothing admission.** :class:`AdmissionController` keeps a
+  FIFO queue (priority first, then arrival): a gang is admitted only
+  when EVERY host group it needs — ``num_hosts`` whole hosts of the
+  matching per-host chip shape — can be reserved atomically. No partial
+  holds, ever: a gang is either fully reserved or fully queued.
+- **Priority preemption.** A higher-priority gang displaces whole
+  lower-priority gangs (never a fraction of one); victims re-queue with
+  a reason naming the preemptor.
+- **Failure-domain recovery.** A host going NotReady drains every
+  reservation touching it — the WHOLE victim gang re-queues for
+  re-admission (a half-dead gang holding chips is the deadlock this PR
+  exists to kill).
+- **The reservation-table contract.** Admitted reservations publish as a
+  ConfigMap (:data:`RESERVATION_CONFIGMAP` / :data:`RESERVATION_KEY`)
+  whose JSON schema is twin-pinned with the C++ device plugin
+  (native/plugin/reservation.cc, the RetryableStatus pattern): tpud
+  projects the ConfigMap to a file and its ``Allocate`` rejects any
+  device set that is not EXACTLY one admitted gang's host group —
+  the kubelet cannot seat a partial gang even if it tries.
+
+Concurrency: one ``_lock`` guards controller state; I/O (LIST/GET/PATCH)
+always happens OUTSIDE it, so the admission lock is a leaf in the
+process-wide acquisition graph (pinned by tests/test_lockorder.py).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import (Any, Dict, List, Mapping, Optional, Sequence, Set,
+                    Tuple)
+
+from . import kubeapply, telemetry as _telemetry, topology
+
+# --------------------------------------------------------------------------
+# The reservation-table contract — twins of native/plugin/reservation.cc
+# (ReservationConfigMapName/ReservationKey/ReservationSchemaVersion/
+# GangAnnotation). tests/test_admission.py source-greps the C++ literals
+# against these; rename both sides or neither.
+
+RESERVATION_CONFIGMAP = "tpu-gang-reservations"
+RESERVATION_KEY = "reservations.json"
+RESERVATION_SCHEMA_VERSION = 1
+GANG_ANNOTATION = "tpu-stack.dev/gang"
+
+# Python-only surface annotations (the request/decision halves of the
+# contract; tpud never reads these).
+GANG_ACCELERATOR_ANNOTATION = "tpu-stack.dev/gang-accelerator"
+GANG_PRIORITY_ANNOTATION = "tpu-stack.dev/gang-priority"
+GANG_STATUS_ANNOTATION = "tpu-stack.dev/gang-status"
+GANG_REASON_ANNOTATION = "tpu-stack.dev/gang-reason"
+
+STATUS_ADMITTED = "admitted"
+STATUS_QUEUED = "queued"
+STATUS_PREEMPTED = "preempted"
+
+NODES_PATH = "/api/v1/nodes"
+
+# Node label carrying the host's accelerator type (the feature-discovery
+# label set; discovery/labels.py TYPE).
+ACCELERATOR_LABEL = "google.com/tpu.accelerator-type"
+TPU_RESOURCE = "google.com/tpu"
+
+
+# --------------------------------------------------------------------------
+# Data model.
+
+
+@dataclass(frozen=True)
+class GangRequest:
+    """One gang-annotated workload, as read from its Job."""
+
+    name: str
+    namespace: str
+    job_name: str
+    accelerator: str
+    priority: int = 0
+
+    @property
+    def job_path(self) -> str:
+        return (f"/apis/batch/v1/namespaces/{self.namespace}"
+                f"/jobs/{self.job_name}")
+
+
+@dataclass(frozen=True)
+class HostCapacity:
+    """One Node's admission-relevant state."""
+
+    name: str
+    accelerator: str
+    chips: int
+    ready: bool
+
+
+@dataclass(frozen=True)
+class Reservation:
+    """A fully-admitted gang's atomic hold: whole host groups only."""
+
+    gang: str
+    accelerator: str
+    priority: int
+    # host -> reserved chip ids (always the full host group, sorted)
+    hosts: Tuple[Tuple[str, Tuple[int, ...]], ...]
+
+    def host_names(self) -> Tuple[str, ...]:
+        return tuple(h for h, _ids in self.hosts)
+
+
+@dataclass(frozen=True)
+class Decision:
+    """Why a gang is where it is (surfaced via annotations + tpuctl
+    queue)."""
+
+    status: str  # admitted | queued | preempted
+    reason: str
+
+
+@dataclass
+class PassResult:
+    """One reconcile pass's outcome summary."""
+
+    gangs: int = 0
+    admitted: List[str] = field(default_factory=list)
+    newly_admitted: List[str] = field(default_factory=list)
+    queued: List[str] = field(default_factory=list)
+    preempted: List[Tuple[str, str]] = field(default_factory=list)  # victim, by
+    drained: List[str] = field(default_factory=list)
+    published: bool = False
+
+    def line(self) -> str:
+        bits = [f"{self.gangs} gang(s)",
+                f"{len(self.admitted)} admitted",
+                f"{len(self.queued)} queued"]
+        if self.newly_admitted:
+            bits.append(f"newly admitted: {', '.join(self.newly_admitted)}")
+        if self.preempted:
+            bits.append("preempted: " + ", ".join(
+                f"{v} (by {b})" for v, b in self.preempted))
+        if self.drained:
+            bits.append(f"drained: {', '.join(self.drained)}")
+        if self.published:
+            bits.append("reservations published")
+        return "admission: " + "; ".join(bits)
+
+
+# --------------------------------------------------------------------------
+# Reservation-table (de)serialisation — the wire twin of
+# tpud::ParseReservations.
+
+
+def build_table(reservations: Mapping[str, Reservation]) -> Dict[str, Any]:
+    """The ``reservations.json`` document for a set of admitted gangs —
+    canonical form (sorted keys, sorted chip ids) so equal states render
+    byte-identical and the publish path can diff cheaply."""
+    gangs: Dict[str, Any] = {}
+    for name in sorted(reservations):
+        res = reservations[name]
+        gangs[name] = {
+            "accelerator": res.accelerator,
+            "priority": res.priority,
+            "hosts": {h: sorted(ids) for h, ids in res.hosts},
+        }
+    return {"version": RESERVATION_SCHEMA_VERSION, "gangs": gangs}
+
+
+def parse_table(doc: Mapping[str, Any]) -> Dict[str, Reservation]:
+    """Parse a reservation document; raises ``ValueError`` on a wrong
+    schema version or malformed entries (the C++ twin fails closed the
+    same way)."""
+    version = doc.get("version")
+    if version != RESERVATION_SCHEMA_VERSION:
+        raise ValueError(
+            f"reservations: unsupported schema version {version!r} "
+            f"(want {RESERVATION_SCHEMA_VERSION})")
+    out: Dict[str, Reservation] = {}
+    gangs = doc.get("gangs") or {}
+    if not isinstance(gangs, Mapping):
+        raise ValueError("reservations: 'gangs' is not an object")
+    for name, entry in gangs.items():
+        if not isinstance(entry, Mapping):
+            raise ValueError(f"reservations: gang {name!r} is not an object")
+        hosts_in = entry.get("hosts") or {}
+        if not isinstance(hosts_in, Mapping):
+            raise ValueError(
+                f"reservations: gang {name!r} 'hosts' is not an object")
+        hosts: List[Tuple[str, Tuple[int, ...]]] = []
+        for host, ids in sorted(hosts_in.items()):
+            if (not isinstance(ids, Sequence) or isinstance(ids, str)
+                    or not all(isinstance(i, int) for i in ids)):
+                raise ValueError(
+                    f"reservations: gang {name!r} host {host!r} chip list "
+                    "is not an integer array")
+            hosts.append((host, tuple(sorted(ids))))
+        out[str(name)] = Reservation(
+            gang=str(name),
+            accelerator=str(entry.get("accelerator", "")),
+            priority=int(entry.get("priority", 0)),
+            hosts=tuple(hosts))
+    return out
+
+
+def check_allocation(reservations: Mapping[str, Reservation], host: str,
+                     device_ids: Sequence[int]) -> Tuple[bool, str]:
+    """Python twin of ``tpud::CheckAllocation`` — the Allocate
+    enforcement verdict. Returns ``(True, gang)`` when ``device_ids`` is
+    EXACTLY one admitted gang's reserved host group on ``host``;
+    ``(False, reason)`` otherwise, with a partial seat named as such.
+    Verdict parity with the C++ vectors is pinned by
+    tests/test_admission.py."""
+    want = set(device_ids)
+    if len(want) != len(device_ids):
+        return False, "duplicate device ids in allocation request"
+    host_reserved = False
+    for name in sorted(reservations):
+        res = reservations[name]
+        for res_host, ids in res.hosts:
+            if res_host != host:
+                continue
+            host_reserved = True
+            reserved = set(ids)
+            if reserved == want:
+                return True, res.gang
+            if want and want <= reserved:
+                return False, (
+                    f"partial allocation of gang '{res.gang}' on host "
+                    f"'{host}': requested {len(want)} of {len(reserved)} "
+                    "reserved chip(s); gangs are seated whole or not at "
+                    "all")
+    if host_reserved:
+        return False, ("device set does not match any admitted gang "
+                       f"reservation on host '{host}'")
+    return False, (f"no admitted gang reservation covers host '{host}'; "
+                   "the admission loop has not granted this job chips")
+
+
+# --------------------------------------------------------------------------
+# Cluster-state readers (Node/Job object -> model).
+
+
+def host_capacity(node: Mapping[str, Any]) -> Optional[HostCapacity]:
+    """A Node object's admission view, or None when it advertises no TPU
+    accelerator type (non-TPU nodes are invisible to the queue)."""
+    meta = node.get("metadata") or {}
+    labels = meta.get("labels") or {}
+    acc = labels.get(ACCELERATOR_LABEL)
+    if not acc:
+        return None
+    status = node.get("status") or {}
+    capacity = status.get("capacity") or {}
+    try:
+        chips = int(str(capacity.get(TPU_RESOURCE, "0")))
+    except ValueError:
+        chips = 0
+    ready = False
+    for cond in status.get("conditions") or []:
+        if isinstance(cond, Mapping) and cond.get("type") == "Ready":
+            ready = str(cond.get("status")) == "True"
+    return HostCapacity(name=str(meta.get("name", "")),
+                        accelerator=str(acc), chips=chips, ready=ready)
+
+
+def gang_of_job(job: Mapping[str, Any]) -> Optional[GangRequest]:
+    """The gang request a Job declares via annotations, or None for
+    non-gang workloads."""
+    meta = job.get("metadata") or {}
+    anns = meta.get("annotations") or {}
+    gang = anns.get(GANG_ANNOTATION)
+    if not gang:
+        return None
+    try:
+        priority = int(str(anns.get(GANG_PRIORITY_ANNOTATION, "0")))
+    except ValueError:
+        priority = 0
+    return GangRequest(
+        name=str(gang),
+        namespace=str(meta.get("namespace", "default")),
+        job_name=str(meta.get("name", "")),
+        accelerator=topology.canonical_name(
+            str(anns.get(GANG_ACCELERATOR_ANNOTATION, ""))),
+        priority=priority)
+
+
+def _host_matches(host: HostCapacity,
+                  slice_acc: topology.AcceleratorType) -> bool:
+    """Host eligibility for one gang: the host's advertised accelerator
+    must present the slice's per-host chip group (same generation, same
+    per-host grid) with full capacity. A host labeled with the slice
+    type itself ("v5e-16") or the per-host type ("v5e-8") both match —
+    the catalogue keys eligibility by per-host shape, not by spelling."""
+    try:
+        host_acc = topology.get(host.accelerator)
+    except KeyError:
+        return False
+    return (host_acc.generation == slice_acc.generation
+            and host_acc.chips_per_host == slice_acc.chips_per_host
+            and host_acc.topology == slice_acc.topology
+            and host.chips >= slice_acc.chips_per_host)
+
+
+# --------------------------------------------------------------------------
+# The arbitration: a deterministic greedy recompute.
+
+
+@dataclass
+class Arbitration:
+    admitted: Dict[str, Reservation]
+    decisions: Dict[str, Decision]
+
+
+def arbitrate(hosts: Sequence[HostCapacity], gangs: Sequence[GangRequest],
+              previous: Mapping[str, Reservation],
+              arrival: Mapping[str, float]) -> Arbitration:
+    """One admission pass, recomputed from scratch: rank every live gang
+    by (priority desc, arrival, name) and admit greedily, whole slices
+    only. Stickiness: an already-admitted gang keeps its exact hosts
+    when they are still eligible (no churn); a higher-priority newcomer
+    naturally displaces lower-priority holders because it ranks first in
+    the recompute — that IS the preemption, and it is all-or-nothing on
+    both sides by construction."""
+    ranked = sorted(
+        gangs, key=lambda g: (-g.priority,
+                              arrival.get(g.name, float("inf")), g.name))
+    taken: Set[str] = set()
+    admitted: Dict[str, Reservation] = {}
+    decisions: Dict[str, Decision] = {}
+    host_by_name = {h.name: h for h in hosts}
+    # Displacement cost per host: a preempting newcomer must take FREE
+    # hosts first, then the lowest-priority holder's — so preemption
+    # evicts the least important gang, never a higher-priority bystander
+    # whose hosts merely sort first.
+    live = {g.name for g in gangs}
+    prev_holder_prio: Dict[str, int] = {}
+    for res in previous.values():
+        if res.gang not in live:
+            continue
+        for h in res.host_names():
+            prev_holder_prio[h] = max(prev_holder_prio.get(h, res.priority),
+                                      res.priority)
+    for g in ranked:
+        if g.name in decisions:  # duplicate gang name: first request wins
+            continue
+        try:
+            acc = topology.get(g.accelerator)
+        except KeyError:
+            decisions[g.name] = Decision(
+                STATUS_QUEUED,
+                f"unknown accelerator type {g.accelerator!r}; see the "
+                "topology catalogue")
+            continue
+        eligible = sorted(
+            h.name for h in hosts
+            if h.ready and h.name not in taken and _host_matches(h, acc))
+        need = acc.num_hosts
+        if len(eligible) < need:
+            decisions[g.name] = Decision(
+                STATUS_QUEUED,
+                f"waiting for {need} x {acc.chips_per_host}-chip host(s) "
+                f"for {acc.name}; {len(eligible)} eligible host(s) free")
+            continue
+        prev = previous.get(g.name)
+        chosen: List[str]
+        if prev is not None and all(
+                h in host_by_name and host_by_name[h].ready
+                and (h in eligible) for h in prev.host_names()) \
+                and len(prev.host_names()) == need:
+            chosen = list(prev.host_names())
+        else:
+            chosen = sorted(
+                eligible,
+                key=lambda h: (prev_holder_prio.get(h, -1), h))[:need]
+        taken.update(chosen)
+        chips = tuple(range(acc.chips_per_host))
+        admitted[g.name] = Reservation(
+            gang=g.name, accelerator=acc.name, priority=g.priority,
+            hosts=tuple((h, chips) for h in sorted(chosen)))
+        decisions[g.name] = Decision(
+            STATUS_ADMITTED,
+            f"reserved {need} host group(s): {', '.join(sorted(chosen))}")
+    return Arbitration(admitted=admitted, decisions=decisions)
+
+
+# --------------------------------------------------------------------------
+# The controller.
+
+
+class AdmissionController:
+    """The gang-admission control loop against one apiserver.
+
+    ``step()`` is one reconcile pass (LIST nodes + Jobs, arbitrate,
+    publish the reservation ConfigMap, annotate Jobs with their
+    decision); ``run()`` loops it. All apiserver I/O happens outside
+    ``_lock`` — the lock guards pure state and never nests."""
+
+    def __init__(self, client: kubeapply.Client, namespace: str,
+                 telemetry: Optional[_telemetry.Telemetry] = None) -> None:
+        self.client = client
+        self.namespace = namespace
+        self.telemetry = telemetry
+        self._lock = threading.Lock()
+        self._admitted: Dict[str, Reservation] = {}  # guarded-by: _lock
+        self._decisions: Dict[str, Decision] = {}  # guarded-by: _lock
+        # first-seen + queued-since instants (monotonic) per gang name;
+        # queued_since feeds the gang-wait histogram on admission
+        self._first_seen: Dict[str, float] = {}  # guarded-by: _lock
+        self._queued_since: Dict[str, float] = {}  # guarded-by: _lock
+        self._last_published: Optional[str] = None  # guarded-by: _lock
+        self._last_annotations: Dict[str, Tuple[str, str]] = {}  # guarded-by: _lock
+        self._bootstrapped = False  # guarded-by: _lock
+        self.passes = 0  # guarded-by: _lock
+
+    # ------------------------------------------------------------- state
+
+    def admitted_snapshot(self) -> Dict[str, Reservation]:
+        with self._lock:
+            return dict(self._admitted)
+
+    def decisions_snapshot(self) -> Dict[str, Decision]:
+        with self._lock:
+            return dict(self._decisions)
+
+    # ------------------------------------------------------------- I/O
+
+    def _read_cluster(self) -> Tuple[List[HostCapacity], List[GangRequest],
+                                     Dict[str, Mapping[str, Any]]]:
+        nodes = self.client.list_collection(NODES_PATH)
+        hosts = [h for h in (host_capacity(n) for n in nodes.values())
+                 if h is not None]
+        jobs = self.client.list_collection(
+            f"/apis/batch/v1/namespaces/{self.namespace}/jobs")
+        gangs: List[GangRequest] = []
+        by_job: Dict[str, Mapping[str, Any]] = {}
+        for obj in jobs.values():
+            g = gang_of_job(obj)
+            if g is not None:
+                gangs.append(g)
+                by_job[g.name] = obj
+        return hosts, gangs, by_job
+
+    def _configmap_path(self) -> str:
+        return (f"/api/v1/namespaces/{self.namespace}/configmaps/"
+                f"{RESERVATION_CONFIGMAP}")
+
+    def _publish(self, payload: str) -> None:
+        cm = {
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {
+                "name": RESERVATION_CONFIGMAP,
+                "namespace": self.namespace,
+                "labels": {"app.kubernetes.io/part-of": "tpu-stack"},
+            },
+            "data": {RESERVATION_KEY: payload},
+        }
+        self.client.apply(cm)
+
+    # ------------------------------------------------------------- pass
+
+    def step(self) -> PassResult:
+        """One admission pass. Returns the summary (also surfaced as the
+        ``admission-pass`` span in the trace)."""
+        tel = self.telemetry
+        with _telemetry.maybe_span(tel, "admission-pass", "admission"):
+            self._maybe_bootstrap()
+            hosts, gangs, _jobs = self._read_cluster()
+            now = time.monotonic()
+            publish_payload, annotate, result = self._reconcile(
+                hosts, gangs, now)
+            if publish_payload is not None:
+                # commit the published-state memo only AFTER the write
+                # lands: a failed publish must be retried next pass, not
+                # latched as done (an admitted gang whose table never
+                # reached the cluster could otherwise never seat)
+                self._publish(publish_payload)
+                with self._lock:
+                    self._last_published = publish_payload
+                result.published = True
+            for gang_name, path, status, reason in annotate:
+                code, _body = self.client.patch_merge(path, {
+                    "metadata": {"annotations": {
+                        GANG_STATUS_ANNOTATION: status,
+                        GANG_REASON_ANNOTATION: reason,
+                    }}})
+                if 200 <= code < 300:
+                    # same discipline: only a LANDED annotation is
+                    # remembered — a 403/404 (non-retryable, returned
+                    # rather than raised) is re-attempted next pass
+                    with self._lock:
+                        self._last_annotations[gang_name] = (status,
+                                                             reason)
+            if tel is not None:
+                tel.event("admission-result", gangs=result.gangs,
+                          admitted=len(result.admitted),
+                          queued=len(result.queued),
+                          preempted=len(result.preempted))
+        return result
+
+    def _maybe_bootstrap(self) -> None:
+        """Recover a restarted controller's state from the reservation
+        ConfigMap its predecessor published: the admission loop must be
+        crash-restartable WITHOUT forgetting who holds chips — a fresh
+        process that ignored existing reservations would double-book the
+        fleet (or fail to drain a dead host's gang). An unparseable
+        table recovers as EMPTY but still forces a re-publish (the next
+        pass overwrites the corruption with canonical state)."""
+        with self._lock:
+            if self._bootstrapped:
+                return
+        code, cm = self.client.get(self._configmap_path())
+        recovered: Dict[str, Reservation] = {}
+        last: Optional[str] = None
+        if code == 200:
+            raw = str((cm.get("data") or {}).get(RESERVATION_KEY) or "")
+            last = raw
+            if raw:
+                try:
+                    recovered = parse_table(json.loads(raw))
+                    last = json.dumps(build_table(recovered),
+                                      sort_keys=True,
+                                      separators=(",", ":"))
+                except (ValueError, TypeError):
+                    recovered = {}
+        with self._lock:
+            if not self._bootstrapped:
+                self._bootstrapped = True
+                self._admitted = recovered
+                self._last_published = last
+
+    def _reconcile(self, hosts: Sequence[HostCapacity],
+                   gangs: Sequence[GangRequest], now: float
+                   ) -> Tuple[Optional[str],
+                              List[Tuple[str, str, str, str]], PassResult]:
+        """The pure half of a pass: arbitrate under the lock and decide
+        what to write (ConfigMap payload, per-Job annotations) WITHOUT
+        doing any I/O. Returns (payload-or-None, [(gang, job_path,
+        status, reason)], result). The written-state memos
+        (_last_published / _last_annotations) are NOT updated here —
+        step() commits them only after the corresponding write lands, so
+        a failed write is retried on the next pass instead of being
+        latched as done."""
+        tel = self.telemetry
+        result = PassResult(gangs=len(gangs))
+        with self._lock:
+            self.passes += 1
+            for g in gangs:
+                self._first_seen.setdefault(g.name, now)
+                self._queued_since.setdefault(g.name, now)
+            live = {g.name for g in gangs}
+            previous = dict(self._admitted)
+            ready_hosts = {h.name for h in hosts if h.ready}
+            outcome = arbitrate(hosts, gangs, previous, self._first_seen)
+            # classify transitions against the previous pass
+            for name, prev_res in previous.items():
+                if name in outcome.admitted or name not in live:
+                    continue
+                lost = [h for h in prev_res.host_names()
+                        if h not in ready_hosts]
+                if lost:
+                    result.drained.append(name)
+                    outcome.decisions[name] = Decision(
+                        STATUS_QUEUED,
+                        f"reservation drained: host {lost[0]} NotReady; "
+                        "re-queued for re-admission")
+                else:
+                    new_holders = sorted(
+                        o.gang for o in outcome.admitted.values()
+                        if o.gang != name
+                        and set(o.host_names()) & set(prev_res.host_names())
+                        and o.gang not in previous)
+                    if new_holders:
+                        result.preempted.append((name, new_holders[0]))
+                        outcome.decisions[name] = Decision(
+                            STATUS_PREEMPTED,
+                            "preempted by higher-priority gang "
+                            f"'{new_holders[0]}'")
+            # metric facts are COLLECTED under the lock and emitted after
+            # it: the admission lock must stay a leaf (never held across
+            # a telemetry-lock acquisition — pinned by test_lockorder)
+            admit_waits: List[Tuple[str, float]] = []
+            for name in outcome.admitted:
+                if name not in previous:
+                    result.newly_admitted.append(name)
+                    waited = now - self._queued_since.pop(name, now)
+                    admit_waits.append(
+                        (outcome.admitted[name].accelerator, waited))
+                else:
+                    self._queued_since.pop(name, None)
+            for name in list(self._first_seen):
+                if name not in live:
+                    self._first_seen.pop(name, None)
+                    self._queued_since.pop(name, None)
+            self._admitted = outcome.admitted
+            self._decisions = {n: d for n, d in outcome.decisions.items()
+                               if n in live}
+            result.admitted = sorted(outcome.admitted)
+            result.queued = sorted(live - set(outcome.admitted))
+            # the publish decision: canonical payload, diffed against the
+            # last write; an empty table is only worth a mutation when a
+            # non-empty one was published before (the no-gangs hot path
+            # must stay request-free)
+            payload = json.dumps(build_table(outcome.admitted),
+                                 sort_keys=True, separators=(",", ":"))
+            publish: Optional[str] = None
+            if payload != self._last_published and (
+                    outcome.admitted or self._last_published is not None):
+                publish = payload
+            annotate: List[Tuple[str, str, str, str]] = []
+            for g in gangs:
+                d = outcome.decisions.get(g.name)
+                if d is None:
+                    continue
+                if self._last_annotations.get(g.name) != (d.status,
+                                                          d.reason):
+                    annotate.append((g.name, g.job_path, d.status,
+                                     d.reason))
+            for name in list(self._last_annotations):
+                if name not in live:
+                    self._last_annotations.pop(name, None)
+        if tel is not None:
+            for accelerator, waited in admit_waits:
+                tel.histogram(
+                    _telemetry.GANG_WAIT_SECONDS,
+                    "seconds gangs waited in the admission queue"
+                ).observe(waited)
+                tel.counter(_telemetry.ADMISSIONS_TOTAL,
+                            "gangs admitted all-or-nothing",
+                            accelerator=accelerator).inc()
+            for _victim, _by in result.preempted:
+                tel.counter(_telemetry.PREEMPTIONS_TOTAL,
+                            "whole-gang priority preemptions").inc()
+        return publish, annotate, result
+
+    # ------------------------------------------------------------- loop
+
+    def run(self, interval: float = 1.0,
+            stop: Optional[threading.Event] = None,
+            max_passes: int = 0) -> None:
+        """Poll-loop the controller (``tpuctl admission``): one pass per
+        interval until ``stop`` is set (or ``max_passes`` reached)."""
+        done = 0
+        while stop is None or not stop.is_set():
+            try:
+                self.step()
+            except kubeapply.ApplyError:
+                # the apiserver outlasted the retry budget this pass; the
+                # loop IS the outer retry — written-state memos commit
+                # only after their writes land, so the next tick re-reads
+                # the world and re-sends anything that didn't
+                pass
+            done += 1
+            if max_passes and done >= max_passes:
+                return
+            if stop is not None:
+                if stop.wait(interval):
+                    return
+            else:
+                time.sleep(interval)
+
+
+# --------------------------------------------------------------------------
+# Read-side view (`tpuctl queue`): no controller needed — the queue state
+# lives on the cluster (Job annotations + the reservation ConfigMap).
+
+
+@dataclass(frozen=True)
+class GangView:
+    """One gang as `tpuctl queue` shows it."""
+
+    name: str
+    accelerator: str
+    priority: int
+    status: str
+    reason: str
+    hosts: Tuple[Tuple[str, Tuple[int, ...]], ...]
+    job: str
+
+    def host_summary(self) -> str:
+        return ",".join(h for h, _ids in self.hosts) or "-"
+
+
+def fetch_queue(client: kubeapply.Client,
+                namespace: str) -> List[GangView]:
+    """The cluster's current gang queue: gang-annotated Jobs joined with
+    the published reservation table. Sorted admitted first, then by
+    (priority desc, name) — the order the queue drains in."""
+    jobs = client.list_collection(
+        f"/apis/batch/v1/namespaces/{namespace}/jobs")
+    code, cm = client.get(
+        f"/api/v1/namespaces/{namespace}/configmaps/"
+        f"{RESERVATION_CONFIGMAP}")
+    reservations: Dict[str, Reservation] = {}
+    if code == 200:
+        raw = ((cm.get("data") or {}).get(RESERVATION_KEY) or "")
+        if raw:
+            try:
+                reservations = parse_table(json.loads(raw))
+            except (ValueError, TypeError):
+                reservations = {}
+    views: List[GangView] = []
+    for obj in jobs.values():
+        g = gang_of_job(obj)
+        if g is None:
+            continue
+        anns = (obj.get("metadata") or {}).get("annotations") or {}
+        res = reservations.get(g.name)
+        status = str(anns.get(GANG_STATUS_ANNOTATION,
+                              STATUS_ADMITTED if res else STATUS_QUEUED))
+        views.append(GangView(
+            name=g.name, accelerator=g.accelerator, priority=g.priority,
+            status=status,
+            reason=str(anns.get(GANG_REASON_ANNOTATION, "")),
+            hosts=res.hosts if res is not None else (),
+            job=f"{g.namespace}/{g.job_name}"))
+    views.sort(key=lambda v: (v.status != STATUS_ADMITTED, -v.priority,
+                              v.name))
+    return views
+
+
+def format_queue(views: Sequence[GangView]) -> str:
+    """The `tpuctl queue` table."""
+    headers = ("GANG", "ACCELERATOR", "PRIORITY", "STATUS", "HOSTS",
+               "REASON")
+    rows = [(v.name, v.accelerator, str(v.priority), v.status,
+             v.host_summary(), v.reason or "-") for v in views]
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(headers)]
+    lines = ["  ".join(h.ljust(widths[i])
+                       for i, h in enumerate(headers)).rstrip()]
+    for r in rows:
+        lines.append("  ".join(c.ljust(widths[i])
+                               for i, c in enumerate(r)).rstrip())
+    if not rows:
+        lines.append("(no gang-annotated jobs)")
+    return "\n".join(lines)
+
+
+def describe_gang(views: Sequence[GangView], name: str) -> str:
+    """`tpuctl queue GANG`: the one-gang detail block."""
+    for v in views:
+        if v.name != name:
+            continue
+        lines = [f"gang:        {v.name}",
+                 f"job:         {v.job}",
+                 f"accelerator: {v.accelerator}",
+                 f"priority:    {v.priority}",
+                 f"status:      {v.status}"]
+        if v.reason:
+            lines.append(f"reason:      {v.reason}")
+        if v.hosts:
+            lines.append("reservation:")
+            for host, ids in v.hosts:
+                lines.append(
+                    f"  {host}: chips {','.join(map(str, ids))}")
+        return "\n".join(lines)
+    known = ", ".join(sorted(v.name for v in views)) or "none"
+    return f"gang {name!r} not found (known: {known})"
+
+
+# --------------------------------------------------------------------------
+# Manifest helpers (tests, bench, CI e2e, and the rendered multihost Jobs
+# all build gang objects from one place).
+
+
+def node_manifest(name: str, accelerator: str,
+                  ready: bool = True) -> Dict[str, Any]:
+    """A Node object as the feature-discovery + kubelet pair would
+    publish it: accelerator-type label, TPU capacity, Ready condition."""
+    acc = topology.get(accelerator)
+    return {
+        "apiVersion": "v1", "kind": "Node",
+        "metadata": {
+            "name": name,
+            "labels": {
+                ACCELERATOR_LABEL: acc.name,
+                "google.com/tpu.present": "true",
+            },
+        },
+        "status": {
+            "capacity": {TPU_RESOURCE: str(acc.chips_per_host)},
+            "conditions": [
+                {"type": "Ready",
+                 "status": "True" if ready else "False"},
+            ],
+        },
+    }
+
+
+def gang_annotations(gang: str, accelerator: str,
+                     priority: int = 0) -> Dict[str, str]:
+    """The annotation triple a workload opts into gang admission with."""
+    return {
+        GANG_ANNOTATION: gang,
+        GANG_ACCELERATOR_ANNOTATION: topology.canonical_name(accelerator),
+        GANG_PRIORITY_ANNOTATION: str(priority),
+    }
+
+
+def gang_job_manifest(gang: str, accelerator: str, namespace: str,
+                      priority: int = 0,
+                      job_name: str = "") -> Dict[str, Any]:
+    """A minimal gang-annotated Indexed Job (tests/bench/CI): completions
+    == parallelism == the slice's host count, whole-host chip requests —
+    the shape `tpuctl lint` R07 demands."""
+    acc = topology.get(accelerator)
+    return {
+        "apiVersion": "batch/v1", "kind": "Job",
+        "metadata": {
+            "name": job_name or f"gang-{gang}",
+            "namespace": namespace,
+            "annotations": gang_annotations(gang, accelerator, priority),
+        },
+        "spec": {
+            "completionMode": "Indexed",
+            "completions": acc.num_hosts,
+            "parallelism": acc.num_hosts,
+            "template": {"spec": {
+                "restartPolicy": "Never",
+                "containers": [{
+                    "name": "worker",
+                    "image": "tpu-stack/worker:v1",
+                    "resources": {
+                        "requests": {TPU_RESOURCE: str(acc.chips_per_host)},
+                        "limits": {TPU_RESOURCE: str(acc.chips_per_host)},
+                    },
+                }],
+            }},
+        },
+    }

@@ -195,6 +195,7 @@ _KINDS: Dict[str, Tuple[str, bool]] = {
     "Job": ("jobs", False),
     "ClusterRole": ("clusterroles", True),
     "ClusterRoleBinding": ("clusterrolebindings", True),
+    "Node": ("nodes", True),
     "Role": ("roles", False),
     "RoleBinding": ("rolebindings", False),
     # the operator's runtime flag surface (ClusterPolicy analog)
@@ -1482,6 +1483,15 @@ class Client:
     def delete(self, path: str) -> Tuple[int, Any]:
         """DELETE one object; (status, parsed body)."""
         return self._request("DELETE", path)
+
+    def patch_merge(self, path: str,
+                    patch: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+        """One RFC 7386 merge-PATCH; (status, parsed body). The small
+        targeted-mutation primitive (the admission loop's decision
+        annotations ride on it) — full-object intents go through
+        apply/apply_ssa instead."""
+        return self._request("PATCH", path, patch,
+                             "application/merge-patch+json")
 
     def wait_crd_established(self, name: str, timeout: float,
                              poll: float = 1.0) -> None:

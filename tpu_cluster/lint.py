@@ -42,6 +42,11 @@ bad-bundle fixture per rule):
   R06  image pins (no ``:latest``/untagged) and probe/port cross-check
        (a probe's named port must exist in containerPorts; a numeric
        probe port should be declared).
+  R07  gang shape: a TPU Job whose parallelism/completions don't tile
+       any catalogue slice topology is deadlock-by-construction — its
+       workers can never all seat, so the gang-admission queue (or a raw
+       cluster) would hold it forever. Also demands parallelism ==
+       completions and Indexed completion mode on multi-worker TPU Jobs.
 
 Surfaces: ``tpuctl lint`` (see __main__.py), the pre-apply gate
 ``gate()`` called by ``apply_groups``/``apply_groups_kubectl`` under
@@ -55,7 +60,7 @@ from dataclasses import dataclass
 from typing import (Any, Callable, Collection, Dict, FrozenSet, List,
                     Optional, Sequence, Set, Tuple)
 
-from . import kubeapply
+from . import kubeapply, topology
 from .spec import ClusterSpec
 
 Manifest = Dict[str, Any]
@@ -127,7 +132,7 @@ class Finding:
     """One lint result: rule id, severity, the object it is about, a
     JSON-path locus inside that object, a human message, and a fix hint."""
 
-    rule: str       # "R01".."R06"
+    rule: str       # "R01".."R07"
     severity: str   # SEV_ERROR | SEV_WARN
     kind: str
     namespace: str  # "" for cluster-scoped objects
@@ -818,6 +823,109 @@ def _r06_images_probes(bundle: _Bundle) -> List[Finding]:
 
 
 # --------------------------------------------------------------------------
+# R07 — gang shape: multi-worker TPU Jobs must tile a catalogue slice
+
+
+def _tpu_chip_request(obj: Manifest, resource: str) -> Optional[int]:
+    """The workload's per-pod TPU chip count, when it requests any (the
+    first TPU-carrying container; R05 already enforces request==limit)."""
+    for _cpath, c in _containers(_pod_spec(obj)):
+        limits = (c.get("resources") or {}).get("limits") or {}
+        requests = (c.get("resources") or {}).get("requests") or {}
+        val = limits.get(resource, requests.get(resource))
+        if val is None:
+            continue
+        try:
+            return int(str(val))
+        except ValueError:
+            return None  # R05's finding; don't double-report
+    return None
+
+
+def _slice_for_workers(generation: str, per_host: Tuple[int, int],
+                       workers: int) -> Optional[str]:
+    """The catalogue slice tiling ``workers`` hosts of this per-host
+    shape, or None when no such slice exists."""
+    for acc in topology.ACCELERATOR_TYPES.values():
+        if (acc.generation == generation and acc.topology == per_host
+                and acc.num_hosts == workers):
+            return acc.name
+    return None
+
+
+def _r07_gang_shape(bundle: _Bundle,
+                    spec: Optional[ClusterSpec]) -> List[Finding]:
+    """A multi-worker TPU Job is a gang: every worker must seat a whole
+    host group and the worker count must tile a catalogue slice, or the
+    job deadlocks waiting for peers that can never exist. This is the
+    static half of the admission story — the deadlock-by-construction
+    bundle fails here, before any request."""
+    findings: List[Finding] = []
+    if spec is None:
+        return findings
+    acc = spec.tpu.accelerator_type
+    resource = spec.tpu.resource_name
+    for _loc, obj in bundle.workloads():
+        if obj.get("kind") != "Job":
+            continue
+        chips = _tpu_chip_request(obj, resource)
+        if chips is None:
+            continue
+        jspec = obj.get("spec") or {}
+        completions = jspec.get("completions")
+        parallelism = jspec.get("parallelism")
+        workers = int(completions if completions is not None
+                      else parallelism if parallelism is not None else 1)
+        par = int(parallelism) if parallelism is not None else workers
+        if workers <= 1 and par <= 1:
+            continue  # single-worker: R05's aligned-size check suffices
+        if par != workers:
+            findings.append(_finding(
+                bundle, obj, "R07", SEV_ERROR, ".spec.parallelism",
+                f"TPU Job runs {workers} completion(s) at parallelism "
+                f"{par}; a gang needs every worker running at once — "
+                "any fewer deadlocks waiting for peers that are not "
+                "scheduled",
+                "set parallelism == completions"))
+            continue
+        if jspec.get("completionMode") != "Indexed":
+            findings.append(_finding(
+                bundle, obj, "R07", SEV_ERROR, ".spec.completionMode",
+                f"multi-worker TPU Job ({workers} workers) without "
+                "Indexed completion mode; workers cannot derive their "
+                "slice rank (TPU_WORKER_ID)",
+                "set completionMode: Indexed"))
+        if chips != acc.chips_per_host:
+            findings.append(_finding(
+                bundle, obj, "R07", SEV_ERROR,
+                ".spec.template.spec.containers[0].resources",
+                f"multi-worker TPU Job requests {chips} chip(s)/worker "
+                f"but {acc.name} hosts carry {acc.chips_per_host}; "
+                "multi-host gangs take whole host groups or deadlock on "
+                "a partially-held host",
+                f"request {resource}: {acc.chips_per_host} per worker"))
+            continue
+        match = _slice_for_workers(acc.generation, acc.topology, workers)
+        if match is None:
+            candidates = sorted(
+                (a.num_hosts, a.name)
+                for a in topology.ACCELERATOR_TYPES.values()
+                if a.generation == acc.generation
+                and a.topology == acc.topology and a.num_hosts > 1)
+            known = ", ".join(f"{n}={name}" for n, name in candidates) \
+                or "none"
+            findings.append(_finding(
+                bundle, obj, "R07", SEV_ERROR, ".spec.completions",
+                f"{workers} worker(s) x {chips}-chip hosts matches no "
+                f"{acc.generation} catalogue slice topology (host counts: "
+                f"{known}); the gang can never be fully admitted — "
+                "deadlock by construction",
+                "size completions/parallelism to a catalogue slice's "
+                "host count"))
+    return findings
+
+
+# --------------------------------------------------------------------------
 # entry points
 
 
@@ -837,6 +945,7 @@ def lint_groups(groups: Sequence[Sequence[Manifest]],
     findings.extend(_r04_ordering(bundle, external))
     findings.extend(_r05_tpu(bundle, spec))
     findings.extend(_r06_images_probes(bundle))
+    findings.extend(_r07_gang_shape(bundle, spec))
     findings.sort(key=lambda f: (f.severity != SEV_ERROR, f.rule, f.kind,
                                  f.namespace, f.name, f.path))
     return findings

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List
 
+from .. import admission
 from ..lint import LINT_ALLOW_ANNOTATION
 from ..spec import ClusterSpec
 from ..workloads.multihost import DEFAULT_COORDINATOR_PORT
@@ -147,6 +148,12 @@ def multihost_psum_job(spec: ClusterSpec, num_hosts: int = 0,
         "completions": num_hosts,
         "parallelism": num_hosts,
     })
+    if acc.num_hosts > 1:
+        # Multi-host slices opt into gang admission (ISSUE 10): the
+        # admission loop reserves all num_hosts host groups atomically or
+        # queues the job whole — first-come-first-deadlocked is over.
+        job["metadata"].setdefault("annotations", {}).update(
+            admission.gang_annotations(name, acc.name))
     tmpl = job["spec"]["template"]
     tmpl["spec"]["subdomain"] = svc_name
     container = tmpl["spec"]["containers"][0]

@@ -110,6 +110,10 @@ READY_SECONDS = "tpuctl_ready_seconds"
 WATCH_RECONNECTS_TOTAL = "tpuctl_watch_reconnects_total"
 JOURNAL_SKIPS_TOTAL = "tpuctl_journal_skips_total"
 VERIFY_KUBECTL_CALLS = "tpuctl_verify_kubectl_calls_total"
+# Gang admission (ISSUE 10): the admission loop's control-plane families.
+ADMISSIONS_TOTAL = "tpuctl_admissions_total"
+PREEMPTIONS_TOTAL = "tpuctl_preemptions_total"
+GANG_WAIT_SECONDS = "tpuctl_gang_wait_seconds"
 
 # Fixed default buckets, request-latency shaped (seconds). Shared with
 # the ready-wait histogram: its tail rides the +Inf bucket.
