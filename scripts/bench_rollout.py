@@ -80,8 +80,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.join(REPO, "tests"))
 
-from fake_apiserver import (FakeApiServer, slow_fault_script,  # noqa: E402
-                            standard_fault_script)
+from fake_apiserver import (FakeApiServer, fleet_store,  # noqa: E402
+                            slow_fault_script, standard_fault_script)
 from tpu_cluster import admission  # noqa: E402
 from tpu_cluster import kubeapply  # noqa: E402
 from tpu_cluster import spec as specmod  # noqa: E402
@@ -113,6 +113,21 @@ SLOW_FAULT_UNIT_S = 0.05
 SLOW_ATTEMPT_DEADLINE_S = 0.25
 SLOW_HEDGE_S = 0.1
 SLOW_DEADLINE_GRACE_S = 0.2
+# The fleet column (ISSUE 11): the synthetic-cluster scale the sublinear
+# pins run at, the 20-node baseline they are measured against, and the
+# fleet-mode client knobs (paginated LISTs + the multiplexed transport).
+# The --check contract: cold-rollout requests at FLEET_NODES within
+# FLEET_REQUEST_RATIO_MAX of the baseline count (requests O(bundle), not
+# O(nodes)), an idle watch-driven admission pass issues ZERO requests
+# after sync, and the 100-queued-gang decision pass — span-derived —
+# stays under FLEET_DECISION_LATENCY_MAX_S.
+FLEET_NODES = 1000
+FLEET_BASELINE_NODES = 20
+FLEET_PAGE_LIMIT = 250
+FLEET_MUX_POOL = 8
+FLEET_GANGS = 100
+FLEET_REQUEST_RATIO_MAX = 2.0
+FLEET_DECISION_LATENCY_MAX_S = 10.0
 
 
 def full_stack_groups(spec):
@@ -471,6 +486,117 @@ def gang_arm(latency_s: float) -> dict:
     }
 
 
+def _fleet_rollout(num_nodes: int, latency_s: float,
+                   max_inflight: int) -> dict:
+    """One cold full-bundle install against a fake seeded with a
+    ``num_nodes`` synthetic fleet (nodes + bound pods), through the
+    fleet-mode client (multiplexed transport + paginated LISTs). The
+    request count is span-derived and audit-parity checked — the number
+    the sublinear gate compares across fleet sizes."""
+    spec = specmod.default_spec()
+    groups = full_stack_groups(spec)
+    tel = telemetry.Telemetry()
+    with FakeApiServer(auto_ready=True, latency_s=latency_s,
+                       store=fleet_store(num_nodes)) as api:
+        client = kubeapply.Client(api.url, telemetry=tel,
+                                  mux=FLEET_MUX_POOL,
+                                  list_page_limit=FLEET_PAGE_LIMIT)
+        t0 = time.monotonic()
+        kubeapply.apply_groups(client, groups, wait=True, stage_timeout=60,
+                               poll=0.05, max_inflight=max_inflight,
+                               watch_ready=True)
+        wall = time.monotonic() - t0
+        client.close()
+        _assert_audit_parity(tel, api)
+    return {"nodes": num_nodes, "wall_s": round(wall, 3),
+            "requests": _trace_requests(tel)}
+
+
+def _admission_pass_spans_s(tel) -> list:
+    """Durations (seconds) of the admission-pass spans in the trace —
+    the decision-latency numbers the fleet gate reads, derived from the
+    SAME span tree `tpuctl admission --trace-out` hands a user."""
+    return [float(e.get("dur", 0.0)) / 1e6
+            for e in tel.chrome_trace().get("traceEvents", [])
+            if e.get("name") == "admission-pass" and e.get("ph") == "X"]
+
+
+def fleet_arm(latency_s: float, max_inflight: int) -> dict:
+    """The fleet-scale column (ISSUE 11), three sublinear pins:
+
+    ``cold`` vs ``baseline``: the identical bundle installed against a
+    1000-node fleet and a 20-node cluster — the request count must stay
+    O(bundle), within ``FLEET_REQUEST_RATIO_MAX`` of the baseline.
+
+    ``admission``: a watch-driven controller (informer cache, paginated
+    sync) over the 1000-node fleet with ``FLEET_GANGS`` gang jobs queued
+    at pass start. One pass decides them all; its latency is the
+    admission-pass SPAN duration, not a stopwatch. After the decisions
+    land, idle passes must touch the apiserver exactly ZERO times —
+    O(events) means a quiet fleet costs nothing."""
+    ns = "tpu-system"
+    cold = _fleet_rollout(FLEET_NODES, latency_s, max_inflight)
+    baseline = _fleet_rollout(FLEET_BASELINE_NODES, latency_s,
+                              max_inflight)
+
+    store = fleet_store(FLEET_NODES)
+    for i in range(FLEET_GANGS):
+        job = admission.gang_job_manifest(f"fleet-g{i:03d}", "v5e-16", ns)
+        name = job["metadata"]["name"]
+        store[f"/apis/batch/v1/namespaces/{ns}/jobs/{name}"] = job
+    tel = telemetry.Telemetry()
+    with FakeApiServer(auto_ready=True, latency_s=latency_s,
+                       store=store) as api:
+        client = kubeapply.Client(api.url, retry=FAULT_RETRY,
+                                  telemetry=tel,
+                                  list_page_limit=FLEET_PAGE_LIMIT)
+        ctrl = admission.AdmissionController(client, ns, telemetry=tel)
+        informers = ctrl.build_informers(page_limit=FLEET_PAGE_LIMIT)
+        try:
+            informers.start()
+            if not informers.wait_synced(60):
+                raise SystemExit("bench_rollout: fleet informers never "
+                                 "synced")
+            sync_requests = len(api.log)
+            first = ctrl.step()
+            decided = len(first.admitted) + len(first.queued)
+
+            def non_watch_requests() -> int:
+                # exclude ?watch=1 stream re-opens: a watch window
+                # expiring mid-measurement is the O(streams) backstop,
+                # not a pass reading the world
+                return sum(1 for _m, p in api.log if "watch=1" not in p)
+
+            settled = non_watch_requests()
+            for _ in range(5):
+                ctrl.step()
+            idle_requests = non_watch_requests() - settled
+            relists = sum(inf.relists
+                          for inf in informers.informers.values())
+        finally:
+            informers.stop()
+            client.close()
+    spans = _admission_pass_spans_s(tel)
+    if not spans:
+        raise SystemExit("bench_rollout: no admission-pass span recorded")
+    return {
+        "cold": cold,
+        "baseline": baseline,
+        "request_ratio_vs_baseline": round(
+            cold["requests"] / max(1, baseline["requests"]), 2),
+        "admission": {
+            "nodes": FLEET_NODES,
+            "gangs": decided,
+            "sync_requests": sync_requests,
+            "decision_latency_s": round(max(spans), 4),
+            "idle_pass_requests": idle_requests,
+            # full re-LISTs the informers ever paid: exactly one per
+            # collection (the initial sync) on a flap-free run
+            "relists": relists,
+        },
+    }
+
+
 def _operator_binary() -> str:
     """The C++ operator, if a native build tree already has it (conftest /
     CI build it; this bench never builds — the drift column is reported
@@ -591,6 +717,7 @@ def main(argv=None) -> int:
                    trace_out=args.trace_out, collect=collect)
     ssa = ssa_arm(latency_s, args.passes, args.max_inflight)
     gang = gang_arm(latency_s)
+    fleet = fleet_arm(latency_s, args.max_inflight)
     ready_watch = readiness_arm(latency_s, watch=True)
     ready_poll = readiness_arm(latency_s, watch=False)
     faults = {
@@ -659,6 +786,11 @@ def main(argv=None) -> int:
         # its latency), whole-gang preemption count, and the
         # zero-partial-allocations contract at the kubelet seat check.
         "gang": gang,
+        # Fleet scale (ISSUE 11): cold rollout at 1000 synthetic nodes
+        # within 2x of the 20-node request count (O(bundle), not
+        # O(nodes)), span-derived decision latency for 100 queued gangs,
+        # and ZERO requests per idle watch-driven admission pass.
+        "fleet": fleet,
     }
     print(json.dumps(doc, separators=(",", ":")))
 
@@ -751,6 +883,25 @@ def main(argv=None) -> int:
                   "race_admitted==1, preemptions>=1, preemptor admitted, "
                   "partial_allocations==0, full_host_groups_admitted==2)",
                   file=sys.stderr)
+            return 1
+        # fleet scale (ISSUE 11): the sublinear pins — a 50x node-count
+        # jump may not even DOUBLE the rollout's request bill, the
+        # 100-gang decision pass stays bounded (span-derived), and an
+        # idle watch-driven admission pass costs zero requests (with
+        # exactly one full LIST per collection ever paid)
+        adm = fleet["admission"]
+        if not (fleet["request_ratio_vs_baseline"]
+                <= FLEET_REQUEST_RATIO_MAX
+                and adm["gangs"] == FLEET_GANGS
+                and adm["decision_latency_s"]
+                <= FLEET_DECISION_LATENCY_MAX_S
+                and adm["idle_pass_requests"] == 0
+                and adm["relists"] == 2):
+            print(f"bench_rollout: FAIL — fleet column {fleet} (need "
+                  f"request_ratio <= {FLEET_REQUEST_RATIO_MAX:g}, "
+                  f"gangs == {FLEET_GANGS}, decision latency <= "
+                  f"{FLEET_DECISION_LATENCY_MAX_S:g}s, idle_pass_requests "
+                  "== 0, relists == 2)", file=sys.stderr)
             return 1
     return 0
 

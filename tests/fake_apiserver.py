@@ -223,6 +223,76 @@ def _filter_selector(items, query: str):
 _NODE_FAULT_KINDS = ("node_not_ready", "node_ready", "evict_pods")
 
 
+# ------------------------------------------------------------------ fleet
+# (ISSUE 11): the synthetic 500-1000 node cluster the fleet-scale work
+# runs against. Kept dependency-free like the rest of this fake (no
+# tpu_cluster import) — the label/capacity spellings are twins of
+# admission.node_manifest and are pinned by tests/test_fleet.py.
+
+FLEET_ACCELERATOR_LABEL = "google.com/tpu.accelerator-type"
+FLEET_TPU_RESOURCE = "google.com/tpu"
+
+
+def fleet_node(name: str, accelerator: str = "v5e-8", chips: int = 8,
+               ready: bool = True) -> Dict[str, Any]:
+    """One synthetic Node the way the feature-discovery + kubelet pair
+    would publish it: discovery labels, TPU capacity, Ready condition,
+    and kubelet-shaped nodeInfo/addresses status."""
+    return {
+        "apiVersion": "v1", "kind": "Node",
+        "metadata": {
+            "name": name,
+            "labels": {
+                FLEET_ACCELERATOR_LABEL: accelerator,
+                "google.com/tpu.present": "true",
+                "kubernetes.io/hostname": name,
+            },
+        },
+        "status": {
+            "capacity": {FLEET_TPU_RESOURCE: str(chips),
+                         "cpu": "96", "memory": "384Gi"},
+            "allocatable": {FLEET_TPU_RESOURCE: str(chips)},
+            "conditions": [
+                {"type": "Ready",
+                 "status": "True" if ready else "False"},
+            ],
+            "nodeInfo": {"kubeletVersion": "v1.29.0",
+                         "containerRuntimeVersion": "containerd://1.7.0",
+                         "osImage": "Fake Linux"},
+            "addresses": [{"type": "Hostname", "address": name}],
+        },
+    }
+
+
+def fleet_store(num_nodes: int, accelerator: str = "v5e-8",
+                chips_per_node: int = 8, pods_per_node: int = 1,
+                namespace: str = "tpu-system",
+                name_prefix: str = "fleet") -> Dict[str, Dict[str, Any]]:
+    """A ``FakeApiServer(store=...)`` seed for a synthetic fleet:
+    ``num_nodes`` Ready Nodes (discovery labels + TPU capacity + kubelet-
+    shaped status) with ``pods_per_node`` running Pods bound to each via
+    ``spec.nodeName`` — the object-count scale the sublinear pins run
+    against without paying one HTTP request per seeded object."""
+    store: Dict[str, Dict[str, Any]] = {}
+    for i in range(num_nodes):
+        node = f"{name_prefix}-{i:04d}"
+        store[f"/api/v1/nodes/{node}"] = fleet_node(
+            node, accelerator=accelerator, chips=chips_per_node)
+        for p in range(pods_per_node):
+            pod = f"{node}-pod-{p}"
+            store[f"/api/v1/namespaces/{namespace}/pods/{pod}"] = {
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": pod, "namespace": namespace,
+                             "labels": {"app.kubernetes.io/part-of":
+                                        "tpu-stack-fleet"}},
+                "spec": {"nodeName": node,
+                         "containers": [{"name": "w",
+                                         "image": "tpu-stack/worker:v1"}]},
+                "status": {"phase": "Running"},
+            }
+    return store
+
+
 class ChaosEngine:
     """Scripted fault injection for the fake apiserver — the promotion of
     the old ad-hoc ``reject_posts``/``reject_watch`` hooks (which are now
@@ -537,7 +607,10 @@ class FakeApiServer:
                  latency_s: float = 0.0,
                  reject_watch: Optional[Dict[str, int]] = None,
                  watch_gone_once=(), chaos=None,
-                 ssa_unsupported: bool = False):
+                 ssa_unsupported: bool = False,
+                 continue_ttl_s: float = 300.0,
+                 apf_inflight_budget: Optional[int] = None,
+                 apf_retry_after_s: float = 0.05):
         self.auto_ready = auto_ready
         # An apiserver predating server-side apply: every
         # application/apply-patch+yaml PATCH answers 415, the capability
@@ -608,7 +681,35 @@ class FakeApiServer:
         # tests/test_lockorder.py pins the resulting _lock ->
         # _responses_lock edge as the fake's ONLY lock nesting
         self._responses_lock = threading.Lock()
+        # Paginated-LIST continuation pages served, by collection path
+        # (ISSUE 11): the server-side half of the pagination audit.
+        self.list_pages: Dict[str, int] = {}  # guarded-by: _responses_lock
         self._lock = threading.Lock()
+        # -------------------------------------------------- pagination
+        # (ISSUE 11): collection GETs honor ?limit=N and ?continue=TOK
+        # (apiserver chunked-LIST semantics). A continue token snapshots
+        # the item NAME order at first-page time, so pages stay stable
+        # under concurrent mutation; tokens expire after continue_ttl_s
+        # (or via expire_continue_tokens()) and an expired/unknown token
+        # answers 410 Gone reason=Expired — the client must re-LIST from
+        # a clean first page, exactly like a real apiserver compaction.
+        self.continue_ttl_s = continue_ttl_s
+        self._continue_tokens: Dict[str, Dict[str, Any]] = {}  # guarded-by: _lock
+        self._continue_seq = 0  # guarded-by: _lock
+        # -------------------------------------------------- APF budget
+        # (ISSUE 11): API Priority & Fairness-style load shedding. When
+        # apf_inflight_budget is set, a non-watch request arriving while
+        # `budget` requests are already inside their service window is
+        # answered 429 + Retry-After instead of being handled — the
+        # fault the client's retry family (and the never-hedge-a-429
+        # pin) must absorb. None (default) = off, byte-identical
+        # handling. Own leaf lock: the inflight gate must not nest with
+        # the store or audit locks.
+        self.apf_inflight_budget = apf_inflight_budget
+        self.apf_retry_after_s = apf_retry_after_s
+        self._apf_lock = threading.Lock()
+        self._apf_inflight = 0  # guarded-by: _apf_lock
+        self.apf_rejections = 0  # guarded-by: _apf_lock
         # watch support (?watch=1): every mutation through the HTTP
         # handlers (or the touch() test hook) bumps _rev and records the
         # touched path; watchers block on the condition and stream events
@@ -648,7 +749,11 @@ class FakeApiServer:
                 self.wfile.write(body)
 
             def _record(self):
-                if fake.latency_s > 0:
+                # with the APF budget armed the service-time sleep moves
+                # INSIDE the inflight slot (_apf_begin), so concurrent
+                # requests overlap in the counted window; budget off =
+                # the original sleep-here hot path, byte-identical
+                if fake.latency_s > 0 and fake.apf_inflight_budget is None:
                     time.sleep(fake.latency_s)
                 # span anchor + inbound trace context, captured before
                 # any handling so the server span covers service time
@@ -657,6 +762,67 @@ class FakeApiServer:
                 with fake._lock:
                     fake.log.append((self.command, self.path))
                     fake.headers_seen.append(dict(self.headers))
+
+            # --------------------------------------------- APF inflight
+            # gate (ISSUE 11): _apf_begin claims one service slot (or
+            # answers 429 + Retry-After when the budget is full),
+            # _apf_end releases it — callers pair them try/finally.
+            # Watch streams are EXEMPT from the count (a long-lived
+            # stream would consume the budget forever) but still pay the
+            # service-time sleep; the budget-off path never touches the
+            # APF lock at all.
+
+            def _apf_begin(self, is_watch: bool = False) -> bool:
+                """True = proceed (slot held unless exempt); False = a
+                429 was sent and the request is done. Must be called
+                AFTER the request body has been drained (same keep-alive
+                rule as _chaos)."""
+                self._apf_held = False
+                if fake.apf_inflight_budget is None:
+                    return True
+                if is_watch:
+                    if fake.latency_s > 0:
+                        time.sleep(fake.latency_s)
+                    return True
+                with fake._apf_lock:
+                    fake._apf_inflight += 1
+                    over = fake._apf_inflight > fake.apf_inflight_budget
+                    if over:
+                        fake._apf_inflight -= 1
+                        fake.apf_rejections += 1
+                if over:
+                    self._reply_429()
+                    return False
+                self._apf_held = True
+                if fake.latency_s > 0:
+                    time.sleep(fake.latency_s)
+                return True
+
+            def _apf_end(self) -> None:
+                if getattr(self, "_apf_held", False):
+                    self._apf_held = False
+                    with fake._apf_lock:
+                        fake._apf_inflight -= 1
+
+            def _reply_429(self) -> None:
+                """APF load-shed reply: 429 + Retry-After (the header the
+                client's retry family honors). One audit entry + span
+                like every other handled request."""
+                path = self.path.partition("?")[0]
+                fake._note_response(self.command, path, 429)
+                self._span(429, apf=True)
+                body = json.dumps({
+                    "kind": "Status", "code": 429,
+                    "reason": "TooManyRequests",
+                    "message": "too many concurrent requests in flight; "
+                               "retry after backoff"}).encode()
+                self.send_response(429)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Retry-After",
+                                 str(fake.apf_retry_after_s))
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
 
             def _span(self, status: int, **extra: Any):
                 """One server-side span for THIS request (same one-entry
@@ -951,43 +1117,57 @@ class FakeApiServer:
                 path, _, query = self.path.partition("?")
                 q = parse_qs(query)
                 is_watch = q.get("watch", ["0"])[0] in ("1", "true")
-                if self._chaos(is_watch):
+                if not self._apf_begin(is_watch):
                     return
-                if is_watch:
-                    try:
-                        self._serve_watch(path, q)
-                    finally:
-                        # the stream's span covers its whole lifetime —
-                        # open to window end / invalidation / client gone
-                        self._span(200, watch=True)
-                    return
-                with fake._lock:
-                    obj = fake.store.get(path)
-                    if path in fake.ghost_get_404:
-                        obj = None  # stale read: stored but reported absent
-                        fake.ghost_get_404.discard(path)
-                    if obj is None and \
-                            path.rsplit("/", 1)[-1] in COLLECTION_SEGMENTS:
-                        # collection GET: list stored objects one level
-                        # under the path, honoring ?labelSelector=k=v (the
-                        # operator's prune sweep uses this). Gated on known
-                        # plural segments so a GET of an absent OBJECT
-                        # (e.g. a parent whose seeded "<path>/status" key
-                        # exists) still 404s like a real apiserver.
-                        prefix = path.rstrip("/") + "/"
-                        items = [o for p, o in fake.store.items()
-                                 if p.startswith(prefix)
-                                 and "/" not in p[len(prefix):]]
-                        # list metadata.resourceVersion: where a client's
-                        # watch resumes from (apiserver LIST semantics)
-                        obj = {"kind": "List",
-                               "metadata": {"resourceVersion":
-                                            str(fake._rev)},
-                               "items": _filter_selector(items, query)}
-                if obj is None:
-                    self._reply(404, {"kind": "Status", "code": 404})
-                else:
+                try:
+                    if self._chaos(is_watch):
+                        return
+                    if is_watch:
+                        try:
+                            self._serve_watch(path, q)
+                        finally:
+                            # the stream's span covers its whole lifetime
+                            # — open to window end / invalidation /
+                            # client gone
+                            self._span(200, watch=True)
+                        return
+                    page_status = None
+                    with fake._lock:
+                        obj = fake.store.get(path)
+                        if path in fake.ghost_get_404:
+                            # stale read: stored but reported absent
+                            obj = None
+                            fake.ghost_get_404.discard(path)
+                        if obj is None and \
+                                path.rsplit("/", 1)[-1] in \
+                                COLLECTION_SEGMENTS:
+                            # collection GET: list stored objects one
+                            # level under the path, honoring
+                            # ?labelSelector=k=v and ?limit=/?continue=
+                            # pagination (ISSUE 11). Gated on known
+                            # plural segments so a GET of an absent
+                            # OBJECT (e.g. a parent whose seeded
+                            # "<path>/status" key exists) still 404s
+                            # like a real apiserver.
+                            obj, page_status = \
+                                fake._collection_page_locked(path, query)
+                    if page_status is not None:
+                        self._reply(*page_status)
+                        return
+                    if obj is None:
+                        self._reply(404, {"kind": "Status", "code": 404})
+                        return
+                    if (obj.get("kind") == "List"
+                            and (q.get("continue", [""])[0]
+                                 or (obj.get("metadata") or {})
+                                 .get("continue"))):
+                        # one audit bump per served page of a PAGINATED
+                        # list (outside _lock; own lock — see
+                        # list_pages)
+                        fake._note_list_page(path)
                     self._reply(200, obj)
+                finally:
+                    self._apf_end()
 
             # requires: fake._lock
             def _finalize_create_locked(self, path: str, obj: Dict[str, Any],
@@ -1022,6 +1202,14 @@ class FakeApiServer:
             def do_POST(self):
                 self._record()
                 obj = self._body()
+                if not self._apf_begin():
+                    return
+                try:
+                    self._do_post(obj)
+                finally:
+                    self._apf_end()
+
+            def _do_post(self, obj):
                 if self._chaos():
                     return
                 name = (obj or {}).get("metadata", {}).get("name")
@@ -1052,13 +1240,18 @@ class FakeApiServer:
             def do_PUT(self):
                 self._record()
                 obj = self._body()
-                if self._chaos():
+                if not self._apf_begin():
                     return
-                with fake._lock:
-                    existed = self.path in fake.store
-                    fake.store[self.path] = obj
-                    fake._note_change(self.path)
-                self._reply(200 if existed else 201, obj)
+                try:
+                    if self._chaos():
+                        return
+                    with fake._lock:
+                        existed = self.path in fake.store
+                        fake.store[self.path] = obj
+                        fake._note_change(self.path)
+                    self._reply(200 if existed else 201, obj)
+                finally:
+                    self._apf_end()
 
             def _serve_ssa(self, path: str, q: Dict[str, list],
                            intent: Any):
@@ -1172,6 +1365,14 @@ class FakeApiServer:
             def do_PATCH(self):
                 self._record()
                 patch = self._body()
+                if not self._apf_begin():
+                    return
+                try:
+                    self._do_patch(patch)
+                finally:
+                    self._apf_end()
+
+            def _do_patch(self, patch):
                 ctype = self.headers.get("Content-Type") or ""
                 is_ssa = ctype.startswith("application/apply-patch+yaml")
                 if self._chaos(is_ssa=is_ssa):
@@ -1238,13 +1439,18 @@ class FakeApiServer:
 
             def do_DELETE(self):
                 self._record()
-                if self._chaos():
+                if not self._apf_begin():
                     return
-                with fake._lock:
-                    gone = fake.store.pop(self.path, None)
-                    if gone is not None:
-                        fake._note_change(self.path)
-                self._reply(200 if gone is not None else 404, {})
+                try:
+                    if self._chaos():
+                        return
+                    with fake._lock:
+                        gone = fake.store.pop(self.path, None)
+                        if gone is not None:
+                            fake._note_change(self.path)
+                    self._reply(200 if gone is not None else 404, {})
+                finally:
+                    self._apf_end()
 
         class Server(ThreadingHTTPServer):
             def handle_error(self, request, client_address):
@@ -1298,6 +1504,105 @@ class FakeApiServer:
         key = (method, path, status)
         with self._responses_lock:
             self.responses[key] = self.responses.get(key, 0) + 1
+
+    def _note_list_page(self, path: str) -> None:
+        """Count one served page of a PAGINATED collection LIST (a reply
+        that carried or consumed a continue token) — published as
+        fake_apiserver_list_pages_total{path}."""
+        with self._responses_lock:
+            self.list_pages[path] = self.list_pages.get(path, 0) + 1
+
+    # --------------------------------------------------------- pagination
+
+    # requires: self._lock
+    def _new_continue_locked(self, path: str, names: List[str],
+                             offset: int, rev: str) -> str:
+        """Mint a continue token snapshotting the remaining item-name
+        order (apiserver chunked-LIST semantics: pages come from the
+        first page's snapshot, at its resourceVersion). Caller holds
+        self._lock."""
+        self._continue_seq += 1
+        token = f"ct-{self._continue_seq:06d}"
+        self._continue_tokens[token] = {
+            "path": path, "names": list(names), "offset": offset,
+            "rev": rev,
+            "expires": time.monotonic() + self.continue_ttl_s}
+        if len(self._continue_tokens) > 256:
+            # bounded, oldest-first: an abandoned chase must not leak
+            for k in sorted(self._continue_tokens)[
+                    :len(self._continue_tokens) - 256]:
+                self._continue_tokens.pop(k, None)
+        return token
+
+    # requires: self._lock
+    def _collection_page_locked(self, path: str, query: str):
+        """One collection-LIST reply body honoring ``?labelSelector=``,
+        ``?limit=`` and ``?continue=``: ``(listing, None)`` for a 200,
+        ``(None, (status, body))`` for an error reply — today only the
+        410 Gone reason=Expired an expired/unknown continue token earns
+        (the client must restart from a clean first page). Caller holds
+        self._lock."""
+        q = parse_qs(query)
+        prefix = path.rstrip("/") + "/"
+        items = [o for p, o in self.store.items()
+                 if p.startswith(prefix) and "/" not in p[len(prefix):]]
+        items = _filter_selector(items, query)
+        token = q.get("continue", [""])[0]
+        try:
+            limit = int(q.get("limit", ["0"])[0])
+        except ValueError:
+            limit = 0
+        if token:
+            rec = self._continue_tokens.get(token)
+            if rec is None or rec["path"] != path \
+                    or time.monotonic() >= rec["expires"]:
+                self._continue_tokens.pop(token, None)
+                return None, (410, {
+                    "kind": "Status", "code": 410, "reason": "Expired",
+                    "message": "The provided continue parameter is too "
+                               "old to display a consistent list result; "
+                               "start a new list without the continue "
+                               "parameter"})
+            # single-use: each page mints the NEXT token (and a client
+            # retry of a consumed page re-LISTs cleanly via the 410)
+            self._continue_tokens.pop(token, None)
+            names = rec["names"]
+            offset = int(rec["offset"])
+            by_name = {str((o.get("metadata") or {}).get("name", "")): o
+                       for o in items}
+            page_names = (names[offset:offset + limit] if limit > 0
+                          else names[offset:])
+            page = [by_name[n] for n in page_names if n in by_name]
+            meta: Dict[str, Any] = {"resourceVersion": rec["rev"]}
+            next_offset = offset + len(page_names)
+            if limit > 0 and next_offset < len(names):
+                meta["continue"] = self._new_continue_locked(
+                    path, names, next_offset, rec["rev"])
+            return {"kind": "List", "metadata": meta, "items": page}, None
+        rev = str(self._rev)
+        if limit > 0 and len(items) > limit:
+            # deterministic page order: sorted by name, like a real
+            # apiserver's etcd key order (unpaginated lists keep the
+            # historical store order)
+            items = sorted(items, key=lambda o: str(
+                (o.get("metadata") or {}).get("name", "")))
+            names = [str((o.get("metadata") or {}).get("name", ""))
+                     for o in items]
+            meta = {"resourceVersion": rev,
+                    "continue": self._new_continue_locked(
+                        path, names, limit, rev)}
+            return {"kind": "List", "metadata": meta,
+                    "items": items[:limit]}, None
+        return {"kind": "List", "metadata": {"resourceVersion": rev},
+                "items": items}, None
+
+    def expire_continue_tokens(self) -> None:
+        """Force every outstanding continue token expired — the test
+        hook for the 410 re-LIST path (no sleeping past
+        continue_ttl_s)."""
+        with self._lock:
+            for rec in self._continue_tokens.values():
+                rec["expires"] = 0.0
 
     def _note_span(self, method: str, path: str, status: int,
                    t_start: Optional[float], traceparent: str,
@@ -1369,6 +1674,18 @@ class FakeApiServer:
             lines.append(
                 f'fake_apiserver_chaos_faults_total{{kind="{kind}"}} '
                 f"{fired[kind]}")
+        with self._responses_lock:
+            pages = sorted(self.list_pages.items())
+        lines.append("# TYPE fake_apiserver_list_pages_total counter")
+        for path, n in pages:
+            lines.append(
+                f'fake_apiserver_list_pages_total{{path='
+                f'"{prom_escape(path)}"}} {n}')
+        with self._apf_lock:
+            rejected = self.apf_rejections
+        lines.append("# TYPE fake_apiserver_apf_rejections_total counter")
+        lines.append('fake_apiserver_apf_rejections_total'
+                     f'{{reason="inflight"}} {rejected}')
         return "\n".join(lines) + "\n"
 
     # ------------------------------------------------------------- watch

@@ -1,0 +1,508 @@
+"""Fleet-scale control-plane suite (ISSUE 11).
+
+The sublinear pins for the 1000-node synthetic fleet: paginated LIST
+(`limit`/`continue` chased transparently, expired continue token → one
+clean re-LIST, never a partial result), APF-style 429 + Retry-After
+load shedding absorbed by the retry family (and NEVER hedged — a hedge
+against load shedding amplifies the storm), the multiplexed transport's
+parity + socket-bound pins (mux off ⇒ request/mutation multiset
+byte-identical to the pre-fleet client; mux on ⇒ sockets O(pool) no
+matter how many worker threads drive it), and the watch-driven informer
+cache behind event-driven admission (an idle pass at fleet size issues
+ZERO apiserver reads after initial sync; an apiserver flap costs exactly
+one paginated re-LIST, not a storm)."""
+
+import threading
+import time
+from collections import Counter
+
+import pytest
+
+from fake_apiserver import (FakeApiServer, fleet_node, fleet_store,
+                            FLEET_ACCELERATOR_LABEL)
+from tpu_cluster import admission, informer, kubeapply, telemetry
+from tpu_cluster.render import manifests
+from tpu_cluster import spec as specmod
+
+NS = "tpu-system"
+NODES = "/api/v1/nodes"
+JOBS = f"/apis/batch/v1/namespaces/{NS}/jobs"
+MUTATING = ("POST", "PATCH", "PUT", "DELETE")
+
+FAST_RETRY = kubeapply.RetryPolicy(attempts=8, base_s=0.02, cap_s=0.5)
+
+
+def _collection_lists(log, path):
+    """Audit entries that are collection LISTs of `path` (paginated or
+    not), EXCLUDING watch-stream opens."""
+    return [(m, p) for m, p in log
+            if m == "GET" and p.partition("?")[0] == path
+            and "watch=1" not in p]
+
+
+# ----------------------------------------------------------- fleet store
+
+
+def test_fleet_node_is_an_admission_host_twin():
+    """The synthetic fleet's label/capacity spellings must parse through
+    the REAL admission host extractor — the fake stays dependency-free,
+    so the spelling twin is pinned here instead of shared."""
+    assert FLEET_ACCELERATOR_LABEL == admission.ACCELERATOR_LABEL
+    host = admission.host_capacity(fleet_node("n1", "v5e-8", chips=8))
+    assert host is not None
+    assert host.name == "n1" and host.chips == 8 and host.ready
+    not_ready = admission.host_capacity(
+        fleet_node("n2", "v5e-8", ready=False))
+    assert not_ready is not None and not not_ready.ready
+
+
+def test_fleet_store_seeds_nodes_and_bound_pods():
+    store = fleet_store(50, pods_per_node=2)
+    nodes = [p for p in store if p.startswith(f"{NODES}/")]
+    pods = [p for p in store if "/pods/" in p]
+    assert len(nodes) == 50 and len(pods) == 100
+    pod = store[f"/api/v1/namespaces/{NS}/pods/fleet-0007-pod-1"]
+    assert pod["spec"]["nodeName"] == "fleet-0007"
+    assert pod["status"]["phase"] == "Running"
+    node = store[f"{NODES}/fleet-0007"]
+    assert node["status"]["nodeInfo"]["kubeletVersion"]
+
+
+# ------------------------------------------------------------ pagination
+
+
+def test_paginated_list_chases_continue_tokens():
+    with FakeApiServer(auto_ready=True,
+                       store=fleet_store(250, pods_per_node=0)) as api:
+        tel = telemetry.Telemetry()
+        client = kubeapply.Client(api.url, retry=FAST_RETRY,
+                                  telemetry=tel)
+        items, rv, pages = client.list_paged(NODES, 100)
+        client.close()
+        assert len(items) == 250 and pages == 3
+        assert rv  # the watch-resume point: the first page's snapshot
+        assert "fleet-0000" in items and "fleet-0249" in items
+        # page audit on both sides: 3 wire GETs, 2 carried a continue
+        # token (server counts pages of a PAGINATED chase: all 3)
+        assert len(_collection_lists(api.log, NODES)) == 3
+        assert api.list_pages.get(NODES, 0) >= 2
+        rendered = tel.metrics.render()
+        assert "tpuctl_list_pages_total" in rendered
+
+
+def test_list_collection_page_limit_routes_through_the_chase():
+    with FakeApiServer(auto_ready=True,
+                       store=fleet_store(120, pods_per_node=0)) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY,
+                                  list_page_limit=50)
+        items = client.list_collection(NODES)
+        client.close()
+        assert len(items) == 120
+        assert len(_collection_lists(api.log, NODES)) == 3
+
+
+def test_expired_continue_token_answers_410_then_clean_relist():
+    """The expiry contract, both halves: a consumed/expired token earns
+    410 Gone reason=Expired on the wire, and `list_paged` restarts the
+    WHOLE chase from a clean first page — never a partial result."""
+    with FakeApiServer(auto_ready=True,
+                       store=fleet_store(90, pods_per_node=0)) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY)
+        code, first = client.get(f"{NODES}?limit=40")
+        assert code == 200
+        token = first["metadata"]["continue"]
+        api.expire_continue_tokens()
+        code, resp = client.get(f"{NODES}?limit=40&continue={token}")
+        assert code == 410 and resp.get("reason") == "Expired"
+
+        # expire the minted token exactly once, mid-chase: page 2's 410
+        # must restart from page 1 and produce the FULL collection
+        real_get = client.get
+        expired_once = []
+
+        def sabotage(path):
+            if ("continue=" in path and not expired_once):
+                expired_once.append(True)
+                api.expire_continue_tokens()
+            return real_get(path)
+
+        client.get = sabotage
+        try:
+            items, _rv, pages = client.list_paged(NODES, 40)
+        finally:
+            client.get = real_get
+        client.close()
+        assert expired_once
+        assert len(items) == 90  # complete, not the surviving pages
+        assert pages == 3  # the CLEAN chase's page count
+
+
+def test_every_token_expired_fails_loudly_not_forever():
+    with FakeApiServer(auto_ready=True, continue_ttl_s=0.0,
+                       store=fleet_store(30, pods_per_node=0)) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY)
+        with pytest.raises(kubeapply.ApplyError, match="consecutive"):
+            client.list_paged(NODES, 10)
+        client.close()
+
+
+# ------------------------------------------------------------- APF / 429
+
+
+def test_retry_after_is_a_floor_not_an_appointment():
+    policy = kubeapply.RetryPolicy(base_s=0.01, jitter=0.2)
+    for attempt in (1, 2, 3):
+        d = policy.backoff_s(attempt, retry_after=0.5)
+        assert d >= 0.5  # never return earlier than the server asked
+    # a hostile header cannot park the rollout past cap_s (+ jitter)
+    capped = kubeapply.RetryPolicy(cap_s=1.0).backoff_s(
+        1, retry_after=10_000.0)
+    assert capped <= 1.0 * 1.2 + 1e-9
+    # persistent overload escalates PAST the floor (the herd spreads)
+    late = kubeapply.RetryPolicy(base_s=1.0, cap_s=30.0, jitter=0.0)
+    assert late.backoff_s(4, retry_after=0.05) >= 8.0
+
+
+def test_apf_429_storm_absorbed_by_retry_family():
+    """Load shedding end to end: demand over the inflight budget is
+    answered 429 + Retry-After, the client's retry family absorbs every
+    one, and the server-side rejection counter proves shedding fired."""
+    import concurrent.futures as cf
+    with FakeApiServer(auto_ready=True, latency_s=0.05,
+                       apf_inflight_budget=2,
+                       store=fleet_store(20, pods_per_node=0)) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY)
+        with cf.ThreadPoolExecutor(8) as ex:
+            futs = [ex.submit(client.get, f"{NODES}/fleet-0001")
+                    for _ in range(24)]
+            codes = [f.result()[0] for f in futs]
+        client.close()
+        assert codes == [200] * 24
+        assert api.apf_rejections > 0
+        assert "fake_apiserver_apf_rejections_total" \
+            in api.fake_metrics_text()
+
+
+def test_a_429_is_never_hedged():
+    """The negative pin: once a GET was answered 429, its retries must
+    go through the NON-hedged path — a backup request against a server
+    shedding load amplifies exactly the storm it is shedding."""
+    with FakeApiServer(auto_ready=True, apf_inflight_budget=0,
+                       store=fleet_store(5, pods_per_node=0)) as api:
+        client = kubeapply.Client(
+            api.url, hedge_s=0.0,
+            retry=kubeapply.RetryPolicy(attempts=4, base_s=0.001,
+                                        cap_s=0.01))
+        hedged_calls = []
+        real_hedged = client._request_hedged
+
+        def counting_hedged(method, path):
+            hedged_calls.append(path)
+            return real_hedged(method, path)
+
+        client._request_hedged = counting_hedged
+        code, _ = client.get(f"{NODES}/fleet-0001")
+        client.close()
+        assert code == 429  # budget 0: every attempt shed, surfaced
+        # THE pin: only the FIRST attempt may go through the hedged
+        # path; every post-429 retry is routed non-hedged
+        assert len(hedged_calls) == 1
+        wire = [e for e in api.log if e[0] == "GET"]
+        # wire bound is the documented worst case, not the typical 5:
+        # every attempt may pay a stale-socket fast re-send (the hedged
+        # one when the backup's answer severs the primary mid-flight —
+        # seen under CPU starvation), plus the one backup
+        assert len(wire) <= 2 * 4 + 1, wire
+
+
+# --------------------------------------------------- multiplexed transport
+
+
+def _rollout(api, **client_kw):
+    client = kubeapply.Client(api.url, **client_kw)
+    groups = manifests.rollout_groups(specmod.default_spec())
+    kubeapply.apply_groups(client, groups, wait=True, stage_timeout=60,
+                           poll=0.02, max_inflight=8, watch_ready=True)
+    client.close()
+    return [(m, p.partition("?")[0]) for m, p in api.log]
+
+
+def test_mux_off_is_byte_identical_and_unpaginated():
+    """The parity pin: with mux/list_page_limit unset, no transport
+    object is built, no ?limit= ever appears on the wire, and the
+    request+mutation multiset of a rollout matches the mux rollout
+    exactly — the feature only swaps the socket underneath."""
+    with FakeApiServer(auto_ready=True) as api:
+        baseline = _rollout(api)
+        assert not any("limit=" in p for _, p in api.log)
+    with FakeApiServer(auto_ready=True) as api:
+        muxed = _rollout(api, mux=4)
+    assert Counter(baseline) == Counter(muxed)
+    assert sorted(e for e in muxed if e[0] in MUTATING) == \
+        sorted(e for e in baseline if e[0] in MUTATING)
+
+
+def test_mux_socket_count_is_o_pool_not_o_threads():
+    import concurrent.futures as cf
+    with FakeApiServer(auto_ready=True,
+                       store=fleet_store(10, pods_per_node=0)) as api:
+        client = kubeapply.Client(api.url, mux=3, retry=FAST_RETRY)
+        with cf.ThreadPoolExecutor(16) as ex:
+            futs = [ex.submit(client.get, f"{NODES}/fleet-0002")
+                    for _ in range(96)]
+            codes = {f.result()[0] for f in futs}
+        transport = client._mux_transport
+        assert codes == {200}
+        assert transport.max_open <= 3, \
+            f"16 threads opened {transport.max_open} sockets (pool=3)"
+        client.close()
+
+
+def test_mux_client_off_builds_no_transport():
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        assert client._mux_transport is None
+        client.close()
+
+
+def test_mux_bodyless_204_returns_without_eof_wait():
+    """A 204/304 carries neither Content-Length nor chunked framing by
+    definition — the transport must answer immediately with an empty
+    payload and KEEP the connection, not park in read-to-EOF until the
+    wall severs a healthy pooled socket (the fake always frames its
+    bodies, so this server speaks the RFC shape by hand)."""
+    import socket as socketmod
+    from tpu_cluster import muxhttp
+
+    served = []
+    srv = socketmod.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def serve() -> None:
+        conn, _ = srv.accept()
+        with conn:
+            for _ in range(2):  # two requests on ONE kept-alive conn
+                req = b""
+                while b"\r\n\r\n" not in req:
+                    chunk = conn.recv(4096)
+                    if not chunk:
+                        return
+                    req += chunk
+                served.append(req.split(b" ", 2)[1])
+                conn.sendall(b"HTTP/1.1 204 No Content\r\n\r\n")
+
+    helper = threading.Thread(target=serve, daemon=True)
+    helper.start()
+    transport = muxhttp.MuxTransport(f"http://127.0.0.1:{port}",
+                                     pool_size=1, timeout=2.0)
+    try:
+        t0 = time.monotonic()
+        for path in ("/a", "/b"):
+            status, _headers, payload = transport.request(
+                "GET", path, {}, None, wall_s=2.0)
+            assert status == 204 and payload == b""
+        # both answered well inside the wall, over one reused socket
+        assert time.monotonic() - t0 < 1.5
+        assert served == [b"/a", b"/b"]
+        assert transport.opened == 1
+    finally:
+        transport.close()
+        srv.close()
+        helper.join(timeout=5)
+
+
+# --------------------------------------------------------------- informer
+
+
+def test_informer_syncs_paginated_and_idles_at_zero_requests():
+    with FakeApiServer(auto_ready=True,
+                       store=fleet_store(1000, pods_per_node=0)) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY)
+        tel = telemetry.Telemetry()
+        with informer.Informer(client, NODES, telemetry=tel,
+                               page_limit=250, window_s=30) as inf:
+            assert inf.wait_synced(30)
+            assert len(inf.snapshot()) == 1000
+            assert inf.relists == 1  # the initial sync, nothing else
+            # sync was paginated: 4 bounded pages, not one giant body
+            assert len(_collection_lists(api.log, NODES)) == 4
+            # the watch stream's own open is setup, not idle traffic —
+            # wait for it before baselining
+            deadline = time.monotonic() + 5
+            while inf.reconnects < 1 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            idle_from = len(api.log)
+            time.sleep(0.6)
+            assert len(api.log) == idle_from, \
+                "idle informer issued requests"
+            # one event updates the cache in O(events): no re-LIST
+            seq = inf.seq()
+            api.touch(f"{NODES}/fleet-0500")
+            assert inf.wait_event(seq, timeout=5) > seq
+            assert inf.relists == 1
+            assert len(_collection_lists(api.log, NODES)) == 4
+        client.close()
+        rendered = tel.metrics.render()
+        assert "tpuctl_informer_events_total" in rendered
+        assert "tpuctl_informer_relists_total" in rendered
+        assert "tpuctl_informer_lag_seconds" in rendered
+
+
+def test_informer_flap_resumes_with_one_paginated_relist():
+    """An apiserver restart (410-invalidating every watch and RV) costs
+    the informer exactly ONE paginated re-LIST — no storm — and the
+    cache keeps serving events afterwards."""
+    with FakeApiServer(auto_ready=True,
+                       store=fleet_store(200, pods_per_node=0)) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY)
+        with informer.Informer(client, NODES, page_limit=100,
+                               window_s=30) as inf:
+            assert inf.wait_synced(30)
+            lists_before = len(_collection_lists(api.log, NODES))
+            api.flap()
+            deadline = time.monotonic() + 10
+            while inf.relists < 2 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert inf.relists == 2  # initial + exactly one 410 resync
+            time.sleep(0.3)  # a storm would re-LIST again: catch it
+            assert inf.relists == 2
+            lists_after = len(_collection_lists(api.log, NODES))
+            # one re-sync = one page chase (200 nodes / limit 100)
+            assert lists_after - lists_before == 2
+            assert len(inf.snapshot()) == 200
+            seq = inf.seq()
+            api.touch(f"{NODES}/fleet-0003")
+            assert inf.wait_event(seq, timeout=5) > seq
+        client.close()
+
+
+def test_informer_watch_denied_fails_loudly():
+    with FakeApiServer(auto_ready=True, reject_watch={NODES: 403},
+                       store=fleet_store(5, pods_per_node=0)) as api:
+        client = kubeapply.Client(
+            api.url, retry=kubeapply.RetryPolicy(attempts=2, base_s=0.01))
+        with informer.Informer(client, NODES, window_s=5) as inf:
+            with pytest.raises(kubeapply.ApplyError, match="denied"):
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline:
+                    inf.wait_synced(0.2)  # sync lands, then the denial
+                    time.sleep(0.05)
+        client.close()
+
+
+# ------------------------------------------------- watch-driven admission
+
+
+def test_admission_idle_pass_issues_zero_lists_after_sync():
+    """THE sublinear pin: at 1000 nodes, an armed admission controller
+    holding informers reads the world exactly once (paginated sync);
+    every later pass — idle or admitting — touches the apiserver only
+    to WRITE decisions. Zero LISTs, zero GETs after sync."""
+    store = fleet_store(1000, pods_per_node=0)
+    with FakeApiServer(auto_ready=True, store=store) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY)
+        ctrl = admission.AdmissionController(client, NS)
+        informers = ctrl.build_informers(page_limit=250)
+        try:
+            informers.start()
+            assert informers.wait_synced(30)
+            first = ctrl.step()  # bootstrap CM read happens here, once
+            assert first.gangs == 0
+
+            def non_watch_requests():
+                # a watch WINDOW expiring mid-test re-opens its stream
+                # (one ?watch=1 GET, O(streams) — the legitimate
+                # backstop); the pin is that passes never READ
+                return sum(1 for _m, p in api.log if "watch=1" not in p)
+
+            synced_at = non_watch_requests()
+            for _ in range(5):
+                result = ctrl.step()
+                assert result.gangs == 0
+            assert non_watch_requests() == synced_at, \
+                "idle admission passes touched the apiserver"
+
+            # a submitted gang arrives as a watch EVENT; the admitting
+            # pass reads nothing — its wire traffic is pure mutation
+            client.apply(admission.gang_job_manifest("g1", "v5e-16", NS))
+            assert informers.wait_any_event(5.0)
+            deadline = time.monotonic() + 5
+            admitted = []
+            while not admitted and time.monotonic() < deadline:
+                admitted = ctrl.step().newly_admitted
+                if not admitted:
+                    informers.wait_any_event(0.2)
+            assert admitted == ["g1"]
+            post_sync = api.log[synced_at:]
+            reads = [e for e in post_sync
+                     if e[0] == "GET" and "watch=1" not in e[1]]
+            # the submit's own apply does a capability GET at most; the
+            # CONTROLLER contributed none — no nodes/jobs LIST at all
+            assert not _collection_lists(post_sync, NODES)
+            assert not _collection_lists(post_sync, JOBS)
+            assert all("/jobs/" in p or "/configmaps/" in p
+                       for _m, p in reads), reads
+        finally:
+            informers.stop()
+            client.close()
+
+
+def test_run_watch_arbitrates_on_events_with_resync_backstop():
+    with FakeApiServer(auto_ready=True,
+                       store=fleet_store(4, pods_per_node=0)) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY)
+        client.apply(admission.gang_job_manifest("gw", "v5e-16", NS))
+        ctrl = admission.AdmissionController(client, NS)
+        results = []
+        ctrl.run_watch(resync=0.1, max_passes=3, on_pass=results.append)
+        client.close()
+        assert len(results) == 3
+        assert "gw" in results[0].newly_admitted + results[0].admitted
+        assert ctrl.informers is None  # run_watch owns + tears down
+
+
+def test_run_watch_fails_loudly_when_an_informer_dies():
+    """A watch denied non-retryably AFTER sync freezes the cache; the
+    event loop must raise out (InformerSet.check every wake), never
+    keep arbitrating — draining gangs against a stale snapshot —
+    forever."""
+    with FakeApiServer(auto_ready=True, reject_watch={NODES: 403},
+                       store=fleet_store(5, pods_per_node=0)) as api:
+        client = kubeapply.Client(
+            api.url, retry=kubeapply.RetryPolicy(attempts=2, base_s=0.01))
+        ctrl = admission.AdmissionController(client, NS)
+        with pytest.raises(kubeapply.ApplyError, match="informer"):
+            ctrl.run_watch(resync=0.05, max_passes=1000)
+        assert ctrl.informers is None  # torn down on the error path too
+        client.close()
+
+
+def test_step_refuses_an_unsynced_informer_cache():
+    """build_informers() + step() before the sync landed must raise,
+    never arbitrate: an unsynced snapshot is an EMPTY world, and a pass
+    over it sees zero live gangs — rebuilding the reservation table as
+    empty and un-seating every admitted gang at the Allocate
+    enforcement point. run_watch() syncs first; direct drivers must
+    wait_synced() themselves."""
+    with FakeApiServer(auto_ready=True,
+                       store=fleet_store(5, pods_per_node=0)) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY)
+        ctrl = admission.AdmissionController(client, NS)
+        ctrl.build_informers()  # attached but never started/synced
+        with pytest.raises(kubeapply.ApplyError, match="not synced"):
+            ctrl.step()
+        # nothing was published against the empty view
+        assert not [e for e in api.log if e[0] in MUTATING]
+        client.close()
+
+
+def test_cli_grows_fleet_flags():
+    from tpu_cluster.__main__ import build_parser
+    ap = build_parser()
+    args = ap.parse_args(["admission", "--apiserver", "http://x",
+                          "--watch", "--mux", "4", "--page-limit", "200"])
+    assert args.watch and args.mux == 4 and args.page_limit == 200
+    args = ap.parse_args(["apply", "--apiserver", "http://x"])
+    assert args.mux == 0 and args.page_limit == 0  # defaults OFF

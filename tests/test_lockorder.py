@@ -229,7 +229,8 @@ def test_soak_graph_is_cycle_free_and_pinned():
     # flat — at most one lock at a time. A new edge is a design change
     # to review, and an edge INTO the probe lock would close a cycle.
     flat_files = ("kubeapply.py", "telemetry.py", "verify.py",
-                  "lockorder.py", "conlint.py", "admission.py")
+                  "lockorder.py", "conlint.py", "admission.py",
+                  "informer.py", "muxhttp.py")
     nested = _interesting(edges, flat_files)
     probe = "kubeapply.py:Client._ssa_probe_lock"
     unexpected = {e: s for e, s in nested.items() if e[0] != probe}
@@ -317,3 +318,52 @@ def test_site_naming_is_stable_and_meaningful():
     assert probe.name == "kubeapply.py:Client._ssa_probe_lock"
     assert probe.reentrant
     client.close()
+
+
+def test_informer_locks_stay_leaf_only():
+    """The fleet informer's lock discipline (ISSUE 11): the cache lock
+    (``_lock``/``_cond``) and the connection handoff lock
+    (``_conn_lock``) are LEAF-ONLY — every apiserver round trip,
+    telemetry emission and consumer ``notify`` happens outside them —
+    so a watch-driven admission loop over the cache contributes ZERO
+    outgoing informer edges to the process graph. (The soak pin's
+    flat_files names informer.py/muxhttp.py too; this drives the full
+    sync → event → 410-resume → wake cycle so the edge set is populated
+    even when run alone.)"""
+    monitor = lockorder.installed()
+    if monitor is None:
+        pytest.skip("lock-order monitor disabled (TPU_LOCKORDER=0)")
+    from fake_apiserver import fleet_store
+    from tpu_cluster import admission
+    tel = telemetry.Telemetry()
+    with FakeApiServer(auto_ready=True,
+                       store=fleet_store(40, pods_per_node=0)) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY,
+                                  telemetry=tel, list_page_limit=20)
+        client.apply(admission.gang_job_manifest(
+            "lk-fleet", "v5e-16", "tpu-system"))
+        ctrl = admission.AdmissionController(client, "tpu-system",
+                                             telemetry=tel)
+        informers = ctrl.build_informers(page_limit=20)
+        try:
+            informers.start()
+            assert informers.wait_synced(30)
+            ctrl.step()
+            api.touch("/api/v1/nodes/fleet-0001")  # event path
+            api.flap()  # the 410 full-resync path
+            deadline = time.monotonic() + 10
+            nodes_inf = informers.informers[admission.NODES_PATH]
+            while nodes_inf.relists < 2 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            # the 410-resume path must actually have run, or the edge
+            # set this test exists to populate was never exercised
+            assert nodes_inf.relists == 2, nodes_inf.relists
+            ctrl.step()
+        finally:
+            informers.stop()
+            client.close()
+    edges = monitor.snapshot_edges()
+    outgoing = {e: s for e, s in edges.items()
+                if "informer.py" in e[0] or "muxhttp.py" in e[0]}
+    assert outgoing == {}, \
+        f"informer lock held across another acquisition: {outgoing}"

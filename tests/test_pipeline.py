@@ -1278,6 +1278,19 @@ def test_bench_rollout_json_line_meets_targets():
     gang = doc["gang"]
     assert gang["race_admitted"] == 1 and gang["race_queued"] == 1, gang
     assert gang["preemptions"] >= 1 and gang["preemptor_admitted"], gang
+    # the fleet column (ISSUE 11): a 50x node-count jump must not even
+    # double the rollout's request bill (O(bundle), not O(nodes)); the
+    # 100-queued-gang decision pass is span-derived and bounded; idle
+    # watch-driven admission passes cost ZERO requests after sync, with
+    # exactly one full LIST per collection (nodes + jobs) ever paid
+    fleet = doc["fleet"]
+    assert fleet["cold"]["nodes"] == 1000, fleet
+    assert fleet["request_ratio_vs_baseline"] <= 2.0, fleet
+    adm = fleet["admission"]
+    assert adm["gangs"] == 100, adm
+    assert adm["decision_latency_s"] <= 10.0, adm
+    assert adm["idle_pass_requests"] == 0, adm
+    assert adm["relists"] == 2, adm
     assert gang["partial_allocations"] == 0, gang
     assert gang["full_host_groups_admitted"] == 2, gang
     assert gang["admission_latency_s"] > 0, gang

@@ -129,7 +129,12 @@ def _rest_client(args):
     return kubeapply.Client(
         args.apiserver, token=token, ca_file=args.ca_file,
         insecure_skip_tls_verify=args.insecure_skip_tls_verify,
-        retry=_retry_policy(args))
+        retry=_retry_policy(args),
+        # fleet-scale knobs (ISSUE 11): the multiplexed transport pool
+        # and the paginated-LIST page size (both default OFF — the
+        # pre-fleet byte-identical paths)
+        mux=(getattr(args, "mux", None) or None),
+        list_page_limit=(getattr(args, "page_limit", None) or None))
 
 
 def _kubectl_mode_flags_ok(args, cmd: str) -> bool:
@@ -460,6 +465,17 @@ def cmd_admission(args) -> int:
     try:
         if args.once:
             print(ctrl.step().line())
+        elif args.watch:
+            print(f"admission: watch-driven arbitration in namespace "
+                  f"{ns} (informers over nodes + jobs; resync backstop "
+                  f"{args.interval:g}s; ctrl-c to stop)")
+
+            def _report(result) -> None:
+                if (result.newly_admitted or result.preempted
+                        or result.drained):
+                    print(result.line())
+
+            ctrl.run_watch(resync=args.interval, on_pass=_report)
         else:
             print(f"admission: arbitrating gangs in namespace {ns} every "
                   f"{args.interval:g}s (ctrl-c to stop)")
@@ -657,6 +673,19 @@ def build_parser() -> argparse.ArgumentParser:
     conn.add_argument("--retry-base", type=float, default=0.1,
                       help="first retry backoff in seconds, doubling per "
                            "attempt up to a 5s cap (default 0.1)")
+    conn.add_argument("--mux", type=int, default=0, metavar="POOL",
+                      help="multiplexed transport (fleet scale): route "
+                           "every request through one shared pool of at "
+                           "most POOL persistent connections — sockets "
+                           "O(pool) instead of O(worker threads); 0 "
+                           "(default) keeps the per-thread keep-alive "
+                           "transport")
+    conn.add_argument("--page-limit", type=int, default=0, metavar="N",
+                      help="paginated LISTs (fleet scale): chase "
+                           "?limit=N&continue= pages instead of one "
+                           "giant LIST body — the 410-resume re-sync "
+                           "stays bounded at 1000 nodes; 0 (default) = "
+                           "unpaginated")
 
     p = sub.add_parser("render", help="render artifacts from a cluster-spec")
     p.add_argument("--spec", default="", help="cluster-spec YAML path "
@@ -838,6 +867,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "(CI/scripting mode)")
     p.add_argument("--interval", type=float, default=1.0,
                    help="seconds between admission passes (default 1)")
+    p.add_argument("--watch", action="store_true",
+                   help="event-driven mode (fleet scale): hold one "
+                        "LIST+watch informer per collection (nodes + "
+                        "jobs) and re-arbitrate on EVENTS instead of "
+                        "LISTing the world every pass — an idle pass "
+                        "issues zero apiserver reads after the initial "
+                        "sync; --interval becomes the resync backstop")
     p.add_argument("--trace-out", default="", metavar="PATH",
                    help="write the admission spans as Chrome trace-event "
                         "JSON (merge with rollout traces via `tpuctl "

@@ -411,10 +411,18 @@ class AdmissionController:
     ``_lock`` — the lock guards pure state and never nests."""
 
     def __init__(self, client: kubeapply.Client, namespace: str,
-                 telemetry: Optional[_telemetry.Telemetry] = None) -> None:
+                 telemetry: Optional[_telemetry.Telemetry] = None,
+                 informers: Optional[Any] = None) -> None:
         self.client = client
         self.namespace = namespace
         self.telemetry = telemetry
+        # Watch-driven mode (ISSUE 11): an informer.InformerSet holding
+        # the nodes + jobs collections. When attached (and synced),
+        # _read_cluster reads SNAPSHOTS instead of LISTing — a pass
+        # costs zero apiserver reads, and run_watch wakes on events
+        # instead of polling. None (default) = the PR 10 poll shape,
+        # unchanged.
+        self.informers = informers
         self._lock = threading.Lock()
         self._admitted: Dict[str, Reservation] = {}  # guarded-by: _lock
         self._decisions: Dict[str, Decision] = {}  # guarded-by: _lock
@@ -439,13 +447,33 @@ class AdmissionController:
 
     # ------------------------------------------------------------- I/O
 
+    def _jobs_path(self) -> str:
+        return f"/apis/batch/v1/namespaces/{self.namespace}/jobs"
+
     def _read_cluster(self) -> Tuple[List[HostCapacity], List[GangRequest],
                                      Dict[str, Mapping[str, Any]]]:
-        nodes = self.client.list_collection(NODES_PATH)
+        if self.informers is not None:
+            # watch-driven: the informer caches ARE the cluster view —
+            # an idle pass costs zero LISTs (the O(events) contract,
+            # pinned by tests/test_fleet.py). Guard BEFORE reading: a
+            # dead informer's cache is frozen and an unsynced one is
+            # EMPTY — arbitrating over either would see zero live gangs
+            # and publish an empty reservation table, un-seating every
+            # admitted gang at the Allocate enforcement point.
+            # run_watch() syncs before its first pass; a caller driving
+            # step() directly must wait_synced() first.
+            self.informers.check()
+            if not self.informers.synced():
+                raise kubeapply.ApplyError(
+                    "admission: informer cache not synced — call "
+                    "InformerSet.wait_synced() before step()")
+            nodes = self.informers.snapshot(NODES_PATH)
+            jobs = self.informers.snapshot(self._jobs_path())
+        else:
+            nodes = self.client.list_collection(NODES_PATH)
+            jobs = self.client.list_collection(self._jobs_path())
         hosts = [h for h in (host_capacity(n) for n in nodes.values())
                  if h is not None]
-        jobs = self.client.list_collection(
-            f"/apis/batch/v1/namespaces/{self.namespace}/jobs")
         gangs: List[GangRequest] = []
         by_job: Dict[str, Mapping[str, Any]] = {}
         for obj in jobs.values():
@@ -672,6 +700,65 @@ class AdmissionController:
                     return
             else:
                 time.sleep(interval)
+
+    def build_informers(self, page_limit: int = 0,
+                        window_s: int = 30) -> Any:
+        """Construct (and attach) the watch-driven cluster view: one
+        informer each for the Node collection and this namespace's Jobs,
+        sharing one wake signal. Caller starts/stops it (or uses
+        :meth:`run_watch`, which does both)."""
+        from . import informer as informermod
+        limit = page_limit or informermod.DEFAULT_PAGE_LIMIT
+        self.informers = informermod.InformerSet(
+            self.client, [NODES_PATH, self._jobs_path()],
+            telemetry=self.telemetry, page_limit=limit,
+            window_s=window_s)
+        return self.informers
+
+    def run_watch(self, resync: float = 30.0,
+                  stop: Optional[threading.Event] = None,
+                  max_passes: int = 0,
+                  on_pass: Optional[Any] = None) -> None:
+        """The event-driven loop (``tpuctl admission --watch``): sync
+        the informers, arbitrate once, then re-arbitrate ONLY when a
+        watch event lands (or the ``resync`` interval elapses as a
+        backstop) — O(events) per wake instead of O(nodes) per tick; an
+        idle fleet costs zero apiserver reads between passes."""
+        informers = self.informers
+        own = informers is None
+        if own:
+            # inherit the client's --page-limit: the flag advertises
+            # bounding exactly this sync (0/None -> the informer default)
+            informers = self.build_informers(
+                page_limit=self.client.list_page_limit or 0)
+        assert informers is not None
+        try:
+            if own:
+                informers.start()
+            if not informers.wait_synced(timeout=max(resync, 30.0)):
+                raise kubeapply.ApplyError(
+                    "admission informers never synced")
+            done = 0
+            while stop is None or not stop.is_set():
+                # a dead informer means the cache is FROZEN: raising
+                # here (NOT swallowed below — the swallow is for
+                # transient publish failures) beats silently draining
+                # gangs against a stale world forever
+                informers.check()
+                try:
+                    result = self.step()
+                    if on_pass is not None:
+                        on_pass(result)
+                except kubeapply.ApplyError:
+                    pass  # the loop is the outer retry, like run()
+                done += 1
+                if max_passes and done >= max_passes:
+                    return
+                informers.wait_any_event(resync)
+        finally:
+            if own:
+                informers.stop()
+                self.informers = None
 
 
 # --------------------------------------------------------------------------

@@ -350,10 +350,18 @@ class RetryPolicy:
         cannot park the rollout); otherwise exponential from ``base_s``
         with +/-``jitter`` so a fleet retrying the same blip doesn't
         re-synchronize into a thundering herd."""
-        if retry_after is not None:
-            return max(0.0, min(retry_after, self.cap_s))
         delay = min(self.cap_s, self.base_s * (2 ** (max(1, attempt) - 1)))
-        return delay * (1 - self.jitter + 2 * self.jitter * random.random())
+        delay *= 1 - self.jitter + 2 * self.jitter * random.random()
+        if retry_after is not None:
+            # the server's delay is a FLOOR, not an appointment: a whole
+            # fleet shed at once (APF 429s) that honors the same
+            # Retry-After verbatim re-arrives in lockstep and is shed
+            # again, forever — never return EARLIER than the server
+            # asked, but keep the escalating jittered exponential on
+            # top so persistent overload spreads the herd out (pinned
+            # by test_fleet's storm-absorption test)
+            return max(max(0.0, min(retry_after, self.cap_s)), delay)
+        return delay
 
 
 # Single-try policy: for probes that own their own retry cadence (or tests
@@ -653,6 +661,19 @@ class Client:
     # Persistent per-thread connection reuse. Off = a fresh urllib socket
     # per request (the original transport, the bench's sequential arm).
     keep_alive: bool = True
+    # Multiplexed transport (ISSUE 11): a pool size N routes every
+    # non-hedged request through ONE shared asyncio transport holding at
+    # most N persistent connections — the socket count becomes O(pool)
+    # instead of O(worker threads), and demand beyond the pool queues on
+    # it instead of opening sockets. None (default) = the thread
+    # transports above, byte-identical (no transport object is even
+    # created — the parity pin in tests/test_fleet.py).
+    mux: Optional[int] = None
+    # Paginated LIST page size (ISSUE 11): when set, list_collection and
+    # the watch 410-resume re-LIST chase ?limit=/?continue= pages via
+    # list_paged, so a 1000-node re-sync never buffers one giant body.
+    # None (default) = single unpaginated GET, unchanged.
+    list_page_limit: Optional[int] = None
     # The uniform failure taxonomy (None -> the default RetryPolicy):
     # every _request converges through it, so apply/wait/delete inherit
     # retries without per-call plumbing.
@@ -700,6 +721,15 @@ class Client:
         # thread holds it across its round trip and then writes the
         # answer through it.
         self._ssa_probe_lock = threading.RLock()
+        # The shared multiplexed transport, created EAGERLY when mux is
+        # set (construction is cheap; lazy creation would need a lock in
+        # the request hot path). None = feature off, no code-path change.
+        self._mux_transport: Any = None
+        if self.mux:
+            from . import muxhttp
+            self._mux_transport = muxhttp.MuxTransport(
+                self.base_url, pool_size=int(self.mux),
+                timeout=self.timeout, tls_context=self._tls_context())
 
     # ------------------------------------------------------------ transport
 
@@ -896,6 +926,8 @@ class Client:
                 conn.close()
             except OSError:
                 pass
+        if self._mux_transport is not None:
+            self._mux_transport.close()
 
     def reap_other_connections(self) -> None:
         """Close every pooled connection EXCEPT the calling thread's.
@@ -1043,6 +1075,58 @@ class Client:
                 return 0, _transport_error(exc), None
         raise AssertionError("unreachable: both attempts return")
 
+    def _request_mux(
+            self, method: str, path: str, data: Optional[bytes],
+            content_type: str
+    ) -> Tuple[int, Dict[str, Any], Optional[float]]:
+        """One request through the shared multiplexed transport
+        (``mux=N``): same whole-attempt wall, same status-0
+        classification (deadline / stale-with-one-fresh-retry / garbage
+        / transport) as the keep-alive path, but the socket underneath
+        comes from the bounded shared pool instead of this thread."""
+        from . import muxhttp
+        transport = self._mux_transport
+        assert transport is not None
+        wall = self._attempt_wall()
+        for attempt in (0, 1):
+            span_id, tp = self._attempt_context()
+            t0 = time.monotonic()
+            try:
+                status, rheaders, payload = transport.request(
+                    method, path,
+                    self._headers(data is not None, content_type,
+                                  traceparent=tp), data, wall)
+            except muxhttp.MuxDeadline:
+                self._note_attempt(method, path, 0,
+                                   time.monotonic() - t0, span_id=span_id,
+                                   deadline=True, mux=True)
+                return 0, _attempt_deadline_error(wall), None
+            except muxhttp.MuxStale as exc:
+                self._note_attempt(method, path, 0,
+                                   time.monotonic() - t0, span_id=span_id,
+                                   stale=True, mux=True)
+                if attempt == 0:
+                    # idle pooled conn the server closed: one immediate
+                    # fresh attempt, like the keep-alive stale retry
+                    continue
+                return 0, _transport_error(exc.cause), None
+            except muxhttp.MuxError as exc:
+                self._note_attempt(method, path, 0,
+                                   time.monotonic() - t0, span_id=span_id,
+                                   mux=True)
+                return 0, _transport_error(exc.cause), None
+            code, parsed, garbage = self._classify_payload(status, payload)
+            if garbage:
+                self._note_attempt(method, path, 0,
+                                   time.monotonic() - t0, span_id=span_id,
+                                   garbage=True, mux=True)
+                return 0, parsed, None
+            self._note_attempt(method, path, code, time.monotonic() - t0,
+                               span_id=span_id, mux=True)
+            return code, parsed, _retry_after_s(
+                rheaders.get("retry-after"))
+        raise AssertionError("unreachable: both attempts return")
+
     def _request_oneshot(
             self, method: str, path: str, data: Optional[bytes],
             content_type: str
@@ -1133,20 +1217,31 @@ class Client:
         policy = self.retry or NO_RETRY
         budget = self.budget
         attempt = 0
+        saw_429 = False
         while True:
             attempt += 1
             if budget is not None and budget.exhausted():
                 raise self._deadline_error(f"{method} {path}")
             if self.hedge_s is not None and method == "GET" \
-                    and data is None:
+                    and data is None and not saw_429:
                 code, parsed, retry_after = self._request_hedged(
                     method, path)
+            elif self._mux_transport is not None:
+                code, parsed, retry_after = self._request_mux(
+                    method, path, data, content_type)
             elif self.keep_alive:
                 code, parsed, retry_after = self._request_keepalive(
                     method, path, data, content_type)
             else:
                 code, parsed, retry_after = self._request_oneshot(
                     method, path, data, content_type)
+            if code == 429:
+                # APF-style load shedding: the retry of a throttled read
+                # must NEVER hedge — a backup attempt against a server
+                # that just said "too much in flight" amplifies exactly
+                # the storm it is shedding (pinned by test_fleet's
+                # never-hedge-a-429 test)
+                saw_429 = True
             if code not in policy.retryable or attempt >= policy.attempts:
                 return code, parsed
             with self._retry_lock:
@@ -1307,6 +1402,12 @@ class Client:
         helper = threading.Thread(target=backup, daemon=True)
         helper.start()
         try:
+            # even with mux armed, BOTH hedge attempts deliberately
+            # bypass the shared pool onto dedicated connections: a
+            # hedge exists to race a slow transport, and a backup
+            # queued behind the very pool it is hedging around (or a
+            # sever that kills a pooled socket other requests share)
+            # would defeat it
             if self.keep_alive:
                 code, parsed, retry_after = self._request_keepalive(
                     method, path, None, "", conn_holder=primary_conn)
@@ -1327,11 +1428,19 @@ class Client:
     def get(self, path: str) -> Tuple[int, Dict[str, Any]]:
         return self._request("GET", path)
 
-    def list_collection(self, path: str) -> Dict[str, Dict[str, Any]]:
+    def list_collection(self, path: str,
+                        limit: Optional[int] = None
+                        ) -> Dict[str, Dict[str, Any]]:
         """LIST one collection -> {name: live object}. 404 is an EMPTY
         collection, not an error: a CRD-backed collection doesn't exist
         before its CRD is Established, and the pipelined prefetch must
-        treat that exactly like 'no CRs yet'."""
+        treat that exactly like 'no CRs yet'. ``limit`` (or the
+        client-wide ``list_page_limit``) switches to the paginated
+        ``?limit=/?continue=`` chase — same result, bounded bodies."""
+        if limit is None:
+            limit = self.list_page_limit
+        if limit:
+            return self.list_paged(path, limit)[0]
         code, resp = self.get(path)
         if code == 404:
             return {}
@@ -1339,6 +1448,62 @@ class Client:
             raise ApplyError(
                 f"LIST {path}: {code} {(resp or {}).get('message', resp)}")
         return _index_items(resp)
+
+    def list_paged(self, path: str, limit: int
+                   ) -> Tuple[Dict[str, Dict[str, Any]], str, int]:
+        """LIST one collection in ``limit``-sized pages, chasing
+        ``metadata.continue`` tokens transparently (apiserver chunked-
+        LIST semantics): ``({name: obj}, resourceVersion, pages)`` —
+        the resourceVersion is the FIRST page's snapshot, exactly where
+        a watch resumes from. An EXPIRED continue token mid-chase (410
+        Gone, the apiserver compacted past the snapshot) restarts the
+        whole chase from a clean first page — never a partial result —
+        bounded at two restarts before failing loudly. Every fetched
+        page bumps ``tpuctl_list_pages_total{collection=}``."""
+        tel = self.telemetry
+        restarts = 0
+        while True:
+            items: Dict[str, Dict[str, Any]] = {}
+            rv = ""
+            token = ""
+            pages = 0
+            expired = False
+            while True:
+                query = f"?limit={int(limit)}"
+                if token:
+                    query += "&continue=" + urllib.parse.quote(token,
+                                                               safe="")
+                code, resp = self.get(path + query)
+                if code == 404:
+                    # absent collection = empty (first page), or the
+                    # collection vanished mid-chase: the tail is empty
+                    return items, rv, pages
+                if code == 410 and token:
+                    expired = True
+                    break
+                if code != 200:
+                    raise ApplyError(
+                        f"LIST {path}: {code} "
+                        f"{(resp or {}).get('message', resp)}")
+                pages += 1
+                if tel is not None:
+                    tel.counter(_telemetry.LIST_PAGES_TOTAL,
+                                "paginated LIST pages fetched",
+                                collection=path).inc()
+                items.update(_index_items(resp))
+                meta = (resp or {}).get("metadata") or {}
+                rv = str(meta.get("resourceVersion") or rv)
+                token = str(meta.get("continue") or "")
+                if not token:
+                    return items, rv, pages
+            assert expired
+            restarts += 1
+            if restarts > 2:
+                raise ApplyError(
+                    f"LIST {path}: continue token expired on "
+                    f"{restarts} consecutive chases")
+            if tel is not None:
+                tel.event("list-continue-expired", collection=path)
 
     def _annotated(self, obj: Dict[str, Any]) -> Dict[str, Any]:
         """The object as sent on a MUTATING apply: with telemetry armed,
@@ -1786,18 +1951,29 @@ class Client:
         def relist() -> str:
             """LIST, resolve already-ready members, return the RV the
             watch resumes from ('' when the collection doesn't exist yet
-            or the LIST is denied — the latter degrades)."""
-            bump()
-            code, listing = self.get(coll)
-            if code == 200:
-                items = _index_items(listing)
-                rv = str((listing.get("metadata") or {})
-                         .get("resourceVersion") or "")
-            elif code == 404:
-                items, rv = {}, ""
+            or the LIST is denied — the latter degrades). With
+            ``list_page_limit`` set the LIST is the paginated chase
+            (ISSUE 11): a 410-resume against a fleet-sized collection
+            re-syncs page by page instead of buffering one giant body."""
+            if self.list_page_limit:
+                try:
+                    items, rv, pages = self.list_paged(
+                        coll, self.list_page_limit)
+                except ApplyError as exc:
+                    raise _WatchDenied(0, str(exc))
+                bump(max(1, pages))
             else:
-                raise _WatchDenied(
-                    code, (listing or {}).get("message", listing))
+                bump()
+                code, listing = self.get(coll)
+                if code == 200:
+                    items = _index_items(listing)
+                    rv = str((listing.get("metadata") or {})
+                             .get("resourceVersion") or "")
+                elif code == 404:
+                    items, rv = {}, ""
+                else:
+                    raise _WatchDenied(
+                        code, (listing or {}).get("message", listing))
             for name in list(pending):
                 if _seed_ready(items.get(name), pending[name],
                                allow_empty_daemonsets):

@@ -114,6 +114,16 @@ VERIFY_KUBECTL_CALLS = "tpuctl_verify_kubectl_calls_total"
 ADMISSIONS_TOTAL = "tpuctl_admissions_total"
 PREEMPTIONS_TOTAL = "tpuctl_preemptions_total"
 GANG_WAIT_SECONDS = "tpuctl_gang_wait_seconds"
+# Fleet-scale control plane (ISSUE 11): paginated-LIST and informer
+# families. LIST_PAGES counts every page of a limit/continue chase (the
+# 1000-node re-sync audit); the informer families are the watch-cache's
+# vitals — events applied, full re-LISTs (initial sync / 410 resume;
+# an idle fleet holds this at its post-sync value, the zero-LIST pin),
+# and the lag from event receipt to cache-applied-and-notified.
+LIST_PAGES_TOTAL = "tpuctl_list_pages_total"
+INFORMER_EVENTS_TOTAL = "tpuctl_informer_events_total"
+INFORMER_RELISTS_TOTAL = "tpuctl_informer_relists_total"
+INFORMER_LAG_SECONDS = "tpuctl_informer_lag_seconds"
 
 # Fixed default buckets, request-latency shaped (seconds). Shared with
 # the ready-wait histogram: its tail rides the +Inf bucket.
