@@ -13,4 +13,5 @@ exec "${PYTHON:-python3}" -m mypy --strict \
   tpu_cluster/lint.py tpu_cluster/spec.py tpu_cluster/topology.py \
   tpu_cluster/kubeapply.py tpu_cluster/telemetry.py \
   tpu_cluster/conlint.py tpu_cluster/verify.py tpu_cluster/admission.py \
-  tpu_cluster/informer.py tpu_cluster/muxhttp.py
+  tpu_cluster/informer.py tpu_cluster/muxhttp.py tpu_cluster/events.py \
+  tpu_cluster/slo.py

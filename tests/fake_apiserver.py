@@ -610,7 +610,8 @@ class FakeApiServer:
                  ssa_unsupported: bool = False,
                  continue_ttl_s: float = 300.0,
                  apf_inflight_budget: Optional[int] = None,
-                 apf_retry_after_s: float = 0.05):
+                 apf_retry_after_s: float = 0.05,
+                 event_ttl_s: Optional[float] = None):
         self.auto_ready = auto_ready
         # An apiserver predating server-side apply: every
         # application/apply-patch+yaml PATCH answers 415, the capability
@@ -684,6 +685,19 @@ class FakeApiServer:
         # Paginated-LIST continuation pages served, by collection path
         # (ISSUE 11): the server-side half of the pagination audit.
         self.list_pages: Dict[str, int] = {}  # guarded-by: _responses_lock
+        # ------------------------------------------------------ events
+        # (ISSUE 12): real core/v1 Event semantics. POSTed Events are
+        # counted by reason (fake_apiserver_events_total on the
+        # scrape), stamped with a creation instant, and TTL-compacted
+        # the way a real apiserver GCs Events after --event-ttl:
+        # event_ttl_s set -> every Event POST first sweeps Events older
+        # than the TTL out of the store (watch DELETED events emitted;
+        # compact_events() is the explicit test hook). None (default) =
+        # Events never expire, byte-identical handling.
+        self.event_ttl_s = event_ttl_s
+        self.events_posted: Dict[str, int] = {}  # guarded-by: _responses_lock
+        self.events_compacted = 0  # guarded-by: _responses_lock
+        self._event_created: Dict[str, float] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         # -------------------------------------------------- pagination
         # (ISSUE 11): collection GETs honor ?limit=N and ?continue=TOK
@@ -1219,7 +1233,8 @@ class FakeApiServer:
                 # Real apiserver core/v1 Event validation: the Event's
                 # namespace must agree with involvedObject.namespace —
                 # 'default' when the involved object is cluster-scoped.
-                if obj.get("kind") == "Event":
+                is_event = obj.get("kind") == "Event"
+                if is_event:
                     ev_ns = obj.get("metadata", {}).get("namespace", "")
                     inv_ns = obj.get("involvedObject", {}).get(
                         "namespace", "")
@@ -1228,6 +1243,10 @@ class FakeApiServer:
                             "message": "event namespace does not match "
                                        "involvedObject namespace"})
                         return
+                    # TTL sweep BEFORE storing (the arriving Event is
+                    # by definition the newest); takes fake._lock, so
+                    # it must run outside the store hold below
+                    fake.compact_events()
                 path = f"{self.path.partition('?')[0]}/{name}"
                 with fake._lock:
                     if path in fake.store:
@@ -1235,6 +1254,10 @@ class FakeApiServer:
                                           "reason": "AlreadyExists"})
                         return
                     obj = self._finalize_create_locked(path, obj)
+                    if is_event:
+                        fake._event_created[path] = time.monotonic()
+                if is_event:
+                    fake._note_event_posted(str(obj.get("reason", "")))
                 self._reply(201, obj)
 
             def do_PUT(self):
@@ -1512,6 +1535,37 @@ class FakeApiServer:
         with self._responses_lock:
             self.list_pages[path] = self.list_pages.get(path, 0) + 1
 
+    # ------------------------------------------------------------- events
+
+    def _note_event_posted(self, reason: str) -> None:
+        """Count one stored Event create by reason — published as
+        fake_apiserver_events_total{reason}."""
+        with self._responses_lock:
+            self.events_posted[reason] = \
+                self.events_posted.get(reason, 0) + 1
+
+    def compact_events(self) -> List[str]:
+        """TTL-compact stored Events (a real apiserver GCs Events after
+        ``--event-ttl``, default 1h): every Event older than
+        ``event_ttl_s`` leaves the store with a watch DELETED event.
+        Runs automatically before each Event POST; this is also the
+        explicit test hook. No-op (empty list) when event_ttl_s is
+        None."""
+        if self.event_ttl_s is None:
+            return []
+        cutoff = time.monotonic() - self.event_ttl_s
+        with self._lock:
+            victims = sorted(p for p, t in self._event_created.items()
+                             if t <= cutoff)
+            for p in victims:
+                self._event_created.pop(p, None)
+                if self.store.pop(p, None) is not None:
+                    self._note_change(p)
+        if victims:
+            with self._responses_lock:
+                self.events_compacted += len(victims)
+        return victims
+
     # --------------------------------------------------------- pagination
 
     # requires: self._lock
@@ -1686,6 +1740,16 @@ class FakeApiServer:
         lines.append("# TYPE fake_apiserver_apf_rejections_total counter")
         lines.append('fake_apiserver_apf_rejections_total'
                      f'{{reason="inflight"}} {rejected}')
+        with self._responses_lock:
+            ev_rows = sorted(self.events_posted.items())
+            compacted = self.events_compacted
+        lines.append("# TYPE fake_apiserver_events_total counter")
+        for reason, n in ev_rows:
+            lines.append(
+                f'fake_apiserver_events_total{{reason='
+                f'"{prom_escape(reason)}"}} {n}')
+        lines.append("# TYPE fake_apiserver_events_compacted_total counter")
+        lines.append(f"fake_apiserver_events_compacted_total {compacted}")
         return "\n".join(lines) + "\n"
 
     # ------------------------------------------------------------- watch

@@ -671,3 +671,122 @@ def test_rendered_multihost_jobs_carry_gang_annotations():
         anns = (o.get("metadata") or {}).get("annotations") or {}
         if o.get("kind") == "Job" and "multihost" not in o["metadata"]["name"]:
             assert admission.GANG_ANNOTATION not in anns
+
+
+# ----------------------------------------------------------- events
+# (ISSUE 12): each decision TRANSITION lands exactly one correlated
+# Event on the gang's Job, and a failed Event post is never retried by
+# the controller loop (fire-and-forget, unlike the annotations).
+
+
+def _gang_events(api, gang):
+    from tpu_cluster import events as eventsmod
+    out = []
+    for p in sorted(api.paths("/events/")):
+        e = api.get(p)
+        if e and eventsmod.event_matches(e, f"Job/gang-{gang}"):
+            out.append(e)
+    return out
+
+
+def test_each_decision_transition_lands_exactly_one_event():
+    """Admitted -> Drained -> ReAdmitted on one gang, Admitted ->
+    Preempted on another: every transition is exactly ONE Event on the
+    gang's Job (steady-state passes add nothing), carrying the same
+    story the gang-reason annotation tells."""
+    from tpu_cluster import events as eventsmod
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY)
+        seed_hosts(client, ("node-a", "node-b"))
+        submit_gang(client, "low", priority=0)
+        rec = eventsmod.EventRecorder(client, component="tpu-admission")
+        ctrl = admission.AdmissionController(client, NS, events=rec)
+        ctrl.step()                                    # low: Admitted
+        ctrl.step()                                    # steady state
+        submit_gang(client, "high", priority=9)
+        ctrl.step()                     # high: Admitted; low: Preempted
+        # the preemptor leaves; low re-admits out of preemption
+        client.delete(f"/apis/batch/v1/namespaces/{NS}/jobs/gang-high")
+        ctrl.step()                                    # low: ReAdmitted
+        api.set_node_ready("node-b", ready=False)
+        ctrl.step()                                    # low: Drained
+        ctrl.step()                                    # steady state
+        api.set_node_ready("node-b", ready=True)
+        ctrl.step()                                    # low: ReAdmitted
+        low = _gang_events(api, "low")
+        high = _gang_events(api, "high")
+        client.close()
+    assert [(e["reason"], e["type"], e["count"]) for e in low] == [
+        ("Admitted", "Normal", 1),
+        ("Preempted", "Warning", 1),
+        ("ReAdmitted", "Normal", 1),
+        ("Drained", "Warning", 1),
+        ("ReAdmitted", "Normal", 1),
+    ], low
+    assert [e["reason"] for e in high] == ["Admitted"]
+    drained = [e for e in low if e["reason"] == "Drained"][0]
+    assert "node-b" in drained["message"]
+    preempted = [e for e in low if e["reason"] == "Preempted"][0]
+    assert "high" in preempted["message"]
+
+
+def test_failed_event_post_is_not_retried_by_the_controller_loop():
+    """The fail-open pin (acceptance): with every Event write 403ing,
+    each decision's Event is attempted EXACTLY once across many passes
+    — the memo commits on attempt, not on success — while the decision
+    ANNOTATIONS (which do re-send until they land) still converge."""
+    from tpu_cluster import events as eventsmod
+    chaos = [{"status": 403, "method": "POST", "match": "/events"},
+             {"status": 403, "method": "PATCH", "match": "/events/"}]
+    with FakeApiServer(auto_ready=True, chaos=chaos) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY)
+        seed_hosts(client, ("node-a", "node-b"))
+        submit_gang(client, "failopen")
+        rec = eventsmod.EventRecorder(client, component="tpu-admission")
+        ctrl = admission.AdmissionController(client, NS, events=rec)
+        for _ in range(4):
+            ctrl.step()
+        event_writes = [(m, p) for m, p in api.log
+                        if "/events" in p and m in ("POST", "PATCH")]
+        job = api.get(f"/apis/batch/v1/namespaces/{NS}"
+                      "/jobs/gang-failopen")
+        client.close()
+    # ONE attempted write for the single Admitted transition — not one
+    # per pass, and no retry of the failure
+    assert len(event_writes) == 1, event_writes
+    assert rec.counts() == {"emitted": 1, "dropped": 0, "failures": 1}
+    assert api.paths("/events/") == []
+    # the annotation path is unaffected: the decision still landed
+    anns = job["metadata"]["annotations"]
+    assert anns[admission.GANG_STATUS_ANNOTATION] == "admitted"
+
+
+def test_fresh_controller_recovers_event_memo_from_annotations():
+    """Every `tpuctl admission --once` is a FRESH process. The decision
+    event memo is recovered from the gang Jobs' live annotations
+    (_seed_event_memo), so (a) a steady-state pass by a new controller
+    re-emits nothing, and (b) a gang the PREDECESSOR drained comes back
+    as ReAdmitted — not plain Admitted — exactly as the long-running
+    loop would report it."""
+    from tpu_cluster import events as eventsmod
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY)
+        seed_hosts(client, ("node-a", "node-b"))
+        submit_gang(client, "train")
+
+        def fresh_pass():
+            rec = eventsmod.EventRecorder(client,
+                                          component="tpu-admission")
+            admission.AdmissionController(client, NS,
+                                          events=rec).step()
+
+        fresh_pass()                             # Admitted
+        fresh_pass()                             # steady state: nothing
+        api.set_node_ready("node-b", ready=False)
+        fresh_pass()                             # Drained
+        api.set_node_ready("node-b", ready=True)
+        fresh_pass()                             # ReAdmitted (recovered)
+        evs = _gang_events(api, "train")
+        client.close()
+    assert [(e["reason"], e["count"]) for e in evs] == [
+        ("Admitted", 1), ("Drained", 1), ("ReAdmitted", 1)], evs

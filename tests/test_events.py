@@ -1,0 +1,626 @@
+"""Kubernetes Events pipeline suite (ISSUE 12).
+
+The recorder's client-go-shaped contracts as pinned tier-1 tests: v1
+Event shape + namespace validation, (object, reason, message)
+aggregation with count-bump PATCHes, the token-bucket spam filter, and
+the HARD fail-open contract (one wire attempt per write, never a retry,
+never an error on the hot path — a full bundle converges with 100% of
+Event writes failing). Plus the zero-overhead pin (events=None is
+byte-identical on the request+mutation multiset, the telemetry=None
+shape), the anti-spam chaos soak (a 503 burst collapses into ONE
+counted Event per object, store parity with a clean run preserved),
+transport-level wiring (Retrying/RetryExhausted/HedgeFired/
+WatchResumed), informer Relisted/SyncLost events, the fake's Event TTL
+compaction, and the `tpuctl events` CLI including --follow and the
+traceparent join."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from fake_apiserver import FakeApiServer, standard_fault_script
+from tpu_cluster import admission, events, informer, kubeapply, telemetry
+from tpu_cluster import spec as specmod
+from tpu_cluster.render import manifests
+
+NS = "tpu-system"
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FAST_RETRY = kubeapply.RetryPolicy(attempts=8, base_s=0.02, cap_s=0.3)
+MUTATING = ("POST", "PATCH", "PUT", "DELETE")
+
+NS_OBJ = {"apiVersion": "v1", "kind": "Namespace",
+          "metadata": {"name": NS}}
+CM_OBJ = {"apiVersion": "v1", "kind": "ConfigMap",
+          "metadata": {"name": "ev-cm", "namespace": NS},
+          "data": {"k": "v"}}
+
+
+def stored_events(api):
+    """Every stored Event object (path-sorted)."""
+    return [api.get(p) for p in sorted(api.paths("/events/"))]
+
+
+def event_wire_writes(api):
+    """(method, path) of every Event write that reached the fake."""
+    return [(m, p) for m, p in api.log
+            if "/events" in p and m in ("POST", "PATCH")]
+
+
+# ------------------------------------------------------------- recorder
+
+
+def test_recorder_posts_v1_event_shape_and_namespace_rule():
+    """One emit -> one stored v1 Event: involvedObject reference,
+    reason/message/type, count 1, timestamps, source component — and
+    the namespace rule (an Event about a cluster-scoped object lands in
+    'default', which the fake's validation enforces like a real
+    apiserver)."""
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        rec = events.EventRecorder(client, component="tpu-test")
+        rec.emit(NS_OBJ, "TestReason", "hello", type_="Warning")
+        rec.emit(CM_OBJ, "CmReason", "namespaced")
+        client.close()
+        evs = stored_events(api)
+    assert len(evs) == 2
+    by_reason = {e["reason"]: e for e in evs}
+    ns_ev = by_reason["TestReason"]
+    assert ns_ev["metadata"]["namespace"] == "default"  # cluster-scoped
+    assert ns_ev["involvedObject"]["kind"] == "Namespace"
+    assert ns_ev["involvedObject"]["name"] == NS
+    assert ns_ev["type"] == "Warning"
+    assert ns_ev["count"] == 1
+    assert ns_ev["firstTimestamp"] and ns_ev["lastTimestamp"]
+    assert ns_ev["source"]["component"] == "tpu-test"
+    cm_ev = by_reason["CmReason"]
+    assert cm_ev["metadata"]["namespace"] == NS
+    assert cm_ev["involvedObject"]["namespace"] == NS
+
+
+def test_identical_emits_aggregate_into_one_counted_event():
+    """The client-go correlator shape: identical (object, reason,
+    message) emits inside the window collapse into ONE Event whose
+    count is bumped via PATCH; a different message-key starts its own
+    Event."""
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        rec = events.EventRecorder(client)
+        for _ in range(4):
+            rec.emit(CM_OBJ, "Retrying", "same message")
+        rec.emit(CM_OBJ, "Retrying", "different message")
+        client.close()
+        evs = stored_events(api)
+        writes = event_wire_writes(api)
+    assert len(evs) == 2
+    counts = sorted(e["count"] for e in evs)
+    assert counts == [1, 4]
+    # 2 POSTs (one per distinct key) + 3 count-bump PATCHes
+    assert sum(1 for m, _ in writes if m == "POST") == 2
+    assert sum(1 for m, _ in writes if m == "PATCH") == 3
+    assert rec.counts() == {"emitted": 5, "dropped": 0, "failures": 0}
+
+
+def test_aggregation_window_rollover_starts_a_fresh_event():
+    """An emit past the aggregation window is a NEW Event (client-go
+    10-minute window semantics), driven via the injectable clock — no
+    sleeping."""
+    fake_now = [0.0]
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        rec = events.EventRecorder(client, window_s=10.0,
+                                   clock=lambda: fake_now[0])
+        rec.emit(CM_OBJ, "R", "m")
+        fake_now[0] = 5.0
+        rec.emit(CM_OBJ, "R", "m")  # inside: aggregates
+        fake_now[0] = 20.0
+        rec.emit(CM_OBJ, "R", "m")  # past the window: fresh Event
+        client.close()
+        evs = stored_events(api)
+    assert sorted(e["count"] for e in evs) == [1, 2]
+
+
+def test_spam_filter_token_bucket_drops_and_counts():
+    """The per-object token bucket: burst emits pass, the overflow is
+    DROPPED before any wire attempt (counted in
+    tpuctl_events_dropped_total), and a different object has its own
+    bucket."""
+    tel = telemetry.Telemetry()
+    fake_now = [0.0]
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        rec = events.EventRecorder(client, telemetry=tel, spam_burst=3,
+                                   spam_refill_per_s=0.0,
+                                   clock=lambda: fake_now[0])
+        for i in range(5):
+            rec.emit(CM_OBJ, "Spam", f"msg {i}")  # distinct keys
+        rec.emit(NS_OBJ, "Spam", "other object")  # own bucket
+        client.close()
+        evs = stored_events(api)
+        writes = event_wire_writes(api)
+    assert len(evs) == 4  # 3 from the burst + 1 for the other object
+    assert len(writes) == 4  # dropped emits never reached the wire
+    assert rec.counts()["dropped"] == 2
+    assert tel.metrics.total(telemetry.EVENTS_DROPPED_TOTAL) == 2
+    assert tel.metrics.total(telemetry.EVENTS_EMITTED_TOTAL) == 4
+
+
+def test_recorder_stamps_traceparent_annotation_when_telemetry_armed():
+    tel = telemetry.Telemetry()
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        rec = events.EventRecorder(client, telemetry=tel)
+        rec.emit(CM_OBJ, "R", "m")
+        client.close()
+        (ev,) = stored_events(api)
+    tp = ev["metadata"]["annotations"][events.TRACEPARENT_ANNOTATION]
+    parsed = telemetry.parse_traceparent(tp)
+    assert parsed is not None and parsed[0] == tel.tracer.trace_id
+
+
+# ------------------------------------------------- fail-open + parity
+
+
+def test_fail_open_pin_apply_converges_with_all_event_writes_failing():
+    """THE fail-open pin (acceptance): every Event write 403s, yet the
+    full bundle converges exactly as if events were healthy; each
+    failed write was attempted EXACTLY once (no retries — request_once
+    bypasses the RetryPolicy), and the only trace left is the
+    tpuctl_event_emit_failures_total counter."""
+    spec = specmod.default_spec()
+    groups = manifests.rollout_groups(spec)
+    tel = telemetry.Telemetry()
+    chaos = [
+        # every Event write (POST to the collection, PATCH count bumps)
+        {"status": 403, "method": "POST", "match": "/events"},
+        {"status": 403, "method": "PATCH", "match": "/events/"},
+        # plus a bounded 503 burst so the rollout actually EMITS
+        {"status": 503, "count": 3, "retry_after": 0.01,
+         "method": "PATCH", "match": f"/api/v1/namespaces/{NS}",
+         "exact": True},
+    ]
+    with FakeApiServer(auto_ready=True, chaos=chaos) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY,
+                                  telemetry=tel)
+        client.events = events.EventRecorder(client, telemetry=tel)
+        kubeapply.apply_groups(client, groups, wait=True,
+                               stage_timeout=60, poll=0.02,
+                               max_inflight=8, watch_ready=True)
+        assert client.retries >= 3, "the 503 burst never bit"
+        writes = event_wire_writes(api)
+        assert stored_events(api) == []  # nothing ever landed
+        client.close()
+    counts = client.events.counts()
+    assert counts["emitted"] >= 3
+    assert counts["failures"] == counts["emitted"], counts
+    # one wire attempt per emit — the never-retry half of the pin
+    assert len(writes) == counts["emitted"], (writes, counts)
+    assert tel.metrics.total(telemetry.EVENT_EMIT_FAILURES_TOTAL) \
+        == counts["failures"]
+
+
+def _rollout_log(api, with_events: bool):
+    groups = manifests.rollout_groups(specmod.default_spec())
+    client = kubeapply.Client(api.url)
+    if with_events:
+        client.events = events.EventRecorder(client)
+    kubeapply.apply_groups(client, groups, wait=True, stage_timeout=60,
+                           poll=0.02, max_inflight=8, watch_ready=True)
+    client.close()
+    return [(m, p.partition("?")[0]) for m, p in api.log]
+
+
+def test_events_none_parity_pin_request_and_mutation_multiset():
+    """The zero-overhead pin (acceptance), same shape as the
+    telemetry=None pin: events=None is the default, and ARMING a
+    recorder against a healthy apiserver changes neither the request
+    multiset nor the mutation multiset — a clean rollout has nothing
+    to report, so the recorder must cost zero wire traffic."""
+    assert kubeapply.Client("http://127.0.0.1:1").events is None
+    with FakeApiServer(auto_ready=True) as api:
+        baseline = _rollout_log(api, with_events=False)
+    with FakeApiServer(auto_ready=True) as api:
+        armed = _rollout_log(api, with_events=True)
+    assert sorted(baseline) == sorted(armed)
+    assert (sorted(m for m, _ in baseline if m in MUTATING)
+            == sorted(m for m, _ in armed if m in MUTATING))
+
+
+def test_anti_spam_chaos_soak_bounded_event_cardinality():
+    """The anti-spam soak (acceptance): the standard chaos script with
+    a recorder armed emits a BOUNDED Event set — at most ONE aggregated
+    Event per (involvedObject, reason, message) key, total Event
+    objects no larger than the emit count — and the store converges to
+    parity with a clean install (Events excluded: they are the run's
+    own annotations, not state)."""
+    groups = manifests.rollout_groups(specmod.default_spec())
+    with FakeApiServer(auto_ready=True) as clean_api:
+        client = kubeapply.Client(clean_api.url)
+        kubeapply.apply_groups(client, groups, wait=True,
+                               stage_timeout=60, poll=0.02,
+                               max_inflight=8)
+        client.close()
+        clean_store = set(clean_api.snapshot())
+    with FakeApiServer(auto_ready=True, latency_s=0.002,
+                       chaos=standard_fault_script(0.03)) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY)
+        client.events = events.EventRecorder(client)
+        kubeapply.apply_groups(client, groups, wait=True,
+                               stage_timeout=60, poll=0.02,
+                               max_inflight=8, watch_ready=True)
+        assert client.retries > 0, "the fault script never bit"
+        evs = [e for e in stored_events(api) if e is not None]
+        store_now = {p for p in api.snapshot() if "/events/" not in p}
+        client.close()
+    assert store_now == clean_store
+    counts = client.events.counts()
+    keys = [(e["involvedObject"]["kind"], e["involvedObject"]["name"],
+             e["reason"], e["message"]) for e in evs]
+    assert len(keys) == len(set(keys)), \
+        f"duplicate Event objects for one correlation key: {keys}"
+    assert len(evs) <= counts["emitted"]
+    # every RetryPolicy retry produced an emit (path_ref covers the
+    # context-free prefetch/readiness requests); the chaos script hits
+    # the recorder's OWN writes too, and those fail OPEN — counted,
+    # never retried, never fatal (the 503 window covers every path)
+    assert counts["emitted"] >= client.retries, (counts, client.retries)
+    retrying = [e for e in evs if e["reason"] == "Retrying"]
+    assert sum(e["count"] for e in retrying) <= client.retries
+
+
+def test_failed_post_does_not_poison_the_aggregation_window():
+    """A transient failure on the FIRST write of an aggregation key
+    must not poison the rest of its 10-minute window: no Event exists
+    on the server to count-bump, so the aggregate is dropped with the
+    failure and the NEXT emit of the same key starts a fresh POST (a
+    failed bump keeps the aggregate — that Event DOES exist). The
+    failed attempt itself is still never re-sent: one wire attempt per
+    emit."""
+    chaos = [{"status": 503, "count": 1, "method": "POST",
+              "match": "/events"}]
+    with FakeApiServer(auto_ready=True, chaos=chaos) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY)
+        rec = events.EventRecorder(client)
+        rec.emit(NS_OBJ, "Retrying", "503 Retry-After honored")  # fails
+        rec.emit(NS_OBJ, "Retrying", "503 Retry-After honored")  # POST
+        rec.emit(NS_OBJ, "Retrying", "503 Retry-After honored")  # bump
+        evs = stored_events(api)
+        writes = event_wire_writes(api)
+        client.close()
+    assert rec.counts() == {"emitted": 3, "dropped": 0, "failures": 1}
+    assert len(writes) == 3, writes  # one attempt per emit, no retries
+    retrying = [e for e in evs if e["reason"] == "Retrying"]
+    assert len(retrying) == 1, retrying
+    assert retrying[0]["count"] == 2
+
+
+def test_503_burst_collapses_into_one_counted_event():
+    """The deterministic cardinality pin: a count-bounded 503 burst on
+    ONE object's apply produces exactly one Retrying Event whose count
+    EQUALS the burst size — ≤1 aggregated Event per (object, reason)
+    with count ≥ burst (acceptance wording, pinned exactly)."""
+    burst = 4
+    chaos = [{"status": 503, "count": burst, "retry_after": 0.01,
+              "method": "PATCH", "match": f"/api/v1/namespaces/{NS}",
+              "exact": True}]
+    with FakeApiServer(auto_ready=True, chaos=chaos) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY)
+        client.events = events.EventRecorder(client)
+        kubeapply.apply_groups(
+            client, manifests.rollout_groups(specmod.default_spec()),
+            wait=True, stage_timeout=60, poll=0.02, max_inflight=8)
+        evs = stored_events(api)
+        client.close()
+    retrying = [e for e in evs if e["reason"] == "Retrying"]
+    assert len(retrying) == 1, retrying
+    assert retrying[0]["count"] == burst
+    assert retrying[0]["involvedObject"]["name"] == NS
+
+
+# ------------------------------------------------- transport wiring
+
+
+def test_retry_exhausted_emits_warning_event():
+    """An apply whose retries run out leaves a RetryExhausted Warning
+    on the object (in addition to the Retrying trail)."""
+    chaos = [{"status": 503, "method": "PATCH",
+              "match": f"/api/v1/namespaces/{NS}", "exact": True}]
+    with FakeApiServer(auto_ready=True, chaos=chaos,
+                       store={f"/api/v1/namespaces/{NS}":
+                              dict(NS_OBJ)}) as api:
+        client = kubeapply.Client(
+            api.url, retry=kubeapply.RetryPolicy(attempts=3,
+                                                 base_s=0.01))
+        client.events = events.EventRecorder(client)
+        with pytest.raises(kubeapply.ApplyError):
+            client.apply(NS_OBJ)
+        evs = stored_events(api)
+        client.close()
+    reasons = {e["reason"]: e for e in evs}
+    assert "RetryExhausted" in reasons, reasons
+    ex = reasons["RetryExhausted"]
+    assert ex["type"] == "Warning"
+    assert ex["involvedObject"]["name"] == NS
+    assert "503" in ex["message"]
+
+
+def test_hedge_fired_emits_event_on_the_hedged_object():
+    """A stalled idempotent read rescued by a hedge leaves a HedgeFired
+    Event on the object being applied."""
+    obj_path = f"/api/v1/namespaces/{NS}/configmaps/ev-cm"
+    chaos = [{"stall": 5.0, "count": 1, "method": "GET",
+              "match": obj_path, "exact": True}]
+    with FakeApiServer(auto_ready=True, chaos=chaos) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY,
+                                  hedge_s=0.05, attempt_deadline_s=2.0)
+        client.events = events.EventRecorder(client)
+        # the merge path GETs first — the stalled read hedges
+        assert client.apply(CM_OBJ) in ("created", "patched")
+        assert client.hedges >= 1
+        evs = stored_events(api)
+        client.close()
+    hedged = [e for e in evs if e["reason"] == "HedgeFired"]
+    assert len(hedged) == 1, evs
+    assert hedged[0]["involvedObject"]["name"] == "ev-cm"
+    assert "backup attempt" in hedged[0]["message"]
+
+
+def test_watch_410_resume_emits_event():
+    """A watch invalidated mid-readiness-wait (410 Gone) records a
+    WatchResumed Event naming the collection."""
+    ds = {"apiVersion": "apps/v1", "kind": "DaemonSet",
+          "metadata": {"name": "ev-ds", "namespace": NS},
+          "spec": {"selector": {"matchLabels": {"a": "b"}},
+                   "template": {"metadata": {"labels": {"a": "b"}},
+                                "spec": {"containers": []}}}}
+    coll = f"/apis/apps/v1/namespaces/{NS}/daemonsets"
+    with FakeApiServer(auto_ready=False,
+                       watch_gone_once=(coll,)) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY)
+        client.events = events.EventRecorder(client)
+        client.apply(ds)
+
+        def make_ready():
+            time.sleep(0.3)
+            api.set_ready(f"{coll}/ev-ds")
+
+        t = threading.Thread(target=make_ready)
+        t.start()
+        client.wait_ready([ds], timeout=10, poll=0.05, watch=True)
+        t.join()
+        evs = stored_events(api)
+        client.close()
+    resumed = [e for e in evs if e["reason"] == "WatchResumed"]
+    assert len(resumed) == 1, evs
+    assert coll in resumed[0]["message"]
+    assert resumed[0]["involvedObject"]["name"] == "ev-ds"
+
+
+# --------------------------------------------------------- informer
+
+
+def test_informer_relist_emits_aggregated_event_on_flap():
+    """A 410-driven informer re-LIST lands a Relisted Event on the
+    collection (a relist STORM would aggregate into one climbing
+    count — that is the point)."""
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY)
+        client.apply(admission.node_manifest("ev-n1", "v5e-8"))
+        rec = events.EventRecorder(client)
+        inf = informer.Informer(client, admission.NODES_PATH,
+                                page_limit=50, events=rec)
+        with inf:
+            assert inf.wait_synced(10)
+            api.flap()
+            deadline = time.monotonic() + 10
+            while inf.relists < 2 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert inf.relists == 2
+            # the emit happens after the relist counter: poll for it
+            while time.monotonic() < deadline:
+                if any(e and e["reason"] == "Relisted"
+                       for e in stored_events(api)):
+                    break
+                time.sleep(0.02)
+        evs = stored_events(api)
+        client.close()
+    relisted = [e for e in evs if e["reason"] == "Relisted"]
+    assert len(relisted) == 1, evs
+    assert relisted[0]["involvedObject"]["kind"] == "Node"
+    assert "410" in relisted[0]["message"]
+
+
+def test_informer_terminal_watch_denial_emits_sync_lost():
+    """A terminally-denied watch (RBAC without the verb) records a
+    SyncLost Warning before the informer freezes — the Event the
+    operator sees next to the stuck controller."""
+    with FakeApiServer(auto_ready=True,
+                       reject_watch={admission.NODES_PATH: 403}) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY)
+        rec = events.EventRecorder(client)
+        inf = informer.Informer(client, admission.NODES_PATH,
+                                page_limit=50, events=rec)
+        inf.start()
+        try:
+            deadline = time.monotonic() + 10
+            while inf.error is None and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert inf.error is not None
+            while time.monotonic() < deadline:
+                if any(e and e["reason"] == "SyncLost"
+                       for e in stored_events(api)):
+                    break
+                time.sleep(0.02)
+        finally:
+            inf.stop()
+        evs = stored_events(api)
+        client.close()
+    lost = [e for e in evs if e["reason"] == "SyncLost"]
+    assert len(lost) == 1, evs
+    assert lost[0]["type"] == "Warning"
+    assert "watch denied" in lost[0]["message"]
+
+
+# ------------------------------------------------------------- fake
+
+
+def test_fake_event_ttl_compaction():
+    """The fake's --event-ttl analog: Events older than event_ttl_s
+    are swept (watch DELETED events emitted) on the next Event POST,
+    and the sweep is counted on the scrape."""
+    with FakeApiServer(auto_ready=True, event_ttl_s=0.05) as api:
+        client = kubeapply.Client(api.url)
+        rec = events.EventRecorder(client)
+        rec.emit(CM_OBJ, "Old", "will expire")
+        time.sleep(0.1)
+        rec.emit(CM_OBJ, "New", "fresh")
+        evs = [e for e in stored_events(api) if e is not None]
+        text = api.fake_metrics_text()
+        client.close()
+    assert [e["reason"] for e in evs] == ["New"]
+    assert "fake_apiserver_events_compacted_total 1" in text
+    assert 'fake_apiserver_events_total{reason="New"} 1' in text
+    assert 'fake_apiserver_events_total{reason="Old"} 1' in text
+
+
+def test_collection_ref_and_event_namespace_units():
+    ref = events.collection_ref(
+        f"/apis/batch/v1/namespaces/{NS}/jobs")
+    assert ref == {"apiVersion": "batch/v1", "kind": "Job",
+                   "namespace": NS, "name": "jobs"}
+    nodes = events.collection_ref("/api/v1/nodes")
+    assert nodes["kind"] == "Node" and nodes["namespace"] == ""
+    assert events.event_namespace(nodes) == "default"
+    assert events.event_namespace(ref) == NS
+
+
+# -------------------------------------------------------------- CLI
+
+
+def _cli(api, *args, check=True):
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_cluster", *args,
+         "--apiserver", api.url],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    if check:
+        assert proc.returncode == 0, (args, proc.stdout, proc.stderr)
+    return proc
+
+
+def test_events_cli_lists_filters_and_joins_traces():
+    """`tpuctl events`: the table lists recorded Events, --for filters
+    by involvedObject, and the TRACE column names the rollout trace
+    via the traceparent annotation (the Event's own, or the involved
+    object's PR 8 breadcrumb)."""
+    tel = telemetry.Telemetry()
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url, telemetry=tel)
+        for n in ("cli-a", "cli-b"):
+            client.apply(admission.node_manifest(n, "v5e-8"))
+        client.apply(admission.gang_job_manifest("clig", "v5e-16", NS))
+        rec = events.EventRecorder(client)  # NO telemetry: join must
+        # fall back to the involved JOB's traceparent annotation
+        ctrl = admission.AdmissionController(client, NS, events=rec)
+        ctrl.step()
+        client.close()
+
+        out = _cli(api, "events", "--namespace", NS).stdout
+        assert "Admitted" in out and "Job/gang-clig" in out
+        assert tel.tracer.trace_id[:16] in out, out
+
+        proc = _cli(api, "events", "--for", "Job/gang-clig", "--json")
+        doc = json.loads(proc.stdout)
+        assert len(doc["events"]) == 1
+        assert doc["events"][0]["reason"] == "Admitted"
+        assert doc["events"][0]["trace"] == tel.tracer.trace_id
+
+        proc = _cli(api, "events", "--for", "Job/absent", "--json")
+        assert json.loads(proc.stdout)["events"] == []
+
+
+def test_events_cli_follow_streams_new_events():
+    """`tpuctl events --follow` prints the current set, then streams
+    Events that arrive while it is watching (bounded by
+    --follow-seconds for scripting)."""
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        rec = events.EventRecorder(client)
+        rec.emit(CM_OBJ, "Before", "already there")
+
+        def late_emit():
+            time.sleep(0.8)
+            rec.emit(CM_OBJ, "After", "streamed in")
+
+        t = threading.Thread(target=late_emit)
+        t.start()
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpu_cluster", "events",
+             "--apiserver", api.url, "--namespace", NS,
+             "--follow", "--follow-seconds", "3"],
+            capture_output=True, text=True, timeout=120, cwd=REPO)
+        t.join()
+        client.close()
+    assert proc.returncode == 0, proc.stderr
+    assert "Before" in proc.stdout
+    assert "After" in proc.stdout, proc.stdout
+
+
+def test_events_cli_follow_covers_default_namespace_too():
+    """Without --namespace, --follow round-robins BOTH default
+    namespaces — the TPU namespace and 'default', where Events about
+    cluster-scoped objects (informer Relisted/SyncLost on /api/v1/
+    nodes) land — and the initial listing shares its collection GET
+    with the watch resourceVersion, so an Event posted between listing
+    and watching is never skipped."""
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        client.apply(admission.node_manifest("fol-n1", "v5e-8"))
+        node = api.get("/api/v1/nodes/fol-n1")
+        rec = events.EventRecorder(client)
+        rec.emit(CM_OBJ, "NsBefore", "in the tpu namespace")
+        rec.emit(node, "ClusterBefore", "about a node -> default ns")
+
+        def late_emit():
+            time.sleep(1.0)
+            rec.emit(CM_OBJ, "NsAfter", "streamed from the tpu ns")
+            rec.emit(node, "ClusterAfter", "streamed from default")
+
+        t = threading.Thread(target=late_emit)
+        t.start()
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpu_cluster", "events",
+             "--apiserver", api.url,
+             "--follow", "--follow-seconds", "6"],
+            capture_output=True, text=True, timeout=120, cwd=REPO)
+        t.join()
+        client.close()
+    assert proc.returncode == 0, proc.stderr
+    for want in ("NsBefore", "ClusterBefore", "NsAfter",
+                 "ClusterAfter"):
+        assert want in proc.stdout, (want, proc.stdout)
+
+
+def test_apply_cli_events_flag_records_retry_trail(tmp_path):
+    """`tpuctl apply --events` against a briefly-503ing fake leaves an
+    aggregated Retrying Event readable back through `tpuctl events`."""
+    chaos = [{"status": 503, "count": 2, "retry_after": 0.01,
+              "method": "PATCH", "match": f"/api/v1/namespaces/{NS}",
+              "exact": True}]
+    with FakeApiServer(auto_ready=True, chaos=chaos) as api:
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpu_cluster", "apply",
+             "--apiserver", api.url, "--events", "--parallel",
+             "--stage-timeout", "60", "--poll", "0.05",
+             "--flight-recorder", "off",
+             "--retry-attempts", "8", "--retry-base", "0.01"],
+            capture_output=True, text=True, timeout=300, cwd=REPO)
+        assert proc.returncode == 0, (proc.stdout, proc.stderr)
+        evs = [e for e in stored_events(api) if e is not None]
+        out = _cli(api, "events", "--for", NS).stdout
+    retrying = [e for e in evs if e["reason"] == "Retrying"]
+    assert len(retrying) == 1 and retrying[0]["count"] == 2, evs
+    assert "Retrying" in out

@@ -230,7 +230,7 @@ def test_soak_graph_is_cycle_free_and_pinned():
     # to review, and an edge INTO the probe lock would close a cycle.
     flat_files = ("kubeapply.py", "telemetry.py", "verify.py",
                   "lockorder.py", "conlint.py", "admission.py",
-                  "informer.py", "muxhttp.py")
+                  "informer.py", "muxhttp.py", "events.py", "slo.py")
     nested = _interesting(edges, flat_files)
     probe = "kubeapply.py:Client._ssa_probe_lock"
     unexpected = {e: s for e, s in nested.items() if e[0] != probe}
@@ -248,6 +248,12 @@ def test_soak_graph_is_cycle_free_and_pinned():
         # CLI arms it for every REST apply); its lock is leaf-only —
         # record()/flush() acquire nothing inside it
         "telemetry.py:FlightRecorder._lock",
+        # the events recorder (ISSUE 12): a retry of the SSA probe
+        # request emits a Retrying event while the probe lock is held
+        # (by design — the probe spans its whole round trip), and the
+        # recorder's aggregation lock is leaf-only: the decision is
+        # made under it, the Event wire attempt happens after release
+        "events.py:EventRecorder._lock",
     }
     under_probe = {e[1] for e in nested if e[0] == probe}
     assert under_probe <= allowed_under_probe, \
@@ -300,6 +306,40 @@ def test_admission_lock_stays_leaf_only():
                 if "admission.py" in e[0]}
     assert outgoing == {}, \
         f"admission lock held across another acquisition: {outgoing}"
+
+
+def test_event_recorder_lock_stays_leaf_only():
+    """The events recorder's lock discipline (ISSUE 12): aggregation/
+    spam-filter decisions under ``_lock``, the Event wire attempt
+    outside it — so the recorder contributes ZERO outgoing edges even
+    while emitting from inside retry loops and admission passes. (The
+    soak pin's flat_files also names events.py; this drives the
+    recorder explicitly — POST, count-bump PATCH, spam drop, failed
+    write — so the edge set is populated even when run alone.)"""
+    monitor = lockorder.installed()
+    if monitor is None:
+        pytest.skip("lock-order monitor disabled (TPU_LOCKORDER=0)")
+    from tpu_cluster import events
+    tel = telemetry.Telemetry()
+    cm = {"apiVersion": "v1", "kind": "ConfigMap",
+          "metadata": {"name": "lk-ev", "namespace": "tpu-system"}}
+    chaos = [{"status": 403, "method": "PATCH", "match": "/events/",
+              "count": 1}]
+    with FakeApiServer(auto_ready=True, chaos=chaos) as api:
+        client = kubeapply.Client(api.url, telemetry=tel)
+        rec = events.EventRecorder(client, telemetry=tel, spam_burst=3,
+                                   spam_refill_per_s=0.0)
+        rec.emit(cm, "LockDrive", "post")
+        rec.emit(cm, "LockDrive", "post")  # PATCH bump (403s: fail-open)
+        for i in range(4):
+            rec.emit(cm, "LockDrive", f"spam {i}")  # last one drops
+        client.close()
+    assert rec.counts()["failures"] >= 1
+    assert rec.counts()["dropped"] >= 1
+    edges = monitor.snapshot_edges()
+    outgoing = {e: s for e, s in edges.items() if "events.py" in e[0]}
+    assert outgoing == {}, \
+        f"events recorder lock held across another acquisition: {outgoing}"
 
 
 def test_site_naming_is_stable_and_meaningful():

@@ -1,0 +1,216 @@
+"""SLO burn-rate evaluator suite (ISSUE 12).
+
+The SRE-workbook multi-window multi-burn-rate discipline over
+span-derived samples: burn math pinned on synthetic traces, the
+dual-window AND-gate (a short blip must NOT page), the time-synthesis
+scale, and the `tpuctl slo check` CLI contract — exit 0 on a clean
+full-bundle rollout trace, exit 1 naming the burning window pair on the
+checked-in synthetic violation fixture, exit 2 on junk input."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from fake_apiserver import FakeApiServer
+from tpu_cluster import kubeapply, slo, telemetry
+from tpu_cluster import spec as specmod
+from tpu_cluster.render import manifests
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures")
+VIOLATION = os.path.join(FIXTURES, "slo_violation_trace.json")
+
+
+def _http_span(ts_s, status, watch=False, dur_us=1000.0):
+    args = {"verb": "GET", "status": status}
+    if watch:
+        args["watch"] = True
+    return {"name": "GET /x", "cat": "http", "ph": "X",
+            "ts": round(ts_s * 1e6, 1), "dur": dur_us, "pid": 1,
+            "tid": 1, "args": args}
+
+
+def _admission_span(ts_s, dur_s):
+    return {"name": "admission-pass", "cat": "admission", "ph": "X",
+            "ts": round(ts_s * 1e6, 1), "dur": round(dur_s * 1e6, 1),
+            "pid": 1, "tid": 1, "args": {}}
+
+
+def _trace(events):
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# ------------------------------------------------------------- math
+
+
+def test_burn_rate_math_against_hand_computed_values():
+    """100s timeline, scale anchored so 1h == the trace: 10 bad of 100
+    samples overall = 10% errors = 10x burn of a 1% budget in the long
+    page window."""
+    events = [_http_span(i, 200) for i in range(90)]
+    events += [_http_span(90 + i, 0) for i in range(10)]
+    report = slo.evaluate([_trace(events)])
+    av = {v.slo.name: v for v in report.verdicts}["apply-availability"]
+    page = [w for w in av.windows if w.severity == "page"][0]
+    assert page.samples_long == 100
+    assert page.burn_long == pytest.approx(10.0, rel=1e-6)
+    # the 5m window is the most recent 100/12 s — all bad there
+    assert page.burn_short == pytest.approx(100.0, rel=1e-6)
+    assert not page.burning  # 10x long < 14.4x: no page
+    warn = [w for w in av.windows if w.severity == "warn"][0]
+    assert warn.burning  # 10x >> 1x over both clamped windows
+
+
+def test_dual_window_gate_a_short_blip_does_not_page():
+    """The whole point of multi-window alerting: a burst that saturates
+    the SHORT window but is diluted over the LONG one must not fire."""
+    events = [_http_span(i * 0.1, 200) for i in range(990)]
+    # a dense burst right at the end: short window burns, long doesn't
+    events += [_http_span(99.0 + i * 0.02, 0) for i in range(30)]
+    report = slo.evaluate([_trace(events)])
+    av = {v.slo.name: v for v in report.verdicts}["apply-availability"]
+    page = [w for w in av.windows if w.severity == "page"][0]
+    assert page.burn_short > 14.4  # the blip saturates 5m
+    assert page.burn_long < 14.4
+    assert not page.burning
+    assert not av.burning or [w for w in av.windows
+                              if w.burning][0].severity == "warn"
+
+
+def test_watch_uptime_and_admission_latency_extractors():
+    events = [_http_span(1.0, 200, watch=True),
+              _http_span(2.0, 403, watch=True),
+              _admission_span(3.0, 0.01),
+              _admission_span(4.0, 5.0)]  # slower than the threshold
+    doc = _trace(events)
+    watch = [s for s in slo.DEFAULT_SLOS if s.name == "watch-uptime"][0]
+    adm = [s for s in slo.DEFAULT_SLOS
+           if s.name == "admission-latency"][0]
+    assert sorted(g for _t, g in slo.samples_for(watch, doc)) \
+        == [False, True]
+    assert sorted(g for _t, g in slo.samples_for(adm, doc)) \
+        == [False, True]
+    # http non-watch spans feed availability only
+    avail = [s for s in slo.DEFAULT_SLOS
+             if s.name == "apply-availability"][0]
+    assert slo.samples_for(avail, doc) == []
+
+
+def test_429_and_5xx_count_against_availability_404_does_not():
+    events = [_http_span(1.0, 200), _http_span(2.0, 404),
+              _http_span(3.0, 429), _http_span(4.0, 503),
+              _http_span(5.0, 0)]
+    avail = [s for s in slo.DEFAULT_SLOS
+             if s.name == "apply-availability"][0]
+    good = sorted(g for _t, g in slo.samples_for(avail, _trace(events)))
+    assert good == [False, False, False, True, True]
+
+
+def test_explicit_scale_controls_window_mapping():
+    """scale=1 means nominal seconds ARE trace seconds: a 100s trace
+    fits entirely inside every window, so short == long burn."""
+    events = [_http_span(i, 200 if i % 2 else 0) for i in range(100)]
+    report = slo.evaluate([_trace(events)], scale=1.0)
+    av = {v.slo.name: v for v in report.verdicts}["apply-availability"]
+    page = [w for w in av.windows if w.severity == "page"][0]
+    assert report.scale == 1.0
+    assert page.burn_short == pytest.approx(page.burn_long)
+
+
+def test_no_samples_is_healthy_but_visible():
+    report = slo.evaluate([_trace([_admission_span(1.0, 0.01)])])
+    av = {v.slo.name: v for v in report.verdicts}["apply-availability"]
+    assert av.total_samples == 0 and not av.burning
+    assert report.ok
+    assert "no samples" in slo.format_report(report)
+
+
+def test_evaluate_rejects_junk():
+    with pytest.raises(ValueError):
+        slo.evaluate([])
+    with pytest.raises(ValueError):
+        slo.evaluate([{"not": "a trace"}])
+
+
+# -------------------------------------------------------------- CLI
+
+
+def _slo_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "tpu_cluster", "slo", "check", *args],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+
+
+def test_clean_rollout_trace_passes_slo_check_cli(tmp_path):
+    """Acceptance: `tpuctl slo check` exits 0 on a clean full-bundle
+    rollout's trace."""
+    tel = telemetry.Telemetry()
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url, telemetry=tel)
+        kubeapply.apply_groups(
+            client, manifests.rollout_groups(specmod.default_spec()),
+            wait=True, stage_timeout=60, poll=0.02, max_inflight=8,
+            watch_ready=True)
+        client.close()
+    trace = tmp_path / "clean.json"
+    tel.write_trace(str(trace))
+    proc = _slo_cli(str(trace))
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "all budgets healthy" in proc.stdout
+    proc = _slo_cli(str(trace), "--json")
+    doc = json.loads(proc.stdout)
+    assert doc["ok"] is True
+    assert {s["name"] for s in doc["slos"]} == {
+        "apply-availability", "watch-uptime", "admission-latency"}
+
+
+def test_violation_fixture_burns_and_names_the_window_pair():
+    """Acceptance: the checked-in synthetic violation fixture exits 1
+    with the burning window pair NAMED — both severities fire (the
+    failure burst is dense AND sustained)."""
+    proc = _slo_cli(VIOLATION)
+    assert proc.returncode == 1, (proc.stdout, proc.stderr)
+    assert "error budget burning" in proc.stdout
+    assert "page (5m/1h)" in proc.stdout
+    assert "apply-availability" in proc.stdout
+    proc = _slo_cli(VIOLATION, "--json")
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert doc["ok"] is False
+    av = [s for s in doc["slos"]
+          if s["name"] == "apply-availability"][0]
+    assert av["burning"] is True
+    page = [w for w in av["windows"] if w["severity"] == "page"][0]
+    assert page["burning"] and page["burn_short"] > 14.4 \
+        and page["burn_long"] > 14.4
+
+
+def test_slo_check_cli_junk_input_is_rc2(tmp_path):
+    bogus = tmp_path / "bogus.json"
+    bogus.write_text('{"not": "a trace"}')
+    proc = _slo_cli(str(bogus))
+    assert proc.returncode == 2, proc.stdout
+    assert "no traceEvents" in proc.stderr
+    proc = _slo_cli(str(tmp_path / "absent.json"))
+    assert proc.returncode == 2
+    assert "cannot read" in proc.stderr
+
+
+def test_slo_check_pools_samples_across_multiple_traces(tmp_path):
+    """Multiple trace inputs pool their samples (CLI + server + bench
+    arms of one run), ages aligned on each doc's own timeline end."""
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps(_trace([_http_span(i, 200)
+                                    for i in range(10)])))
+    b.write_text(json.dumps(_trace([_http_span(i, 200)
+                                    for i in range(5)])))
+    proc = _slo_cli(str(a), str(b), "--json")
+    assert proc.returncode == 0
+    doc = json.loads(proc.stdout)
+    av = [s for s in doc["slos"]
+          if s["name"] == "apply-availability"][0]
+    assert av["samples"] == 15

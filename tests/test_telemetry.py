@@ -119,10 +119,12 @@ def test_fake_metrics_label_escaping_hostile_path():
     api._server.server_close()
     assert f'path="{prom_escape(hostile)}"' in text
     # every sample line stays one line and parseable: name{labels} value
+    # (labels optional — unlabeled totals like events_compacted are
+    # valid exposition format too)
     for ln in text.splitlines():
         if ln.startswith("#") or not ln:
             continue
-        assert re.match(r'^[a-z_]+\{.*\} \d+$', ln), ln
+        assert re.match(r'^[a-z_]+(\{.*\})? \d+$', ln), ln
     assert prom_escape("a\\b\"c\nd") == 'a\\\\b\\"c\\nd'
 
 
@@ -605,3 +607,27 @@ def test_telemetry_off_is_behaviorally_identical(spec):
                                poll=0.02, max_inflight=8)
         client.close()
         assert api.get(f"/api/v1/namespaces/{NS}") is not None
+
+
+def test_unretained_tracer_drops_finished_span_trees():
+    """retain_spans=False (the long-running admission loop without
+    --trace-out): each finished parentless span — and with it its whole
+    subtree — is dropped instead of accumulating one pass tree per pass
+    forever; an OPEN span stays visible (the crashed-rollout export
+    contract), and the metrics registry is unaffected."""
+    tel = telemetry.Telemetry(retain_spans=False)
+    for _ in range(50):
+        with tel.span("admission-pass", "admission"):
+            tel.leaf("GET /api/v1/nodes", "http", 0.001)
+    assert tel.tracer.roots == []
+    # parentless leafs (watch threads reporting outside any pass) too
+    tel.leaf("watch chunk", "http", 0.001)
+    assert tel.tracer.roots == []
+    with tel.span("in-flight", "admission") as span:
+        assert tel.tracer.roots == [span]
+    assert tel.tracer.roots == []
+    # the default keeps everything (write_trace consumes it)
+    kept = telemetry.Telemetry()
+    with kept.span("admission-pass", "admission"):
+        pass
+    assert len(kept.tracer.roots) == 1

@@ -29,6 +29,15 @@ with rendered artifacts and an ordered, readiness-gated apply:
            plugin's Allocate enforcement
   queue    list/describe the gang queue (admitted, queued, preempted —
            with reasons and reserved hosts)
+  events   list or stream (--follow) the Kubernetes Events the stack's
+           controllers record (Admitted/Preempted/Drained/ReAdmitted,
+           Retrying/RetryExhausted, HedgeFired, WatchResumed ...),
+           each row joined with the rollout trace that caused it via
+           the tpu-stack.dev/traceparent annotation
+  slo      multi-window multi-burn-rate SLO evaluation (SRE-workbook
+           shape: 5m/1h page, 6h/3d warn) over span-derived samples —
+           `tpuctl slo check TRACE...` exits 1 when an error budget is
+           burning, naming the window pair
   verify   the executable acceptance runbook (BASELINE configs)
   triage   the executable troubleshooting runbook
   top      per-phase/per-object breakdown of a rollout trace captured
@@ -52,8 +61,9 @@ from typing import Dict
 
 import yaml
 
-from . import (admission as admissionmod, conlint as conlintmod, kubeapply,
-               lint as lintmod, spec as specmod, telemetry, triage, verify)
+from . import (admission as admissionmod, conlint as conlintmod,
+               events as eventsmod, kubeapply, lint as lintmod,
+               slo as slomod, spec as specmod, telemetry, triage, verify)
 from .render import jobs, kubeadm, manifests, nodeprep, operator_bundle
 
 
@@ -198,11 +208,14 @@ def cmd_apply(args) -> int:
     if fr_path:
         recorder = telemetry.FlightRecorder(fr_path)
     # armed only when SOMETHING consumes it: the recorder (on by
-    # default, --flight-recorder=off disables) or an output flag — an
-    # explicit full opt-out must get the telemetry=None zero-overhead
-    # path, not an unconsumed span tree
+    # default, --flight-recorder=off disables), an output flag, or an
+    # armed Events recorder (which stamps each Event with the run's
+    # trace id and counts emit failures) — an explicit full opt-out
+    # must get the telemetry=None zero-overhead path, not an unconsumed
+    # span tree
     tel = (telemetry.Telemetry(recorder=recorder)
-           if (recorder is not None or args.trace_out or args.metrics_out)
+           if (recorder is not None or args.trace_out or args.metrics_out
+               or (rest_mode and args.events))
            else None)
     if rest_mode:
         # SIGTERM must dump, like a crash: raising SystemExit lets the
@@ -257,6 +270,14 @@ def cmd_apply(args) -> int:
             client.telemetry = tel
             client.budget = budget
             client.hedge_s = args.hedge
+            if args.events:
+                # the Events pipeline (ISSUE 12): operational Events
+                # (Retrying/RetryExhausted/DeadlineExceeded/HedgeFired/
+                # WatchResumed) recorded next to the objects they
+                # happened for — fail-open, one attempt each, never on
+                # the critical path
+                client.events = eventsmod.EventRecorder(
+                    client, component="tpuctl", telemetry=tel)
             try:
                 result = kubeapply.apply_groups(
                     client, groups, wait=args.wait,
@@ -303,6 +324,11 @@ def cmd_apply(args) -> int:
                 print("apply: note: --hedge has no effect on the kubectl "
                       "backend (kubectl owns its own transport); pass "
                       "--apiserver for hedged reads", file=sys.stderr)
+            if args.events:
+                print("apply: note: --events has no effect on the "
+                      "kubectl backend (the recorder posts through the "
+                      "REST client); pass --apiserver for the Events "
+                      "pipeline", file=sys.stderr)
             if tel is not None:
                 print("apply: note: --trace-out/--metrics-out instrument "
                       "the REST engine's requests; the kubectl backend "
@@ -455,12 +481,28 @@ def cmd_admission(args) -> int:
         return 2
     spec = _load_spec(args.spec)
     ns = args.namespace or spec.tpu.namespace
-    tel = (telemetry.Telemetry()
-           if (args.trace_out or args.metrics_out) else None)
+    # events need a Telemetry even when no trace/metrics file was
+    # asked for: the recorder stamps each decision Event with the
+    # run's trace id, which is what `tpuctl events` joins on. Span
+    # retention follows --trace-out: without it nothing ever exports
+    # the span tree, and the forever-running loop must not grow one
+    # admission-pass tree per pass until it OOMs (the metrics registry
+    # and the traceparent stamp — the parts events/--metrics-out
+    # consume — are bounded and unaffected)
+    tel = (telemetry.Telemetry(retain_spans=bool(args.trace_out))
+           if (args.trace_out or args.metrics_out or args.events)
+           else None)
     client = _rest_client(args)
     assert client is not None
     client.telemetry = tel
-    ctrl = admissionmod.AdmissionController(client, ns, telemetry=tel)
+    # decision Events are ON by default for the admission CLI (the
+    # controller's decisions are exactly what `tpuctl events --for`
+    # exists to show); --no-events restores the annotation-only loop
+    recorder = (eventsmod.EventRecorder(client, component="tpu-admission",
+                                        telemetry=tel)
+                if args.events else None)
+    ctrl = admissionmod.AdmissionController(client, ns, telemetry=tel,
+                                            events=recorder)
     rc = 0
     try:
         if args.once:
@@ -515,6 +557,169 @@ def cmd_admission(args) -> int:
                 print(f"admission: cannot write metrics: {exc}",
                       file=sys.stderr)
     return rc
+
+
+def _print_event_rows(client, rows, as_json: bool) -> None:
+    cache: Dict[str, str] = {}
+    joined = [(e, eventsmod.trace_of_event(client, e, cache))
+              for e in rows]
+    if as_json:
+        print(json.dumps({"events": [
+            dict(e, trace=t) for e, t in joined]}))
+        return
+    print(eventsmod.EVENT_HEADER)
+    for e, t in joined:
+        print(eventsmod.format_event_row(e, t))
+    if not joined:
+        print("(no events)")
+
+
+def _follow_events(client, namespaces, args) -> int:
+    """`tpuctl events --follow`: print the current Events of every
+    target namespace, then stream new/updated ones off ?watch=1
+    streams until interrupted (or --follow-seconds elapses — the
+    scripting/test bound). Each namespace's initial rows and its watch
+    resourceVersion come from the SAME collection GET, so an Event
+    posted between listing and watching is never silently skipped.
+    With several namespaces (the default: the TPU namespace plus
+    'default', where Events about cluster-scoped objects land) the
+    watches round-robin on short windows — one connection at a time,
+    worst-case inter-namespace latency one window."""
+    colls = [f"/api/v1/namespaces/{ns}/events" for ns in namespaces]
+    cache: Dict[str, str] = {}
+    rv: Dict[str, str] = {}
+    rows = []
+    for coll in colls:
+        code, body = client.get(coll)
+        if code == 200:
+            rv[coll] = str(((body or {}).get("metadata") or {})
+                           .get("resourceVersion") or "")
+            rows.extend((body or {}).get("items") or [])
+        else:
+            rv[coll] = ""
+    rows.sort(key=lambda e: (str(e.get("lastTimestamp", "")),
+                             str((e.get("metadata") or {})
+                                 .get("name", ""))))
+    if args.for_:
+        rows = [e for e in rows if eventsmod.event_matches(e, args.for_)]
+    print(eventsmod.EVENT_HEADER, flush=True)
+    for e in rows:
+        print(eventsmod.format_event_row(
+            e, eventsmod.trace_of_event(client, e, cache)), flush=True)
+    deadline = (time.monotonic() + args.follow_seconds
+                if args.follow_seconds > 0 else None)
+    # single namespace: long windows (one mostly-idle connection);
+    # several: short windows so each namespace is streamed in turn
+    max_window = 30 if len(colls) == 1 else 2
+    try:
+        while deadline is None or time.monotonic() < deadline:
+            for coll in colls:
+                left = (deadline - time.monotonic()
+                        if deadline is not None else max_window)
+                if left <= 0:
+                    break
+                window = max(1, min(max_window, int(left) + 1))
+                try:
+                    conn, resp = client._open_watch(coll, rv[coll],
+                                                    window)
+                except (kubeapply._WatchDenied, OSError) as exc:
+                    print(f"events: watch failed ({exc}); retrying",
+                          file=sys.stderr)
+                    time.sleep(0.5)
+                    continue
+                try:
+                    while deadline is None \
+                            or time.monotonic() < deadline:
+                        try:
+                            raw = resp.readline()
+                        except OSError:
+                            # stream died (apiserver restart, reset):
+                            # re-open from the held RV, same as the
+                            # informer's pump
+                            break
+                        if not raw:
+                            break  # window over: re-open from held RV
+                        try:
+                            ev = json.loads(raw)
+                        except ValueError:
+                            continue
+                        obj = ev.get("object") or {}
+                        if ev.get("type") == "ERROR":
+                            rv[coll] = ""  # compacted: resume from now
+                            break
+                        new_rv = (obj.get("metadata") or {}).get(
+                            "resourceVersion")
+                        if new_rv:
+                            rv[coll] = str(new_rv)
+                        if ev.get("type") == "DELETED" \
+                                or obj.get("kind") != "Event":
+                            continue
+                        if args.for_ and not eventsmod.event_matches(
+                                obj, args.for_):
+                            continue
+                        print(eventsmod.format_event_row(
+                            obj, eventsmod.trace_of_event(client, obj,
+                                                          cache)),
+                              flush=True)
+                finally:
+                    conn.close()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def cmd_events(args) -> int:
+    """List or stream the Events the stack's controllers record (the
+    third observability pillar): `tpuctl events [--for OBJ]` joins each
+    row with the causing rollout trace; `--follow` streams."""
+    if not args.apiserver:
+        print("events: --apiserver URL required (Events live on the "
+              "cluster)", file=sys.stderr)
+        return 2
+    spec = _load_spec(args.spec)
+    namespaces = ([args.namespace] if args.namespace
+                  else [spec.tpu.namespace, "default"])
+    namespaces = list(dict.fromkeys(namespaces))
+    client = _rest_client(args)
+    assert client is not None
+    try:
+        if args.follow:
+            return _follow_events(client, namespaces, args)
+        rows = eventsmod.fetch_events(client, namespaces)
+        if args.for_:
+            rows = [e for e in rows
+                    if eventsmod.event_matches(e, args.for_)]
+        _print_event_rows(client, rows, args.json)
+    finally:
+        client.close()
+    return 0
+
+
+def cmd_slo(args) -> int:
+    """`tpuctl slo check TRACE...`: evaluate the SLO set as
+    multi-window multi-burn-rate rules over span-derived samples.
+    Exit 0 = every error budget healthy, 1 = burning (window pair
+    named), 2 = unreadable input."""
+    docs = []
+    for path in args.traces:
+        try:
+            docs.append(slomod.load_trace(path))
+        except OSError as exc:
+            print(f"slo: cannot read {path}: {exc}", file=sys.stderr)
+            return 2
+        except ValueError as exc:
+            print(f"slo: {path}: not a trace: {exc}", file=sys.stderr)
+            return 2
+    try:
+        report = slomod.evaluate(docs, scale=args.scale)
+    except ValueError as exc:
+        print(f"slo: {exc}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(report.to_dict()))
+    else:
+        print(slomod.format_report(report))
+    return 0 if report.ok else 1
 
 
 def cmd_verify(args) -> int:
@@ -782,6 +987,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "Prometheus text: per-verb/status request "
                         "counters, latency and time-to-ready histograms, "
                         "retry/skip/reconnect counters")
+    p.add_argument("--events", action="store_true",
+                   help="record operational Kubernetes Events next to "
+                        "the objects the rollout touches (REST backend): "
+                        "Retrying/RetryExhausted on the retry taxonomy, "
+                        "DeadlineExceeded, HedgeFired, WatchResumed — "
+                        "client-go-shaped aggregation + spam filter, "
+                        "fail-open (a failed Event write only bumps "
+                        "tpuctl_event_emit_failures_total); read them "
+                        "back with `tpuctl events`")
     p.add_argument("--flight-recorder", default="", metavar="PATH|off",
                    help="always-on bounded post-mortem trace (REST "
                         "backend): a ring of the last spans/retry events, "
@@ -874,6 +1088,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "LISTing the world every pass — an idle pass "
                         "issues zero apiserver reads after the initial "
                         "sync; --interval becomes the resync backstop")
+    p.add_argument("--events", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="post one correlated Event per decision "
+                        "transition (Admitted/Preempted/Drained/"
+                        "ReAdmitted) on the gang's Job — on by default; "
+                        "--no-events restores the annotation-only loop")
     p.add_argument("--trace-out", default="", metavar="PATH",
                    help="write the admission spans as Chrome trace-event "
                         "JSON (merge with rollout traces via `tpuctl "
@@ -884,6 +1104,53 @@ def build_parser() -> argparse.ArgumentParser:
                         "tpuctl_preemptions_total, "
                         "tpuctl_gang_wait_seconds) as Prometheus text")
     p.set_defaults(fn=cmd_admission)
+
+    p = sub.add_parser(
+        "events", help="list or stream (--follow) the Kubernetes Events "
+                       "the stack's controllers record, each row joined "
+                       "with the rollout trace that caused it",
+        parents=[conn])
+    p.add_argument("--namespace", default="",
+                   help="namespace to read Events from (default: the "
+                        "spec's TPU namespace plus 'default', where "
+                        "Events about cluster-scoped objects land)")
+    p.add_argument("--for", dest="for_", default="",
+                   metavar="[KIND/]NAME",
+                   help="only Events whose involvedObject matches "
+                        "(e.g. Job/gang-train, or a bare object name)")
+    p.add_argument("--follow", action="store_true",
+                   help="stream new/updated Events off a watch after "
+                        "printing the current set")
+    p.add_argument("--follow-seconds", type=float, default=0.0,
+                   help="with --follow: stop streaming after this many "
+                        "seconds (0 = until interrupted; the "
+                        "scripting/CI bound)")
+    p.add_argument("--json", action="store_true",
+                   help="one machine-readable JSON document instead of "
+                        "the table (list mode only)")
+    p.set_defaults(fn=cmd_events)
+
+    p = sub.add_parser(
+        "slo", help="SLO burn-rate evaluation over span-derived "
+                    "samples (SRE-workbook multi-window multi-burn-rate "
+                    "rules: 5m/1h page, 6h/3d warn)")
+    ssub = p.add_subparsers(dest="slo_cmd", required=True)
+    sp = ssub.add_parser(
+        "check", help="evaluate every SLO x window pair over one or "
+                      "more rollout traces; exit 1 when a budget is "
+                      "burning (window pair named)")
+    sp.add_argument("traces", nargs="+", metavar="TRACE",
+                    help="Chrome trace JSON files (tpuctl apply "
+                         "--trace-out, bench arms, flight-recorder "
+                         "dumps)")
+    sp.add_argument("--scale", type=float, default=None,
+                    help="nominal seconds represented by one trace "
+                         "second (default: the 1h page window spans "
+                         "the whole trace)")
+    sp.add_argument("--json", action="store_true",
+                    help="one machine-readable JSON document instead "
+                         "of the table")
+    sp.set_defaults(fn=cmd_slo)
 
     p = sub.add_parser("verify", help="run the acceptance runbook")
     p.add_argument("--spec", default="")

@@ -73,6 +73,21 @@ STATUS_ADMITTED = "admitted"
 STATUS_QUEUED = "queued"
 STATUS_PREEMPTED = "preempted"
 
+# Event reasons (ISSUE 12): one per decision transition, posted on the
+# gang's Job. ReAdmitted is distinct from Admitted on purpose — the
+# Drained→ReAdmitted pair on one Job is the whole drain/recovery story
+# in two `tpuctl events --for` rows.
+EVENT_ADMITTED = "Admitted"
+EVENT_READMITTED = "ReAdmitted"
+EVENT_PREEMPTED = "Preempted"
+EVENT_DRAINED = "Drained"
+
+# The drain decision's reason prefix — shared between the decision text
+# and the restarted-controller event-memo recovery (step() seeds
+# _events_noted from live annotations so a drained gang re-admitted by
+# a FRESH process still reads ReAdmitted, not Admitted).
+DRAIN_REASON_PREFIX = "reservation drained"
+
 NODES_PATH = "/api/v1/nodes"
 
 # Node label carrying the host's accelerator type (the feature-discovery
@@ -412,10 +427,23 @@ class AdmissionController:
 
     def __init__(self, client: kubeapply.Client, namespace: str,
                  telemetry: Optional[_telemetry.Telemetry] = None,
-                 informers: Optional[Any] = None) -> None:
+                 informers: Optional[Any] = None,
+                 events: Optional[Any] = None) -> None:
         self.client = client
         self.namespace = namespace
         self.telemetry = telemetry
+        # Events pipeline (ISSUE 12): an events.EventRecorder. Each
+        # admission DECISION TRANSITION (Admitted / Preempted / Drained
+        # / ReAdmitted) lands exactly one correlated Event on the
+        # gang's Job — the operator-facing record that until now lived
+        # only in the gang-reason annotation. FIRE-AND-FORGET by
+        # design: the emission memo commits when the emit is attempted,
+        # not when it lands, so a failed Event post is NEVER re-sent by
+        # the controller loop (the recorder's fail-open contract,
+        # pinned by test_admission.py) — unlike the annotations above,
+        # which ARE re-sent until they land. None (default) = no
+        # events, byte-identical passes.
+        self.events = events
         # Watch-driven mode (ISSUE 11): an informer.InformerSet holding
         # the nodes + jobs collections. When attached (and synced),
         # _read_cluster reads SNAPSHOTS instead of LISTing — a pass
@@ -432,6 +460,11 @@ class AdmissionController:
         self._queued_since: Dict[str, float] = {}  # guarded-by: _lock
         self._last_published: Optional[str] = None  # guarded-by: _lock
         self._last_annotations: Dict[str, Tuple[str, str]] = {}  # guarded-by: _lock
+        # last Event reason ATTEMPTED per gang (fire-and-forget memo —
+        # see the `events` comment above); also how ReAdmitted is told
+        # apart from Admitted (a gang whose last event was Drained/
+        # Preempted comes BACK as ReAdmitted)
+        self._events_noted: Dict[str, str] = {}  # guarded-by: _lock
         self._bootstrapped = False  # guarded-by: _lock
         self.passes = 0  # guarded-by: _lock
 
@@ -507,10 +540,11 @@ class AdmissionController:
         tel = self.telemetry
         with _telemetry.maybe_span(tel, "admission-pass", "admission"):
             self._maybe_bootstrap()
-            hosts, gangs, _jobs = self._read_cluster()
+            hosts, gangs, jobs = self._read_cluster()
+            self._seed_event_memo(jobs)
             now = time.monotonic()
-            publish_payload, annotate, result = self._reconcile(
-                hosts, gangs, now)
+            publish_payload, annotate, emit_events, result = \
+                self._reconcile(hosts, gangs, now)
             if publish_payload is not None:
                 # commit the published-state memo only AFTER the write
                 # lands: a failed publish must be retried next pass, not
@@ -533,6 +567,17 @@ class AdmissionController:
                     with self._lock:
                         self._last_annotations[gang_name] = (status,
                                                              reason)
+            # decision-transition Events (ISSUE 12), OUTSIDE the lock
+            # and fire-and-forget: the memo already committed in
+            # _reconcile, so a failed post is never re-sent (pinned)
+            rec = self.events
+            if rec is not None:
+                for gang_name, ev_reason, ev_message, ev_type in \
+                        emit_events:
+                    involved = jobs.get(gang_name)
+                    if involved is not None:
+                        rec.emit(involved, ev_reason, ev_message,
+                                 type_=ev_type)
             if tel is not None:
                 tel.event("admission-result", gangs=result.gangs,
                           admitted=len(result.admitted),
@@ -571,18 +616,51 @@ class AdmissionController:
                 self._admitted = recovered
                 self._last_published = last
 
+    def _seed_event_memo(self, jobs: Mapping[str, Mapping[str, Any]]
+                         ) -> None:
+        """Recover the fire-and-forget event memo for gangs this
+        process has never decided on: every `tpuctl admission --once`
+        is a fresh process, so without recovery a gang the PREDECESSOR
+        drained/preempted would come back as plain Admitted instead of
+        ReAdmitted. The predecessor's decision is read from the gang
+        Job's live annotations (the same state the queue CLI renders);
+        a gang with no decision annotation seeds nothing — its next
+        transition emits normally."""
+        if self.events is None:
+            return
+        with self._lock:
+            for name, job in jobs.items():
+                if name in self._events_noted:
+                    continue
+                anns = ((job.get("metadata") or {})
+                        .get("annotations") or {})
+                status = str(anns.get(GANG_STATUS_ANNOTATION, ""))
+                reason = str(anns.get(GANG_REASON_ANNOTATION, ""))
+                if status == STATUS_PREEMPTED:
+                    self._events_noted[name] = EVENT_PREEMPTED
+                elif status == STATUS_QUEUED and \
+                        reason.startswith(DRAIN_REASON_PREFIX):
+                    self._events_noted[name] = EVENT_DRAINED
+                elif status == STATUS_ADMITTED:
+                    self._events_noted[name] = EVENT_ADMITTED
+
     def _reconcile(self, hosts: Sequence[HostCapacity],
                    gangs: Sequence[GangRequest], now: float
                    ) -> Tuple[Optional[str],
+                              List[Tuple[str, str, str, str]],
                               List[Tuple[str, str, str, str]], PassResult]:
         """The pure half of a pass: arbitrate under the lock and decide
-        what to write (ConfigMap payload, per-Job annotations) WITHOUT
-        doing any I/O. Returns (payload-or-None, [(gang, job_path,
-        status, reason)], result). The written-state memos
+        what to write (ConfigMap payload, per-Job annotations, decision
+        Events) WITHOUT doing any I/O. Returns (payload-or-None,
+        [(gang, job_path, status, reason)], [(gang, event_reason,
+        message, event_type)], result). The written-state memos
         (_last_published / _last_annotations) are NOT updated here —
         step() commits them only after the corresponding write lands, so
         a failed write is retried on the next pass instead of being
-        latched as done."""
+        latched as done. The EVENT memo (_events_noted) is the
+        deliberate exception: it commits here, before any I/O, because
+        events are fire-and-forget — a failed post must NOT be
+        re-attempted by the next pass (the fail-open pin)."""
         tel = self.telemetry
         result = PassResult(gangs=len(gangs))
         with self._lock:
@@ -604,8 +682,8 @@ class AdmissionController:
                     result.drained.append(name)
                     outcome.decisions[name] = Decision(
                         STATUS_QUEUED,
-                        f"reservation drained: host {lost[0]} NotReady; "
-                        "re-queued for re-admission")
+                        f"{DRAIN_REASON_PREFIX}: host {lost[0]} "
+                        "NotReady; re-queued for re-admission")
                 else:
                     new_holders = sorted(
                         o.gang for o in outcome.admitted.values()
@@ -661,6 +739,45 @@ class AdmissionController:
             for name in list(self._last_annotations):
                 if name not in live:
                     self._last_annotations.pop(name, None)
+            # decision-transition Events: computed (and MEMO-COMMITTED)
+            # under the lock, emitted by step() after it. newly_admitted
+            # reads the memo BEFORE overwriting, so a gang whose last
+            # event was Drained/Preempted comes back as ReAdmitted.
+            emit: List[Tuple[str, str, str, str]] = []
+            if self.events is not None:
+                for name in result.drained:
+                    if self._events_noted.get(name) != EVENT_DRAINED:
+                        self._events_noted[name] = EVENT_DRAINED
+                        emit.append((name, EVENT_DRAINED,
+                                     outcome.decisions[name].reason,
+                                     "Warning"))
+                for victim, _by in result.preempted:
+                    if self._events_noted.get(victim) != EVENT_PREEMPTED:
+                        self._events_noted[victim] = EVENT_PREEMPTED
+                        emit.append((victim, EVENT_PREEMPTED,
+                                     outcome.decisions[victim].reason,
+                                     "Warning"))
+                for name in result.newly_admitted:
+                    prev = self._events_noted.get(name)
+                    came_back = prev in (EVENT_DRAINED, EVENT_PREEMPTED)
+                    ev_reason = (EVENT_READMITTED if came_back
+                                 else EVENT_ADMITTED)
+                    if prev != ev_reason:
+                        self._events_noted[name] = ev_reason
+                        message = outcome.decisions[name].reason
+                        if came_back:
+                            # name what the gang recovered FROM — the
+                            # operator-facing half of the story, and
+                            # what keeps back-to-back recoveries from
+                            # aggregating into one counted Event
+                            cause = ("drain" if prev == EVENT_DRAINED
+                                     else "preemption")
+                            message = (f"re-admitted after {cause}: "
+                                       f"{message}")
+                        emit.append((name, ev_reason, message, "Normal"))
+                for name in list(self._events_noted):
+                    if name not in live:
+                        self._events_noted.pop(name, None)
         if tel is not None:
             for accelerator, waited in admit_waits:
                 tel.histogram(
@@ -673,7 +790,7 @@ class AdmissionController:
             for _victim, _by in result.preempted:
                 tel.counter(_telemetry.PREEMPTIONS_TOTAL,
                             "whole-gang priority preemptions").inc()
-        return publish, annotate, result
+        return publish, annotate, emit, result
 
     # ------------------------------------------------------------- loop
 
@@ -712,7 +829,7 @@ class AdmissionController:
         self.informers = informermod.InformerSet(
             self.client, [NODES_PATH, self._jobs_path()],
             telemetry=self.telemetry, page_limit=limit,
-            window_s=window_s)
+            window_s=window_s, events=self.events)
         return self.informers
 
     def run_watch(self, resync: float = 30.0,

@@ -63,10 +63,20 @@ class Informer:
                  telemetry: Optional[_telemetry.Telemetry] = None,
                  page_limit: int = DEFAULT_PAGE_LIMIT,
                  window_s: int = 30,
-                 notify: Optional[Callable[[], None]] = None) -> None:
+                 notify: Optional[Callable[[], None]] = None,
+                 events: Optional[Any] = None) -> None:
         self.client = client
         self.path = path
         self.telemetry = telemetry
+        # Events pipeline (ISSUE 12): an events.EventRecorder. The
+        # informer reports its two operationally-interesting states as
+        # Events on the collection it watches: a 410-driven re-LIST
+        # ("Relisted" — a RELIST STORM shows up as ONE aggregated Event
+        # with a climbing count, which is the point) and a terminal
+        # watch failure ("SyncLost", Warning — the cache is frozen and
+        # consumers are about to find out). Fail-open like every other
+        # recorder call site; None (default) = no events.
+        self.event_recorder = events
         self.page_limit = max(1, int(page_limit))
         self.window_s = max(1, int(window_s))
         self._notify = notify
@@ -230,6 +240,25 @@ class Informer:
                         "full informer re-LISTs (initial sync + 410 "
                         "resume)", collection=self.path,
                         reason=reason).inc()
+        rec = self.event_recorder
+        if rec is not None and reason != "initial":
+            # the initial sync is routine; RESUMES are the signal — and
+            # a storm of them aggregates into one counted Event
+            from . import events as eventsmod
+            rec.emit(eventsmod.collection_ref(self.path), "Relisted",
+                     f"informer on {self.path} re-listed after a "
+                     f"{reason} watch invalidation")
+
+    def _note_sync_lost(self, detail: str) -> None:
+        """One SyncLost Warning when the informer goes terminal — the
+        cache is FROZEN from here on and consumers' check() is about to
+        start raising."""
+        rec = self.event_recorder
+        if rec is not None:
+            from . import events as eventsmod
+            rec.emit(eventsmod.collection_ref(self.path), "SyncLost",
+                     f"informer on {self.path} lost its watch: {detail}",
+                     type_="Warning")
 
     def _count_events(self, by_type: Dict[str, int]) -> None:
         tel = self.telemetry
@@ -250,6 +279,7 @@ class Informer:
             with self._cond:
                 self._error = str(exc)
                 self._cond.notify_all()
+            self._note_sync_lost(str(exc))
             return None
         if self._stop.is_set():
             # stopped while the LIST was in flight: drop the result —
@@ -291,6 +321,7 @@ class Informer:
                 with self._cond:
                     self._error = f"watch denied: {exc}"
                     self._cond.notify_all()
+                self._note_sync_lost(f"watch denied: {exc}")
                 return
             with self._conn_lock:
                 self._conn = conn
@@ -377,12 +408,13 @@ class InformerSet:
     def __init__(self, client: kubeapply.Client, paths: List[str],
                  telemetry: Optional[_telemetry.Telemetry] = None,
                  page_limit: int = DEFAULT_PAGE_LIMIT,
-                 window_s: int = 30) -> None:
+                 window_s: int = 30,
+                 events: Optional[Any] = None) -> None:
         self._wake = threading.Event()
         self.informers: Dict[str, Informer] = {
             path: Informer(client, path, telemetry=telemetry,
                            page_limit=page_limit, window_s=window_s,
-                           notify=self._wake.set)
+                           notify=self._wake.set, events=events)
             for path in paths}
 
     def start(self) -> "InformerSet":

@@ -621,6 +621,38 @@ def _ssa_is_noop(live: Optional[Dict[str, Any]], desired: Dict[str, Any],
     return _merge_patch(live, desired) == live
 
 
+class _EventObjScope:
+    """Context manager pushing one object onto the calling thread's
+    event-context stack (``Client._local.event_objs``) so transport-
+    level Event emissions can name the object being applied. The
+    null-scope singleton below keeps the events=None hot path free of
+    any per-call allocation or thread-local traffic."""
+
+    __slots__ = ("_local", "_obj")
+
+    def __init__(self, local: Any, obj: Optional[Dict[str, Any]]) -> None:
+        self._local = local
+        self._obj = obj
+
+    def __enter__(self) -> "_EventObjScope":
+        if self._obj is not None:
+            stack = getattr(self._local, "event_objs", None)
+            if stack is None:
+                stack = []
+                self._local.event_objs = stack
+            stack.append(self._obj)
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        if self._obj is not None:
+            stack = getattr(self._local, "event_objs", None)
+            if stack:
+                stack.pop()
+
+
+_NULL_EVENT_SCOPE = _EventObjScope(None, None)
+
+
 @dataclass
 class Client:
     base_url: str
@@ -694,6 +726,20 @@ class Client:
     # builds the rollout span tree around it. None (default) = zero
     # overhead, identical behavior.
     telemetry: Optional[_telemetry.Telemetry] = None
+    # Kubernetes Events pipeline (ISSUE 12): an
+    # tpu_cluster.events.EventRecorder (duck-typed Any — events.py
+    # imports this module, not the reverse). When attached, the apply
+    # paths record operational Events next to the objects they touch:
+    # Retrying / RetryExhausted on the retry taxonomy, DeadlineExceeded
+    # on budget exhaustion, HedgeFired on a hedge, WatchResumed on a
+    # 410 watch resume. Emission is FAIL-OPEN by the recorder's
+    # contract (one wire attempt, never raises, failures counted in
+    # tpuctl_event_emit_failures_total) and rides request_once(), so it
+    # can never recurse into this client's retry/budget/hedge
+    # machinery. None (default) = byte-identical request+mutation
+    # multiset (the pin in tests/test_events.py, the telemetry=None
+    # shape).
+    events: Any = None
     _warned_insecure: bool = field(default=False, repr=False, compare=False)
     _local: Any = field(default=None, repr=False, compare=False)
     _conns: Any = field(default=None, repr=False, compare=False)
@@ -1243,6 +1289,15 @@ class Client:
                 # never-hedge-a-429 test)
                 saw_429 = True
             if code not in policy.retryable or attempt >= policy.attempts:
+                if code in policy.retryable:
+                    # the retry budget ran out on a still-retryable
+                    # answer — the Event the operator greps for when an
+                    # apply gave up (ISSUE 12)
+                    self._emit_event(
+                        "Warning", "RetryExhausted",
+                        f"{method} {path.partition('?')[0]} still "
+                        f"failing ({code}) after {attempt} attempt(s)",
+                        path=path)
                 return code, parsed
             with self._retry_lock:
                 self.retries += 1
@@ -1264,6 +1319,13 @@ class Client:
                     "retry", code=code, attempt=attempt,
                     classification=policy.classify(code),
                     backoff_s=round(backoff, 4))
+            # stable message per (object, verb, path, code) so a retry
+            # STORM aggregates into one counted Event instead of one
+            # row per attempt (the anti-spam soak pin)
+            self._emit_event(
+                "Warning", "Retrying",
+                f"{method} {path.partition('?')[0]} answered {code}; "
+                "retrying under backoff", path=path)
             time.sleep(backoff)
 
     def _deadline_error(self, context: str) -> DeadlineExceeded:
@@ -1284,6 +1346,9 @@ class Client:
                 for e in events[:3]]
         hint = (f"; slowest attempts: {', '.join(slowest)}"
                 if slowest else "")
+        self._emit_event(
+            "Warning", "DeadlineExceeded",
+            f"rollout deadline ({total:.1f}s) exhausted during {context}")
         return DeadlineExceeded(
             f"rollout deadline ({total:.1f}s) exhausted during "
             f"{context}{hint}", slowest_attempts=slowest)
@@ -1416,6 +1481,11 @@ class Client:
                     method, path, None, "")
         finally:
             primary_done.set()
+        if fired:
+            self._emit_event(
+                "Normal", "HedgeFired",
+                f"GET {path.partition('?')[0]} hedged with a backup "
+                f"attempt past the {hedge_s:.3g}s threshold", path=path)
         if code != 0 or not fired:
             return code, parsed, retry_after
         # the primary failed after a hedge fired: prefer the backup's
@@ -1427,6 +1497,67 @@ class Client:
 
     def get(self, path: str) -> Tuple[int, Dict[str, Any]]:
         return self._request("GET", path)
+
+    def request_once(self, method: str, path: str,
+                     body: Optional[Dict[str, Any]] = None,
+                     content_type: str = "application/json"
+                     ) -> Tuple[int, Dict[str, Any]]:
+        """ONE wire attempt — no RetryPolicy loop, no budget-exhaustion
+        raise, no hedging. The Events pipeline's fail-open transport
+        (ISSUE 12): an Event write must cost at most one attempt and
+        must not recurse into the retry machinery that may itself be
+        emitting the event. Uses whichever transport the client is
+        configured with (mux / keep-alive / oneshot), so it still
+        respects the whole-attempt wall and records telemetry like any
+        other attempt."""
+        data = json.dumps(body).encode() if body is not None else None
+        if self._mux_transport is not None:
+            code, parsed, _ra = self._request_mux(method, path, data,
+                                                  content_type)
+        elif self.keep_alive:
+            code, parsed, _ra = self._request_keepalive(method, path,
+                                                        data, content_type)
+        else:
+            code, parsed, _ra = self._request_oneshot(method, path, data,
+                                                      content_type)
+        return code, parsed
+
+    # ------------------------------------------------------------- events
+    # (ISSUE 12): the apply paths keep a per-thread "current object"
+    # stack so transport-level emissions (retry/deadline/hedge live in
+    # _request, which never sees the object) can name the object they
+    # happened FOR. Zero overhead with events=None: the scope helper
+    # returns a shared null scope and no stack is ever created.
+
+    def _event_scope(self, obj: Dict[str, Any]) -> "_EventObjScope":
+        if self.events is None:
+            return _NULL_EVENT_SCOPE
+        return _EventObjScope(self._local, obj)
+
+    def _event_involved(self) -> Optional[Dict[str, Any]]:
+        stack = getattr(self._local, "event_objs", None)
+        return stack[-1] if stack else None
+
+    def _emit_event(self, type_: str, reason: str, message: str,
+                    involved: Optional[Dict[str, Any]] = None,
+                    path: Optional[str] = None) -> None:
+        """Fail-open event emission about the current (or an explicit)
+        involved object. With neither, ``path`` derives a best-effort
+        reference (events.path_ref) so transport-level events outside
+        any apply context — a prefetch LIST retrying, a readiness GET
+        storm — still land next to SOMETHING greppable; with nothing
+        nameable at all, silently a no-op."""
+        rec = self.events
+        if rec is None:
+            return
+        if involved is None:
+            involved = self._event_involved()
+        if involved is None and path is not None:
+            from . import events as _events
+            involved = _events.path_ref(path)
+        if involved is None:
+            return
+        rec.emit(involved, reason, message, type_=type_)
 
     def list_collection(self, path: str,
                         limit: Optional[int] = None
@@ -1538,7 +1669,13 @@ class Client:
         return out
 
     def apply(self, obj: Dict[str, Any]) -> str:
-        """Create-or-patch one object; returns 'created' | 'patched'."""
+        """Create-or-patch one object; returns 'created' | 'patched'.
+        The object is this thread's event context for the duration
+        (transport-level Events name the object being applied)."""
+        with self._event_scope(obj):
+            return self._apply_merge_path(obj)
+
+    def _apply_merge_path(self, obj: Dict[str, Any]) -> str:
         path = object_path(obj)
         obj = self._annotated(obj)
         code, resp = self.get(path)
@@ -1586,12 +1723,14 @@ class Client:
         probe lock through its round trip, so a concurrent first tier
         cannot fan N probe requests at an apiserver that will 415 them
         all."""
-        with self._ssa_probe_lock:
-            if self.ssa_supported is None:
-                # capability unknown: probe while HOLDING the lock, so a
-                # concurrent first tier serializes on one probe request
-                return self._apply_ssa_once(obj, force, manager)
-        return self._apply_ssa_once(obj, force, manager)
+        with self._event_scope(obj):
+            with self._ssa_probe_lock:
+                if self.ssa_supported is None:
+                    # capability unknown: probe while HOLDING the lock,
+                    # so a concurrent first tier serializes on one probe
+                    # request
+                    return self._apply_ssa_once(obj, force, manager)
+            return self._apply_ssa_once(obj, force, manager)
 
     def _apply_ssa_once(self, obj: Dict[str, Any], force: bool,
                         manager: str) -> Tuple[str, Dict[str, Any]]:
@@ -2064,6 +2203,14 @@ class Client:
             if expired:
                 # expired RV: re-LIST for fresh state + a resumable RV,
                 # then re-watch on the next loop turn
+                if members:
+                    # one Event per resume, on the collection's first
+                    # waited object (aggregation collapses a flap storm)
+                    self._emit_event(
+                        "Normal", "WatchResumed",
+                        f"watch on {coll} invalidated (410 Gone); "
+                        "re-listing and re-watching",
+                        involved=members[0])
                 try:
                     rv = relist()
                 except _WatchDenied as exc:
@@ -2875,8 +3022,9 @@ def _apply_one_cached(client: Client, obj: Dict[str, Any],
     name = f"{obj['kind']}/{obj['metadata']['name']}"
     with _telemetry.maybe_span(tel, name, "apply",
                                parent=parent_span) as span:
-        action = _apply_one_uncounted(client, obj, cache, cache_lock,
-                                      mode_state)
+        with client._event_scope(obj):
+            action = _apply_one_uncounted(client, obj, cache, cache_lock,
+                                          mode_state)
         if span is not None:
             span.annotate("action", action)
         if action == "unchanged" and tel is not None:

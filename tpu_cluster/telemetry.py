@@ -124,6 +124,16 @@ LIST_PAGES_TOTAL = "tpuctl_list_pages_total"
 INFORMER_EVENTS_TOTAL = "tpuctl_informer_events_total"
 INFORMER_RELISTS_TOTAL = "tpuctl_informer_relists_total"
 INFORMER_LAG_SECONDS = "tpuctl_informer_lag_seconds"
+# Kubernetes Events pipeline (ISSUE 12): the recorder's own vitals.
+# EMITTED counts every emit() that reached the wire (new Event POSTs and
+# aggregated count-bump PATCHes alike, labeled by reason); DROPPED
+# counts emits the token-bucket spam filter refused before any request;
+# EMIT_FAILURES is the fail-open contract's only failure surface — a
+# refused/failed Event write bumps it and NOTHING else happens (no
+# retry, no raised error, the hot path proceeds).
+EVENTS_EMITTED_TOTAL = "tpuctl_events_emitted_total"
+EVENTS_DROPPED_TOTAL = "tpuctl_events_dropped_total"
+EVENT_EMIT_FAILURES_TOTAL = "tpuctl_event_emit_failures_total"
 
 # Fixed default buckets, request-latency shaped (seconds). Shared with
 # the ready-wait histogram: its tail rides the +Inf bucket.
@@ -484,6 +494,7 @@ class Span:
         if self.end_s is None:
             self.end_s = time.monotonic() - self.tracer.t0
             self._record_end()
+            self.tracer._discard_ended_root(self)
 
     def _record_end(self) -> None:
         """Feed the flight recorder one completed-span record (called
@@ -548,6 +559,14 @@ class Tracer:
         # once before instrumentation starts (the Telemetry constructor),
         # read by every recording thread
         self.recorder: Optional["FlightRecorder"] = None
+        # span retention: True keeps every finished span for a later
+        # chrome_trace()/write_trace() export (one-shot rollouts); False
+        # drops a finished parentless span (and with it its whole
+        # subtree) — the mode for long-running controllers whose trace
+        # is never exported, where retaining every pass's tree would
+        # grow without bound. Set once before instrumentation starts
+        # (the Telemetry constructor), like `recorder`.
+        self.retain_spans = True
         self.lock: Any = threading.Lock()
         self.roots: List[Span] = []  # guarded-by: lock
         self._tls = threading.local()  # thread-owned (per-thread stack)
@@ -607,7 +626,20 @@ class Tracer:
         span.start_s = max(0.0, span.start_s - max(0.0, duration_s))
         span.end_s = span.start_s + max(0.0, duration_s)
         span._record_end()
+        self._discard_ended_root(span)
         return span
+
+    def _discard_ended_root(self, span: Span) -> None:
+        """With retention off, a finished parentless span is dropped
+        from ``roots`` — the flight recorder (already fed on end) and
+        the metrics registry are the bounded surfaces that remain."""
+        if self.retain_spans or span.parent is not None:
+            return
+        with self.lock:
+            try:
+                self.roots.remove(span)
+            except ValueError:
+                pass
 
     def event(self, name: str, **args: Any) -> None:
         """Instant event on the calling thread's innermost open span
@@ -791,8 +823,10 @@ class Telemetry:
     """The facade instrumented code holds: one tracer + one registry
     (+ optionally one flight recorder fed by the tracer)."""
 
-    def __init__(self, recorder: Optional[FlightRecorder] = None) -> None:
+    def __init__(self, recorder: Optional[FlightRecorder] = None,
+                 retain_spans: bool = True) -> None:
         self.tracer = Tracer()
+        self.tracer.retain_spans = retain_spans
         self.metrics = MetricsRegistry()
         self.recorder = recorder
         if recorder is not None:
@@ -935,12 +969,25 @@ def summarize_trace(trace: Dict[str, Any], limit: int = 10) -> str:
         f"{s}: {n}" for s, n in sorted(by_status.items()))
     lines.append(f"requests: {len(reqs)} ({verb_text})")
     lines.append(f"  by status: {status_text}")
-    retries = [e for e in trace["traceEvents"]
-               if isinstance(e, dict) and e.get("ph") == "i"
-               and e.get("name") == "retry"]
+    instants = [e for e in trace["traceEvents"]
+                if isinstance(e, dict) and e.get("ph") == "i"]
+    retries = [e for e in instants if e.get("name") == "retry"]
     if retries:
         lines.append(f"  retries: {len(retries)} "
                      "(see instant events in the trace)")
+    if instants:
+        # instant events (retry/hedge/chaos marks, admission results)
+        # are the trace's "what happened" annotations — a summary that
+        # drops them hides exactly the interesting runs (ISSUE 12's
+        # `tpuctl top` fix)
+        by_name: Dict[str, int] = {}
+        for e in instants:
+            n = str(e.get("name", "?"))
+            by_name[n] = by_name.get(n, 0) + 1
+        lines.append("")
+        lines.append("instant events (by name):")
+        for n, count in sorted(by_name.items()):
+            lines.append(f"  {n:<22} {count:6d}")
     lines.append("")
     lines.append(f"slowest spans (top {limit}):")
     interesting = [e for e in complete
