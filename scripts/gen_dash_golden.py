@@ -1,0 +1,101 @@
+#!/usr/bin/env python3
+"""Regenerate the `tpuctl dash --replay` golden pair (ISSUE 13):
+
+  tests/fixtures/dash_tsdb.json    a dumped TSDB snapshot (synthetic,
+                                   fixed timestamps — no clocks)
+  tests/fixtures/dash_golden.txt   the frame `tpuctl dash --once
+                                   --replay dash_tsdb.json` must render
+                                   BYTE-EXACT (tier-1 + the CI live
+                                   metrics gate both diff against it)
+
+Run with --check to verify the committed pair is self-consistent (the
+CI mode); with no flags it rewrites both files. The snapshot is built
+from literal samples so the golden can only change when the renderer
+or the TSDB query semantics change — which is exactly when a human
+should be looking at the diff.
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tpu_cluster import metricsdb  # noqa: E402
+
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "dash_tsdb.json")
+GOLDEN = os.path.join(REPO, "tests", "fixtures", "dash_golden.txt")
+
+# The snapshot timeline: 60s of scrapes at 2s cadence, "now" = t=120.
+T0, T1, STEP = 60.0, 120.0, 2.0
+
+
+def build() -> metricsdb.TSDB:
+    tsdb = metricsdb.TSDB(retention_s=600.0, staleness_s=30.0,
+                          clock=lambda: T1)
+    ticks = int((T1 - T0) / STEP) + 1
+    for i in range(ticks):
+        ts = T0 + i * STEP
+        # two healthy targets, one dead one
+        for job in ("fake", "tpuctl"):
+            tsdb.append("up", {"job": job}, 1.0, ts=ts, mtype="gauge")
+        tsdb.append("up", {"job": "operator"}, 0.0, ts=ts,
+                    mtype="gauge")
+        # fake: a steady 12 req/s with a mid-window 503 wave
+        tsdb.append("fake_apiserver_requests_total",
+                    {"job": "fake", "verb": "GET", "path": "/api/v1",
+                     "code": "200"},
+                    1000.0 + i * 24.0, ts=ts, mtype="counter")
+        bad = 30.0 + 18.0 * min(max(i - 9, 0), 7)  # a mid-window wave
+        tsdb.append("fake_apiserver_requests_total",
+                    {"job": "fake", "verb": "PATCH", "path": "/api/v1",
+                     "code": "503"},
+                    bad, ts=ts, mtype="counter")
+        # tpuctl: client counters + a latency histogram ramp
+        tsdb.append("tpuctl_requests_total",
+                    {"job": "tpuctl", "verb": "GET", "code": "200"},
+                    500.0 + i * 20.0, ts=ts, mtype="counter")
+        for le, per_tick in (("0.005", 16.0), ("0.025", 19.0),
+                             ("0.1", 19.8), ("+Inf", 20.0)):
+            tsdb.append("tpuctl_request_duration_seconds_bucket",
+                        {"job": "tpuctl", "verb": "GET", "le": le},
+                        100.0 + i * per_tick, ts=ts, mtype="counter")
+        # events ride the fake's audit
+        tsdb.append("fake_apiserver_events_total",
+                    {"job": "fake", "reason": "Retrying"},
+                    4.0 + i * 0.5, ts=ts, mtype="counter")
+        tsdb.append("fake_apiserver_events_total",
+                    {"job": "fake", "reason": "Admitted"},
+                    1.0 + (1.0 if i >= 20 else 0.0), ts=ts,
+                    mtype="counter")
+    # TYPE lines ride ingest normally; dumped types matter for replay
+    return tsdb
+
+
+def main() -> int:
+    check = "--check" in sys.argv[1:]
+    tsdb = build()
+    dump = json.dumps(tsdb.dump(), indent=1, sort_keys=True) + "\n"
+    golden = metricsdb.render_dash(metricsdb.TSDB.load(
+        json.loads(dump)), window_s=60.0) + "\n"
+    if check:
+        ok = True
+        for path, want in ((FIXTURE, dump), (GOLDEN, golden)):
+            with open(path, encoding="utf-8") as f:
+                have = f.read()
+            if have != want:
+                print(f"STALE: {path} (rerun scripts/gen_dash_golden.py)")
+                ok = False
+        print("dash golden pair " + ("in sync" if ok else "STALE"))
+        return 0 if ok else 1
+    with open(FIXTURE, "w", encoding="utf-8") as f:
+        f.write(dump)
+    with open(GOLDEN, "w", encoding="utf-8") as f:
+        f.write(golden)
+    print(f"wrote {FIXTURE}\nwrote {GOLDEN}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -737,11 +737,44 @@ class FakeApiServer:
         # bumped by flap(): streams opened under an older epoch end with
         # ERROR/410 — "the apiserver you were watching restarted"
         self._flap_epoch = 0  # guarded-by: _lock
+        # Live connections (ISSUE 13): ThreadingHTTPServer's shutdown()
+        # stops the LISTENER but not established handler threads, so an
+        # in-process "restart" (stop() + a new instance on the pinned
+        # port) used to leave ZOMBIE handlers serving the old store —
+        # watch streams until their window expired, and plain
+        # keep-alive connections (a scraper's, a pooled Client's)
+        # INDEFINITELY. stop() severs every live connection so the old
+        # world dies NOW; flap() severs only the watch streams (its
+        # contract is watch invalidation — the store survives a flap).
+        # Both pinned by test_metricsdb's restart test. Own leaf lock:
+        # register/sever never nest with _lock or the audit lock (the
+        # lockorder soak pins the fake's edge set).
+        self._conns: List[Any] = []  # guarded-by: _conns_lock
+        self._watch_conns: List[Any] = []  # guarded-by: _conns_lock
+        self._conns_lock = threading.Lock()
 
         fake = self
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+
+            def setup(self):
+                super().setup()
+                # every connection is severable at stop(): a parked
+                # keep-alive handler must die with its "restarted"
+                # server, not zombie-serve the old store (see _conns)
+                with fake._conns_lock:
+                    fake._conns.append(self.connection)
+
+            def finish(self):
+                try:
+                    super().finish()
+                finally:
+                    with fake._conns_lock:
+                        try:
+                            fake._conns.remove(self.connection)
+                        except ValueError:
+                            pass
 
             def log_message(self, *args):
                 pass
@@ -1137,9 +1170,15 @@ class FakeApiServer:
                     if self._chaos(is_watch):
                         return
                     if is_watch:
+                        # registered for the restart sever: stop()/
+                        # flap() shut this socket down so the stream
+                        # dies with the "restarted" server instead of
+                        # zombie-serving the old store to window end
+                        fake._watch_register(self.connection)
                         try:
                             self._serve_watch(path, q)
                         finally:
+                            fake._watch_unregister(self.connection)
                             # the stream's span covers its whole lifetime
                             # — open to window end / invalidation /
                             # client gone
@@ -1505,7 +1544,15 @@ class FakeApiServer:
     def stop(self):
         if self.chaos is not None:
             self.chaos.stop()
+        # listener down FIRST (shutdown blocks until the accept loop
+        # exits, so no new handler can register), THEN sever every
+        # established connection — watch streams AND parked keep-alive
+        # ones — which would otherwise keep serving the old store, a
+        # zombie the client holding them never noticed (see _conns).
+        # Severing first would race a connection accepted between the
+        # snapshot and the shutdown.
         self._server.shutdown()
+        self._sever_all()
         self._server.server_close()
 
     @property
@@ -1798,12 +1845,56 @@ class FakeApiServer:
         and every in-flight watch stream is invalidated with ERROR/410 —
         clients must re-LIST and re-watch. The store itself survives (etcd
         outlived the restart), and the revision counter jumps the way a
-        restarted apiserver's resourceVersions do."""
+        restarted apiserver's resourceVersions do. Streams parked in a
+        blocking send (or opened a breath before the epoch bump) are
+        additionally SEVERED — outside the store lock — so no watch
+        handler can keep serving pre-flap state past the restart."""
         with self._lock:
             self._rev += 1000
             self._changes.clear()
             self._flap_epoch += 1
             self._changed.notify_all()
+        self._sever_watches()
+
+    # Severing helpers: each takes ONLY the leaf _conns_lock (the
+    # lockorder soak pins the fake's edge set — severing must not nest
+    # under _lock). shutdown(SHUT_RDWR) is the only thing that
+    # reliably unblocks both a handler's next write and the client's
+    # blocking readline (the PR 9 sever rule); handler threads then
+    # unwind through their BrokenPipe handling and unregister.
+
+    def _watch_register(self, conn) -> None:
+        with self._conns_lock:
+            self._watch_conns.append(conn)
+
+    def _watch_unregister(self, conn) -> None:
+        with self._conns_lock:
+            try:
+                self._watch_conns.remove(conn)
+            except ValueError:
+                pass
+
+    def _sever_all(self) -> None:
+        """Sever EVERY live connection (the stop()/restart path)."""
+        with self._conns_lock:
+            conns = list(self._conns)
+        self._shutdown_conns(conns)
+
+    def _sever_watches(self) -> None:
+        """Sever only the watch streams (the flap() contract: watches
+        invalidate, plain connections survive a flap like they survive
+        a real apiserver's graceful watch compaction)."""
+        with self._conns_lock:
+            conns = list(self._watch_conns)
+        self._shutdown_conns(conns)
+
+    @staticmethod
+    def _shutdown_conns(conns) -> None:
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
 
     # ------------------------------------------------------------- test hooks
 

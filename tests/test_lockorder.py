@@ -230,7 +230,8 @@ def test_soak_graph_is_cycle_free_and_pinned():
     # to review, and an edge INTO the probe lock would close a cycle.
     flat_files = ("kubeapply.py", "telemetry.py", "verify.py",
                   "lockorder.py", "conlint.py", "admission.py",
-                  "informer.py", "muxhttp.py", "events.py", "slo.py")
+                  "informer.py", "muxhttp.py", "events.py", "slo.py",
+                  "metricsdb.py")
     nested = _interesting(edges, flat_files)
     probe = "kubeapply.py:Client._ssa_probe_lock"
     unexpected = {e: s for e, s in nested.items() if e[0] != probe}
@@ -340,6 +341,57 @@ def test_event_recorder_lock_stays_leaf_only():
     outgoing = {e: s for e, s in edges.items() if "events.py" in e[0]}
     assert outgoing == {}, \
         f"events recorder lock held across another acquisition: {outgoing}"
+
+
+def test_metricsdb_locks_stay_leaf_only():
+    """The scrape pipeline's lock discipline (ISSUE 13): TSDB._lock
+    guards the series map, ScrapeManager._lock guards scrape
+    accounting, and BOTH are leaf-only — every wire attempt, parse,
+    cross-object ingest and telemetry emission happens outside them —
+    so a scrape loop feeding a live dashboard contributes ZERO
+    outgoing metricsdb edges. (The soak pin's flat_files names
+    metricsdb.py too; this drives scrape → ingest → query →
+    live-SLO → dash explicitly so the edge set is populated even when
+    run alone.)"""
+    monitor = lockorder.installed()
+    if monitor is None:
+        pytest.skip("lock-order monitor disabled (TPU_LOCKORDER=0)")
+    from tpu_cluster import metricsdb
+    tel = telemetry.Telemetry()
+    tsdb = metricsdb.TSDB()
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url, telemetry=tel)
+        client.apply({"apiVersion": "v1", "kind": "ConfigMap",
+                      "metadata": {"name": "lk-mdb",
+                                   "namespace": "default"}})
+        server = metricsdb.MetricsServer(tel.metrics, 0).start()
+        manager = metricsdb.ScrapeManager(
+            [metricsdb.Target("fake", api.url + "/__fake_metrics"),
+             metricsdb.Target("self", server.url)],
+            tsdb, interval_s=0.02, telemetry=tel)
+        try:
+            manager.start()
+            deadline = time.monotonic() + 10
+            while manager.scrapes() < 3 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            client.get("/api/v1/namespaces/default/configmaps/lk-mdb")
+            manager.scrape_once()
+            # the query layer under the monitor too
+            tsdb.rate("fake_apiserver_requests_total", 60.0)
+            tsdb.histogram_quantile(
+                0.99, telemetry.REQUEST_SECONDS, window_s=60.0)
+            metricsdb.live_slo_report(tsdb)
+            metricsdb.render_dash(tsdb)
+            tsdb.dump()
+        finally:
+            manager.stop()
+            server.stop()
+            client.close()
+    edges = monitor.snapshot_edges()
+    outgoing = {e: s for e, s in edges.items()
+                if "metricsdb.py" in e[0]}
+    assert outgoing == {}, \
+        f"metricsdb lock held across another acquisition: {outgoing}"
 
 
 def test_site_naming_is_stable_and_meaningful():

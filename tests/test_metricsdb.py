@@ -1,0 +1,813 @@
+"""Continuous-metrics suite (ISSUE 13): the scrape pipeline, the TSDB,
+live SLO evaluation and `tpuctl dash`.
+
+The pins, in module order:
+
+- PARSER: `parse_text(reg.render()).samples == reg.samples()` — the
+  render/parse symmetry contract — plus hostile-label fuzz (escaped
+  quotes/backslashes/newlines round-trip byte-exact through the real
+  renderer), label-free samples, +Inf buckets, junk rejection.
+- TSDB: counter-reset handling (a restarted target must never produce
+  a negative rate), staleness on instant reads, retention pruning,
+  histogram_quantile interpolation, dump/load determinism.
+- SCRAPER: ingest + self-metric synthesis against the real fake
+  apiserver, and the HARD fail-open pin — 100% of targets down leaves
+  the loop healthy, `up 0` everywhere, zero exceptions.
+- LIVE SLO: `tpuctl slo check --live` reaches the SAME verdict (rc and
+  burning window pairs) as the trace-derived path on one shared
+  chaos-soak run — the acceptance criterion — and a sustained 503
+  storm exits 1 through the real CLI.
+- DASH: `tpuctl dash --once --replay` renders the checked-in golden
+  frame byte-exact.
+- RESTART: an in-process FakeApiServer restart (stop() + new instance
+  on the pinned port) severs live watch streams — a client holding a
+  watch across the restart sees its stream DIE now, never a zombie
+  handler serving the pre-restart store until window expiry.
+"""
+
+import http.client
+import io
+import json
+import os
+import random
+import sys
+import threading
+import time
+from contextlib import redirect_stderr, redirect_stdout
+
+import pytest
+
+from fake_apiserver import FakeApiServer, standard_fault_script
+from tpu_cluster import kubeapply, metricsdb, slo, telemetry
+from tpu_cluster import spec as specmod
+from tpu_cluster.__main__ import main as cli_main
+from tpu_cluster.render import manifests
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures")
+DASH_TSDB = os.path.join(FIXTURES, "dash_tsdb.json")
+DASH_GOLDEN = os.path.join(FIXTURES, "dash_golden.txt")
+
+FAST_RETRY = kubeapply.RetryPolicy(attempts=8, base_s=0.02, cap_s=0.3)
+
+NASTY_LABELS = [
+    'plain', 'with "quotes"', "back\\slash", "new\nline",
+    'all\\three: "\\\n"', "\\n literal backslash-n", "trailing\\",
+    "", "comma,brace{}=equals", "unicode ✓ ✗",
+]
+
+
+# ------------------------------------------------------------------ parser
+
+
+def _nasty_registry() -> telemetry.MetricsRegistry:
+    reg = telemetry.MetricsRegistry()
+    for i, value in enumerate(NASTY_LABELS):
+        reg.counter("hostile_total", "hostile labels", label=value).inc(
+            i + 1)
+    reg.counter("bare_total", "no labels at all").inc(7)
+    reg.gauge("a_gauge", "negative and fractional").set(-2.75)
+    hist = reg.histogram("lat_seconds", "latency",
+                         buckets=(0.001, 0.25, 4.0), who='h"i\n\\')
+    # 0.1 + 0.2 on purpose: the sum is not binary-representable, so
+    # samples() must spell values through render()'s _fmt rounding or
+    # the parity pin below compares 0.30000000000000004 against the
+    # parsed 0.3 and fails
+    for v in (0.0001, 0.1, 0.2, 1.0, 99.0):
+        hist.observe(v)
+    return reg
+
+
+def test_parse_render_round_trip_parity_pin():
+    """THE symmetry contract: parsing render() output reproduces the
+    registry's flat sample set and family types exactly — histograms
+    included (cumulative le rows, +Inf, _sum, _count)."""
+    reg = _nasty_registry()
+    parsed = metricsdb.parse_text(reg.render())
+    assert parsed.samples == reg.samples()
+    assert parsed.types == reg.family_types()
+    # the +Inf bucket row exists and equals the observation count
+    inf_rows = [v for (name, pairs), v in parsed.samples.items()
+                if name == "lat_seconds_bucket"
+                and dict(pairs).get("le") == "+Inf"]
+    assert inf_rows == [5.0]
+
+
+def test_parser_hostile_label_fuzz_seeded():
+    """Randomized label values over the full escape alphabet
+    round-trip byte-exact through the REAL renderer (seeded — a
+    failure reproduces)."""
+    rng = random.Random(1337)
+    alphabet = 'ab"\\\n{},= \t✓'
+    values = ["".join(rng.choice(alphabet) for _ in range(rng.randint(0, 24)))
+              for _ in range(200)]
+    reg = telemetry.MetricsRegistry()
+    for i, value in enumerate(values):
+        reg.counter("fuzz_total", "", v=value, i=str(i)).inc()
+    parsed = metricsdb.parse_text(reg.render())
+    got = {dict(pairs)["i"]: dict(pairs)["v"]
+           for (name, pairs) in parsed.samples
+           if name == "fuzz_total"}
+    assert got == {str(i): v for i, v in enumerate(values)}
+
+
+def test_escape_unescape_inverse():
+    for value in NASTY_LABELS:
+        assert telemetry.unescape_label(
+            telemetry.escape_label(value)) == value
+    # unknown escapes keep their backslash (parser tolerance rule)
+    assert telemetry.unescape_label("\\x") == "\\x"
+
+
+def test_parse_tolerates_comments_and_timestamps_rejects_junk():
+    doc = ("# some free comment\n"
+           "# TYPE x counter\n"
+           "x{a=\"b\"} 4 1700000000\n"  # trailing prom timestamp
+           "\n"
+           "y 2.5\n")
+    parsed = metricsdb.parse_text(doc)
+    assert parsed.samples[("x", (("a", "b"),))] == 4.0
+    assert parsed.samples[("y", ())] == 2.5
+    assert parsed.types == {"x": "counter"}
+    for junk in ("{no_name} 1", "x{unterminated=\"v} 1",
+                 "x{a=\"b\"}", "x notanumber", "x{a=b} 1"):
+        with pytest.raises(ValueError):
+            metricsdb.parse_text(junk)
+
+
+# -------------------------------------------------------------------- tsdb
+
+
+def _clocked_tsdb(**kwargs):
+    clock = [0.0]
+    tsdb = metricsdb.TSDB(clock=lambda: clock[0], **kwargs)
+    return clock, tsdb
+
+
+def test_counter_reset_never_negative_rate():
+    """A restarted target's counter drops to zero mid-window: increase
+    counts the post-reset value, rate stays >= 0 — never a negative
+    (the satellite's explicit unit)."""
+    clock, tsdb = _clocked_tsdb()
+    for ts, v in [(0, 100), (1, 120), (2, 5), (3, 15)]:
+        clock[0] = float(ts)
+        tsdb.append("c_total", {"job": "x"}, v, mtype="counter")
+    inc = tsdb.increase("c_total", 10)
+    assert inc == {(("job", "x"),): 35.0}  # 20 + 5(reset) + 10
+    rate = tsdb.rate("c_total", 10)
+    assert all(v >= 0 for v in rate.values())
+    assert rate[(("job", "x"),)] == pytest.approx(35.0 / 3.0)
+
+
+def test_staleness_hides_dead_series_from_instant_reads():
+    clock, tsdb = _clocked_tsdb(staleness_s=5.0)
+    tsdb.append("up", {"job": "a"}, 1.0)
+    clock[0] = 3.0
+    assert tsdb.latest("up", job="a") == {(("job", "a"),): 1.0}
+    clock[0] = 6.0
+    assert tsdb.latest("up", job="a") == {}  # stale, absent — not 1
+
+
+def test_retention_prunes_and_ring_is_bounded():
+    clock, tsdb = _clocked_tsdb(retention_s=10.0,
+                                max_samples_per_series=8)
+    scrape = metricsdb.ParsedScrape({("m", ()): 1.0}, {"m": "gauge"}, {})
+    for ts in range(30):
+        clock[0] = float(ts)
+        tsdb.ingest(scrape)
+    window = tsdb.window("m", 1000.0)
+    samples = window[()]
+    assert len(samples) <= 8
+    assert all(ts >= 20.0 - 1e-9 for ts, _v in samples)
+
+
+def test_zero_baseline_counts_series_born_under_observation():
+    """A counter series first seen on scrape N (while the target was
+    already observed at N-1) was genuinely zero a scrape ago — the
+    burst-on-a-new-label-set case the live SLO needs counted."""
+    clock, tsdb = _clocked_tsdb()
+    counter = {"t": "counter"}
+    clock[0] = 1.0
+    tsdb.ingest(metricsdb.ParsedScrape({("t", ()): 0.0}, counter, {}))
+    clock[0] = 2.0
+    tsdb.ingest(metricsdb.ParsedScrape(
+        {("t", ()): 0.0, ("t", (("code", "503"),)): 3.0}, counter, {}),
+        zero_baseline_ts=1.0)
+    inc = tsdb.increase("t", 100.0, code="503")
+    assert inc == {(("code", "503"),): 3.0}
+    # gauges never get a synthetic zero (it would fabricate motion)
+    clock[0] = 3.0
+    tsdb.ingest(metricsdb.ParsedScrape(
+        {("g", ()): 5.0}, {"g": "gauge"}, {}), zero_baseline_ts=2.0)
+    assert tsdb.increase("g", 100.0) == {}
+
+
+def test_ingest_renames_colliding_source_labels_exported():
+    """A target that itself exports a ``job`` label (a registry holding
+    ANOTHER scrape manager's self-metrics — the self-monitoring setup)
+    must keep its series DISTINCT: the source label is renamed to
+    ``exported_job`` (the Prometheus convention), never overwritten —
+    overwriting collapsed both series into one ring whose interleaved
+    values the reset heuristic misread as counter resets, fabricating
+    increases."""
+    clock, tsdb = _clocked_tsdb()
+    counter = {"t": "counter"}
+
+    def scrape_at(ts, a, b):
+        clock[0] = ts
+        tsdb.ingest(metricsdb.ParsedScrape(
+            {("t", (("job", "fake"),)): a,
+             ("t", (("job", "self"),)): b}, counter, {}),
+            labels={"job": "self"})
+
+    scrape_at(1.0, 5000.0, 300.0)
+    scrape_at(2.0, 5100.0, 310.0)
+    inc = tsdb.increase("t", 100.0)
+    assert inc == {(("exported_job", "fake"), ("job", "self")): 100.0,
+                   (("job", "self"),): 10.0}
+    # a matching (non-colliding) source value is NOT renamed
+    clock, tsdb2 = _clocked_tsdb()
+    clock[0] = 1.0
+    tsdb2.ingest(metricsdb.ParsedScrape(
+        {("t", (("job", "self"),)): 1.0}, counter, {}),
+        labels={"job": "self"})
+    assert tsdb2.latest("t", job="self") == {(("job", "self"),): 1.0}
+
+
+def test_baseline_lookback_is_capped_and_windows_bounded_above():
+    """Two discriminations the live SLO's short/long windows depend
+    on: (1) the pre-window baseline lookback is capped at staleness_s
+    — a burst that happened during a long scrape gap must NOT be
+    booked into an arbitrarily narrow later window (a false page);
+    (2) a range query anchored in the past never sees samples from
+    its future."""
+    clock, tsdb = _clocked_tsdb(staleness_s=30.0, retention_s=1000.0)
+    for ts, v in [(0.0, 100.0), (300.0, 700.0), (301.0, 705.0)]:
+        clock[0] = ts
+        tsdb.append("c_total", {}, v, mtype="counter")
+    clock[0] = 302.0
+    # short window: the t=0 baseline is 297s before the window start —
+    # far past staleness — so the 600-count burst is NOT attributed
+    assert tsdb.increase("c_total", 5.0) == {(): 5.0}
+    # long window covering everything still sees the full increase
+    assert tsdb.increase("c_total", 1000.0) == {(): 605.0}
+    # (2): a window anchored at t=300 must not include the t=301 sample
+    win = tsdb.window("c_total", 10.0, now=300.0)
+    assert [v for _ts, v in win[()]] == [700.0]
+
+
+def test_histogram_quantile_interpolates_and_caps_at_finite():
+    clock, tsdb = _clocked_tsdb()
+    for le, cum in [("0.1", 10.0), ("0.5", 90.0), ("1", 99.0),
+                    ("+Inf", 100.0)]:
+        tsdb.append("lat_seconds_bucket", {"le": le}, cum)
+    p50 = tsdb.histogram_quantile(0.5, "lat_seconds")
+    assert 0.1 < p50 < 0.5
+    assert p50 == pytest.approx(0.1 + 0.4 * (50 - 10) / (90 - 10))
+    # a rank landing in +Inf answers the highest finite bound
+    assert tsdb.histogram_quantile(0.999, "lat_seconds") == 1.0
+    assert tsdb.histogram_quantile(0.5, "absent") is None
+
+
+def test_aggregate_sum_avg_max():
+    values = {(("a", "1"),): 2.0, (("a", "2"),): 4.0}
+    assert metricsdb.aggregate(values) == 6.0
+    assert metricsdb.aggregate(values, "avg") == 3.0
+    assert metricsdb.aggregate(values, "max") == 4.0
+    assert metricsdb.aggregate({}, "max") == 0.0
+    with pytest.raises(ValueError):
+        metricsdb.aggregate(values, "median")
+
+
+def test_dump_load_round_trip_is_deterministic():
+    clock, tsdb = _clocked_tsdb(max_samples_per_series=5000)
+    for ts in (1.0, 2.0, 3.0):
+        clock[0] = ts
+        tsdb.append("c_total", {"job": "x"}, ts * 10, mtype="counter")
+    doc = json.loads(json.dumps(tsdb.dump()))
+    loaded = metricsdb.TSDB.load(doc)
+    assert loaded.now() == 3.0  # clock frozen at newest sample
+    assert loaded.dump() == tsdb.dump()
+    # the ring bound survives the round trip: a replay of a store with
+    # a non-default bound must not silently truncate its series
+    assert loaded.max_samples_per_series == 5000
+    assert loaded.family_type("c_total") == "counter"
+    # malformed documents are ValueError (the dash CLI's rc-2 path),
+    # NEVER a raw AttributeError/TypeError traceback
+    for junk in ({"not": "a dump"}, [], 7,
+                 {"series": [{"name": "x", "samples": [[None, 1]]}]},
+                 {"series": ["not a series"]}):
+        with pytest.raises(ValueError):
+            metricsdb.TSDB.load(junk)
+
+
+# ------------------------------------------------------------------ scrape
+
+
+def test_scrape_ingests_real_fake_scrape_with_self_metrics():
+    tsdb = metricsdb.TSDB()
+    tel = telemetry.Telemetry()
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        manager = metricsdb.ScrapeManager(
+            [metricsdb.Target("fake", api.url + "/__fake_metrics")],
+            tsdb, telemetry=tel)
+        manager.scrape_once()  # observation starts before traffic
+        client.apply({"apiVersion": "v1", "kind": "ConfigMap",
+                      "metadata": {"name": "cm", "namespace": "default"}})
+        manager.scrape_once()
+        client.close()
+    assert manager.up_snapshot() == {"fake": True}
+    assert metricsdb.aggregate(tsdb.latest("up", job="fake"),
+                               "max") == 1.0
+    # the audit family landed, job-labeled, and a rate is computable
+    assert metricsdb.aggregate(
+        tsdb.rate("fake_apiserver_requests_total", 60.0,
+                  job="fake")) > 0
+    # self-metrics: synthesized into the TSDB and the registry
+    assert tsdb.latest(telemetry.SCRAPE_DURATION_SECONDS, job="fake")
+    assert metricsdb.aggregate(
+        tsdb.latest(telemetry.SCRAPE_SAMPLES_TOTAL, job="fake")) > 0
+    rendered = tel.metrics.render()
+    assert 'up{job="fake"} 1' in rendered
+    assert telemetry.SCRAPE_SAMPLES_TOTAL in rendered
+
+
+def test_scrape_manager_all_targets_down_stays_fail_open():
+    """The acceptance pin: 100% of targets dead (refused port + a
+    target whose body is JSON, not exposition) — the loop stays
+    healthy, up is 0 for every target, zero exceptions surface."""
+    tsdb = metricsdb.TSDB()
+    with FakeApiServer(auto_ready=True) as api:
+        targets = [
+            metricsdb.Target("refused", "http://127.0.0.1:1/metrics"),
+            # a live HTTP server whose body is a JSON 404 — reachable
+            # but NOT exposition text: still a failed scrape, up 0
+            metricsdb.Target("garbled",
+                             api.url + "/api/v1/namespaces/x"
+                             "/configmaps/none"),
+        ]
+        manager = metricsdb.ScrapeManager(targets, tsdb,
+                                          interval_s=0.02,
+                                          timeout_s=0.5)
+        manager.start()
+        deadline = time.monotonic() + 10
+        while manager.scrapes() < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert manager.scrapes() >= 3
+        assert manager.healthy(), "scrape thread died — not fail-open"
+        assert manager.up_snapshot() == {"refused": False,
+                                         "garbled": False}
+        for job in ("refused", "garbled"):
+            ups = tsdb.latest("up", job=job)
+            assert ups and metricsdb.aggregate(ups, "max") == 0.0
+        manager.stop()
+        assert not manager.healthy()
+
+
+def test_scrape_is_wall_bounded_against_a_stalling_target():
+    """A STALLED target (accepts, sends nothing — the PR 9 fault
+    class) costs at most the scrape wall, not the stall duration."""
+    # chaos never intercepts /__fake_metrics (introspection bypasses
+    # it) — stall a REGULAR path and scrape that instead
+    chaos = [{"stall": 30.0, "match": "/api/v1/nodes"}]
+    tsdb = metricsdb.TSDB()
+    with FakeApiServer(auto_ready=True, chaos=chaos) as api:
+        manager = metricsdb.ScrapeManager(
+            [metricsdb.Target("stalled", api.url + "/api/v1/nodes")],
+            tsdb, timeout_s=0.3)
+        t0 = time.monotonic()
+        result = manager.scrape_once()
+        elapsed = time.monotonic() - t0
+        manager.stop()
+    assert result == {"stalled": False}
+    assert elapsed < 5.0, f"scrape blocked {elapsed:.1f}s past its wall"
+
+
+def test_scrape_survives_colliding_self_metric_family_in_registry():
+    """Fail-open extends to the telemetry MIRROR: a caller whose
+    registry already owns `up` as a COUNTER (type collision with the
+    manager's gauge) must not kill the scrape thread — the TSDB
+    synthesis still lands and the loop stays healthy."""
+    tel = telemetry.Telemetry()
+    tel.counter(telemetry.UP, "squatting the name").inc()
+    tsdb = metricsdb.TSDB()
+    with FakeApiServer(auto_ready=True) as api:
+        manager = metricsdb.ScrapeManager(
+            [metricsdb.Target("fake", api.url + "/__fake_metrics")],
+            tsdb, interval_s=0.02, telemetry=tel)
+        manager.start()
+        deadline = time.monotonic() + 10
+        while manager.scrapes() < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert manager.scrapes() >= 3
+        assert manager.healthy(), \
+            "a registry type collision killed the scrape thread"
+        assert manager.up_snapshot() == {"fake": True}
+        assert metricsdb.aggregate(tsdb.latest("up", job="fake"),
+                                   "max") == 1.0
+        manager.stop()
+
+
+def test_duplicate_job_names_rejected():
+    with pytest.raises(ValueError):
+        metricsdb.ScrapeManager(
+            [metricsdb.Target("a", "http://127.0.0.1:1/m"),
+             metricsdb.Target("a", "http://127.0.0.1:2/m")],
+            metricsdb.TSDB())
+    with pytest.raises(ValueError):
+        metricsdb.parse_target("no-equals-url")
+    with pytest.raises(ValueError):
+        metricsdb.Target("j", "ftp://nope/metrics")
+
+
+def test_metrics_server_serves_registry_and_conflicts_raise():
+    reg = telemetry.MetricsRegistry()
+    reg.counter("served_total", "x", job="self").inc(3)
+    server = metricsdb.MetricsServer(reg, 0).start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=5)
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        body = resp.read().decode()
+        assert resp.status == 200
+        assert 'served_total{job="self"} 3' in body
+        conn.request("GET", "/other")
+        assert conn.getresponse().read() and True
+        conn.close()
+        # the bind-conflict contract: constructing on a taken port
+        # raises OSError NOW (callers apply their fail-open policy)
+        with pytest.raises(OSError):
+            metricsdb.MetricsServer(reg, server.port)
+        # and a scrape of the served registry round-trips
+        tsdb = metricsdb.TSDB()
+        manager = metricsdb.ScrapeManager(
+            [metricsdb.Target("self", server.url)], tsdb)
+        assert manager.scrape_once() == {"self": True}
+        manager.stop()
+        assert metricsdb.aggregate(
+            tsdb.latest("served_total", job="self")) == 3.0
+    finally:
+        server.stop()
+
+
+def test_metrics_server_stop_severs_keepalive_handlers():
+    """stop() must kill established keep-alive handler threads, not
+    just the listener — the same ThreadingHTTPServer zombie the fake's
+    restart fix addresses: a scraper's parked connection must die with
+    the server instead of being answered from beyond the grave."""
+    reg = telemetry.MetricsRegistry()
+    reg.counter("zombie_total", "x").inc()
+    server = metricsdb.MetricsServer(reg, 0).start()
+    conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                      timeout=5)
+    conn.request("GET", "/metrics")
+    assert conn.getresponse().read()  # keep-alive connection is live
+    server.stop()
+    served = False
+    try:
+        conn.request("GET", "/metrics")
+        served = conn.getresponse().status == 200
+    except (OSError, http.client.HTTPException):
+        pass
+    conn.close()
+    assert not served, "a zombie handler served the stopped registry"
+
+
+def test_admission_metrics_port_bind_conflict_fails_open():
+    """`tpuctl admission --metrics-port` on a TAKEN port (or an
+    out-of-range one): warn on stderr, loop runs anyway (rc 0) — the
+    satellite's fail-open contract."""
+    reg = telemetry.MetricsRegistry()
+    squatter = metricsdb.MetricsServer(reg, 0).start()
+    try:
+        with FakeApiServer(auto_ready=True) as api:
+            for port in (str(squatter.port), "99999"):
+                out, err = io.StringIO(), io.StringIO()
+                with redirect_stdout(out), redirect_stderr(err):
+                    rc = cli_main(["admission", "--once", "--no-events",
+                                   "--apiserver", api.url,
+                                   "--namespace", "tpu-system",
+                                   "--metrics-port", port])
+                assert rc == 0, (port, out.getvalue(), err.getvalue())
+                assert "cannot bind metrics port" in err.getvalue(), port
+    finally:
+        squatter.stop()
+
+
+def _free_port() -> int:
+    import socket as socketmod
+    sock = socketmod.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    return port
+
+
+def test_admission_metrics_port_serves_live_registry():
+    """The satellite's serving half: a running admission loop with
+    --metrics-port is a first-class scrape target — its live registry
+    (admission families included) parses as exposition text and feeds
+    the TSDB like any other endpoint."""
+    import subprocess
+    port = _free_port()
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        from tpu_cluster import admission
+        client.apply(admission.node_manifest("mp-a", "v5e-8"))
+        client.apply(admission.node_manifest("mp-b", "v5e-8"))
+        client.apply(admission.gang_job_manifest("mp-g", "v5e-16",
+                                                 "tpu-system"))
+        client.close()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tpu_cluster", "admission",
+             "--apiserver", api.url, "--namespace", "tpu-system",
+             "--interval", "0.1", "--no-events",
+             "--metrics-port", str(port)],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            cwd=REPO)
+        try:
+            tsdb = metricsdb.TSDB()
+            manager = metricsdb.ScrapeManager(
+                [metricsdb.Target(
+                    "admission",
+                    f"http://127.0.0.1:{port}/metrics")],
+                tsdb, timeout_s=2.0)
+            deadline = time.monotonic() + 60
+            admitted = 0.0
+            while time.monotonic() < deadline:
+                manager.scrape_once()
+                admitted = metricsdb.aggregate(tsdb.latest(
+                    telemetry.ADMISSIONS_TOTAL, job="admission"))
+                if admitted > 0:
+                    break
+                time.sleep(0.1)
+            manager.stop()
+            assert admitted > 0, "admission families never scraped"
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+
+# ---------------------------------------------------------------- live slo
+
+
+def test_live_and_trace_derived_slo_reach_the_same_verdict():
+    """THE acceptance criterion: one shared chaos-soak run, judged
+    twice — from the client's span tree and from counters scraped off
+    the fake's live /__fake_metrics — must burn the SAME window pairs
+    and produce the same rc-shaped ok bit."""
+    tel = telemetry.Telemetry()
+    tsdb = metricsdb.TSDB()
+    with FakeApiServer(auto_ready=True,
+                       chaos=standard_fault_script(0.05)) as api:
+        manager = metricsdb.ScrapeManager(
+            [metricsdb.Target("fake", api.url + "/__fake_metrics")],
+            tsdb, interval_s=0.03)
+        manager.start()
+        time.sleep(0.05)  # observation starts before the rollout
+        client = kubeapply.Client(api.url, telemetry=tel,
+                                  retry=FAST_RETRY)
+        kubeapply.apply_groups(
+            client, manifests.rollout_groups(specmod.default_spec()),
+            wait=True, stage_timeout=60, poll=0.02, max_inflight=8)
+        client.close()
+        time.sleep(0.1)  # one more scrape past the last request
+        manager.stop()
+
+    trace_report = slo.evaluate([tel.chrome_trace()])
+    live_report = metricsdb.live_slo_report(tsdb)
+
+    def burning_pairs(report):
+        return {(v.slo.name, w.severity) for v in report.verdicts
+                for w in v.windows if w.burning}
+
+    assert trace_report.ok == live_report.ok
+    assert burning_pairs(trace_report) == burning_pairs(live_report)
+    # the soak actually bit: the early 503/drop burst must burn the
+    # warn pair on BOTH paths (and only warn — the burst is at the
+    # START, so the recent page short-window stays clean)
+    assert burning_pairs(live_report) == {("apply-availability",
+                                           "warn")}
+    # SLOs without a live counter expression stay VISIBLY empty
+    live_watch = [v for v in live_report.verdicts
+                  if v.slo.name == "watch-uptime"][0]
+    assert live_watch.total_samples == 0 and not live_watch.burning
+
+
+def test_slo_check_live_cli_rc0_clean_rc1_on_503_burst():
+    """The CLI contract end-to-end: healthy traffic exits 0; a
+    sustained 503 storm exits 1 with apply-availability burning."""
+    def run_live(api_url):
+        out = io.StringIO()
+        with redirect_stdout(out):
+            rc = cli_main(["slo", "check", "--live",
+                           "--targets",
+                           f"fake={api_url}/__fake_metrics",
+                           "--duration", "0.6",
+                           "--scrape-interval", "0.1", "--json"])
+        return rc, json.loads(out.getvalue())
+
+    def drive(client, stop):
+        while not stop.is_set():
+            client.get("/api/v1/namespaces/default/configmaps/probe")
+            time.sleep(0.02)
+
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        stop = threading.Event()
+        t = threading.Thread(target=drive, args=(client, stop),
+                             daemon=True)
+        t.start()
+        rc, doc = run_live(api.url)
+        stop.set()
+        t.join(timeout=10)
+        client.close()
+    assert rc == 0 and doc["ok"], doc
+
+    with FakeApiServer(auto_ready=True,
+                       chaos=[{"status": 503, "match": "/api/"}]) as api:
+        client = kubeapply.Client(api.url, retry=kubeapply.NO_RETRY)
+        stop = threading.Event()
+        t = threading.Thread(target=drive, args=(client, stop),
+                             daemon=True)
+        t.start()
+        rc, doc = run_live(api.url)
+        stop.set()
+        t.join(timeout=10)
+        client.close()
+    assert rc == 1 and not doc["ok"], doc
+    burning = [s["name"] for s in doc["slos"] if s["burning"]]
+    assert burning == ["apply-availability"], doc
+
+
+def test_slo_check_live_cli_invalid_invocations_rc2():
+    assert cli_main(["slo", "check", "--live"]) == 2  # no targets
+    assert cli_main(["slo", "check"]) == 2  # neither traces nor live
+    assert cli_main(["slo", "check", "--targets", "a=http://x/m"]) == 2
+    assert cli_main(["slo", "check", "--live", "--targets",
+                     "notaurl"]) == 2
+
+
+def test_slo_check_live_all_targets_down_notes_and_stays_rc0():
+    """Dead targets are data, not errors: the live check notes them on
+    stderr and reports 'no samples' healthy (rc 0) instead of
+    crashing — the fail-open contract surfaced at the CLI."""
+    out, err = io.StringIO(), io.StringIO()
+    with redirect_stdout(out), redirect_stderr(err):
+        rc = cli_main(["slo", "check", "--live", "--targets",
+                       "dead=http://127.0.0.1:1/metrics",
+                       "--duration", "0.2",
+                       "--scrape-interval", "0.05"])
+    assert rc == 0
+    assert "target dead is down" in err.getvalue()
+    assert "no samples" in out.getvalue()
+
+
+# -------------------------------------------------------------------- dash
+
+
+def test_dash_replay_renders_the_golden_frame_byte_exact():
+    out = io.StringIO()
+    with redirect_stdout(out):
+        rc = cli_main(["dash", "--once", "--replay", DASH_TSDB])
+    assert rc == 0
+    with open(DASH_GOLDEN, encoding="utf-8") as f:
+        golden = f.read()
+    assert out.getvalue() == golden
+
+
+def test_dash_live_once_smoke_against_the_fake():
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        client.apply({"apiVersion": "v1", "kind": "ConfigMap",
+                      "metadata": {"name": "d", "namespace": "default"}})
+        out = io.StringIO()
+        with redirect_stdout(out):
+            rc = cli_main(["dash", "--once", "--interval", "0.1",
+                           "--targets",
+                           f"fake={api.url}/__fake_metrics"])
+        client.close()
+    frame = out.getvalue()
+    assert rc == 0
+    assert "fake" in frame and "UP" in frame
+    assert " 1 " in frame.splitlines()[2]  # the fake row is up
+
+
+def test_dash_invalid_invocations_rc2(tmp_path):
+    assert cli_main(["dash", "--once"]) == 2  # no targets, no replay
+    # duplicate job names are bad input (rc 2), never a traceback
+    assert cli_main(["dash", "--once",
+                     "--targets", "a=http://127.0.0.1:1/m",
+                     "--targets", "a=http://127.0.0.1:2/m"]) == 2
+    bogus = tmp_path / "bogus.json"
+    bogus.write_text('{"not": "a dump"}')
+    assert cli_main(["dash", "--replay", str(bogus)]) == 2
+    # non-object / type-mangled dumps are rc 2 too, never a traceback
+    bogus.write_text("[]")
+    assert cli_main(["dash", "--replay", str(bogus)]) == 2
+    bogus.write_text('{"series": [{"name": "x", '
+                     '"samples": [[null, 1]]}]}')
+    assert cli_main(["dash", "--replay", str(bogus)]) == 2
+    assert cli_main(["dash", "--replay",
+                     str(tmp_path / "absent.json")]) == 2
+
+
+# ----------------------------------------------------------------- restart
+
+
+def _open_raw_watch(port, path, window_s=30):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=20)
+    conn.request("GET", f"{path}?watch=1&timeoutSeconds={window_s}")
+    resp = conn.getresponse()
+    assert resp.status == 200
+    return conn, resp
+
+
+def test_restart_severs_zombie_watch_streams():
+    """The satellite's pin: an in-process restart (stop(), then a new
+    FakeApiServer on the pinned port with a different store) severs
+    established watch streams — the old handler thread must NOT keep
+    serving the pre-restart store until its 30s window expires, and a
+    post-restart read never observes pre-restart state."""
+    coll = "/api/v1/namespaces/ns/configmaps"
+    pre = {f"{coll}/pre-obj": {
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": {"name": "pre-obj", "namespace": "ns"}}}
+    post = {f"{coll}/post-obj": {
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": {"name": "post-obj", "namespace": "ns"}}}
+    api = FakeApiServer(auto_ready=True, store=pre).start()
+    port = api._server.server_address[1]
+    conn, resp = _open_raw_watch(port, coll)
+    # a POOLED KEEP-ALIVE client held across the restart too: parked
+    # plain handlers used to zombie-serve the old store INDEFINITELY
+    # (watch streams at least expired with their window)
+    held = kubeapply.Client(api.url)
+    code, _ = held.get(f"{coll}/pre-obj")
+    assert code == 200
+    try:
+        api.stop()
+        api2 = FakeApiServer(auto_ready=True, port=port,
+                             store=post).start()
+        try:
+            t0 = time.monotonic()
+            try:
+                line = resp.readline()
+            except OSError:
+                line = b""
+            elapsed = time.monotonic() - t0
+            assert elapsed < 5.0, \
+                f"zombie watch survived the restart {elapsed:.1f}s"
+            assert line == b"", \
+                f"zombie watch served post-restart bytes: {line!r}"
+            # the HELD client's severed socket stale-retries onto the
+            # NEW instance — pre-restart state must be gone even on a
+            # connection opened before the restart
+            code, _ = held.get(f"{coll}/pre-obj")
+            assert code == 404, \
+                "a zombie keep-alive handler served the old store"
+            # and a fresh client sees ONLY the new store
+            client = kubeapply.Client(api2.url)
+            code, _ = client.get(f"{coll}/post-obj")
+            assert code == 200
+            listing = client.list_collection(coll)
+            assert set(listing) == {"post-obj"}
+            client.close()
+        finally:
+            api2.stop()
+    finally:
+        held.close()
+        conn.close()
+
+
+def test_flap_invalidates_streams_promptly_and_serves_current_state():
+    """flap() (same-instance restart): the held stream dies NOW —
+    in-band ERROR/410 or severed socket, whichever wins the race —
+    and a fresh watch + LIST sees only current store state."""
+    coll = "/api/v1/namespaces/ns/configmaps"
+    store = {f"{coll}/pre-obj": {
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": {"name": "pre-obj", "namespace": "ns"}}}
+    with FakeApiServer(auto_ready=True, store=store) as api:
+        port = api._server.server_address[1]
+        conn, resp = _open_raw_watch(port, coll)
+        time.sleep(0.05)
+        t0 = time.monotonic()
+        api.flap()
+        try:
+            line = resp.readline()
+        except OSError:
+            line = b""
+        elapsed = time.monotonic() - t0
+        conn.close()
+        assert elapsed < 2.0, f"stream outlived the flap {elapsed:.1f}s"
+        if line:  # the graceful race outcome: one in-band 410
+            ev = json.loads(line)
+            assert ev["type"] == "ERROR"
+            assert ev["object"]["code"] == 410
+        client = kubeapply.Client(api.url)
+        listing = client.list_collection(coll)
+        assert set(listing) == {"pre-obj"}
+        client.close()

@@ -37,7 +37,13 @@ with rendered artifacts and an ordered, readiness-gated apply:
   slo      multi-window multi-burn-rate SLO evaluation (SRE-workbook
            shape: 5m/1h page, 6h/3d warn) over span-derived samples —
            `tpuctl slo check TRACE...` exits 1 when an error budget is
-           burning, naming the window pair
+           burning, naming the window pair; `--live --targets JOB=URL`
+           evaluates the same rules over counter ratios scraped from
+           live /metrics endpoints instead (same rc contract)
+  dash     terminal dashboard over a scrape-fed time-series store:
+           per-target up, request/error rates, p99 latency
+           sparklines, event counts — `--once --replay FILE` renders
+           a deterministic golden frame from a dumped TSDB
   verify   the executable acceptance runbook (BASELINE configs)
   triage   the executable troubleshooting runbook
   top      per-phase/per-object breakdown of a rollout trace captured
@@ -63,7 +69,8 @@ import yaml
 
 from . import (admission as admissionmod, conlint as conlintmod,
                events as eventsmod, kubeapply, lint as lintmod,
-               slo as slomod, spec as specmod, telemetry, triage, verify)
+               metricsdb as metricsdbmod, slo as slomod,
+               spec as specmod, telemetry, triage, verify)
 from .render import jobs, kubeadm, manifests, nodeprep, operator_bundle
 
 
@@ -490,11 +497,31 @@ def cmd_admission(args) -> int:
     # and the traceparent stamp — the parts events/--metrics-out
     # consume — are bounded and unaffected)
     tel = (telemetry.Telemetry(retain_spans=bool(args.trace_out))
-           if (args.trace_out or args.metrics_out or args.events)
+           if (args.trace_out or args.metrics_out or args.events
+               or args.metrics_port)
            else None)
     client = _rest_client(args)
     assert client is not None
     client.telemetry = tel
+    # --metrics-port: serve the loop's LIVE registry so the admission/
+    # informer controller becomes a first-class scrape target (ISSUE
+    # 13). Fail-open on bind conflict by contract: two loops racing
+    # for one port must not take the arbitration down — warn, continue
+    # unscraped.
+    metrics_server = None
+    if args.metrics_port:
+        assert tel is not None
+        try:
+            # OverflowError: an out-of-range port fails the BIND like a
+            # conflict does, and must get the same fail-open treatment
+            metrics_server = metricsdbmod.MetricsServer(
+                tel.metrics, args.metrics_port).start()
+            print(f"admission: serving /metrics on "
+                  f"{metrics_server.url}")
+        except (OSError, OverflowError) as exc:
+            print(f"admission: cannot bind metrics port "
+                  f"{args.metrics_port} ({exc}); continuing without "
+                  "a metrics endpoint", file=sys.stderr)
     # decision Events are ON by default for the admission CLI (the
     # controller's decisions are exactly what `tpuctl events --for`
     # exists to show); --no-events restores the annotation-only loop
@@ -544,6 +571,8 @@ def cmd_admission(args) -> int:
         rc = 1
     finally:
         client.close()
+        if metrics_server is not None:
+            metrics_server.stop()
         if tel is not None and args.trace_out:
             try:
                 tel.write_trace(args.trace_out)
@@ -695,31 +724,152 @@ def cmd_events(args) -> int:
     return 0
 
 
+def _parse_targets(specs):
+    """--targets JOB=URL list -> [metricsdb.Target] (ValueError names
+    the offending spec)."""
+    return [metricsdbmod.parse_target(spec) for spec in specs]
+
+
+def _slo_live_report(args):
+    """The `slo check --live` evidence pass: scrape every target for
+    --duration at --scrape-interval into a fresh TSDB, then evaluate
+    the SLO set over the scraped counter ratios (same verdict math and
+    report shape as the trace path). Down targets are noted on stderr
+    — a dead target is `up 0` data, never an exception (the
+    ScrapeManager's fail-open contract)."""
+    targets = _parse_targets(args.targets)
+    tsdb = metricsdbmod.TSDB()
+    manager = metricsdbmod.ScrapeManager(
+        targets, tsdb, interval_s=args.scrape_interval,
+        timeout_s=args.scrape_timeout)
+    try:
+        deadline = time.monotonic() + max(0.0, args.duration)
+        manager.scrape_once()
+        while time.monotonic() < deadline:
+            time.sleep(max(0.01, args.scrape_interval))
+            manager.scrape_once()
+    finally:
+        manager.stop()
+    for job, up in sorted(manager.up_snapshot().items()):
+        if not up:
+            print(f"slo: note: target {job} is down (up 0) — its "
+                  "families contribute no live samples",
+                  file=sys.stderr)
+    return metricsdbmod.live_slo_report(tsdb, scale=args.scale)
+
+
 def cmd_slo(args) -> int:
     """`tpuctl slo check TRACE...`: evaluate the SLO set as
-    multi-window multi-burn-rate rules over span-derived samples.
-    Exit 0 = every error budget healthy, 1 = burning (window pair
-    named), 2 = unreadable input."""
-    docs = []
-    for path in args.traces:
+    multi-window multi-burn-rate rules over span-derived samples —
+    or, with `--live --targets JOB=URL...`, over counter ratios
+    scraped from live /metrics endpoints. Exit 0 = every error budget
+    healthy, 1 = burning (window pair named), 2 = unreadable/invalid
+    input. Both modes share the verdict math, report shape and rc
+    contract (the sample-source abstraction in slo.py)."""
+    if args.live:
+        if args.traces:
+            print("slo: --live evaluates scraped targets; drop the "
+                  "TRACE arguments (or drop --live)", file=sys.stderr)
+            return 2
+        if not args.targets:
+            print("slo: --live needs at least one --targets JOB=URL",
+                  file=sys.stderr)
+            return 2
         try:
-            docs.append(slomod.load_trace(path))
-        except OSError as exc:
-            print(f"slo: cannot read {path}: {exc}", file=sys.stderr)
-            return 2
+            report = _slo_live_report(args)
         except ValueError as exc:
-            print(f"slo: {path}: not a trace: {exc}", file=sys.stderr)
+            print(f"slo: {exc}", file=sys.stderr)
             return 2
-    try:
-        report = slomod.evaluate(docs, scale=args.scale)
-    except ValueError as exc:
-        print(f"slo: {exc}", file=sys.stderr)
-        return 2
+    else:
+        if args.targets:
+            print("slo: --targets needs --live (trace mode reads "
+                  "files)", file=sys.stderr)
+            return 2
+        if not args.traces:
+            print("slo: pass TRACE files (or --live --targets ...)",
+                  file=sys.stderr)
+            return 2
+        docs = []
+        for path in args.traces:
+            try:
+                docs.append(slomod.load_trace(path))
+            except OSError as exc:
+                print(f"slo: cannot read {path}: {exc}", file=sys.stderr)
+                return 2
+            except ValueError as exc:
+                print(f"slo: {path}: not a trace: {exc}",
+                      file=sys.stderr)
+                return 2
+        try:
+            report = slomod.evaluate(docs, scale=args.scale)
+        except ValueError as exc:
+            print(f"slo: {exc}", file=sys.stderr)
+            return 2
     if args.json:
         print(json.dumps(report.to_dict()))
     else:
         print(slomod.format_report(report))
     return 0 if report.ok else 1
+
+
+def cmd_dash(args) -> int:
+    """`tpuctl dash`: terminal dashboard over a scrape-fed TSDB —
+    per-target up, request/error rates, p99 latency, sparklines, event
+    counts. Live mode redraws every --interval; --once renders one
+    frame; --replay FILE renders a DETERMINISTIC frame from a dumped
+    TSDB (the golden-test surface — byte-exact for a given dump)."""
+    if args.replay:
+        try:
+            with open(args.replay, encoding="utf-8") as f:
+                doc = json.load(f)
+            tsdb = metricsdbmod.TSDB.load(doc)
+        except OSError as exc:
+            print(f"dash: cannot read {args.replay}: {exc}",
+                  file=sys.stderr)
+            return 2
+        except ValueError as exc:
+            print(f"dash: {args.replay}: {exc}", file=sys.stderr)
+            return 2
+        print(metricsdbmod.render_dash(tsdb, window_s=args.window))
+        return 0
+    if not args.targets:
+        print("dash: pass --targets JOB=URL (repeatable) or --replay "
+              "FILE", file=sys.stderr)
+        return 2
+    tsdb = metricsdbmod.TSDB()
+    try:
+        # ValueError covers bad JOB=URL specs AND duplicate job names
+        # (the manager's constructor check) — both are rc-2 bad input
+        manager = metricsdbmod.ScrapeManager(
+            _parse_targets(args.targets), tsdb,
+            interval_s=args.interval, timeout_s=args.scrape_timeout)
+    except ValueError as exc:
+        print(f"dash: {exc}", file=sys.stderr)
+        return 2
+    try:
+        if args.once:
+            # two scrapes one short gap apart: a single snapshot has no
+            # deltas, and a rate-free dashboard answers nothing
+            manager.scrape_once()
+            time.sleep(min(0.5, args.interval))
+            manager.scrape_once()
+            print(metricsdbmod.render_dash(tsdb, window_s=args.window))
+            return 0
+        manager.start()
+        frames = 0
+        while args.frames <= 0 or frames < args.frames:
+            time.sleep(args.interval)
+            frames += 1
+            # ANSI clear + home, then one frame — a dumb-terminal
+            # redraw loop, not a TUI dependency
+            print("\x1b[2J\x1b[H"
+                  + metricsdbmod.render_dash(tsdb, window_s=args.window),
+                  flush=True)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        manager.stop()
+    return 0
 
 
 def cmd_verify(args) -> int:
@@ -1103,6 +1253,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "(tpuctl_admissions_total, "
                         "tpuctl_preemptions_total, "
                         "tpuctl_gang_wait_seconds) as Prometheus text")
+    p.add_argument("--metrics-port", type=int, default=0, metavar="N",
+                   help="serve the loop's LIVE metrics registry over "
+                        "HTTP on 127.0.0.1:N (/metrics, exposition "
+                        "text) so the controller is a first-class "
+                        "scrape target for tpuctl dash / slo check "
+                        "--live; fail-open on bind conflict (warn, "
+                        "continue); 0 (default) = off")
     p.set_defaults(fn=cmd_admission)
 
     p = sub.add_parser(
@@ -1139,18 +1296,69 @@ def build_parser() -> argparse.ArgumentParser:
         "check", help="evaluate every SLO x window pair over one or "
                       "more rollout traces; exit 1 when a budget is "
                       "burning (window pair named)")
-    sp.add_argument("traces", nargs="+", metavar="TRACE",
+    sp.add_argument("traces", nargs="*", metavar="TRACE",
                     help="Chrome trace JSON files (tpuctl apply "
                          "--trace-out, bench arms, flight-recorder "
-                         "dumps)")
+                         "dumps); omitted in --live mode")
     sp.add_argument("--scale", type=float, default=None,
                     help="nominal seconds represented by one trace "
                          "second (default: the 1h page window spans "
-                         "the whole trace)")
+                         "the whole trace / scraped span)")
+    sp.add_argument("--live", action="store_true",
+                    help="evaluate over LIVE scraped counter ratios "
+                         "instead of trace spans: scrape --targets "
+                         "for --duration, then apply the same "
+                         "multi-window rules to windowed bad/total "
+                         "increases of the code-labeled request "
+                         "counters (same report shape and rc "
+                         "contract)")
+    sp.add_argument("--targets", action="append", default=[],
+                    metavar="JOB=URL",
+                    help="scrape target for --live (repeatable): a "
+                         "full exposition URL, e.g. "
+                         "op=http://127.0.0.1:9400/metrics or the "
+                         "fake's .../__fake_metrics")
+    sp.add_argument("--duration", type=float, default=2.0,
+                    help="--live: how long to scrape before "
+                         "evaluating (seconds, default 2)")
+    sp.add_argument("--scrape-interval", type=float, default=0.25,
+                    help="--live: seconds between scrapes "
+                         "(default 0.25)")
+    sp.add_argument("--scrape-timeout", type=float, default=2.0,
+                    help="--live: whole-attempt wall per scrape "
+                         "(default 2; a stalled target marks up 0 at "
+                         "the wall, never blocks the loop)")
     sp.add_argument("--json", action="store_true",
                     help="one machine-readable JSON document instead "
                          "of the table")
     sp.set_defaults(fn=cmd_slo)
+
+    p = sub.add_parser(
+        "dash", help="terminal dashboard over a scrape-fed "
+                     "time-series store: per-target up, request/error "
+                     "rates, p99 latency sparklines, event counts")
+    p.add_argument("--targets", action="append", default=[],
+                   metavar="JOB=URL",
+                   help="scrape target (repeatable): operator "
+                        "/metrics, the fake's /__fake_metrics, a "
+                        "control loop's --metrics-port endpoint")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="seconds between scrapes/redraws (default 2)")
+    p.add_argument("--window", type=float, default=60.0,
+                   help="rate/quantile window in seconds (default 60)")
+    p.add_argument("--once", action="store_true",
+                   help="render a single frame and exit (two quick "
+                        "scrapes so rates exist)")
+    p.add_argument("--replay", default="", metavar="FILE",
+                   help="render one DETERMINISTIC frame from a dumped "
+                        "TSDB JSON snapshot instead of scraping — the "
+                        "golden-test surface (implies --once)")
+    p.add_argument("--frames", type=int, default=0,
+                   help="live mode: stop after N frames (0 = until "
+                        "interrupted; the scripting/CI bound)")
+    p.add_argument("--scrape-timeout", type=float, default=2.0,
+                   help="whole-attempt wall per scrape (default 2)")
+    p.set_defaults(fn=cmd_dash)
 
     p = sub.add_parser("verify", help="run the acceptance runbook")
     p.add_argument("--spec", default="")

@@ -313,6 +313,16 @@ class _WatchDenied(Exception):
 # twin (kubeclient::RetryableStatus, pinned in native/operator/selftest.cc).
 RETRYABLE_STATUSES = frozenset({0, 429, 500, 502, 503, 504})
 
+# Exception types that mark a STALE pooled keep-alive socket on a first
+# attempt (the server closed an idle connection): retried ONCE on a
+# fresh connection immediately, before the RetryPolicy loop is charged.
+# One definition shared by the parsed transport (_request_keepalive) and
+# the raw scrape transport (get_raw) so the classification cannot drift
+# between them.
+STALE_SOCKET_EXCEPTIONS: Tuple[type, ...] = (
+    http.client.RemoteDisconnected, http.client.BadStatusLine,
+    BrokenPipeError, ConnectionResetError)
+
 
 @dataclass(frozen=True)
 class RetryPolicy:
@@ -1105,10 +1115,8 @@ class Client:
                 return 0, _attempt_deadline_error(wall), None
             except (http.client.HTTPException, OSError) as exc:
                 self._drop_connection()
-                if attempt == 0 and isinstance(
-                        exc, (http.client.RemoteDisconnected,
-                              http.client.BadStatusLine,
-                              BrokenPipeError, ConnectionResetError)):
+                if attempt == 0 and isinstance(exc,
+                                               STALE_SOCKET_EXCEPTIONS):
                     # stale pooled socket: one fresh retry — still a wire
                     # attempt the server may have seen (chaos drops reply
                     # with a closed socket AFTER logging the request)
@@ -1497,6 +1505,52 @@ class Client:
 
     def get(self, path: str) -> Tuple[int, Dict[str, Any]]:
         return self._request("GET", path)
+
+    def get_raw(self, path: str) -> Tuple[int, bytes]:
+        """ONE logical GET returning ``(status, raw body bytes)`` — no
+        JSON parsing, no RetryPolicy loop, no hedging. The scrape
+        transport (ISSUE 13, metricsdb.ScrapeManager): exposition
+        bodies are Prometheus text, and a scrape is fail-open by
+        contract — a dead target is DATA (``up 0``), not an error — so
+        one attempt is the whole budget. Runs over the calling thread's
+        pooled keep-alive connection with the same single stale-socket
+        fast retry as every other request (an idle scrape interval
+        outliving the server's keep-alive timeout must read as a stale
+        socket, not a dead target), the whole attempt bounded by the
+        PR 9 wall. Status 0 = transport failure / wall exceeded."""
+        wall = self._attempt_wall()
+        for attempt in (0, 1):
+            conn = self._connection()
+            span_id, tp = self._attempt_context()
+            t0 = time.monotonic()
+            try:
+                status, payload, _ra = self._perform_attempt(
+                    conn, "GET", path, None, "", wall, tp)
+                self._note_attempt("GET", path, status,
+                                   time.monotonic() - t0,
+                                   span_id=span_id, scrape=True)
+                return status, payload
+            except _AttemptDeadline:
+                self._drop_connection()
+                self._note_attempt("GET", path, 0,
+                                   time.monotonic() - t0,
+                                   span_id=span_id, deadline=True,
+                                   scrape=True)
+                return 0, b""
+            except (http.client.HTTPException, OSError) as exc:
+                self._drop_connection()
+                if attempt == 0 and isinstance(exc,
+                                               STALE_SOCKET_EXCEPTIONS):
+                    self._note_attempt("GET", path, 0,
+                                       time.monotonic() - t0,
+                                       span_id=span_id, stale=True,
+                                       scrape=True)
+                    continue
+                self._note_attempt("GET", path, 0,
+                                   time.monotonic() - t0,
+                                   span_id=span_id, scrape=True)
+                return 0, b""
+        raise AssertionError("unreachable: both attempts return")
 
     def request_once(self, method: str, path: str,
                      body: Optional[Dict[str, Any]] = None,

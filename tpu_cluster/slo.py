@@ -32,16 +32,36 @@ Pass an explicit scale to change the mapping; the report records it.
 
 ``tpuctl slo check TRACE...`` exits 0 when no severity is burning and 1
 with the burning window pair named — the CI health gate.
+
+LIVE MODE (ISSUE 13): the verdict math is factored behind
+:data:`SampleSource` — a per-SLO windowed ``(bad, total)`` ratio
+callable — so the same multi-window rules also evaluate over SCRAPED
+counter increases: ``tpuctl slo check --live --targets ...`` feeds
+sources built by ``metricsdb.live_slo_report`` from a running
+ScrapeManager's TSDB (counters gain their time axis from the scrape
+timeline), with the identical rc contract and report shape,
+verdict-pinned against the trace-derived path by
+tests/test_metricsdb.py.
 """
 
 from __future__ import annotations
 
 import json
 from dataclasses import dataclass
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Mapping, Optional, \
+    Sequence, Tuple
 
 # One sample: (age_s before the end of its trace's timeline, good)
 Sample = Tuple[float, bool]
+
+# One SLO's evidence for windowed ratio queries (the sample-source
+# abstraction, ISSUE 13): called with a window width in SOURCE seconds
+# (trace seconds for span-derived samples, TSDB seconds for scraped
+# counters), returns ``(bad, total)`` over the most recent window.
+# Both checkers share the verdict math through it: `tpuctl slo check`
+# wraps span samples (:func:`source_from_samples`), `--live` wraps
+# counter increases (metricsdb.live_slo_report).
+SampleSource = Callable[[float], Tuple[float, float]]
 
 
 @dataclass(frozen=True)
@@ -231,17 +251,58 @@ class SLOReport:
                 "slos": [v.to_dict() for v in self.verdicts]}
 
 
-def _burn(samples: Sequence[Sample], window_trace_s: float,
-          budget: float) -> Tuple[float, int]:
-    """(burn rate, sample count) over the most recent
-    ``window_trace_s`` seconds of trace time. No samples -> burn 0 (no
-    evidence of burning; the report carries the count so 'no data' is
-    visible, not silently green-with-confidence)."""
-    recent = [good for age, good in samples if age <= window_trace_s]
-    if not recent:
-        return 0.0, 0
-    bad = sum(1 for good in recent if not good)
-    return (bad / len(recent)) / max(budget, 1e-9), len(recent)
+def source_from_samples(samples: Sequence[Sample]) -> SampleSource:
+    """The span-derived :data:`SampleSource`: ``(bad, total)`` counts
+    of the samples no older than the window."""
+    def ratio(window_s: float) -> Tuple[float, float]:
+        recent = [good for age, good in samples if age <= window_s]
+        return (float(sum(1 for good in recent if not good)),
+                float(len(recent)))
+    return ratio
+
+
+def _empty_source(window_s: float) -> Tuple[float, float]:
+    """The no-evidence source: burn 0 with a visible zero count (an SLO
+    the live mapping cannot express must read 'ok (no samples)', never
+    silently green-with-confidence)."""
+    return 0.0, 0.0
+
+
+def evaluate_sources(sources: Mapping[str, SampleSource],
+                     slos: Sequence[SLODef] = DEFAULT_SLOS,
+                     windows: Sequence[BurnWindow] = DEFAULT_WINDOWS,
+                     scale: Optional[float] = None,
+                     span_s: float = 0.0) -> SLOReport:
+    """Evaluate every SLO x window pair against per-SLO ratio SOURCES —
+    the shared verdict math under both `tpuctl slo check` paths (span
+    samples and live scraped counters). ``scale`` maps nominal window
+    seconds onto source seconds; default anchors the long page window
+    (1h) to ``span_s``. No evidence in a window -> burn 0 with the
+    count carried, so 'no data' stays visible in the report."""
+    if scale is None:
+        scale = _ANCHOR_WINDOW_S / max(span_s, 1e-6)
+    verdicts: List[SLOVerdict] = []
+    for slo in slos:
+        src = sources.get(slo.name, _empty_source)
+        budget = max(1.0 - slo.objective, 1e-9)
+        wvs: List[WindowVerdict] = []
+        for w in windows:
+            bad_s, n_short = src(w.short_s / scale)
+            bad_l, n_long = src(w.long_s / scale)
+            burn_short = (bad_s / n_short) / budget if n_short else 0.0
+            burn_long = (bad_l / n_long) / budget if n_long else 0.0
+            wvs.append(WindowVerdict(
+                severity=w.severity, short_s=w.short_s, long_s=w.long_s,
+                factor=w.factor, burn_short=burn_short,
+                burn_long=burn_long, samples_short=int(round(n_short)),
+                samples_long=int(round(n_long)),
+                burning=(burn_short > w.factor
+                         and burn_long > w.factor)))
+        total = src(float("inf"))[1]
+        verdicts.append(SLOVerdict(slo=slo, windows=tuple(wvs),
+                                   total_samples=int(round(total))))
+    return SLOReport(verdicts=tuple(verdicts), scale=float(scale),
+                     trace_span_s=span_s)
 
 
 def evaluate(traces: Sequence[Dict[str, Any]],
@@ -265,28 +326,10 @@ def evaluate(traces: Sequence[Dict[str, Any]],
                                for e in spans))
         for slo in slos:
             per_slo[slo.name].extend(samples_for(slo, doc))
-    if scale is None:
-        scale = _ANCHOR_WINDOW_S / max(span_s, 1e-6)
-    verdicts: List[SLOVerdict] = []
-    for slo in slos:
-        samples = per_slo[slo.name]
-        budget = 1.0 - slo.objective
-        wvs: List[WindowVerdict] = []
-        for w in windows:
-            burn_short, n_short = _burn(samples, w.short_s / scale,
-                                        budget)
-            burn_long, n_long = _burn(samples, w.long_s / scale, budget)
-            wvs.append(WindowVerdict(
-                severity=w.severity, short_s=w.short_s, long_s=w.long_s,
-                factor=w.factor, burn_short=burn_short,
-                burn_long=burn_long, samples_short=n_short,
-                samples_long=n_long,
-                burning=(burn_short > w.factor
-                         and burn_long > w.factor)))
-        verdicts.append(SLOVerdict(slo=slo, windows=tuple(wvs),
-                                   total_samples=len(samples)))
-    return SLOReport(verdicts=tuple(verdicts), scale=float(scale),
-                     trace_span_s=span_s)
+    sources = {name: source_from_samples(samples)
+               for name, samples in per_slo.items()}
+    return evaluate_sources(sources, slos=slos, windows=windows,
+                            scale=scale, span_s=span_s)
 
 
 def format_report(report: SLOReport) -> str:

@@ -134,6 +134,15 @@ INFORMER_LAG_SECONDS = "tpuctl_informer_lag_seconds"
 EVENTS_EMITTED_TOTAL = "tpuctl_events_emitted_total"
 EVENTS_DROPPED_TOTAL = "tpuctl_events_dropped_total"
 EVENT_EMIT_FAILURES_TOTAL = "tpuctl_event_emit_failures_total"
+# Continuous metrics (ISSUE 13): the scrape pipeline's self-metrics.
+# UP is the Prometheus liveness convention — 1 for a target whose
+# scrape parsed, 0 for a dead/garbled one — synthesized per target by
+# metricsdb.ScrapeManager next to its own duration and ingested-sample
+# vitals (a scrape loop that cannot account for itself is just another
+# unobserved controller).
+UP = "up"
+SCRAPE_DURATION_SECONDS = "tpuctl_scrape_duration_seconds"
+SCRAPE_SAMPLES_TOTAL = "tpuctl_scrape_samples_total"
 
 # Fixed default buckets, request-latency shaped (seconds). Shared with
 # the ready-wait histogram: its tail rides the +Inf bucket.
@@ -202,10 +211,48 @@ def _label_pairs(labels: Dict[str, str]) -> LabelPairs:
     return tuple(sorted(labels.items()))
 
 
-def _escape(value: str) -> str:
-    """Prometheus label-value escaping (backslash, quote, newline)."""
+def escape_label(value: str) -> str:
+    """Prometheus label-value escaping (backslash, quote, newline) —
+    the WRITE half of the exposition format's label grammar;
+    :func:`unescape_label` is the read twin the scrape parser
+    (tpu_cluster.metricsdb) applies, inverse-pinned by
+    tests/test_metricsdb.py's hostile-label fuzz."""
     return (value.replace("\\", "\\\\").replace('"', '\\"')
             .replace("\n", "\\n"))
+
+
+# Historical internal spelling (predates the parse twin); same function.
+_escape = escape_label
+
+
+def unescape_label(value: str) -> str:
+    """Inverse of :func:`escape_label`: one left-to-right pass decoding
+    ``\\\\``, ``\\"`` and ``\\n`` (an unknown escape keeps its backslash
+    verbatim, the Prometheus parser's tolerance rule). Sequential on
+    purpose — chained str.replace would mis-decode ``\\\\n`` (an escaped
+    backslash followed by a literal n) into a newline."""
+    out: List[str] = []
+    i = 0
+    n = len(value)
+    while i < n:
+        c = value[i]
+        if c == "\\" and i + 1 < n:
+            nxt = value[i + 1]
+            if nxt == "\\":
+                out.append("\\")
+                i += 2
+                continue
+            if nxt == '"':
+                out.append('"')
+                i += 2
+                continue
+            if nxt == "n":
+                out.append("\n")
+                i += 2
+                continue
+        out.append(c)
+        i += 1
+    return "".join(out)
 
 
 def _fmt(value: float) -> str:
@@ -215,6 +262,14 @@ def _fmt(value: float) -> str:
     if value == int(value) and abs(value) < 1e15:
         return str(int(value))
     return repr(round(value, 9))
+
+
+def fmt_value(value: float) -> str:
+    """Public face of :func:`_fmt` — the scrape-side parity surface:
+    :meth:`MetricsRegistry.samples` spells histogram ``le`` labels with
+    it, and the metricsdb parser's round-trip pin compares against
+    those exact strings."""
+    return _fmt(value)
 
 
 class Counter:
@@ -437,6 +492,46 @@ class MetricsRegistry:
                     suffix = f"{{{label_text}}}" if label_text else ""
                     lines.append(f"{name}{suffix} {_fmt(child.value)}")
         return "\n".join(lines) + "\n"
+
+    def samples(self) -> Dict[Tuple[str, LabelPairs], float]:
+        """Every sample line :meth:`render` emits, as a flat ``{(name,
+        sorted label pairs): value}`` mapping — histograms expand to
+        their cumulative ``_bucket`` rows (``le`` spelled via
+        :func:`fmt_value`, ``+Inf`` included), ``_sum`` and ``_count``,
+        exactly as rendered. This is the render/parse symmetry surface:
+        ``metricsdb.parse_text(reg.render()).samples == reg.samples()``
+        is the parity pin the scrape parser lives under
+        (tests/test_metricsdb.py)."""
+        out: Dict[Tuple[str, LabelPairs], float] = {}
+        with self._lock:
+            families = sorted(self._families.items())
+        for name, fam in families:
+            with self._lock:
+                series = sorted(fam.series.items())
+            for key, child in series:
+                if isinstance(child, Histogram):
+                    cum, h_sum = child.snapshot()
+                    for bound, c in zip(child.buckets, cum):
+                        le_key = tuple(sorted(
+                            key + (("le", fmt_value(bound)),)))
+                        out[(f"{name}_bucket", le_key)] = float(c)
+                    inf_key = tuple(sorted(key + (("le", "+Inf"),)))
+                    out[(f"{name}_bucket", inf_key)] = float(cum[-1])
+                    # values pass through the SAME _fmt spelling render
+                    # prints (repr(round(v, 9)) for fractions): a raw
+                    # 0.1+0.2 sum would compare 0.30000000000000004
+                    # against the parsed 0.3 and break the parity pin
+                    out[(f"{name}_sum", key)] = float(fmt_value(h_sum))
+                    out[(f"{name}_count", key)] = float(cum[-1])
+                else:
+                    out[(name, key)] = float(fmt_value(child.value))
+        return out
+
+    def family_types(self) -> Dict[str, str]:
+        """{family name: counter|gauge|histogram} — the ``# TYPE`` lines
+        render() emits, for the parser parity pin."""
+        with self._lock:
+            return {name: fam.mtype for name, fam in self._families.items()}
 
 
 # --------------------------------------------------------------------------
