@@ -1,0 +1,226 @@
+#include "informer.h"
+
+#include <string.h>
+
+namespace informer {
+
+namespace {
+
+double SecondsSince(const struct timespec& ref) {
+  // direct timespec math, NOT ElapsedMs: the int-milliseconds return
+  // overflows after ~24.8 days — exactly the long-outage case staleness
+  // exists to expose
+  struct timespec now;
+  clock_gettime(CLOCK_MONOTONIC, &now);
+  double s = static_cast<double>(now.tv_sec - ref.tv_sec) +
+             (now.tv_nsec - ref.tv_nsec) / 1e9;
+  return s < 0 ? 0 : s;
+}
+
+}  // namespace
+
+bool SubsetMatch(const minijson::Value& want, const minijson::Value& have) {
+  using minijson::Value;
+  if (want.type() != have.type()) return false;
+  switch (want.type()) {
+    case Value::Type::kNull:
+      return true;
+    case Value::Type::kBool:
+      return want.as_bool() == have.as_bool();
+    case Value::Type::kNumber:
+      return want.as_number() == have.as_number();
+    case Value::Type::kString:
+      return want.as_string() == have.as_string();
+    case Value::Type::kArray: {
+      const auto& w = want.elements();
+      const auto& h = have.elements();
+      // arrays compare whole: list merge semantics (reorder, append) are
+      // a drift the operator's merge-patch would revert, so report them
+      if (w.size() != h.size()) return false;
+      for (size_t i = 0; i < w.size(); ++i)
+        if (!w[i] || !h[i] || !SubsetMatch(*w[i], *h[i])) return false;
+      return true;
+    }
+    case Value::Type::kObject: {
+      for (const auto& kv : want.items()) {
+        minijson::ValuePtr hv = have.Get(kv.first);
+        if (!kv.second || !hv || !SubsetMatch(*kv.second, *hv))
+          return false;
+      }
+      return true;
+    }
+  }
+  return false;
+}
+
+Informer::Informer(const kubeclient::Config* cfg, std::string collection,
+                   int page_limit, int window_s)
+    : cfg_(cfg),
+      coll_(std::move(collection)),
+      page_limit_(page_limit < 1 ? 1 : page_limit),
+      window_s_(window_s < 1 ? 1 : window_s) {
+  clock_gettime(CLOCK_MONOTONIC, &fresh_at_);
+}
+
+Informer::~Informer() { Close(); }
+
+void Informer::Close() { ws_.Close(); }
+
+minijson::ValuePtr Informer::GetObject(const std::string& name) const {
+  auto it = cache_.find(name);
+  return it == cache_.end() ? nullptr : it->second;
+}
+
+void Informer::Touch() { clock_gettime(CLOCK_MONOTONIC, &fresh_at_); }
+
+double Informer::StalenessSeconds() const { return SecondsSince(fresh_at_); }
+
+void Informer::BackOff() {
+  ++strikes_;
+  clock_gettime(CLOCK_MONOTONIC, &blocked_at_);
+  backoff_ms_ = kubeclient::WatchBackoffMs(strikes_, 1000, 30000);
+  ws_.Close();
+  ++reconnects_;
+}
+
+bool Informer::Resync(std::string* err) {
+  std::map<std::string, minijson::ValuePtr> fresh;
+  std::string cont, rv;
+  int pages = 0;
+  bool restarted = false;
+  for (;;) {
+    std::string q = coll_ + "?limit=" + std::to_string(page_limit_);
+    if (!cont.empty()) q += "&continue=" + cont;
+    kubeclient::Response r = kubeclient::Call(*cfg_, "GET", q);
+    if (r.status == 410) {
+      // continue token expired mid-chase: restart the LIST from a clean
+      // first page, at most once (apiserver chunked-LIST semantics — a
+      // second 410 means the server can't serve a consistent list)
+      if (restarted) {
+        *err = "paginated LIST " + coll_ + ": continue expired twice";
+        return false;
+      }
+      restarted = true;
+      fresh.clear();
+      cont.clear();
+      pages = 0;
+      continue;
+    }
+    if (!r.ok()) {
+      *err = "LIST " + q + " -> " + std::to_string(r.status) + " " +
+             (r.status ? r.body.substr(0, 160) : r.error);
+      return false;
+    }
+    minijson::ValuePtr doc = minijson::Parse(r.body);
+    minijson::ValuePtr items = doc ? doc->Get("items") : nullptr;
+    if (!items || !items->is_array()) {
+      *err = "LIST " + coll_ + ": reply without items[]";
+      return false;
+    }
+    ++pages;
+    for (const auto& item : items->elements()) {
+      std::string name = item->PathString("metadata.name");
+      if (!name.empty()) fresh[name] = item;
+    }
+    rv = doc->PathString("metadata.resourceVersion", rv);
+    cont = doc->PathString("metadata.continue");
+    if (cont.empty()) break;
+  }
+  cache_ = std::move(fresh);
+  rv_ = rv;
+  pages_last_list_ = pages;
+  ++relists_;
+  synced_ = true;
+  strikes_ = 0;
+  backoff_ms_ = 0;
+  Touch();
+  // any stream opened before this list is a stale cursor: drop it so the
+  // next Pump resumes from the fresh resourceVersion
+  ws_.Close();
+  return true;
+}
+
+int Informer::Pump(const std::function<void(const Event&)>& on_event) {
+  if (!synced_) return 0;
+  if (!ws_.is_open()) {
+    if (backoff_ms_ > 0 &&
+        kubeclient::ElapsedMs(blocked_at_) < backoff_ms_)
+      return 0;
+    std::string err;
+    std::string path =
+        coll_ + "?watch=1&timeoutSeconds=" + std::to_string(window_s_);
+    if (!rv_.empty()) path += "&resourceVersion=" + rv_;
+    clock_gettime(CLOCK_MONOTONIC, &opened_at_);
+    if (!ws_.Open(*cfg_, path, window_s_ + 30, &err)) {
+      BackOff();
+      return 0;
+    }
+    backoff_ms_ = 0;
+  }
+  // Bounded drain: a saturating stream must hand control back so the
+  // caller can serve its status listener and the other informers.
+  constexpr int kMaxDrain = 64;
+  int delivered = 0;
+  for (int drained = 0; drained < kMaxDrain; ++drained) {
+    std::string line;
+    kubeclient::WatchStream::Result r = ws_.Next(0, &line);
+    if (r == kubeclient::WatchStream::kTimeout) break;
+    if (r == kubeclient::WatchStream::kClosed ||
+        r == kubeclient::WatchStream::kError) {
+      bool clean = r == kubeclient::WatchStream::kClosed &&
+                   kubeclient::ElapsedMs(opened_at_) >=
+                       window_s_ * 1000 - 1500;
+      if (clean) {
+        // the server served the whole timeoutSeconds window and closed
+        // it properly: the cache is provably fresh as of now; re-watch
+        // from the held resourceVersion at full rate, NO re-LIST
+        Touch();
+        strikes_ = 0;
+        backoff_ms_ = 0;
+        ws_.Close();
+      } else {
+        // quick close / transport break: capped exponential backoff —
+        // a rejecting proxy must not tight-loop stream opens
+        BackOff();
+      }
+      break;
+    }
+    minijson::ValuePtr ev = minijson::Parse(line);
+    std::string type =
+        ev && ev->Get("type") ? ev->Get("type")->as_string() : "";
+    minijson::ValuePtr obj = ev ? ev->Get("object") : nullptr;
+    if (!ev || type == "ERROR" || !obj || !obj->Get("metadata")) {
+      // Watch-level ERROR (410 Expired after a flap) or junk the https
+      // transport echoed as lines: the cursor is dead. Exactly ONE
+      // paginated re-LIST rebuilds the cache, then the stream resumes
+      // from the fresh resourceVersion. A failing re-LIST backs off and
+      // keeps the previous cache (the interval resync retries).
+      ws_.Close();
+      std::string err;
+      if (!Resync(&err)) BackOff();
+      break;
+    }
+    std::string name = obj->PathString("metadata.name");
+    if (name.empty()) continue;
+    if (type == "DELETED") {
+      cache_.erase(name);
+    } else {
+      cache_[name] = obj;
+      std::string rv = obj->PathString("metadata.resourceVersion");
+      if (!rv.empty()) rv_ = rv;
+    }
+    ++events_;
+    Touch();
+    if (on_event) {
+      Event e;
+      e.type = type;
+      e.name = name;
+      e.object = obj;
+      on_event(e);
+    }
+    ++delivered;
+  }
+  return delivered;
+}
+
+}  // namespace informer

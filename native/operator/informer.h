@@ -1,0 +1,123 @@
+// informer — a shared per-collection LIST+watch cache for the operator
+// (client-go SharedInformer analog; C++ twin of tpu_cluster/informer.py).
+//
+// One Informer owns one collection path. Resync() performs the paginated
+// initial LIST (`?limit=N` + `continue=` chase, restarted at most once
+// when a continue token expires with 410); Pump() drains the streaming
+// `?watch=1` connection WITHOUT blocking, maintaining the name->object
+// cache and resourceVersion cursor. After the initial sync, steady state
+// costs zero reads: the stream is the only traffic, and a clean
+// timeoutSeconds window expiry re-watches from the held resourceVersion
+// with NO re-LIST. A watch-level ERROR (410 Expired after an apiserver
+// flap, or an error body echoed as event lines) costs exactly ONE
+// paginated re-LIST, then the stream resumes from the fresh
+// resourceVersion — O(events), never O(objects x passes).
+//
+// Unlike the threaded Python twin, this informer is single-threaded and
+// cooperatively pumped (the operator's status listener must be served
+// between drains); every request goes through kubeclient::Call /
+// WatchStream::Open and inherits their whole-attempt walls.
+
+#ifndef TPU_NATIVE_OPERATOR_INFORMER_H_
+#define TPU_NATIVE_OPERATOR_INFORMER_H_
+
+#include <time.h>
+
+#include <functional>
+#include <map>
+#include <string>
+
+#include "kubeclient.h"
+#include "minijson.h"
+
+namespace informer {
+
+// One cache mutation, delivered from Pump(): type is the wire event type
+// ("MODIFIED"/"DELETED"); object is the full current object for MODIFIED
+// and the skeleton `{"metadata": {"name": ...}}` payload for DELETED.
+struct Event {
+  std::string type;
+  std::string name;
+  minijson::ValuePtr object;
+};
+
+// True when every field `want` specifies is present and equal in `have`:
+// objects recurse per key, arrays must match in length and element-wise,
+// scalars compare exactly. The cache-resident drift probe — a desired
+// manifest that SubsetMatch()es the cached live object needs no apply
+// (server-set fields the manifest doesn't mention never count as drift).
+bool SubsetMatch(const minijson::Value& want, const minijson::Value& have);
+
+class Informer {
+ public:
+  // cfg must outlive the informer. window_s is the watch timeoutSeconds
+  // — also the staleness bound a healthy idle stream guarantees (each
+  // clean window expiry proves the server was reachable through it).
+  Informer(const kubeclient::Config* cfg, std::string collection,
+           int page_limit = 200, int window_s = 30);
+  ~Informer();
+
+  // Paginated LIST replacing the whole cache. False (with *err) when the
+  // apiserver is unreachable or replies garbage; the previous cache and
+  // resourceVersion are kept so the caller can retry.
+  bool Resync(std::string* err);
+
+  // Drain available watch events into the cache, (re)opening the stream
+  // as due (capped exponential backoff after abnormal closes). Never
+  // blocks; returns the number of events delivered to on_event this
+  // call. No-op before the first successful Resync().
+  int Pump(const std::function<void(const Event&)>& on_event);
+
+  void Close();
+
+  bool synced() const { return synced_; }
+  bool stream_open() const { return ws_.is_open(); }
+  const std::string& collection() const { return coll_; }
+  const std::map<std::string, minijson::ValuePtr>& objects() const {
+    return cache_;
+  }
+  // nullptr when absent.
+  minijson::ValuePtr GetObject(const std::string& name) const;
+
+  long long relists() const { return relists_; }
+  long long events() const { return events_; }
+  // abnormal-close reopens + failed opens (quick-close churn); a stream
+  // cleanly idling out its window does not count
+  long long reconnects() const { return reconnects_; }
+  int pages_last_list() const { return pages_last_list_; }
+
+  // Seconds since this cache was last PROVEN fresh: a completed list,
+  // a delivered event, or a clean watch-window expiry. The
+  // tpu_operator_sync_lag_seconds source — bounded by ~window_s on a
+  // healthy stream, growing without bound when the apiserver is gone.
+  double StalenessSeconds() const;
+
+ private:
+  void Touch();
+  void BackOff();
+
+  const kubeclient::Config* cfg_;
+  std::string coll_;
+  int page_limit_;
+  int window_s_;
+
+  kubeclient::WatchStream ws_;
+  std::map<std::string, minijson::ValuePtr> cache_;
+  std::string rv_;  // resourceVersion cursor (list reply / event objects)
+  bool synced_ = false;
+
+  int strikes_ = 0;    // consecutive abnormal closes / failed opens
+  int backoff_ms_ = 0; // 0 = may (re)open immediately
+  struct timespec opened_at_ = {0, 0};
+  struct timespec blocked_at_ = {0, 0};
+  struct timespec fresh_at_ = {0, 0};  // StalenessSeconds anchor
+
+  long long relists_ = 0;
+  long long events_ = 0;
+  long long reconnects_ = 0;
+  int pages_last_list_ = 0;
+};
+
+}  // namespace informer
+
+#endif  // TPU_NATIVE_OPERATOR_INFORMER_H_

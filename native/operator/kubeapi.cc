@@ -138,6 +138,9 @@ const std::vector<std::string>& OperatorMetricNames() {
       "tpu_operator_watch_reconnects_total",
       "tpu_operator_queue_depth",
       "tpu_operator_sync_lag_seconds",
+      "tpu_operator_workqueue_adds_total",
+      "tpu_operator_workqueue_retries_total",
+      "tpu_operator_workqueue_depth",
   };
   return *names;
 }
@@ -154,6 +157,7 @@ const std::vector<std::string>& OperatorTraceEventNames() {
       "ready-wait",
       "watch-sleep",
       "drift-event",
+      "reconcile-object",
   };
   return *names;
 }

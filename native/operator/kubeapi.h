@@ -40,9 +40,9 @@ bool IsClusterScoped(const std::string& kind);
 // omitted when ns is empty.
 std::vector<std::string> SweepCollections(const std::string& ns);
 
-// Kinds the operator treats as operand *workloads* — the collections the
-// drift watch holds open across the sleep (operator_main.cc
-// OwnedWorkloadCollections). This is the C++ half of a pinned twin table:
+// Kinds the operator treats as operand *workloads* — the kinds whose
+// watch events are generation-filtered drift (operator_main.cc
+// OnInformerEvent). This is the C++ half of a pinned twin table:
 // the Python bundle linter's OPERAND_WORKLOAD_KINDS
 // (tpu_cluster/lint.py) names the same GVKs, and native/operator/
 // selftest.cc + tests/test_lint.py pin the two against each other (same

@@ -43,9 +43,11 @@
 #include <vector>
 
 #include "../common/httpread.h"
+#include "informer.h"
 #include "kubeapi.h"
 #include "kubeclient.h"
 #include "minijson.h"
+#include "workqueue.h"
 
 namespace {
 
@@ -63,9 +65,16 @@ struct Options {
                              // watch-pump cadence)
   bool policy_watch = true;  // event-driven CR watch (?watch=1 stream);
                              // GET-probe polling remains the fallback
-  bool operand_watch = true; // event-driven drift repair: watch the owned
-                             // workload collections across the sleep; the
-                             // interval pass stays the resync backstop
+  bool operand_watch = true; // event-driven drift repair: per-collection
+                             // informer caches + the rate-limited
+                             // workqueue; the interval pass stays the
+                             // full-resync backstop. --no-operand-watch
+                             // = no informers at all (request-driven
+                             // passes, the pre-informer behavior).
+  int page_limit = 200;      // informer LIST pagination (?limit=)
+  int watch_window_s = 30;   // informer watch timeoutSeconds — also the
+                             // staleness bound a healthy idle stream
+                             // guarantees (sync_lag_seconds source)
   int interval_s = 15;
   int stage_timeout_s = 600;
   int poll_ms = 1000;
@@ -290,9 +299,11 @@ class Operator {
     char host[256] = "host";
     gethostname(host, sizeof(host) - 1);
     identity_ = std::string(host) + "-" + std::to_string(getpid());
-    // sync lag is "seconds since the last CONVERGED pass"; before the
-    // first one it counts from process start, so a never-converging
-    // operator shows an ever-growing lag instead of a flat 0
+    // sync lag is informer-cache staleness when the informer core runs
+    // (see Metrics); in the request-driven modes it falls back to
+    // "seconds since the last CONVERGED pass", counted from process
+    // start before the first one, so a never-converging operator shows
+    // an ever-growing lag instead of a flat 0
     clock_gettime(CLOCK_MONOTONIC, &start_ts_);
   }
 
@@ -358,7 +369,9 @@ class Operator {
 
   bool ReconcileObjects() {
     ++passes_;
-    FetchPolicy();
+    EnsureInformers();
+    if (ShouldFetchPolicy()) FetchPolicy();
+    RebuildKeyIndex();
     for (auto& bo : bundle_) {
       bo.applied = false;
       bo.ready = false;
@@ -388,6 +401,16 @@ class Operator {
                       bundle_[j]);
             return false;
           }
+          continue;
+        }
+        // Informer fast path: when the cached live object already
+        // matches the desired manifest field-for-field, the resync
+        // round costs ZERO requests for it — the informer cache, not a
+        // GET, is the drift probe. Identity (uid/generation/
+        // traceparent) is adopted from the cache like RememberUid
+        // adopts it from an API response.
+        if (CleanInCache(&bundle_[j])) {
+          bundle_[j].applied = true;
           continue;
         }
         double apply_ts = trace_.NowUs();
@@ -427,10 +450,17 @@ class Operator {
                             {"ok", gate_ok ? "true" : "false"}});
       };
       while (!g_stop) {
+        // The informer streams stay open THROUGH the gate (the
+        // pass->watch blind window is gone): readiness comes off the
+        // cache, and drift landing mid-reconcile is classified into the
+        // workqueue and repaired here, not discovered by a catch-up
+        // LIST later.
+        PumpInformers();
+        DrainQueue(16);
         bool all_ready = true;
         for (size_t j = i; j < stage_end; ++j) {
           if (bundle_[j].disabled) continue;
-          if (!bundle_[j].ready && !CheckReady(&bundle_[j]))
+          if (!bundle_[j].ready && !CheckReadyAny(&bundle_[j]))
             all_ready = false;
         }
         if (all_ready) {
@@ -816,19 +846,28 @@ class Operator {
     return out;
   }
 
-  // Workload collections this bundle owns — the drift-watch targets. The
-  // operator only watches what it applied: DaemonSets/Deployments carry
-  // generation-tracked specs whose external edits (and deletions) are the
-  // drift this repairs; config/RBAC drift waits for the interval resync.
-  std::vector<std::string> OwnedWorkloadCollections() const {
+  // --- Informer/workqueue core (controller-runtime model) ---------------
+  //
+  // One informer per distinct bundle collection keeps a full local cache
+  // fed by paginated-LIST-once-then-WATCH, so reconcile cost is O(events)
+  // instead of O(objects x passes): a synced idle operator issues ZERO
+  // reads per interval (resync rounds diff the desired bundle against the
+  // cache), and drift events are classified into a rate-limited dedup
+  // workqueue whose Reconcile(key) repairs exactly the drifted object in
+  // O(1) requests. The informer streams stay open THROUGH reconcile
+  // passes, which is what deleted the old pass->watch blind-window
+  // catch-up LIST: an event landing mid-reconcile sits in the queue (or
+  // is re-queued by Done() if its key was being processed) instead of
+  // going invisible until the interval resync.
+
+  bool UseInformers() const { return opt_.operand_watch && !opt_.once; }
+
+  // Distinct collection paths over ALL bundle objects — config kinds too:
+  // a ConfigMap edit is drift exactly like a DaemonSet edit, and the
+  // zero-idle-reads contract needs every owned kind cache-resident.
+  std::vector<std::string> BundleCollections() const {
     std::vector<std::string> colls;
-    const auto& watch_kinds = kubeapi::OperandWorkloadKinds();
     for (const auto& bo : bundle_) {
-      std::string kind = bo.obj->PathString("kind");
-      if (std::find(watch_kinds.begin(), watch_kinds.end(), kind) ==
-          watch_kinds.end())
-        continue;
-      if (bo.disabled) continue;
       std::string err;
       std::string coll = kubeapi::CollectionPath(*bo.obj, &err);
       if (coll.empty()) continue;
@@ -838,104 +877,325 @@ class Operator {
     return colls;
   }
 
-  // One owned-operand drift watch: a workload collection held open across
-  // the sleep, reopened with capped exponential backoff when it closes
-  // quickly (WatchBackoffMs — a persistently rejecting server must not
-  // tight-loop stream opens, which are curl spawns on https).
-  struct OperandWatchState {
-    std::string coll;
-    kubeclient::WatchStream ws;
-    int strikes = 0;             // consecutive quick closes / failed opens
-    struct timespec opened_at;   // quick-close detection
-    struct timespec blocked_at;  // when the current backoff started
-    int backoff_ms = 0;          // 0 = may (re)open immediately
-  };
-
-  // One LIST of an owned workload collection against the recorded
-  // per-object generations: the catch-up read that closes the
-  // pass→watch BLIND WINDOW. While a reconcile pass runs, no watch
-  // stream is open, and the streams (re)opened for the next sleep start
-  // at "now" — without this, a delete or spec edit that landed mid-pass
-  // would sleep invisibly until the interval resync (observed as a
-  // multi-second repair gap under chaos). Returns true when an owned
-  // object is missing or carries an unexpected generation, i.e. the
-  // caller must reconcile immediately instead of sleeping. A failing
-  // LIST returns false: the stream + interval resync still cover it.
-  bool OwnedDriftInList(const std::string& coll,
-                        const std::map<std::string, double>& owned) {
-    kubeclient::Response list = kubeclient::Call(cfg_, "GET", coll);
-    if (!list.ok()) return false;
-    minijson::ValuePtr doc = minijson::Parse(list.body);
-    minijson::ValuePtr items = doc ? doc->Get("items") : nullptr;
-    if (!items || !items->is_array()) return false;
-    std::map<std::string, double> live;
-    for (const auto& item : items->elements())
-      live[item->PathString("metadata.name")] =
-          item->PathNumber("metadata.generation", 0);
-    for (const auto& kv : owned) {
-      if (kv.first.rfind(coll + "/", 0) != 0) continue;
-      std::string name = kv.first.substr(coll.size() + 1);
-      auto it = live.find(name);
-      if (it != live.end() && kv.second == 0)
-        continue;  // generation never observed: nothing to compare
-      if (it == live.end() || it->second != kv.second) {
-        fprintf(stderr,
-                "tpu-operator: operand drift (%s %s, catch-up list); "
-                "reconciling now\n", name.c_str(),
-                it == live.end() ? "deleted mid-pass"
-                                 : "generation changed mid-pass");
-        trace_.AddInstant("drift-event", "watch",
-                          {{"object", name}, {"via", "catch-up-list"}});
-        return true;
+  // Create informers for collections the bundle gained, drop informers
+  // for collections it lost, and (re)try the initial paginated LIST of
+  // any not yet synced (an unreachable apiserver keeps the informer
+  // around unsynced; the per-object request path covers that pass).
+  void EnsureInformers() {
+    if (!UseInformers()) {
+      informers_.clear();
+      return;
+    }
+    std::vector<std::string> colls = BundleCollections();
+    for (auto it = informers_.begin(); it != informers_.end();) {
+      if (std::find(colls.begin(), colls.end(), it->first) == colls.end())
+        it = informers_.erase(it);
+      else
+        ++it;
+    }
+    for (const auto& coll : colls) {
+      auto& inf = informers_[coll];
+      if (!inf)
+        inf = std::make_unique<informer::Informer>(
+            &cfg_, coll, opt_.page_limit, opt_.watch_window_s);
+      if (!inf->synced()) {
+        std::string err;
+        if (inf->Resync(&err))
+          fprintf(stderr,
+                  "tpu-operator: informer %s synced (%zu objects, "
+                  "%d pages)\n",
+                  coll.c_str(), inf->objects().size(),
+                  inf->pages_last_list());
+        else
+          fprintf(stderr,
+                  "tpu-operator: informer %s initial list failed (%s); "
+                  "pass falls back to per-object requests\n",
+                  coll.c_str(), err.c_str());
       }
     }
-    return false;
   }
 
-  // Event-driven sleep: hold streaming `?watch=1` connections for the
-  // whole interval (the controller-runtime model — zero GET probes) on
-  //  - the policy CR (when ``policy_stream``), and
-  //  - every owned workload collection (drift repair: an external spec
-  //    edit or delete of an operand reconciles NOW, not at the next
-  //    interval pass, which remains the resync backstop),
-  // pumping the status listener between waits and checking the bundle
-  // dir's LOCAL fingerprint at the probe cadence. Returns true when the
-  // sleep was fully handled (an event cut it short, or it ran out);
-  // false = the POLICY stream could not be established or died — the
-  // caller falls back to GET-probe polling for the remaining *left_ms.
-  // Operand streams never fail the sleep over: each just backs off and
-  // retries, because the interval pass already backstops drift.
+  // The synced informer covering this object, or nullptr (no informer
+  // core, unknown collection, initial list still failing).
+  informer::Informer* InformerFor(const BundleObject& bo) {
+    if (informers_.empty()) return nullptr;
+    std::string err;
+    std::string coll = kubeapi::CollectionPath(*bo.obj, &err);
+    auto it = informers_.find(coll);
+    if (it == informers_.end() || !it->second->synced()) return nullptr;
+    return it->second.get();
+  }
+
+  // coll/name -> bundle_ index; rebuilt at pass start (the bundle is
+  // reloaded from disk each pass) so event classification and
+  // Reconcile(key) resolve against the CURRENT desired state.
+  void RebuildKeyIndex() {
+    key_index_.clear();
+    for (size_t i = 0; i < bundle_.size(); ++i) {
+      std::string err;
+      std::string coll = kubeapi::CollectionPath(*bundle_[i].obj, &err);
+      if (coll.empty()) continue;
+      key_index_[coll + "/" +
+                 bundle_[i].obj->PathString("metadata.name")] = i;
+    }
+  }
+
+  // Adopt identity from a CACHED live object — uid (event correlation),
+  // generation (the drift filter), traceparent — exactly what RememberUid
+  // adopts from an API response body.
+  void RememberLive(BundleObject* bo, const minijson::Value& live) {
+    std::string uid = live.PathString("metadata.uid");
+    if (!uid.empty()) bo->uid = uid;
+    double gen = live.PathNumber("metadata.generation", 0);
+    if (gen > 0) bo->generation = gen;
+    std::string tp = AnnotationTraceparent(live);
+    if (!tp.empty()) bo->traceparent = tp;
+  }
+
+  // Zero-request convergence probe: the cached live object carries every
+  // field the desired manifest specifies (SubsetMatch — server-set fields
+  // the manifest doesn't mention never count as drift, the merge-patch
+  // reading). True = nothing to apply; identity adopted from the cache.
+  bool CleanInCache(BundleObject* bo) {
+    informer::Informer* inf = InformerFor(*bo);
+    if (!inf) return false;
+    minijson::ValuePtr live =
+        inf->GetObject(bo->obj->PathString("metadata.name"));
+    if (!live) return false;
+    if (!informer::SubsetMatch(*bo->obj, *live)) return false;
+    RememberLive(bo, *live);
+    return true;
+  }
+
+  // Readiness off the informer cache when one covers the object (zero
+  // requests); one GET otherwise (CheckReady, the pre-informer path).
+  bool CheckReadyAny(BundleObject* bo) {
+    informer::Informer* inf = InformerFor(*bo);
+    if (!inf) return CheckReady(bo);
+    std::string kind = bo->obj->PathString("kind");
+    if (kind != "DaemonSet" && kind != "Deployment" && kind != "Job") {
+      bo->ready = true;
+      return true;
+    }
+    minijson::ValuePtr live =
+        inf->GetObject(bo->obj->PathString("metadata.name"));
+    if (!live) return false;
+    double gen = live->PathNumber("metadata.generation", 0);
+    if (gen > 0) bo->generation = gen;
+    bool ready = kubeapi::IsReady(*live);
+    if (!ready && opt_.allow_empty_daemonsets && kind == "DaemonSet" &&
+        live->PathNumber("status.desiredNumberScheduled", -1) == 0)
+      ready = true;  // cluster has no matching nodes yet; don't wedge
+    bo->ready = ready;
+    return ready;
+  }
+
+  // Classify one watch event against the desired state; drifted keys go
+  // into the workqueue (dedup'd while queued). The operator's own writes
+  // self-filter: generation-tracked kinds compare metadata.generation
+  // against the recorded applied generation (status churn echoes as
+  // MODIFIED with an unchanged generation), config kinds SubsetMatch the
+  // event object against the manifest.
+  void OnInformerEvent(const std::string& coll, const informer::Event& ev) {
+    auto it = key_index_.find(coll + "/" + ev.name);
+    if (it == key_index_.end()) return;  // not an object we own
+    BundleObject& bo = bundle_[it->second];
+    if (bo.disabled) return;  // DeleteDisabled's own DELETED echo
+    if (ev.type == "DELETED") {
+      fprintf(stderr,
+              "tpu-operator: operand drift (%s deleted, watch "
+              "event); reconciling now\n", ev.name.c_str());
+      trace_.AddInstant("drift-event", "watch",
+                        {{"object", ev.name}, {"via", "operand-watch"}});
+      bo.applied = false;
+      bo.ready = false;
+      queue_.Add(it->first);
+      return;
+    }
+    if (!ev.object) return;
+    const auto& watch_kinds = kubeapi::OperandWorkloadKinds();
+    std::string kind = bo.obj->PathString("kind");
+    if (std::find(watch_kinds.begin(), watch_kinds.end(), kind) !=
+        watch_kinds.end()) {
+      double gen = ev.object->PathNumber("metadata.generation", 0);
+      // Generation filter: status churn (readiness counts) echoes as
+      // MODIFIED with an unchanged generation — only an external spec
+      // edit moves it. generation==0 recorded = never observed: nothing
+      // to compare (the resync round's SubsetMatch still covers it).
+      if (bo.generation == 0 || gen == bo.generation) return;
+      fprintf(stderr,
+              "tpu-operator: operand drift (%s generation "
+              "%.0f -> %.0f, watch event); reconciling now\n",
+              ev.name.c_str(), bo.generation, gen);
+      kubeapi::TraceEmitter::Args dargs = {
+          {"object", ev.name}, {"via", "operand-watch"}};
+      std::string tp = AnnotationTraceparent(*ev.object);
+      if (!tp.empty()) {
+        // the spec edit's OWN trace context (a tpuctl re-apply): the
+        // repair attributes straight back to its cause
+        dargs.push_back({"traceparent", tp});
+        dargs.push_back(
+            {"trace_id", kubeapi::ParseTraceparent(tp).first});
+      }
+      trace_.AddInstant("drift-event", "watch", dargs);
+      queue_.Add(it->first);
+      return;
+    }
+    // config kind (no generation tracking): diff against desired
+    if (informer::SubsetMatch(*bo.obj, *ev.object)) return;
+    fprintf(stderr,
+            "tpu-operator: operand drift (%s modified, watch event); "
+            "reconciling now\n", ev.name.c_str());
+    trace_.AddInstant("drift-event", "watch",
+                      {{"object", ev.name}, {"via", "operand-watch"}});
+    queue_.Add(it->first);
+  }
+
+  // Drain pending watch events from every informer, non-blocking.
+  // Returns the number of events delivered.
+  int PumpInformers() {
+    if (informers_.empty()) return 0;
+    int total = 0;
+    for (auto& kv : informers_) {
+      const std::string& coll = kv.first;
+      int n = kv.second->Pump(
+          [&](const informer::Event& ev) { OnInformerEvent(coll, ev); });
+      total += n;
+      // a flooding collection must not starve the status listener: the
+      // informer's own drain is bounded at 64, pump /healthz between
+      if (n >= 64) Sleep(0);
+    }
+    return total;
+  }
+
+  // Per-object reconcile — the workqueue's unit of work, the O(1)-repair
+  // path. Wrapped in a "reconcile-object" trace slice carrying the
+  // causing traceparent (the per-event analog of "reconcile-pass").
+  bool ReconcileKey(const std::string& key) {
+    auto it = key_index_.find(key);
+    if (it == key_index_.end()) return true;  // bundle moved on: drop
+    BundleObject& bo = bundle_[it->second];
+    double ts = trace_.NowUs();
+    bool ok;
+    if (!OperandEnabled(bo.operand, bo.default_enabled)) {
+      ok = DeleteDisabled(&bo);
+    } else if (CleanInCache(&bo)) {
+      bo.applied = true;  // cache already matches: drift self-resolved
+      ok = true;
+    } else {
+      double apply_ts = trace_.NowUs();
+      ok = ApplyObject(&bo);
+      kubeapi::TraceEmitter::Args apply_args = {
+          {"object", bo.file}, {"ok", ok ? "true" : "false"}};
+      if (!bo.traceparent.empty()) {
+        apply_args.push_back({"traceparent", bo.traceparent});
+        apply_args.push_back(
+            {"trace_id", kubeapi::ParseTraceparent(bo.traceparent).first});
+      }
+      trace_.AddComplete("apply-object", "reconcile", apply_ts,
+                         trace_.NowUs() - apply_ts, apply_args);
+    }
+    kubeapi::TraceEmitter::Args args = {
+        {"object", bo.file}, {"key", key},
+        {"ok", ok ? "true" : "false"}};
+    if (!bo.traceparent.empty()) {
+      args.push_back({"traceparent", bo.traceparent});
+      args.push_back(
+          {"trace_id", kubeapi::ParseTraceparent(bo.traceparent).first});
+    }
+    trace_.AddComplete("reconcile-object", "reconcile", ts,
+                       trace_.NowUs() - ts, args);
+    if (ok && !bo.disabled && !bo.ready && !CheckReadyAny(&bo)) {
+      // Readiness follow-up without blocking the queue: re-check at the
+      // poll cadence (off the cache) until stage_timeout_s gives up —
+      // the interval resync remains the backstop after that.
+      time_t now = time(nullptr);
+      auto d = ready_deadline_.find(key);
+      if (d == ready_deadline_.end()) {
+        ready_deadline_[key] = now + opt_.stage_timeout_s;
+        queue_.AddAfter(key, opt_.poll_ms);
+      } else if (now < d->second) {
+        queue_.AddAfter(key, opt_.poll_ms);
+      } else {
+        fprintf(stderr,
+                "tpu-operator: %s not ready after %ds (event repair); "
+                "interval resync will retry\n",
+                bo.file.c_str(), opt_.stage_timeout_s);
+        ready_deadline_.erase(d);
+      }
+    } else {
+      ready_deadline_.erase(key);
+    }
+    return ok;
+  }
+
+  // A repair must apply the FRESHEST render: the bundle is a mounted
+  // ConfigMap kubelet live-updates, and repairing drift from a snapshot
+  // taken before a re-render would merge the upgrade away. So before
+  // working the queue, re-read the bundle if its fingerprint moved since
+  // the pass baselined it. A render that fails to parse keeps the
+  // previous bundle and says so loudly — the same keep-last-good
+  // contract as the pass-start reload. pass_bundle_fp_ is deliberately
+  // NOT advanced on success: the sleep's changed-fingerprint check must
+  // still cut the interval short for the full pass (prune, stage gates)
+  // that a per-key repair cannot provide.
+  void RefreshBundleForRepair() {
+    std::string fp = BundleFingerprint();
+    if (fp.empty() || fp == pass_bundle_fp_ || fp == repair_bundle_fp_)
+      return;
+    repair_bundle_fp_ = fp;  // one attempt per distinct render
+    std::vector<BundleObject> fresh;
+    std::string err;
+    if (LoadBundle(opt_.bundle_dir, &fresh, &err)) {
+      bundle_ = std::move(fresh);
+      RebuildKeyIndex();
+    } else {
+      fprintf(stderr, "tpu-operator: bundle reload failed (%s); "
+              "keeping previous bundle\n", err.c_str());
+    }
+  }
+
+  // Work off up to max_keys queued reconciles. Failure re-queues with
+  // capped exponential backoff (AddRateLimited); success Forget()s the
+  // key's strikes. A shed (depth bound hit) flags a full resync owed.
+  int DrainQueue(int max_keys) {
+    int done = 0;
+    std::string key;
+    while (done < max_keys && queue_.Get(&key, 0)) {
+      if (ReconcileKey(key))
+        queue_.Forget(key);
+      else
+        queue_.AddRateLimited(key);
+      queue_.Done(key);
+      ++done;
+    }
+    if (queue_.TakeResyncNeeded()) resync_owed_ = true;
+    return done;
+  }
+
+  // Event-driven sleep: the informer streams (already open — they live
+  // through reconcile passes) and, when ``policy_stream``, a streaming
+  // `?watch=1` on the policy CR are pumped for the whole interval (the
+  // controller-runtime model — zero GET probes), with the status
+  // listener served between waits and the bundle dir's LOCAL fingerprint
+  // checked at the probe cadence. Operand drift is repaired IN PLACE —
+  // informer events are classified into the workqueue and drained here,
+  // O(1) requests per event, without ending the sleep or triggering a
+  // full pass. Returns true when the sleep was fully handled (ran out,
+  // or a policy/bundle change cut it short); false = the POLICY stream
+  // could not be established or died — the caller falls back to
+  // GET-probe polling for the remaining *left_ms.
   bool SleepOnWatches(int* left_ms, const std::string& bundle_fp,
                       bool policy_stream) {
     int secs = (*left_ms + 999) / 1000 + 1;
     std::string err;
-    std::vector<std::unique_ptr<OperandWatchState>> ows;
-    std::map<std::string, double> owned;  // coll/name -> applied generation
-    if (opt_.operand_watch) {
-      for (const auto& coll : OwnedWorkloadCollections()) {
-        auto st = std::make_unique<OperandWatchState>();
-        st->coll = coll;
-        ows.push_back(std::move(st));
-      }
-      for (const auto& bo : bundle_) {
-        std::string kind = bo.obj->PathString("kind");
-        if ((kind != "DaemonSet" && kind != "Deployment") || bo.disabled)
-          continue;
-        std::string coll = kubeapi::CollectionPath(*bo.obj, &err);
-        if (!coll.empty())
-          owned[coll + "/" + bo.obj->PathString("metadata.name")] =
-              bo.generation;
-      }
-    }
-    // Catch-up probes BEFORE opening any stream (so they land outside
-    // the event-driven window the tests pin to zero probes): anything
-    // that drifted while the pass ran — when nothing was watching — is
-    // repaired now instead of at the interval resync. What remains
-    // uncovered is the one-RTT probe→open gap, which the resync
-    // backstops.
+    // Catch-up probe BEFORE opening the policy stream (so it lands
+    // outside the event-driven window the tests pin to zero probes): a
+    // policy edit that landed while the pass ran is honored now. Operand
+    // drift needs no catch-up read at all — the informer streams stayed
+    // open through the pass, so mid-pass events are already sitting in
+    // the workqueue (or were re-queued by Done()).
     if (policy_stream && PolicyProbeSaysReconcile()) return true;
-    for (const auto& owp : ows)
-      if (OwnedDriftInList(owp->coll, owned)) return true;
     kubeclient::WatchStream pws;
     if (policy_stream) {
       std::string path = PolicyPath() + "?watch=1&timeoutSeconds=" +
@@ -972,21 +1232,6 @@ class Operator {
         events_since_pump = 0;
         Sleep(0);  // answer pending /healthz before draining more
       }
-    };
-    auto back_off = [&](OperandWatchState* ow, bool quick) {
-      ow->strikes = quick ? ow->strikes + 1 : 0;
-      clock_gettime(CLOCK_MONOTONIC, &ow->blocked_at);
-      ow->backoff_ms =
-          ow->strikes == 0
-              ? 0
-              : kubeclient::WatchBackoffMs(ow->strikes, 1000, 30000);
-      ow->ws.Close();
-      // each back_off forces exactly one stream re-open attempt later —
-      // the tpu_operator_watch_reconnects_total counter /metrics serves.
-      // Both flavors count: quick closes/failed opens (the churn a
-      // rejecting proxy causes) and windows the server ended early; a
-      // stream that idles out the whole sleep never lands here.
-      ++watch_reconnects_;
     };
     while (!g_stop) {
       recompute_left();
@@ -1025,6 +1270,7 @@ class Operator {
                 trace_.AddInstant("drift-event", "watch",
                                   {{"object", opt_.policy},
                                    {"via", "policy-watch"}});
+                policy_dirty_ = true;
                 return true;
               }
               break;
@@ -1060,6 +1306,7 @@ class Operator {
                     {"trace_id", kubeapi::ParseTraceparent(tp).first});
               }
               trace_.AddInstant("drift-event", "watch", dargs);
+              policy_dirty_ = true;
               return true;
             }
             break;
@@ -1074,91 +1321,24 @@ class Operator {
             return false;
         }
       }
-      for (auto& owp : ows) {
-        OperandWatchState& ow = *owp;
-        if (!ow.ws.is_open()) {
-          if (ow.backoff_ms > 0 &&
-              kubeclient::ElapsedMs(ow.blocked_at) < ow.backoff_ms)
-            continue;
-          std::string werr;
-          std::string wpath = ow.coll + "?watch=1&timeoutSeconds=" +
-                              std::to_string(secs);
-          clock_gettime(CLOCK_MONOTONIC, &ow.opened_at);
-          if (!ow.ws.Open(cfg_, wpath, secs + 30, &werr)) {
-            if (ow.strikes == 0)
-              fprintf(stderr,
-                      "tpu-operator: operand watch %s unavailable (%s); "
-                      "retrying with backoff (interval pass remains the "
-                      "drift backstop)\n", ow.coll.c_str(), werr.c_str());
-            back_off(&ow, true);
-            continue;
-          }
-        }
-        // Bounded drain per iteration: a saturating operand stream must
-        // hand control back so the wall clock and the other streams are
-        // still serviced.
-        for (int drained = 0; drained < kMaxEventDrain; ++drained) {
-          std::string line;
-          kubeclient::WatchStream::Result r = ow.ws.Next(0, &line);
-          if (r == kubeclient::WatchStream::kTimeout) break;
-          if (r == kubeclient::WatchStream::kClosed ||
-              r == kubeclient::WatchStream::kError) {
-            // Quick close = the server/proxy is rejecting the watch:
-            // exponential backoff. A stream that lived out its window
-            // reopens at full rate (strike counter resets).
-            back_off(&ow, kubeclient::ElapsedMs(ow.opened_at) < 2000);
-            break;
-          }
-          idle = false;
-          pump_guard();
-          minijson::ValuePtr ev = minijson::Parse(line);
-          if (!ev) continue;
-          std::string type =
-              ev->Get("type") ? ev->Get("type")->as_string() : "";
-          minijson::ValuePtr obj = ev->Get("object");
-          if (type == "ERROR" || !obj || !obj->Get("metadata")) {
-            // Junk or expired stream (apiserver error body echoed as
-            // lines): drop THIS stream with backoff. Unlike the policy
-            // stream there is no polling to fall back to — the interval
-            // pass already backstops drift.
-            back_off(&ow, true);
-            break;
-          }
-          std::string name = obj->PathString("metadata.name");
-          auto it = owned.find(ow.coll + "/" + name);
-          if (it == owned.end()) continue;  // not an object we applied
-          if (type == "DELETED") {
-            fprintf(stderr,
-                    "tpu-operator: operand drift (%s deleted, watch "
-                    "event); reconciling now\n", name.c_str());
-            trace_.AddInstant("drift-event", "watch",
-                              {{"object", name},
-                               {"via", "operand-watch"}});
-            return true;
-          }
-          double gen = ev->PathNumber("object.metadata.generation", 0);
-          // Generation filter: status churn (readiness counts) echoes as
-          // MODIFIED with an unchanged generation — only an external
-          // spec edit moves it.
-          if (gen != it->second) {
-            fprintf(stderr,
-                    "tpu-operator: operand drift (%s generation "
-                    "%.0f -> %.0f, watch event); reconciling now\n",
-                    name.c_str(), it->second, gen);
-            kubeapi::TraceEmitter::Args dargs = {
-                {"object", name}, {"via", "operand-watch"}};
-            std::string tp = AnnotationTraceparent(*obj);
-            if (!tp.empty()) {
-              // the spec edit's OWN trace context (a tpuctl re-apply):
-              // the repair attributes straight back to its cause
-              dargs.push_back({"traceparent", tp});
-              dargs.push_back(
-                  {"trace_id", kubeapi::ParseTraceparent(tp).first});
-            }
-            trace_.AddInstant("drift-event", "watch", dargs);
-            return true;
-          }
-        }
+      // Informer pump + queue drain: drift events are classified and
+      // repaired right here — O(events) work inside the sleep, the sleep
+      // itself keeps running (the interval pass stays a pure resync
+      // backstop instead of the repair path).
+      if (PumpInformers() > 0) idle = false;
+      // NOT inside DrainQueue itself: the mid-pass drain (stage-gate
+      // loop) runs while ReconcilePass iterates bundle_ by index, where
+      // swapping the vector would invalidate the pass; here the pass is
+      // over and the queue is the only consumer.
+      if (queue_.depth() > 0) RefreshBundleForRepair();
+      if (DrainQueue(16) > 0) idle = false;
+      if (resync_owed_) {
+        // the workqueue shed oldest keys under pressure: per-key repair
+        // lost track of WHICH drifted, so owe one full resync round
+        resync_owed_ = false;
+        fprintf(stderr, "tpu-operator: workqueue shed oldest items under "
+                "pressure; full resync now\n");
+        return true;
       }
       if (!idle) continue;  // events flowed; wall clock rechecked on top
       // Nothing pending on any stream: serve status/healthz for a short
@@ -1196,8 +1376,13 @@ class Operator {
   // flapping apiserver must not cut every sleep short.
   bool PolicyProbeSaysReconcile() {
     kubeclient::Response get = kubeclient::Call(cfg_, "GET", PolicyPath());
-    if (!get.ok())
-      return get.status == 404 && !policy_missing_;  // CR deleted
+    if (!get.ok()) {
+      if (get.status == 404 && !policy_missing_) {  // CR deleted
+        policy_dirty_ = true;
+        return true;
+      }
+      return false;
+    }
     minijson::ValuePtr cr = minijson::Parse(get.body);
     if (!cr) return false;
     double gen = cr->PathNumber("metadata.generation", 0);
@@ -1206,6 +1391,7 @@ class Operator {
               "tpu-operator: policy %s changed (generation %.0f -> %.0f); "
               "reconciling now\n",
               opt_.policy.c_str(), policy_generation_, gen);
+      policy_dirty_ = true;
       return true;
     }
     return false;
@@ -1238,8 +1424,7 @@ class Operator {
     // failure backoff (the apiserver is likely the thing that is down).
     bool policy_stream = opt_.policy_watch && !opt_.policy.empty() &&
                          healthy_;
-    bool operand_stream = opt_.operand_watch && healthy_ &&
-                          !OwnedWorkloadCollections().empty();
+    bool operand_stream = UseInformers() && healthy_ && !informers_.empty();
     if (policy_stream || operand_stream) {
       double ws_ts = trace_.NowUs();
       bool handled = SleepOnWatches(&left, bundle_fp, policy_stream);
@@ -1303,6 +1488,22 @@ class Operator {
              std::make_shared<minijson::Value>(policy_generation_));
       p->Set("missing", std::make_shared<minijson::Value>(policy_missing_));
       root->Set("policy", p);
+    }
+    if (!informers_.empty()) {
+      // per-collection informer state: synced flag, cached object count,
+      // and how many (re)LISTs it has cost — the O(events) audit trail
+      auto infs = minijson::Value::MakeObject();
+      for (const auto& kv : informers_) {
+        auto o = minijson::Value::MakeObject();
+        o->Set("synced",
+               std::make_shared<minijson::Value>(kv.second->synced()));
+        o->Set("objects", std::make_shared<minijson::Value>(
+                              double(kv.second->objects().size())));
+        o->Set("relists", std::make_shared<minijson::Value>(
+                              double(kv.second->relists())));
+        infs->Set(kv.first, o);
+      }
+      root->Set("informers", infs);
     }
     return root->Dump() + "\n";
   }
@@ -1371,30 +1572,55 @@ class Operator {
              "tpu_operator_reconcile_duration_seconds_count %ld\n",
              reconcile_count_, reconcile_sum_s_, reconcile_count_);
     out += buf;
-    // Watch-path churn + the ROADMAP item-2 precursors: queue depth =
-    // bundle objects the latest pass left unapplied (the informer
-    // refactor's rate-limited workqueue depth lands on this name), sync
-    // lag = seconds since the last converged pass (counted from process
-    // start until the first one).
-    int queue_depth = static_cast<int>(bundle_.size()) - applied - disabled;
-    if (queue_depth < 0) queue_depth = 0;
-    // seconds computed directly from the timespec (NOT ElapsedMs, whose
-    // int-milliseconds return overflows after ~24.8 days — exactly the
-    // long-outage case this gauge exists to expose)
-    struct timespec now;
-    clock_gettime(CLOCK_MONOTONIC, &now);
-    const struct timespec& sync_ref = synced_ ? last_sync_ : start_ts_;
-    double lag_s = static_cast<double>(now.tv_sec - sync_ref.tv_sec) +
-                   (now.tv_nsec - sync_ref.tv_nsec) / 1e9;
-    if (lag_s < 0) lag_s = 0;
+    // Watch-path churn + the informer-core gauges: queue depth is the
+    // LIVE workqueue occupancy (keys awaiting Reconcile(key), delayed
+    // retries excluded); sync lag is informer-cache STALENESS — seconds
+    // since the most-stale collection was last proven fresh (completed
+    // list, delivered event, or a clean watch-window expiry), bounded by
+    // ~watch_window_s on a healthy stream and growing without bound when
+    // the apiserver is gone. Request-driven modes (--once,
+    // --no-operand-watch) keep the old meaning: seconds since the last
+    // converged pass (from process start until the first one).
+    double lag_s = 0;
+    bool any_informer = false;
+    for (const auto& kv : informers_) {
+      if (!kv.second->synced()) continue;
+      any_informer = true;
+      lag_s = std::max(lag_s, kv.second->StalenessSeconds());
+    }
+    if (!any_informer) {
+      // seconds computed directly from the timespec (NOT ElapsedMs, whose
+      // int-milliseconds return overflows after ~24.8 days — exactly the
+      // long-outage case this gauge exists to expose)
+      struct timespec now;
+      clock_gettime(CLOCK_MONOTONIC, &now);
+      const struct timespec& sync_ref = synced_ ? last_sync_ : start_ts_;
+      lag_s = static_cast<double>(now.tv_sec - sync_ref.tv_sec) +
+              (now.tv_nsec - sync_ref.tv_nsec) / 1e9;
+      if (lag_s < 0) lag_s = 0;
+    }
+    long reconnects = watch_reconnects_;
+    for (const auto& kv : informers_) reconnects += kv.second->reconnects();
     snprintf(buf, sizeof(buf),
              "# TYPE tpu_operator_watch_reconnects_total counter\n"
              "tpu_operator_watch_reconnects_total %ld\n"
              "# TYPE tpu_operator_queue_depth gauge\n"
-             "tpu_operator_queue_depth %d\n"
+             "tpu_operator_queue_depth %zu\n"
              "# TYPE tpu_operator_sync_lag_seconds gauge\n"
              "tpu_operator_sync_lag_seconds %.3f\n",
-             watch_reconnects_, queue_depth, lag_s);
+             reconnects, queue_.depth(), lag_s);
+    out += buf;
+    // Workqueue families (twin-table pinned in kubeapi.cc/telemetry.py):
+    // adds meters classification pressure, retries the backoff re-queues,
+    // depth the live occupancy again under its workqueue-family name.
+    snprintf(buf, sizeof(buf),
+             "# TYPE tpu_operator_workqueue_adds_total counter\n"
+             "tpu_operator_workqueue_adds_total %lld\n"
+             "# TYPE tpu_operator_workqueue_retries_total counter\n"
+             "tpu_operator_workqueue_retries_total %lld\n"
+             "# TYPE tpu_operator_workqueue_depth gauge\n"
+             "tpu_operator_workqueue_depth %zu\n",
+             queue_.adds(), queue_.retries(), queue_.depth());
     out += buf;
     if (opt_.leader_elect)
       out += "# TYPE tpu_operator_leader gauge\n"
@@ -1470,6 +1696,18 @@ class Operator {
 
   std::string PolicyPath() const { return kPolicyPathPrefix + opt_.policy; }
 
+  // Whether this pass needs to GET the CR at all. With the informer core
+  // and the policy watch both running, the CR is only re-fetched when
+  // something marked it dirty (a watch event, a probe hit, a failed
+  // fetch) — an idle interval costs zero policy reads too. Request-driven
+  // modes (--once, --no-operand-watch, --no-policy-watch) keep the
+  // fetch-every-pass behavior.
+  bool ShouldFetchPolicy() const {
+    if (opt_.policy.empty()) return false;
+    if (!UseInformers() || !opt_.policy_watch) return true;
+    return policy_dirty_ || !policy_seen_ || policy_missing_;
+  }
+
   // Poll the CR once per pass. Fail-open semantics: a missing CR enables
   // everything (deleting the CR must not tear the stack down), and a
   // transport error keeps the last known policy (a flapping apiserver must
@@ -1503,6 +1741,7 @@ class Operator {
       policy_generation_ = cr->PathNumber("metadata.generation", 0);
       policy_seen_ = true;
       policy_missing_ = false;
+      policy_dirty_ = false;
     } else if (get.status == 404) {
       if (!policy_missing_)
         fprintf(stderr, "tpu-operator: policy %s not found; all operands "
@@ -1514,6 +1753,7 @@ class Operator {
               "known policy\n", get.status,
               get.status ? get.body.substr(0, 160).c_str()
                          : get.error.c_str());
+      policy_dirty_ = true;  // stale: retry next pass even when gated
     }
   }
 
@@ -1602,12 +1842,20 @@ class Operator {
     st->Set("readySummary", std::make_shared<Value>(
         std::to_string(have) + "/" + std::to_string(want) + " ready"));
     st->Set("operands", ops);
+    // Dedup on the timestamp-free content: an idle resync that computed
+    // the same status skips the PATCH entirely (lastReconcileTime alone
+    // would otherwise make every pass a write — churning the CR's
+    // resourceVersion and waking every policy watcher in the fleet).
+    std::string fp = st->Dump();
+    if (fp == last_status_written_) return;
     st->Set("lastReconcileTime", std::make_shared<Value>(NowRfc3339()));
     auto root = Value::MakeObject();
     root->Set("status", st);
     // best-effort, like Events: status delivery must never fail the pass
-    kubeclient::Call(cfg_, "PATCH", PolicyPath() + "/status", root->Dump(),
-                     "application/merge-patch+json");
+    kubeclient::Response r =
+        kubeclient::Call(cfg_, "PATCH", PolicyPath() + "/status",
+                         root->Dump(), "application/merge-patch+json");
+    if (r.ok()) last_status_written_ = fp;
   }
 
   // The namespace reconcile failures are reported into. Cluster-scoped
@@ -1831,7 +2079,20 @@ class Operator {
   std::string last_error_;
   // bundle-change tracking (input probe + prune gating)
   std::string pass_bundle_fp_;   // fingerprint at the current pass's start
+  std::string repair_bundle_fp_; // last render the repair path re-read
   std::string last_pruned_fp_;   // fingerprint the last prune sweep covered
+  // informer/workqueue core: one LIST+watch cache per owned collection,
+  // the rate-limited dedup queue of drifted keys, and the desired-state
+  // index (coll/name -> bundle_ slot) events are classified against.
+  // Depth bound 4096 ≈ 2x the largest supported fleet bundle; shedding
+  // flags resync_owed_ (repair-by-full-round instead of unbounded growth)
+  std::map<std::string, std::unique_ptr<informer::Informer>> informers_;
+  workqueue::RateLimitedQueue queue_{4096, 200, 30000};
+  std::map<std::string, size_t> key_index_;
+  std::map<std::string, time_t> ready_deadline_;  // event-repair gates
+  bool resync_owed_ = false;
+  bool policy_dirty_ = true;     // CR must be re-fetched next pass
+  std::string last_status_written_;  // WritePolicyStatus dedup fingerprint
   // policy state (see FetchPolicy for the fail-open/stale semantics)
   std::map<std::string, bool> policy_enabled_;
   double policy_generation_ = 0;
@@ -1901,6 +2162,14 @@ int main(int argc, char** argv) {
                                   // bench's poll arm; debug escape hatch)
       continue;
     }
+    if (FlagVal(a, "--page-limit", &sval)) {
+      opt.page_limit = atoi(sval.c_str());  // informer LIST page size
+      continue;
+    }
+    if (FlagVal(a, "--watch-window", &sval)) {
+      opt.watch_window_s = atoi(sval.c_str());  // watch timeoutSeconds
+      continue;
+    }
     fprintf(stderr,
             "tpu-operator: unknown flag %s\n"
             "usage: tpu-operator [--apiserver=URL] [--token-file=F] "
@@ -1908,6 +2177,7 @@ int main(int argc, char** argv) {
             "  [--bundle-dir=DIR] [--trace-out=PATH] [--policy=NAME]\n"
             "  [--policy-poll-ms=MS]\n"
             "  [--no-policy-watch] [--no-operand-watch]\n"
+            "  [--page-limit=N] [--watch-window=SECS]\n"
             "  [--interval=SECS] [--stage-timeout=SECS]\n"
             "  [--poll-ms=MS] [--status-port=PORT] [--once]\n"
             "  [--leader-elect] [--lease-duration=SECS] [--lease-name=N]\n"

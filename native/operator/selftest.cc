@@ -5,9 +5,11 @@
 #include <string.h>
 
 #include "../common/promescape.h"
+#include "informer.h"
 #include "kubeapi.h"
 #include "kubeclient.h"
 #include "minijson.h"
+#include "workqueue.h"
 
 static int g_failures = 0;
 
@@ -256,7 +258,7 @@ static void TestOperatorMetricNamesTwinTable() {
   // compiler, and `tpuctl verify --config operator-metrics` gates the
   // live scrape. A rename lands here before it lands on a dashboard.
   const auto& names = kubeapi::OperatorMetricNames();
-  CHECK(names.size() == 9);
+  CHECK(names.size() == 12);
   auto has = [&](const char* want) {
     for (const auto& n : names)
       if (n == want) return true;
@@ -271,6 +273,9 @@ static void TestOperatorMetricNamesTwinTable() {
   CHECK(has("tpu_operator_watch_reconnects_total"));
   CHECK(has("tpu_operator_queue_depth"));
   CHECK(has("tpu_operator_sync_lag_seconds"));
+  CHECK(has("tpu_operator_workqueue_adds_total"));
+  CHECK(has("tpu_operator_workqueue_retries_total"));
+  CHECK(has("tpu_operator_workqueue_depth"));
   // uniqueness + the namespace prefix every family must carry
   for (size_t i = 0; i < names.size(); ++i) {
     CHECK(names[i].rfind("tpu_operator_", 0) == 0);
@@ -287,7 +292,7 @@ static void TestOperatorTraceEventNamesTwinTable() {
   // greps the emitted trace artifact. A rename lands here before it
   // lands on a broken merged timeline.
   const auto& names = kubeapi::OperatorTraceEventNames();
-  CHECK(names.size() == 5);
+  CHECK(names.size() == 6);
   auto has = [&](const char* want) {
     for (const auto& n : names)
       if (n == want) return true;
@@ -298,6 +303,7 @@ static void TestOperatorTraceEventNamesTwinTable() {
   CHECK(has("ready-wait"));
   CHECK(has("watch-sleep"));
   CHECK(has("drift-event"));
+  CHECK(has("reconcile-object"));
   for (size_t i = 0; i < names.size(); ++i)
     for (size_t j = i + 1; j < names.size(); ++j)
       CHECK(names[i] != names[j]);
@@ -496,6 +502,97 @@ static void TestWatchBackoff() {
   CHECK(kubeclient::WatchBackoffMs(3, 1000, 0) == 1);
 }
 
+static void TestWorkqueueSemantics() {
+  // The rate-limited dedup queue (client-go util/workqueue analog): the
+  // single-threaded contract checks live here; the threaded invariants
+  // are hammered by grpcmin/stress_selftest.cc under TSan.
+  workqueue::RateLimitedQueue q(0, 5, 100);
+  std::string k;
+  CHECK(!q.Get(&k, 0));  // empty: polls out immediately
+  // dedup while queued: three Adds of one key = one Get
+  q.Add("a");
+  q.Add("a");
+  q.Add("b");
+  q.Add("a");
+  CHECK(q.adds() == 4);   // adds meters pressure, not occupancy
+  CHECK(q.depth() == 2);  // ...occupancy is deduped
+  CHECK(q.Get(&k, 0) && k == "a");
+  CHECK(q.Get(&k, 0) && k == "b");
+  CHECK(!q.Get(&k, 0));
+  q.Done("a");
+  q.Done("b");
+  CHECK(q.depth() == 0);  // a plain Done re-queues nothing
+  // an Add while processing re-queues at Done (the blind-window fix:
+  // an event landing mid-reconcile is never lost)
+  q.Add("a");
+  CHECK(q.depth() == 1);
+  CHECK(q.Get(&k, 0) && k == "a");
+  q.Add("a");             // a is processing: parked, not queued
+  CHECK(q.depth() == 0);
+  q.Done("a");
+  CHECK(q.depth() == 1);  // re-queued by Done
+  CHECK(q.Get(&k, 0) && k == "a");
+  q.Done("a");
+  // AddRateLimited: capped exponential strikes, Forget resets
+  q.AddRateLimited("r");  // strike 1: 5ms
+  CHECK(q.retries() == 1);
+  CHECK(q.StrikesForTest("r") == 1);
+  CHECK(q.depth() == 0);            // delayed, not queued
+  CHECK(!q.Get(&k, 0));
+  CHECK(q.Get(&k, 300) && k == "r");  // due after the delay
+  q.Done("r");
+  for (int i = 0; i < 8; ++i) q.AddRateLimited("r");
+  CHECK(q.StrikesForTest("r") == 9);
+  int due = q.NextDelayMs();
+  CHECK(due >= 0 && due <= 100);  // capped at max_delay_ms
+  CHECK(q.Get(&k, 300) && k == "r");
+  q.Forget("r");
+  q.Done("r");
+  CHECK(q.StrikesForTest("r") == 0);
+  // bounded depth: the OLDEST queued key sheds, resync flagged once
+  workqueue::RateLimitedQueue small(2, 5, 100);
+  small.Add("one");
+  small.Add("two");
+  CHECK(!small.TakeResyncNeeded());
+  small.Add("three");  // sheds "one"
+  CHECK(small.sheds() == 1);
+  CHECK(small.depth() == 2);
+  CHECK(small.TakeResyncNeeded());
+  CHECK(!small.TakeResyncNeeded());  // exactly once
+  CHECK(small.Get(&k, 0) && k == "two");
+  CHECK(small.Get(&k, 0) && k == "three");
+  // shutdown drains waiters
+  small.ShutDown();
+  CHECK(small.shutting_down());
+  CHECK(!small.Get(&k, 0));
+}
+
+static void TestSubsetMatch() {
+  // The informer cache's zero-request drift probe: desired ⊆ live, with
+  // server-set fields (status, uid, resourceVersion) never counting as
+  // drift and arrays comparing whole (merge-patch would revert reorders).
+  auto J = [](const char* s) { return minijson::Parse(s); };
+  auto want = J("{\"spec\": {\"replicas\": 2, \"labels\": {\"a\": \"b\"}},"
+                " \"kind\": \"Deployment\"}");
+  auto live = J("{\"spec\": {\"replicas\": 2, \"labels\": {\"a\": \"b\"},"
+                " \"extra\": 1}, \"kind\": \"Deployment\","
+                " \"status\": {\"readyReplicas\": 2},"
+                " \"metadata\": {\"uid\": \"u1\"}}");
+  CHECK(informer::SubsetMatch(*want, *live));
+  CHECK(!informer::SubsetMatch(*live, *want));  // extra fields missing
+  auto drift = J("{\"spec\": {\"replicas\": 3, \"labels\": {\"a\": \"b\"},"
+                 " \"extra\": 1}, \"kind\": \"Deployment\"}");
+  CHECK(!informer::SubsetMatch(*want, *drift));
+  // arrays: exact length + elementwise
+  CHECK(informer::SubsetMatch(*J("{\"a\": [1, 2]}"), *J("{\"a\": [1, 2]}")));
+  CHECK(!informer::SubsetMatch(*J("{\"a\": [1, 2]}"), *J("{\"a\": [2, 1]}")));
+  CHECK(!informer::SubsetMatch(*J("{\"a\": [1]}"), *J("{\"a\": [1, 2]}")));
+  // scalars + null + type mismatches
+  CHECK(informer::SubsetMatch(*J("{\"x\": null}"), *J("{\"x\": null}")));
+  CHECK(!informer::SubsetMatch(*J("{\"x\": null}"), *J("{\"x\": 0}")));
+  CHECK(!informer::SubsetMatch(*J("{\"x\": \"1\"}"), *J("{\"x\": 1}")));
+}
+
 int main() {
   TestJsonRoundtrip();
   TestJsonErrors();
@@ -513,6 +610,8 @@ int main() {
   TestTraceEmitter();
   TestChunkedDecodeHostileVectors();
   TestWatchBackoff();
+  TestWorkqueueSemantics();
+  TestSubsetMatch();
   if (g_failures) {
     fprintf(stderr, "operator_selftest: %d FAILURES\n", g_failures);
     return 1;

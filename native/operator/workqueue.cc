@@ -1,0 +1,187 @@
+#include "workqueue.h"
+
+#include <algorithm>
+
+namespace workqueue {
+
+RateLimitedQueue::RateLimitedQueue(size_t max_depth, int base_delay_ms,
+                                   int max_delay_ms)
+    : max_depth_(max_depth),
+      base_delay_ms_(base_delay_ms < 1 ? 1 : base_delay_ms),
+      max_delay_ms_(max_delay_ms < base_delay_ms_ ? base_delay_ms_
+                                                  : max_delay_ms) {}
+
+void RateLimitedQueue::AddLocked(const std::string& key) {
+  if (shutting_down_) return;
+  if (dirty_.count(key)) return;  // already queued or pending re-queue
+  dirty_.insert(key);
+  if (processing_.count(key)) return;  // re-queued by Done()
+  if (max_depth_ > 0 && queue_.size() >= max_depth_) {
+    // Shed the OLDEST key: it has waited longest, so it is the one the
+    // next full resync is most likely to re-discover anyway. The flag
+    // makes that resync an obligation, not a hope.
+    const std::string oldest = queue_.front();
+    queue_.pop_front();
+    if (!processing_.count(oldest)) dirty_.erase(oldest);
+    ++sheds_;
+    resync_needed_ = true;
+  }
+  queue_.push_back(key);
+  cv_.notify_one();
+}
+
+void RateLimitedQueue::PromoteDueLocked(Clock::time_point now) {
+  while (!delayed_.empty() && delayed_.begin()->first <= now) {
+    std::string key = delayed_.begin()->second;
+    delayed_.erase(delayed_.begin());
+    AddLocked(key);
+  }
+}
+
+void RateLimitedQueue::Add(const std::string& key) {
+  std::lock_guard<std::mutex> lock(mu_);
+  ++adds_;
+  PromoteDueLocked(Clock::now());
+  AddLocked(key);
+}
+
+void RateLimitedQueue::AddRateLimited(const std::string& key) {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (shutting_down_) return;
+  ++adds_;
+  ++retries_;
+  int strikes = ++strikes_[key];
+  long long delay = base_delay_ms_;
+  for (int i = 1; i < strikes && delay < max_delay_ms_; ++i) delay *= 2;
+  delay = std::min<long long>(delay, max_delay_ms_);
+  delayed_.emplace(Clock::now() + std::chrono::milliseconds(delay), key);
+  cv_.notify_one();
+}
+
+void RateLimitedQueue::AddAfter(const std::string& key, int delay_ms) {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (shutting_down_) return;
+  ++adds_;
+  if (delay_ms <= 0) {
+    PromoteDueLocked(Clock::now());
+    AddLocked(key);
+    return;
+  }
+  delayed_.emplace(Clock::now() + std::chrono::milliseconds(delay_ms), key);
+  cv_.notify_one();
+}
+
+void RateLimitedQueue::Forget(const std::string& key) {
+  std::lock_guard<std::mutex> lock(mu_);
+  strikes_.erase(key);
+}
+
+bool RateLimitedQueue::Get(std::string* key, int wait_ms) {
+  std::unique_lock<std::mutex> lock(mu_);
+  Clock::time_point deadline =
+      Clock::now() + std::chrono::milliseconds(wait_ms < 0 ? 0 : wait_ms);
+  for (;;) {
+    PromoteDueLocked(Clock::now());
+    if (!queue_.empty()) break;
+    if (shutting_down_) return false;
+    Clock::time_point now = Clock::now();
+    if (now >= deadline) return false;
+    // wake for whichever comes first: the wait deadline or the next
+    // delayed key falling due
+    Clock::time_point until = deadline;
+    if (!delayed_.empty() && delayed_.begin()->first < until)
+      until = delayed_.begin()->first;
+    // Wait against a system_clock deadline: a steady_clock wait_until
+    // lowers to pthread_cond_clockwait on this libstdc++, which older
+    // libtsan builds do not intercept — TSan then believes the waiter
+    // never released mu_ and reports phantom double-locks. The
+    // timedwait path is intercepted; a wall-clock jump only perturbs
+    // one wakeup, and the loop re-checks the steady deadline anyway.
+    cv_.wait_until(lock,
+                   std::chrono::system_clock::now() +
+                       std::chrono::duration_cast<
+                           std::chrono::system_clock::duration>(until - now));
+  }
+  *key = queue_.front();
+  queue_.pop_front();
+  dirty_.erase(*key);
+  processing_.insert(*key);
+  return true;
+}
+
+void RateLimitedQueue::Done(const std::string& key) {
+  std::lock_guard<std::mutex> lock(mu_);
+  processing_.erase(key);
+  if (dirty_.count(key)) {
+    // Add() landed while this key was being processed: the event is
+    // honored by re-queueing, never dropped (the blind-window fix).
+    if (max_depth_ > 0 && queue_.size() >= max_depth_) {
+      const std::string oldest = queue_.front();
+      queue_.pop_front();
+      if (!processing_.count(oldest)) dirty_.erase(oldest);
+      ++sheds_;
+      resync_needed_ = true;
+    }
+    queue_.push_back(key);
+    cv_.notify_one();
+  }
+}
+
+void RateLimitedQueue::ShutDown() {
+  std::lock_guard<std::mutex> lock(mu_);
+  shutting_down_ = true;
+  cv_.notify_all();
+}
+
+bool RateLimitedQueue::shutting_down() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return shutting_down_;
+}
+
+int RateLimitedQueue::NextDelayMs() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (!queue_.empty()) return 0;
+  if (delayed_.empty()) return -1;
+  auto due = delayed_.begin()->first;
+  auto now = Clock::now();
+  if (due <= now) return 0;
+  return static_cast<int>(
+      std::chrono::duration_cast<std::chrono::milliseconds>(due - now)
+          .count()) +
+      1;
+}
+
+long long RateLimitedQueue::adds() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return adds_;
+}
+
+long long RateLimitedQueue::retries() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return retries_;
+}
+
+size_t RateLimitedQueue::depth() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return queue_.size();
+}
+
+size_t RateLimitedQueue::sheds() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return sheds_;
+}
+
+bool RateLimitedQueue::TakeResyncNeeded() {
+  std::lock_guard<std::mutex> lock(mu_);
+  bool need = resync_needed_;
+  resync_needed_ = false;
+  return need;
+}
+
+int RateLimitedQueue::StrikesForTest(const std::string& key) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = strikes_.find(key);
+  return it == strikes_.end() ? 0 : it->second;
+}
+
+}  // namespace workqueue

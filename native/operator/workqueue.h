@@ -1,0 +1,111 @@
+// workqueue — the operator's rate-limited, deduplicating reconcile queue
+// (client-go util/workqueue analog, the half of the controller-runtime
+// core that decides WHEN a key is reconciled).
+//
+// Semantics, pinned by native/operator/selftest.cc and hammered under
+// threads by native/grpcmin/stress_selftest.cc (plain + TSan):
+//
+//  - Dedup while queued: Add() of a key already waiting is a no-op for
+//    the queue (the adds counter still moves — it meters pressure, not
+//    occupancy). A key Add()ed while PROCESSING is re-queued when Done()
+//    is called, so an event landing mid-reconcile is never lost — this
+//    is what replaced the operator's pass->watch blind-window LIST.
+//  - Per-item backoff: AddRateLimited() re-queues a failed key after a
+//    capped exponential delay (base << strikes, never above cap);
+//    Forget() resets the key's strike count on success.
+//  - Bounded depth: beyond max_depth the OLDEST queued key is shed and
+//    the queue flags resync_needed — the caller repairs the loss with
+//    one full-resync enqueue instead of growing without bound
+//    (shed-oldest-resync, the informer's relist being the backstop).
+//  - Thread-safe (mutex + condvar). The operator itself is
+//    single-threaded by contract and polls with Get(wait_ms=0); the
+//    locking exists so the concurrency stress selftest can prove the
+//    invariants under real contention.
+
+#ifndef TPU_NATIVE_OPERATOR_WORKQUEUE_H_
+#define TPU_NATIVE_OPERATOR_WORKQUEUE_H_
+
+#include <chrono>
+#include <condition_variable>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <set>
+#include <string>
+#include <vector>
+
+namespace workqueue {
+
+class RateLimitedQueue {
+ public:
+  // max_depth 0 = unbounded. Delays in milliseconds.
+  explicit RateLimitedQueue(size_t max_depth = 0, int base_delay_ms = 5,
+                            int max_delay_ms = 30000);
+
+  // Queue `key` for processing (deduplicated; see header comment).
+  void Add(const std::string& key);
+
+  // Re-queue a failed key after its per-key capped exponential backoff.
+  // Each call is one strike (and one tick of the retries counter).
+  void AddRateLimited(const std::string& key);
+
+  // Queue `key` after a fixed delay (readiness follow-up, not a strike).
+  void AddAfter(const std::string& key, int delay_ms);
+
+  // Clear `key`'s strike count (reconcile succeeded).
+  void Forget(const std::string& key);
+
+  // Pop the next key; blocks up to wait_ms (0 = poll). False on timeout
+  // or shutdown. The key stays marked processing until Done().
+  bool Get(std::string* key, int wait_ms);
+
+  // Processing finished; a key re-Add()ed meanwhile goes back on queue.
+  void Done(const std::string& key);
+
+  void ShutDown();
+  bool shutting_down() const;
+
+  // Milliseconds until the earliest delayed key is due (-1 = none
+  // pending). The single-threaded operator uses this to size its idle
+  // sleep instead of busy-polling Get(0).
+  int NextDelayMs() const;
+
+  // Counters for the tpu_operator_workqueue_* families.
+  long long adds() const;     // every Add/AddRateLimited/AddAfter call
+  long long retries() const;  // AddRateLimited calls
+  size_t depth() const;       // keys queued now (excludes delayed)
+  size_t sheds() const;       // keys dropped by the depth bound
+
+  // True exactly once after a shed: the caller owes a full resync.
+  bool TakeResyncNeeded();
+
+  int StrikesForTest(const std::string& key) const;
+
+ private:
+  using Clock = std::chrono::steady_clock;
+
+  // caller holds mu_: move due delayed keys onto the active queue
+  void PromoteDueLocked(Clock::time_point now);
+  // caller holds mu_: enqueue with dedup + depth bound
+  void AddLocked(const std::string& key);
+
+  mutable std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<std::string> queue_;
+  std::set<std::string> dirty_;       // queued or awaiting re-queue
+  std::set<std::string> processing_;  // handed out via Get()
+  std::map<std::string, int> strikes_;
+  // delayed keys, kept sorted by due time (small N: the operator's
+  // retry/readiness follow-ups, not the hot path)
+  std::multimap<Clock::time_point, std::string> delayed_;
+  size_t max_depth_;
+  int base_delay_ms_, max_delay_ms_;
+  bool shutting_down_ = false;
+  bool resync_needed_ = false;
+  long long adds_ = 0, retries_ = 0;
+  size_t sheds_ = 0;
+};
+
+}  // namespace workqueue
+
+#endif  // TPU_NATIVE_OPERATOR_WORKQUEUE_H_

@@ -128,6 +128,23 @@ FLEET_MUX_POOL = 8
 FLEET_GANGS = 100
 FLEET_REQUEST_RATIO_MAX = 2.0
 FLEET_DECISION_LATENCY_MAX_S = 10.0
+# The operator_fleet column (ISSUE 16): the C++ operator's informer/
+# workqueue core at fleet scale — OPERATOR_FLEET_OPERANDS owned
+# ConfigMap operands on top of the standard bundle, FLEET_NODES
+# synthetic Nodes in the store. The --check contract: a synced idle
+# operator issues ZERO non-watch requests across the idle window, ONE
+# deleted operand is repaired event-bound at <=
+# OPERATOR_FLEET_REPAIR_REQUESTS_MAX requests (the apply PATCH — no
+# re-LIST, no readiness GET: the informer cache answers both), and the
+# p99 reconcile-object slice duration from the operator's own trace
+# stays under OPERATOR_FLEET_P99_MAX_S.
+OPERATOR_FLEET_OPERANDS = 2000
+OPERATOR_FLEET_PAGE_LIMIT = 250
+OPERATOR_FLEET_IDLE_WINDOW_S = 1.0
+OPERATOR_FLEET_REPAIR_MAX_S = 5.0
+OPERATOR_FLEET_REPAIR_REQUESTS_MAX = 3
+OPERATOR_FLEET_P99_MAX_S = 0.5
+OPERATOR_FLEET_DRIFTS = 25
 
 
 def full_stack_groups(spec):
@@ -676,6 +693,138 @@ def drift_arm(latency_s: float, watch: bool, trace_out: str = ""):
             "interval_s": interval}
 
 
+def operator_fleet_arm(trace_out: str = ""):
+    """The informer/workqueue core (ISSUE 16) through the real C++
+    operator at fleet scale: OPERATOR_FLEET_OPERANDS owned ConfigMaps on
+    top of the standard bundle, FLEET_NODES synthetic Nodes in the
+    store. Columns: time to all-informers-synced, non-watch request
+    count across a silent idle window (the O(events) contract: zero),
+    time and request count to repair ONE deleted operand (event-bound,
+    O(1) — the apply PATCH), and the p99 reconcile-object slice duration
+    from the operator's own trace (OPERATOR_FLEET_DRIFTS deletes widen
+    the sample). None when no operator binary is built. Injected
+    latency is deliberately NOT applied: this arm meters request counts
+    and the event path; per-request latency would only linearize the
+    2000-object install."""
+    binary = _operator_binary()
+    if not binary:
+        return None
+    import signal
+    import socket
+    import subprocess
+    import tempfile
+    import urllib.request
+
+    cm_coll = "/api/v1/namespaces/tpu-system/configmaps"
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    if not trace_out:
+        trace_out = os.path.join(
+            tempfile.gettempdir(),
+            f"bench_operator_fleet_trace_{os.getpid()}.json")
+    with tempfile.TemporaryDirectory() as d:
+        operator_bundle.write_bundle(specmod.default_spec(), d)
+        for i in range(OPERATOR_FLEET_OPERANDS):
+            name = f"fleet-cm-{i:05d}"
+            with open(os.path.join(d, f"50-fleet--configmap-{name}.json"),
+                      "w", encoding="utf-8") as f:
+                json.dump(
+                    {"apiVersion": "v1", "kind": "ConfigMap",
+                     "metadata": {"name": name, "namespace": "tpu-system",
+                                  "labels": {"app.kubernetes.io/part-of":
+                                             "tpu-stack"}},
+                     "data": {"idx": str(i)}}, f)
+        with FakeApiServer(auto_ready=True,
+                           store=fleet_store(FLEET_NODES)) as api:
+            t0 = time.monotonic()
+            op = subprocess.Popen(
+                [binary, f"--apiserver={api.url}", f"--bundle-dir={d}",
+                 "--interval=120", "--poll-ms=20", "--stage-timeout=60",
+                 f"--page-limit={OPERATOR_FLEET_PAGE_LIMIT}",
+                 "--watch-window=30", f"--status-port={port}",
+                 f"--trace-out={trace_out}"],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            try:
+                def informers():
+                    try:
+                        with urllib.request.urlopen(
+                                f"http://127.0.0.1:{port}/status",
+                                timeout=2) as r:
+                            return json.loads(r.read()).get(
+                                "informers") or {}
+                    except OSError:
+                        return {}
+
+                def synced():
+                    inf = informers()
+                    return (bool(inf)
+                            and all(v["synced"] for v in inf.values())
+                            and inf.get(cm_coll, {}).get("objects")
+                            == OPERATOR_FLEET_OPERANDS)
+
+                deadline = time.monotonic() + 120
+                while time.monotonic() < deadline and not synced():
+                    time.sleep(0.05)
+                if not synced():
+                    return {"error": "operator never synced the fleet"}
+                sync_s = time.monotonic() - t0
+
+                mark = len(api.log)
+                time.sleep(OPERATOR_FLEET_IDLE_WINDOW_S)
+                idle = len([1 for m, p in api.log[mark:]
+                            if "watch=1" not in p])
+
+                victim = f"{cm_coll}/fleet-cm-00000"
+                mark = len(api.log)
+                t1 = time.monotonic()
+                api.delete(victim)  # fires the DELETED watch event
+                while (time.monotonic() < deadline
+                       and api.get(victim) is None):
+                    time.sleep(0.002)
+                if api.get(victim) is None:
+                    return {"error": "fleet drift never repaired"}
+                repair_s = time.monotonic() - t1
+                repair_requests = len([1 for m, p in api.log[mark:]
+                                       if "watch=1" not in p])
+
+                # widen the reconcile-object sample for the p99 column
+                victims = [f"{cm_coll}/fleet-cm-{i:05d}"
+                           for i in range(1, OPERATOR_FLEET_DRIFTS)]
+                for v in victims:
+                    api.delete(v)
+                while (time.monotonic() < deadline
+                       and any(api.get(v) is None for v in victims)):
+                    time.sleep(0.01)
+            finally:
+                op.send_signal(signal.SIGTERM)
+                try:
+                    op.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    op.kill()
+                    op.wait(timeout=10)
+    durs = []
+    try:
+        with open(trace_out, encoding="utf-8") as f:
+            trace = json.load(f)
+        durs = sorted(ev.get("dur", 0) / 1e6
+                      for ev in trace.get("traceEvents", [])
+                      if ev.get("name") == "reconcile-object")
+    except (OSError, ValueError):
+        pass
+    p99 = durs[min(len(durs) - 1, int(0.99 * len(durs)))] if durs else None
+    return {"operands": OPERATOR_FLEET_OPERANDS,
+            "nodes": FLEET_NODES,
+            "page_limit": OPERATOR_FLEET_PAGE_LIMIT,
+            "sync_s": round(sync_s, 3),
+            "idle_window_s": OPERATOR_FLEET_IDLE_WINDOW_S,
+            "idle_requests": idle,
+            "drift_to_repaired_s": round(repair_s, 4),
+            "repair_requests": repair_requests,
+            "reconcile_slices": len(durs),
+            "reconcile_p99_s": p99}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--latency-ms", type=float, default=5.0,
@@ -750,6 +899,7 @@ def main(argv=None) -> int:
             tempfile.gettempdir(), f"bench_operator_trace_{os.getpid()}.json")
     drift_watch = drift_arm(latency_s, watch=True, trace_out=op_trace_path)
     drift_poll = drift_arm(latency_s, watch=False)
+    operator_fleet = operator_fleet_arm()
 
     spec = specmod.default_spec()
     groups = full_stack_groups(spec)
@@ -791,6 +941,12 @@ def main(argv=None) -> int:
         # O(nodes)), span-derived decision latency for 100 queued gangs,
         # and ZERO requests per idle watch-driven admission pass.
         "fleet": fleet,
+        # Operator fleet (ISSUE 16): the C++ operator's informer/
+        # workqueue core at 2000 owned operands — zero idle reads once
+        # synced, one delete repaired event-bound in O(1) requests, p99
+        # reconcile-object slice from the operator's own trace (null
+        # when the native binary isn't built on this host).
+        "operator_fleet": operator_fleet,
     }
     print(json.dumps(doc, separators=(",", ":")))
 
@@ -903,6 +1059,28 @@ def main(argv=None) -> int:
                   f"{FLEET_DECISION_LATENCY_MAX_S:g}s, idle_pass_requests "
                   "== 0, relists == 2)", file=sys.stderr)
             return 1
+        # operator fleet (ISSUE 16): the informer/workqueue core's
+        # O(events) contract at 2000 owned operands — zero idle reads,
+        # O(1) event-bound repair, bounded reconcile slices. Gated
+        # whenever the native binary was available to run the arm.
+        opf = doc["operator_fleet"]
+        if opf is not None:
+            if not ("error" not in opf
+                    and opf["idle_requests"] == 0
+                    and opf["repair_requests"]
+                    <= OPERATOR_FLEET_REPAIR_REQUESTS_MAX
+                    and opf["drift_to_repaired_s"]
+                    <= OPERATOR_FLEET_REPAIR_MAX_S
+                    and opf["reconcile_slices"] >= 1
+                    and opf["reconcile_p99_s"] is not None
+                    and opf["reconcile_p99_s"] <= OPERATOR_FLEET_P99_MAX_S):
+                print(f"bench_rollout: FAIL — operator_fleet column {opf} "
+                      f"(need idle_requests == 0, repair_requests <= "
+                      f"{OPERATOR_FLEET_REPAIR_REQUESTS_MAX}, repair <= "
+                      f"{OPERATOR_FLEET_REPAIR_MAX_S:g}s, reconcile p99 "
+                      f"<= {OPERATOR_FLEET_P99_MAX_S:g}s)",
+                      file=sys.stderr)
+                return 1
     return 0
 
 

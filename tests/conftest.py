@@ -40,7 +40,8 @@ NATIVE_BUILD_DIR = os.path.join(NATIVE_DIR, "build")
 # exporter suites to run everywhere. Source lists mirror
 # native/CMakeLists.txt.
 _OPERATOR_CORE = ["operator/kubeapi.cc", "operator/kubeclient.cc",
-                  "operator/minijson.cc"]
+                  "operator/minijson.cc", "operator/informer.cc",
+                  "operator/workqueue.cc"]
 _GXX_TARGETS = {
     "tpu-operator": ["operator/operator_main.cc"] + _OPERATOR_CORE,
     "operator_selftest": ["operator/selftest.cc"] + _OPERATOR_CORE,

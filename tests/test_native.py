@@ -75,6 +75,13 @@ def test_concurrency_stress_selftest(native_build):
          "--threads=8", "--rounds=10"],
         check=True, capture_output=True, text=True, timeout=120)
     assert "all OK" in out.stdout
+    # the operator's rate-limited workqueue is contention-hammered by the
+    # same binary (ISSUE 16) — pin that the phase stays in the source so
+    # a refactor cannot silently drop the only multi-threaded coverage
+    # the queue gets
+    src = open(os.path.join(REPO, "native", "grpcmin",
+                            "stress_selftest.cc")).read()
+    assert "workqueue::RateLimitedQueue" in src
 
 
 def test_concurrency_stress_selftest_under_tsan(tmp_path):

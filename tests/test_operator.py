@@ -591,7 +591,12 @@ def test_post_409_falls_back_to_patch(native_build, bundle_dir):
 def test_operator_survives_apiserver_bounce(native_build, bundle_dir):
     """Kill the apiserver mid-reconcile, bring it back on the same port
     with the same store (etcd survived): the operator must reconverge on
-    its own, with no duplicate-create errors — only GET->PATCH repairs."""
+    its own, with no duplicate-create errors. Since the informer core the
+    carried store means there is genuinely NOTHING to repair — the caches
+    re-attach (watch resume from the held resourceVersion) and a correct
+    operator issues ZERO mutations; liveness is proven the O(events) way,
+    by deleting an operand on the revived server and watching the single
+    apply-PATCH repair land."""
     # every bundle object must have landed before the snapshot, or the
     # revived server legitimately gets POSTs for the missing tail
     bundle_size = len(os.listdir(bundle_dir))
@@ -611,20 +616,37 @@ def test_operator_survives_apiserver_bounce(native_build, bundle_dir):
             time.sleep(1.5)  # at least one pass fails against a dead server
             with FakeApiServer(auto_ready=True, port=port,
                                store=carried) as api2:
-                # reconvergence: a full pass lands on the revived server
-                # (SSA apply paths carry ?fieldManager=..., hence `in`)
+                # reconvergence: the informers re-attach to the revived
+                # server — watch streams open again (resourceVersion
+                # resume; the carried store kept the RV history)
                 assert wait_until(
-                    lambda: any(m == "PATCH"
-                                and "tpu-node-status-exporter" in p
+                    lambda: any(m == "GET" and "watch=1" in p
                                 for (m, p) in api2.log),
                     timeout=30), api2.log
+                # the carried store is complete: nothing was created
+                # while the operator reconverged
+                pre = [p for p in api2.created if "/events/" not in p]
+                assert pre == [], pre
+                # prove the operator is actually LIVE on the new server
+                # by deleting an operand: the watch event must drive one
+                # SSA apply-PATCH repair (which re-creates the victim —
+                # the ONLY create the revived server ever sees)
+                victim = f"{DS}/tpu-node-status-exporter"
+                api2.delete(victim)
+                assert wait_until(
+                    lambda: any(m == "PATCH" and victim in p
+                                and "fieldManager=" in p
+                                for (m, p) in api2.log),
+                    timeout=30), api2.log
+                assert wait_until(
+                    lambda: api2.get(victim) is not None, timeout=10)
                 # no duplicate creates: every BUNDLE object survived in
-                # the store, so the repair pass is pure apply-PATCH. A
-                # failure Event from the dead-server window may land here
-                # (its best-effort POST is retried and can straddle the
+                # the store, so repair is pure apply-PATCH. A failure
+                # Event from the dead-server window may land here (its
+                # best-effort POST is retried and can straddle the
                 # revival) — events are reports, not bundle duplicates.
                 created = [p for p in api2.created if "/events/" not in p]
-                assert created == [], created
+                assert created == [victim], created
                 posts = [(m, p) for (m, p) in api2.log
                          if m == "POST" and "/events" not in p]
                 assert posts == [], posts
@@ -1401,3 +1423,222 @@ def test_leader_election_off_by_default(native_build, bundle_dir):
         assert proc.returncode == 0, proc.stderr
         assert api.get(LEASE_PATH) is None
         assert not any("leases" in p for _, p in api.log)
+
+
+# ----------------------------------------------------------------- fleet
+# (ISSUE 16): the informer/workqueue core at fleet scale. The contract
+# under test is O(events): a synced operator's steady-state apiserver
+# traffic is proportional to what CHANGED, never to how many objects it
+# owns or how often its interval fires.
+
+CM = f"/api/v1/namespaces/{NS}/configmaps"
+
+
+def fleet_bundle(tmp_path, count):
+    """The standard bundle plus ``count`` ConfigMap operands in one extra
+    stage — the owned-object scale knob. ConfigMaps are ready on creation,
+    so fleet size stresses the informer cache and workqueue, not the
+    readiness gates."""
+    d = tmp_path / "fleet-bundle"
+    d.mkdir()
+    operator_bundle.write_bundle(specmod.default_spec(), str(d))
+    for i in range(count):
+        name = f"fleet-cm-{i:04d}"
+        obj = {"apiVersion": "v1", "kind": "ConfigMap",
+               "metadata": {"name": name, "namespace": NS,
+                            "labels": {"app.kubernetes.io/part-of":
+                                       "tpu-stack"}},
+               "data": {"idx": str(i)}}
+        (d / f"50-fleet--configmap-{name}.json").write_text(json.dumps(obj))
+    return str(d)
+
+
+def free_port():
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def informer_state(port):
+    """The /status "informers" object (collection path -> {synced,
+    objects, relists}); {} while the server is not up yet."""
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/status", timeout=2) as r:
+            return json.loads(r.read()).get("informers") or {}
+    except OSError:
+        return {}
+
+
+def all_informers_synced(port):
+    inf = informer_state(port)
+    return bool(inf) and all(v["synced"] for v in inf.values())
+
+
+def test_fleet_idle_zero_reads_and_one_delete_is_o1(native_build, tmp_path):
+    """The tentpole proof at scale: 1000 synthetic Nodes in the store and
+    150 owned ConfigMap operands (the tier-1 twin of the bench's 2000).
+    Once every informer reports synced, (a) a silent window shows ZERO
+    non-watch apiserver requests — the cache answers every per-object
+    question the old pass asked with a GET; (b) one kubectl-delete analog
+    is repaired in O(1) requests (the apply PATCH, nothing else — no
+    re-LIST, no readiness GET: the cache serves readiness too); (c) the
+    tpu_operator_workqueue_* families are live on the scrape and
+    tpu_operator_sync_lag_seconds reads as informer-cache staleness,
+    bounded by the watch window rather than growing toward the 120 s
+    interval."""
+    from fake_apiserver import fleet_store
+
+    n = 150
+    page_limit = 40
+    bundle = fleet_bundle(tmp_path, n)
+    port = free_port()
+    with FakeApiServer(auto_ready=True, store=fleet_store(1000)) as api:
+        op = start_operator(
+            native_build, f"--apiserver={api.url}",
+            f"--bundle-dir={bundle}", "--interval=120", "--poll-ms=20",
+            "--stage-timeout=30", f"--page-limit={page_limit}",
+            "--watch-window=30", f"--status-port={port}")
+        try:
+            victim = f"{CM}/fleet-cm-{n - 1:04d}"
+            assert wait_until(lambda: api.get(victim) is not None,
+                              timeout=60)
+            assert wait_until(lambda: all_informers_synced(port),
+                              timeout=30)
+            # the cache becomes complete: the initial LIST ran before the
+            # operands existed, so every one of the n entries arrives via
+            # watch events — drained in bounded batches, hence wait_until
+            # rather than a snapshot assert. The cache is maintained, not
+            # re-fetched (the paginated re-LIST path is pinned by the
+            # flap test below).
+            assert wait_until(
+                lambda: informer_state(port)[CM]["objects"] == n,
+                timeout=30), informer_state(port)[CM]
+
+            mark = len(api.log)
+            time.sleep(1.2)
+            reads = [(m, p) for m, p in api.log[mark:]
+                     if "watch=1" not in p]
+            assert reads == [], \
+                f"synced idle operator touched the apiserver: {reads}"
+
+            mark = len(api.log)
+            api.delete(victim)  # fires the DELETED watch event
+            assert wait_until(lambda: api.get(victim) is not None,
+                              timeout=15), "deleted operand not repaired"
+            repair = [(m, p) for m, p in api.log[mark:]
+                      if "watch=1" not in p]
+            assert 1 <= len(repair) <= 3, repair
+            assert all(victim in p for _m, p in repair), repair
+
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=2) as r:
+                text = r.read().decode()
+            for fam in ("tpu_operator_workqueue_adds_total",
+                        "tpu_operator_workqueue_retries_total",
+                        "tpu_operator_workqueue_depth"):
+                assert any(ln.startswith(fam + " ")
+                           for ln in text.splitlines()), fam
+            adds = [float(ln.split()[-1]) for ln in text.splitlines()
+                    if ln.startswith("tpu_operator_workqueue_adds_total ")]
+            assert adds and adds[0] >= 1  # the delete went THROUGH the queue
+            lag = [float(ln.split()[-1]) for ln in text.splitlines()
+                   if ln.startswith("tpu_operator_sync_lag_seconds ")]
+            assert lag, "sync_lag family missing from live scrape"
+            assert 0 <= lag[0] < 35, lag  # staleness: watch window + slack
+        finally:
+            op.send_signal(signal.SIGTERM)
+            op.wait(timeout=10)
+
+
+def test_fleet_flap_costs_one_paginated_relist_per_collection(native_build,
+                                                              tmp_path):
+    """Chaos bound (ISSUE 16): an apiserver flap (restart — watch history
+    compacted, live streams severed) costs a synced operator exactly ONE
+    paginated re-LIST per owned collection, via the watch ERROR/410 path,
+    then relist counts stabilize: no relist storm, no per-object reads."""
+    n = 120
+    page_limit = 40
+    bundle = fleet_bundle(tmp_path, n)
+    port = free_port()
+    with FakeApiServer(auto_ready=True) as api:
+        op = start_operator(
+            native_build, f"--apiserver={api.url}",
+            f"--bundle-dir={bundle}", "--interval=120", "--poll-ms=20",
+            "--stage-timeout=30", f"--page-limit={page_limit}",
+            "--watch-window=30", f"--status-port={port}")
+        try:
+            assert wait_until(
+                lambda: api.get(f"{CM}/fleet-cm-0000") is not None,
+                timeout=60)
+            assert wait_until(lambda: all_informers_synced(port),
+                              timeout=30)
+            base = {c: v["relists"]
+                    for c, v in informer_state(port).items()}
+            assert base and all(r == 1 for r in base.values()), base
+            pages0 = api.list_pages.get(CM, 0)
+
+            api.flap()
+            assert wait_until(
+                lambda: (lambda inf: bool(inf) and all(
+                    inf.get(c, {}).get("relists") == base[c] + 1
+                    for c in base))(informer_state(port)),
+                timeout=40), informer_state(port)
+            time.sleep(1.0)  # a relist storm would keep counting
+            inf = informer_state(port)
+            assert all(inf[c]["relists"] == base[c] + 1 for c in base), inf
+            assert all(v["synced"] for v in inf.values()), inf
+            # the re-LIST paid exactly the page count of the collection,
+            # once — limit/continue all the way down
+            assert api.list_pages.get(CM, 0) == \
+                pages0 + -(-n // page_limit)
+        finally:
+            op.send_signal(signal.SIGTERM)
+            op.wait(timeout=10)
+
+
+def test_mid_reconcile_drift_converges_without_relist(native_build,
+                                                      bundle_dir):
+    """Satellite (ISSUE 16): the pass->watch blind-window catch-up LIST
+    is deleted — the workqueue's dirty/processing split is the delivery
+    guarantee now. Hammer one operand with deletes faster than its
+    reconcile cycle so some land MID-reconcile; convergence must come
+    from events alone (an Add during processing re-queues at Done, never
+    drops), and the collection is never re-LISTed beyond the informer's
+    initial sync."""
+    port = free_port()
+    with FakeApiServer(auto_ready=True) as api:
+        op = start_operator(
+            native_build, f"--apiserver={api.url}",
+            f"--bundle-dir={bundle_dir}", "--interval=120",
+            "--poll-ms=20", "--stage-timeout=20",
+            f"--status-port={port}")
+        try:
+            path = f"{DS}/tpu-device-plugin"
+            assert wait_until(lambda: api.get(path) is not None,
+                              timeout=20)
+            assert wait_until(lambda: all_informers_synced(port),
+                              timeout=30)
+
+            def ds_lists():
+                return len([p for m, p in api.log
+                            if m == "GET" and p.startswith(DS + "?")
+                            and "watch=1" not in p])
+
+            lists0 = ds_lists()
+            for _ in range(10):
+                api.delete(path)  # no-op (no event) when already absent
+                time.sleep(0.05)
+            assert wait_until(lambda: api.get(path) is not None,
+                              timeout=20), \
+                "mid-reconcile delete lost — the queue dropped an event"
+            time.sleep(0.5)
+            assert api.get(path) is not None  # converged, not flapping
+            assert ds_lists() == lists0, \
+                "drift repair re-LISTed the collection (blind-window relic)"
+        finally:
+            op.send_signal(signal.SIGTERM)
+            op.wait(timeout=10)
+        stderr = op.stderr.read()
+        assert "deleted, watch event" in stderr

@@ -1294,5 +1294,18 @@ def test_bench_rollout_json_line_meets_targets():
     assert gang["partial_allocations"] == 0, gang
     assert gang["full_host_groups_admitted"] == 2, gang
     assert gang["admission_latency_s"] > 0, gang
+    # the operator_fleet column (ISSUE 16): null where the native binary
+    # isn't built; when present — the C++ operator's informer/workqueue
+    # core holds O(events) at 2000 owned operands: zero idle reads after
+    # sync, one delete repaired event-bound in O(1) requests, and the
+    # reconcile-object slices (from the operator's own trace) bounded
+    opf = doc["operator_fleet"]
+    if opf is not None:
+        assert "error" not in opf, opf
+        assert opf["idle_requests"] == 0, opf
+        assert opf["repair_requests"] <= 3, opf
+        assert opf["drift_to_repaired_s"] <= 5.0, opf
+        assert opf["reconcile_slices"] >= 1, opf
+        assert opf["reconcile_p99_s"] <= 0.5, opf
     # the recorded line for the round artifacts / triage summary
     print(f"BENCH_ROLLOUT {json.dumps(doc, separators=(',', ':'))}")

@@ -82,6 +82,9 @@ OPERATOR_METRIC_NAMES: Tuple[str, ...] = (
     "tpu_operator_watch_reconnects_total",
     "tpu_operator_queue_depth",
     "tpu_operator_sync_lag_seconds",
+    "tpu_operator_workqueue_adds_total",
+    "tpu_operator_workqueue_retries_total",
+    "tpu_operator_workqueue_depth",
 )
 
 # Chrome trace-event slice names the C++ operator's trace emitter must
@@ -97,6 +100,7 @@ OPERATOR_TRACE_EVENTS: Tuple[str, ...] = (
     "ready-wait",       # one stage's readiness gate
     "watch-sleep",      # one event-driven sleep holding watch streams
     "drift-event",      # instant: a watch event that triggers reconcile
+    "reconcile-object", # one workqueue key through Reconcile(key)
 )
 
 # The Python client/rollout family names (one place so instrumentation
