@@ -59,6 +59,25 @@ TAIL_BUDGET = 1900
 DETAIL_SIDECAR = "bench_detail.json"
 
 
+def _train_entries(doc: dict):
+    """Every train-step entry in the doc — the single-chip shapes AND the
+    sharded arms — so each compact_line shrink stage covers both sections
+    with one loop (a stage that only knew one section would silently blow
+    the budget the first multi-chip round)."""
+    yield from (doc.get("train_step") or {}).values()
+    yield from ((doc.get("train_step_sharded") or {}).get("arms")
+                or {}).values()
+
+
+def _collective_entries(doc: dict):
+    """The per-op sub-docs of the collectives roofline entry."""
+    col = doc.get("collectives")
+    if isinstance(col, dict):
+        for sub in col.values():
+            if isinstance(sub, dict):
+                yield sub
+
+
 def compact_line(doc: dict) -> str:
     """Compact stdout rendering of the bench doc, guaranteed under
     ``TAIL_BUDGET`` by staged shrinking that never touches the headline
@@ -70,9 +89,20 @@ def compact_line(doc: dict) -> str:
     holds more."""
     doc = json.loads(json.dumps(doc))  # deep copy; doc must stay intact
     doc.pop("measure_points", None)
-    for entry in (doc.get("train_step") or {}).values():
+    # estimator provenance, rep counts and FLOPs scope are audit detail the
+    # README never renders — sidecar-only, unconditionally (the multi-chip
+    # section made the full doc big enough that every rendered byte counts)
+    for key in ("measure_estimator", "measure_reps", "measure_warmup_pair_s"):
+        doc.pop(key, None)
+    for entry in _train_entries(doc):
         entry.pop("points", None)
-        entry.pop("estimator", None)  # identical to measure_estimator
+        entry.pop("estimator", None)
+        entry.pop("flops_scope", None)
+    for sub in _collective_entries(doc):
+        for key in ("estimator", "iters", "reps",
+                    # redundant with the parent doc / the dict key itself
+                    "check", "op", "devices", "payload_mib"):
+            sub.pop(key, None)
     scrape = doc.get("metrics_scrape") or {}
     gauges = scrape.pop("gauges", None)
     if gauges is not None:
@@ -96,22 +126,36 @@ def compact_line(doc: dict) -> str:
         removed = [doc.pop(k, None) for k in ("vocab_note",
                                               "measure_spread_note")]
         hit = any(r is not None for r in removed)
-        for entry in (doc.get("train_step") or {}).values():
+        for entry in _train_entries(doc):
             hit |= entry.pop("spread_note", None) is not None
+        for sub in _collective_entries(doc):
+            hit |= sub.pop("note", None) is not None
         if hit:
             dropped.append("notes dropped")
             line = dump()
     if len(line) > TAIL_BUDGET:
-        hit = False
-        for entry in (doc.get("train_step") or {}).values():
+        hit = doc.pop("measure_tflops_spread", None) is not None
+        for entry in _train_entries(doc):
             hit |= entry.pop("tflops_spread", None) is not None
+        for sub in _collective_entries(doc):
+            hit |= sub.pop("busbw_spread", None) is not None
         if hit:
-            dropped.append("per-shape spreads dropped")
+            dropped.append("spreads dropped")
+            line = dump()
+    if len(line) > TAIL_BUDGET:
+        # the attention label also lives in each arm's config string, so
+        # the standalone key is the next-cheapest rendered-adjacent field
+        hit = False
+        for entry in ((doc.get("train_step_sharded") or {}).get("arms")
+                      or {}).values():
+            hit |= entry.pop("attention", None) is not None
+        if hit:
+            dropped.append("arm attention keys dropped")
             line = dump()
     if len(line) > TAIL_BUDGET:
         # e.g. every shape errored with a 300-char repr each
         hit = False
-        for entry in (doc.get("train_step") or {}).values():
+        for entry in _train_entries(doc):
             if len(entry.get("error", "")) > 80:
                 entry["error"] = entry["error"][:80]
                 hit = True
@@ -210,6 +254,49 @@ def spread_note(spread: dict, peak_tflops: float):
                 "that pair's delta; the median rejects it")
     return ("MEASUREMENT DEFECT: median above physical peak — a majority "
             "of paired reps were stall-biased; do not trust this rate")
+
+
+def config_geom(cfg) -> str:
+    """The one-line geometry label a table reader sees. The vocab belongs
+    in the string: the v8192 choice costs/earns real MFU vs production
+    vocabs (round-4 verdict; the trade-off note travels separately)."""
+    return (f"v{cfg.vocab} d{cfg.d_model} f{cfg.d_ff} h{cfg.n_heads} "
+            f"s{cfg.seq} b{cfg.batch} ({cfg.d_ff // cfg.d_model}x FFN, "
+            f"{cfg.param_dtype} master"
+            + (", bf16 scores" if cfg.score_dtype == "bf16" else "") + ")")
+
+
+def train_step_entry(geom: str, peak_tflops: float, run) -> dict:
+    """One train-step bench entry from a measurement thunk — MFU rounding,
+    spread/note/estimator propagation and error capture in ONE place,
+    shared by the single-chip and sharded sections so the two cannot
+    drift (the round-3 above-peak artifact came from exactly such a
+    drifted copy). ``run`` returns a ``burnin.timed_steps``-shaped dict;
+    ``peak_tflops`` <= 0 (unknown hardware, e.g. the CPU virtualmesh)
+    omits the MFU rather than publishing a ratio against nothing."""
+    try:
+        ts = run()
+    except Exception as exc:  # noqa: BLE001 — keep the line
+        return {"config": geom, "error": repr(exc)[:300]}
+    entry = {
+        "config": geom,
+        "tflops": round(ts["tflops"], 2),
+        "tokens_per_s": round(ts["tokens_per_s"]),
+        "points": ts["points"],
+    }
+    if peak_tflops > 0:
+        entry["mfu"] = round(ts["tflops"] / peak_tflops, 3)
+    # estimator provenance travels per shape: a degenerate-fallback "note"
+    # must be visible next to the rate it qualifies, not lost on the way
+    # into the artifact; "attention"/"flops_scope" label the sharded arms.
+    for key in ("tflops_spread", "note", "estimator", "flops_scope",
+                "attention"):
+        if key in ts:
+            entry[key] = ts[key]
+    snote = spread_note(ts.get("tflops_spread") or {}, peak_tflops)
+    if snote:
+        entry["spread_note"] = snote
+    return entry
 
 
 def validate_matrix() -> dict:
@@ -429,38 +516,10 @@ def main() -> int:
                                 param_dtype="bf16",
                                 score_dtype="bf16"), 40),
                     ("wide", burnin.bench_config(), 20)):
-                # the vocab belongs in the one string a reader sees: the
-                # v8192 choice costs/earns real MFU vs production vocabs
-                # (round-4 verdict; the trade-off note travels below)
-                geom = (f"v{cfg.vocab} d{cfg.d_model} f{cfg.d_ff} "
-                        f"h{cfg.n_heads} s{cfg.seq} b{cfg.batch} "
-                        f"({cfg.d_ff // cfg.d_model}x FFN, "
-                        f"{cfg.param_dtype} master"
-                        + (", bf16 scores" if cfg.score_dtype == "bf16"
-                           else "") + ")")
-                try:
-                    ts = burnin.timed_steps(mesh, cfg, steps=steps)
-                    entry = {
-                        "config": geom,
-                        "tflops": round(ts["tflops"], 2),
-                        "mfu": round(ts["tflops"] / acc.peak_bf16_tflops, 3),
-                        "tokens_per_s": round(ts["tokens_per_s"]),
-                        "points": ts["points"],
-                    }
-                    # estimator provenance travels per shape: a degenerate-
-                    # fallback "note" must be visible next to the MFU it
-                    # qualifies, not lost on the way into the artifact.
-                    for key in ("tflops_spread", "note", "estimator"):
-                        if key in ts:
-                            entry[key] = ts[key]
-                    snote = spread_note(ts.get("tflops_spread") or {},
-                                        acc.peak_bf16_tflops)
-                    if snote:
-                        entry["spread_note"] = snote
-                    doc["train_step"][name] = entry
-                except Exception as exc:  # noqa: BLE001 — keep the line
-                    doc["train_step"][name] = {"config": geom,
-                                               "error": repr(exc)[:300]}
+                doc["train_step"][name] = train_step_entry(
+                    config_geom(cfg), acc.peak_bf16_tflops,
+                    lambda cfg=cfg, steps=steps: burnin.timed_steps(
+                        mesh, cfg, steps=steps))
             # measured cost of a production-size vocab at the standard
             # shape — in the artifact so the README table can surface it
             # next to the v8192 rows; the numbers live in ONE place
@@ -471,6 +530,40 @@ def main() -> int:
                 + " / ".join(f"v{v} {m}" for v, m in
                              sorted(burnin.STANDARD_VOCAB_MFU.items()))
                 + " MFU (burnin.standard_config ledger)")
+        # Multi-chip line (ROADMAP item 5): sharded train-step arms plus
+        # the ICI roofline that makes a DP scaling loss attributable
+        # (compute-bound vs collective-bound). On TPU: multi-device only —
+        # a single chip has no ICI to measure. Everywhere else: ungated
+        # with tiny shapes, labelled by its own platform field — CI runs
+        # the full path end-to-end on the CPU virtualmesh, clusterless.
+        if platform != "tpu" or jax.device_count() > 1:
+            from tpu_cluster.workloads import (burnin, collectives,
+                                               shardbench)
+            n_dev = jax.device_count()
+            per_chip = (acc.peak_bf16_tflops
+                        if platform == "tpu" and acc is not None else 0.0)
+            sharded = {"platform": platform, "devices": n_dev, "arms": {}}
+            if per_chip > 0:
+                # sharded MFU denominator: catalogue per-chip peak x mesh
+                sharded["peak_bf16_tflops"] = round(per_chip * n_dev, 1)
+            for arm in shardbench.plan(n_dev, tiny=platform != "tpu"):
+                att = burnin.select_attention(arm.cfg, platform)
+                geom = (f"mesh {arm.mesh_shape[0]}x{arm.mesh_shape[1]} "
+                        + config_geom(arm.cfg) + f", {att} attn")
+                sharded["arms"][arm.name] = train_step_entry(
+                    geom, per_chip * n_dev,
+                    lambda arm=arm: shardbench.measure_arm(arm, platform))
+            doc["train_step_sharded"] = sharded
+            try:
+                # gradient-sized payload on TPU (a standard-config DP sync
+                # moves ~1 GiB of f32 grads; 256 MiB is a realistic
+                # per-bucket size); token payload on the virtualmesh
+                doc["collectives"] = collectives.ici_roofline(
+                    mib=256 if platform == "tpu" else 1,
+                    iters=8 if platform == "tpu" else 2,
+                    reps=3 if platform == "tpu" else 2)
+            except Exception as exc:  # noqa: BLE001 — keep the line
+                doc["collectives"] = {"error": repr(exc)[:300]}
         # Scrape last, inside the window, holding a known-size device
         # allocation so the live-array HBM accounting (runtime_metrics
         # degradation ladder) has a real value to report even on runtimes

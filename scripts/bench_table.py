@@ -85,7 +85,8 @@ def recover_from_tail(tail: str):
             continue
         if not isinstance(doc, dict) or not any(
                 k in doc for k in ("mfu", "train_step", "metrics_scrape",
-                                   "measure_tflops_spread")):
+                                   "measure_tflops_spread",
+                                   "train_step_sharded", "collectives")):
             # parses but isn't bench-shaped (e.g. a stray error dict echoed
             # in the tail) — rendering it would make a garbage table pass
             # the CI render step; keep scanning / fail clean instead
@@ -189,6 +190,45 @@ def render(doc: dict, name: str) -> str:
                          f"{entry['tflops']} TFLOP/s = "
                          f"{_mfu_cell(entry.get('mfu'))}",
                          "; ".join(n for n in notes if n)))
+    sh = doc.get("train_step_sharded") or {}
+    sh_label = ""
+    if sh:
+        # the section labels its own platform: a CPU-virtualmesh round must
+        # read as the clusterless exercise it is, never as TPU throughput
+        sh_label = (f"{sh.get('devices')}-device {sh.get('platform')} mesh")
+    for arm, entry in (sh.get("arms") or {}).items():
+        if not entry:
+            continue
+        label = f"Sharded train step, {arm} ({entry.get('config')})"
+        if "error" in entry:
+            rows.append((label, "error", entry["error"]))
+            continue
+        value_cell = f"{entry['tflops']} TFLOP/s"
+        mfu_cell = _mfu_cell(entry.get("mfu"))
+        if mfu_cell:  # no MFU off-TPU: no catalogue peak to divide by
+            value_cell += f" = {mfu_cell}"
+        notes = [sh_label, f"{entry.get('tokens_per_s')} tokens/s",
+                 _spread_cell(entry)]
+        rows.append((label, value_cell, "; ".join(n for n in notes if n)))
+    col = doc.get("collectives") or {}
+    if "error" in col:
+        rows.append(("ICI roofline (collectives)", "error", col["error"]))
+    else:
+        parts = []
+        for op in ("all_reduce", "all_gather"):
+            sub = col.get(op) or {}
+            if "busbw_gib_s" in sub:
+                parts.append(f"{op.replace('_', '-')} "
+                             f"{sub['busbw_gib_s']} GiB/s")
+        if parts:
+            notes = [f"busbw at {col.get('payload_mib')} MiB payloads, "
+                     f"{col.get('devices')} devices"]
+            if col.get("link_util") is not None:
+                notes.append(f"link_util {col['link_util']} of the "
+                             f"{col.get('ici_peak_gib_s')} GiB/s catalogue "
+                             "ICI peak")
+            rows.append(("ICI roofline (collectives)", ", ".join(parts),
+                         "; ".join(notes)))
     val = doc.get("validate") or {}
     if "wall_s" in val:
         rows.append(("Acceptance matrix wall-clock", f"{val['wall_s']} s",

@@ -88,6 +88,128 @@ def full_doc() -> dict:
     }
 
 
+def sharded_doc() -> dict:
+    """full_doc plus the round-6 multi-chip additions: the three sharded
+    arms (spread/estimator provenance identical to single-chip entries)
+    and the collectives ICI roofline — the biggest doc bench.py can now
+    emit, which the compact budget must survive."""
+    doc = full_doc()
+    spread = {"min": 1180.2, "median": 1234.5, "max": 1290.8, "n": 5,
+              "rejected": 0}
+
+    def arm(cfg, tflops, mfu, toks, att):
+        return {"config": cfg, "tflops": tflops, "mfu": mfu,
+                "tokens_per_s": toks,
+                "points": [{"steps": 10, "seconds": 2.1},
+                           {"steps": 30, "seconds": 6.0}],
+                "tflops_spread": dict(spread),
+                "estimator": "median_of_per_pair_two_point_deltas",
+                "flops_scope": "per_device_x8", "attention": att}
+
+    doc["train_step_sharded"] = {
+        "platform": "tpu", "devices": 8, "peak_bf16_tflops": 1576.0,
+        "arms": {
+            "dp": arm("mesh 8x1 v8192 d4096 f16384 h16 s512 b64 (4x FFN, "
+                      "f32 master), xla attn", 1201.3, 0.762, 845120,
+                      "xla"),
+            "mp": arm("mesh 2x4 v8192 d4096 f16384 h16 s512 b16 (4x FFN, "
+                      "f32 master), xla attn", 1105.8, 0.702, 778201,
+                      "xla"),
+            "long_context": arm(
+                "mesh 2x4 v8192 d4096 f16384 h16 s8192 b2 (4x FFN, "
+                "f32 master), flash attn", 989.4, 0.628, 690332, "flash"),
+        }}
+    busbw_spread = {"min": 138.2, "median": 142.33, "max": 145.9, "n": 3,
+                    "rejected": 0}
+    doc["collectives"] = {
+        "check": "ici_roofline", "devices": 8, "payload_mib": 256,
+        "all_reduce": {"check": "all_reduce_busbw", "op": "all_reduce",
+                       "devices": 8, "payload_mib": 256, "iters": 8,
+                       "reps": 3, "busbw_gib_s": 142.33,
+                       "estimator": "median_of_per_pair_two_point_deltas",
+                       "busbw_spread": dict(busbw_spread)},
+        "all_gather": {"check": "all_gather_busbw", "op": "all_gather",
+                       "devices": 8, "payload_mib": 256, "iters": 8,
+                       "reps": 3, "busbw_gib_s": 151.02,
+                       "estimator": "median_of_per_pair_two_point_deltas",
+                       "busbw_spread": dict(busbw_spread)},
+        "ici_peak_gib_s": 186.3, "link_util": 0.764,
+    }
+    return doc
+
+
+def test_sharded_doc_fits_and_keeps_the_multichip_numbers():
+    """The full TPU doc WITH the multi-chip section must stage down inside
+    the driver window while every sharded headline number (per-arm
+    tflops/mfu/tokens and both busbw rates) survives — losing the whole
+    section to the last-resort stage would republish the zero-throughput
+    MULTICHIP_r05 state this round exists to fix."""
+    line = bench.compact_line(sharded_doc())
+    assert len(line) <= bench.TAIL_BUDGET
+    parsed = json.loads(line)
+    arms = parsed["train_step_sharded"]["arms"]
+    assert set(arms) == {"dp", "mp", "long_context"}
+    for arm in arms.values():
+        assert "tflops" in arm and "mfu" in arm and "tokens_per_s" in arm
+    assert parsed["train_step_sharded"]["peak_bf16_tflops"] == 1576.0
+    assert parsed["collectives"]["all_reduce"]["busbw_gib_s"] == 142.33
+    assert parsed["collectives"]["all_gather"]["busbw_gib_s"] == 151.02
+    assert parsed["collectives"]["link_util"] == 0.764
+    # the staging recorded what it had to shed — the artifact says the
+    # sidecar holds more, instead of silently reading as complete
+    assert "compacted" in parsed
+    # and the single-chip section is still intact next to it
+    assert parsed["mfu"] == 0.987
+    assert set(parsed["train_step"]) == {"standard", "standard_bf16_params",
+                                         "standard_bf16", "wide"}
+
+
+def test_sharded_render_matches_from_compact_and_full():
+    """README rows built from the compact line must carry the same
+    multi-chip rows/numbers as ones built from the full doc (the spread
+    cells may drop under budget pressure; the numbers must not)."""
+    doc = sharded_doc()
+    compact = json.loads(bench.compact_line(doc))
+    a = bench_table.render(doc, "X.json")
+    b = bench_table.render(compact, "X.json")
+    for needle in ("Sharded train step, dp", "Sharded train step, mp",
+                   "Sharded train step, long_context", "0.762 MFU",
+                   "flash attn", "8-device tpu mesh",
+                   "ICI roofline (collectives)",
+                   "all-reduce 142.33 GiB/s", "all-gather 151.02 GiB/s",
+                   "link_util 0.764"):
+        assert needle in a and needle in b, needle
+
+
+def test_cpu_virtualmesh_sharded_doc_keeps_spreads():
+    """The clusterless CI doc is small: nothing may be staged away — the
+    spread provenance must reach the artifact verbatim, and no MFU may be
+    invented without a catalogue peak."""
+    doc = sharded_doc()
+    # what bench.py emits on the CPU virtualmesh: no matmul extras, no
+    # single-chip train_step block, tiny arm geometry, no peaks
+    for key in ("train_step", "vocab_note", "peak_bf16_tflops", "mfu",
+                "measure_tflops_spread", "measure_spread_note"):
+        doc.pop(key, None)
+    sh = doc["train_step_sharded"]
+    sh["platform"] = "cpu"
+    sh.pop("peak_bf16_tflops")
+    for arm in sh["arms"].values():
+        arm.pop("mfu")
+    doc["collectives"].pop("ici_peak_gib_s")
+    doc["collectives"].pop("link_util")
+    line = bench.compact_line(doc)
+    assert len(line) <= bench.TAIL_BUDGET
+    parsed = json.loads(line)
+    assert "compacted" not in parsed  # nothing was shed
+    for arm in parsed["train_step_sharded"]["arms"].values():
+        assert arm["tflops_spread"]["n"] == 5
+        assert "mfu" not in arm
+    assert parsed["collectives"]["all_reduce"]["busbw_spread"]["n"] == 3
+    table = bench_table.render(parsed, "X.json")
+    assert "8-device cpu mesh" in table
+
+
 def test_compact_line_fits_the_driver_window():
     line = bench.compact_line(full_doc())
     assert len(line) <= bench.TAIL_BUDGET

@@ -62,3 +62,66 @@ def test_r04_schema_renders_both_shapes_with_spread():
     assert "4x FFN" in out and "64x FFN" in out
     assert "spread 159.0/160.0/162.0" in out
     assert "measurement defect" not in out
+
+
+def test_sharded_arms_render_with_platform_label_and_mfu():
+    doc = {
+        "value": 193.0, "mfu": 0.98, "vs_baseline": 2.97,
+        "train_step_sharded": {
+            "platform": "tpu", "devices": 8, "peak_bf16_tflops": 1576.0,
+            "arms": {
+                "dp": {"config": "mesh 8x1 s512 b64, xla attn",
+                       "tflops": 1201.3, "mfu": 0.762,
+                       "tokens_per_s": 845120,
+                       "tflops_spread": {"min": 1180.2, "median": 1234.5,
+                                         "max": 1290.8, "n": 5}},
+                "long_context": {"config": "mesh 2x4 s8192 b2, flash attn",
+                                 "error": "RuntimeError('oom')"},
+            }},
+        "collectives": {
+            "check": "ici_roofline", "devices": 8, "payload_mib": 256,
+            "all_reduce": {"busbw_gib_s": 142.33},
+            "all_gather": {"busbw_gib_s": 151.02},
+            "ici_peak_gib_s": 186.3, "link_util": 0.764,
+        },
+    }
+    out = bench_table.render(doc, "BENCH_x.json")
+    assert "Sharded train step, dp" in out
+    assert "1201.3 TFLOP/s = **0.762 MFU**" in out
+    assert "8-device tpu mesh" in out
+    assert "spread 1180.2/1234.5/1290.8" in out
+    # a failed arm renders as its error, not a dropped row
+    assert "Sharded train step, long_context" in out
+    assert "RuntimeError('oom')" in out
+    assert ("all-reduce 142.33 GiB/s, all-gather 151.02 GiB/s" in out)
+    assert "busbw at 256 MiB payloads, 8 devices" in out
+    assert "link_util 0.764 of the 186.3 GiB/s catalogue ICI peak" in out
+
+
+def test_sharded_cpu_arms_render_without_mfu():
+    """The clusterless round: no catalogue peak, so the value cell is the
+    raw TFLOP/s — rendering an MFU against nothing would be fabrication."""
+    doc = {
+        "value": 0.06, "vs_baseline": 0.001,
+        "train_step_sharded": {
+            "platform": "cpu", "devices": 8,
+            "arms": {"dp": {"config": "mesh 8x1 tiny", "tflops": 0.02,
+                            "tokens_per_s": 48123}}},
+        "collectives": {"check": "ici_roofline", "devices": 8,
+                        "payload_mib": 1,
+                        "all_reduce": {"busbw_gib_s": 0.99},
+                        "all_gather": {"busbw_gib_s": 0.53}},
+    }
+    out = bench_table.render(doc, "BENCH_x.json")
+    assert "| 0.02 TFLOP/s |" in out  # no "= ... MFU" appended
+    assert "MFU**" not in out.split("Sharded")[1]
+    assert "8-device cpu mesh" in out
+    assert "link_util" not in out
+
+
+def test_collectives_error_renders_as_error_row():
+    doc = {"value": 1.0, "vs_baseline": 0.01,
+           "collectives": {"error": "RuntimeError('no mesh')"}}
+    out = bench_table.render(doc, "BENCH_x.json")
+    assert "ICI roofline (collectives)" in out
+    assert "RuntimeError('no mesh')" in out

@@ -1,0 +1,250 @@
+"""Multi-chip bench line, clusterless (ROADMAP item 5).
+
+Everything here runs on the conftest-forced 8-device CPU virtualmesh:
+the flash-crossover selector (pure), the shardbench arm plan and the full
+measured path through ``burnin.timed_steps``, the scan-chained collectives
+busbw, and the shared bench-entry assembly helper. The crossover constant
+is additionally pinned to the measured ledger PROSE it encodes, so the
+table and the code path acting on it cannot cite different numbers.
+"""
+
+import inspect
+import json
+import os
+import sys
+from dataclasses import replace
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+from tpu_cluster.workloads import burnin, collectives, shardbench  # noqa: E402
+
+
+# ---------------------------------------------------------------- selector
+
+def test_select_attention_flash_iff_tpu_past_crossover():
+    cfg = burnin.standard_config()  # d_head = 4096/16 = 256, flash-legal
+    at_cross = replace(cfg, seq=burnin.FLASH_CROSSOVER_SEQ)
+    below = replace(cfg, seq=burnin.FLASH_CROSSOVER_SEQ // 2)
+    assert burnin.select_attention(at_cross, "tpu") == "flash"
+    assert burnin.select_attention(
+        replace(cfg, seq=2 * burnin.FLASH_CROSSOVER_SEQ), "tpu") == "flash"
+    assert burnin.select_attention(below, "tpu") == "xla"
+    # never on CPU — the Pallas kernel is Mosaic-compiled, TPU-only
+    assert burnin.select_attention(at_cross, "cpu") == "xla"
+    assert burnin.select_attention(below, "cpu") == "xla"
+
+
+def test_select_attention_respects_flash_head_layout():
+    # past the crossover but d_head=64 violates the kernel's 128-multiple
+    # layout: forward() would raise, so the selector must not pick flash
+    cfg = replace(burnin.standard_config(), n_heads=64,
+                  seq=burnin.FLASH_CROSSOVER_SEQ)
+    assert (cfg.d_model // cfg.n_heads) % 128 != 0
+    assert burnin.select_attention(cfg, "tpu") == "xla"
+
+
+def test_select_attention_chunked_divisibility_guard():
+    cfg = replace(burnin.standard_config(), attention="chunked",
+                  attn_block=128)
+    assert burnin.select_attention(cfg, "tpu") == "chunked"  # 512 % 128 == 0
+    ragged = replace(cfg, seq=320)  # 320 % 128 != 0: forward() would raise
+    assert burnin.select_attention(ragged, "tpu") == "xla"
+    # the crossover outranks an explicit chunked request on TPU
+    long = replace(cfg, seq=burnin.FLASH_CROSSOVER_SEQ)
+    assert burnin.select_attention(long, "tpu") == "flash"
+
+
+def test_crossover_constant_cites_the_ledger():
+    """The selector's constant and the measured ledger prose
+    (standard_config's round-5 long-sequence table) must name the SAME
+    seq — re-measuring the crossover has to move both together."""
+    src = inspect.getsource(burnin.standard_config)
+    s = burnin.FLASH_CROSSOVER_SEQ
+    assert f"s{s}/b1:" in src, "ledger row for the crossover seq missing"
+    assert f"at s{s}" in src, "ledger conclusion cites a different seq"
+    # and the selector actually uses the constant, not a literal copy
+    assert "FLASH_CROSSOVER_SEQ" in inspect.getsource(
+        burnin.select_attention)
+
+
+# ---------------------------------------------------------------- make_mesh
+
+def test_make_mesh_error_names_the_offending_axis():
+    with pytest.raises(ValueError, match="'data'"):
+        burnin.make_mesh((64, 1))  # dp overshoots, tp=1 fits
+    with pytest.raises(ValueError, match="'model'"):
+        burnin.make_mesh((1, 64))  # tp alone exceeds the device count
+    with pytest.raises(ValueError, match="needs 64 devices, have 8"):
+        burnin.make_mesh((16, 4))
+
+
+# ---------------------------------------------------------------- arm plan
+
+def test_plan_arm_shapes_and_batches():
+    arms = {a.name: a for a in shardbench.plan(8, tiny=True)}
+    assert set(arms) == {"dp", "mp", "long_context"}
+    assert arms["dp"].mesh_shape == (8, 1)
+    assert arms["mp"].mesh_shape == (2, 4)
+    assert arms["long_context"].mesh_shape == (2, 4)
+    # global batch scales with the data axis so per-row batch is constant
+    base = shardbench._TINY
+    assert arms["dp"].cfg.batch == base.batch * 8
+    assert arms["mp"].cfg.batch == base.batch * 2
+    assert arms["long_context"].cfg.seq > arms["mp"].cfg.seq
+    # every batch divides over its data axis (sharding stays whole-shard)
+    for a in arms.values():
+        assert a.cfg.batch % a.mesh_shape[0] == 0
+
+
+def test_plan_full_long_context_arm_is_flash_eligible():
+    arms = {a.name: a for a in shardbench.plan(8, tiny=False)}
+    long = arms["long_context"].cfg
+    assert long.seq >= burnin.FLASH_CROSSOVER_SEQ
+    assert (long.d_model // long.n_heads) % 128 == 0
+    assert burnin.select_attention(long, "tpu") == "flash"
+    assert burnin.select_attention(long, "cpu") == "xla"
+
+
+def test_plan_single_device_degenerates_cleanly():
+    for arm in shardbench.plan(1, tiny=True):
+        assert arm.mesh_shape == (1, 1)
+        assert arm.cfg.batch == shardbench._TINY.batch
+
+
+# ------------------------------------------------- measured path (8-dev)
+
+def test_run_arms_on_the_virtualmesh():
+    """The full sharded bench path, end-to-end and clusterless: every arm
+    measured (no errors), spread well-formed, attention labels from the
+    selector (xla everywhere — this is CPU), mesh factorisation recorded,
+    and the FLOPs denominator scope auditable."""
+    doc = shardbench.run_arms(tiny=True)
+    assert doc["platform"] == "cpu"
+    assert doc["devices"] == 8
+    assert set(doc["arms"]) == {"dp", "mp", "long_context"}
+    for name, arm in doc["arms"].items():
+        assert "error" not in arm, (name, arm)
+        assert arm["attention"] == "xla", name  # never flash off-TPU
+        assert arm["tflops"] > 0 and arm["tokens_per_s"] > 0, name
+        spread = arm.get("tflops_spread")
+        if spread is not None:
+            assert spread["min"] <= spread["median"] <= spread["max"]
+            assert spread["n"] >= 1
+        else:  # noise-floor fallback must say so, never silently
+            assert "note" in arm, name
+        assert arm["flops_scope"] in ("global", "per_device_x8"), name
+    assert doc["arms"]["dp"]["mesh"] == {"data": 8, "model": 1}
+    assert doc["arms"]["mp"]["mesh"] == {"data": 2, "model": 4}
+
+
+def test_timed_steps_single_device_scope_is_global():
+    """(1,1) meshes must keep the executable FLOPs count untouched — the
+    published single-chip rounds depend on that denominator."""
+    mesh = burnin.make_mesh((1, 1))
+    r = burnin.timed_steps(mesh, shardbench._TINY, steps=2, reps=1)
+    assert r["flops_scope"] == "global"
+    assert r["flops_per_step"] > 0
+
+
+def test_run_arms_isolates_a_failing_arm(monkeypatch):
+    """One arm failing to compile must not lose the other arms' numbers."""
+    real = shardbench.measure_arm
+
+    def boom(arm, platform=None):
+        if arm.name == "mp":
+            raise RuntimeError("XLA compile failed")
+        return real(arm, platform)
+
+    monkeypatch.setattr(shardbench, "measure_arm", boom)
+    doc = shardbench.run_arms(tiny=True)
+    assert "error" in doc["arms"]["mp"]
+    assert "RuntimeError" in doc["arms"]["mp"]["error"]
+    assert doc["arms"]["mp"]["mesh"] == {"data": 2, "model": 4}
+    assert "error" not in doc["arms"]["dp"]
+
+
+# ------------------------------------------------------------- collectives
+
+def test_bus_bandwidth_all_reduce_and_all_gather():
+    for op in ("all_reduce", "all_gather"):
+        r = collectives.bus_bandwidth(op, mib=1, iters=2, reps=2)
+        assert r["op"] == op and r["devices"] == 8
+        assert r["busbw_gib_s"] > 0
+        assert ("busbw_spread" in r) or ("note" in r)
+
+
+def test_bus_bandwidth_rejects_unknown_op():
+    with pytest.raises(ValueError, match="unknown collective op"):
+        collectives.bus_bandwidth("all_to_all")
+
+
+def test_ici_roofline_shape():
+    r = collectives.ici_roofline(mib=1, iters=2, reps=2)
+    assert r["check"] == "ici_roofline" and r["devices"] == 8
+    for op in ("all_reduce", "all_gather"):
+        assert r[op]["busbw_gib_s"] > 0
+    # CPU virtualmesh: no catalogue ICI peak, so no link_util claim
+    assert "link_util" not in r
+
+
+def test_ici_catalogue_peaks_present():
+    from tpu_cluster import topology
+    for name in ("v5e-8", "v5p-8", "v6e-8", "v4-8"):
+        assert topology.get(name).ici_gbps > 0
+    # same generation -> same ICI figure regardless of slice shape
+    assert topology.get("v5e-64").ici_gbps == topology.get("v5e-1").ici_gbps
+
+
+# ---------------------------------------------------- shared entry helper
+
+def test_train_step_entry_assembles_and_rounds():
+    ts = {"tflops": 159.987654, "tokens_per_s": 111426.6,
+          "points": [{"steps": 40, "seconds": 1.58}],
+          "tflops_spread": {"min": 150.0, "median": 160.0, "max": 170.0,
+                            "n": 5, "rejected": 0},
+          "estimator": "median_of_per_pair_two_point_deltas",
+          "flops_scope": "per_device_x8", "attention": "flash"}
+    e = bench.train_step_entry("geom", 197.0 * 8, lambda: ts)
+    assert e["tflops"] == 159.99
+    assert e["mfu"] == round(159.987654 / (197.0 * 8), 3)
+    assert e["tokens_per_s"] == 111427
+    assert e["attention"] == "flash"
+    assert e["flops_scope"] == "per_device_x8"
+    assert e["tflops_spread"]["n"] == 5
+
+
+def test_train_step_entry_no_peak_omits_mfu():
+    ts = {"tflops": 0.02, "tokens_per_s": 48123.0, "points": []}
+    e = bench.train_step_entry("geom", 0.0, lambda: ts)
+    assert "mfu" not in e  # no ratio against nothing (CPU virtualmesh)
+    assert e["tflops"] == 0.02
+
+
+def test_train_step_entry_captures_errors():
+    def boom():
+        raise RuntimeError("x" * 1000)
+
+    e = bench.train_step_entry("geom", 197.0, boom)
+    assert e["config"] == "geom"
+    assert len(e["error"]) <= 300 and "RuntimeError" in e["error"]
+
+
+def test_config_geom_matches_the_published_format():
+    """The geom string is what BENCH_r05 rows carry — the extraction must
+    reproduce it byte-for-byte or the README rows silently change."""
+    assert bench.config_geom(burnin.standard_config()) == (
+        "v8192 d4096 f16384 h16 s512 b8 (4x FFN, f32 master)")
+    cfg = replace(burnin.standard_config(), param_dtype="bf16",
+                  score_dtype="bf16")
+    assert bench.config_geom(cfg) == (
+        "v8192 d4096 f16384 h16 s512 b8 (4x FFN, bf16 master, bf16 scores)")
+
+
+def test_shardbench_cli_doc_is_json_serialisable():
+    doc = shardbench.run_arms(n_devices=4, tiny=True)
+    line = json.dumps(doc)
+    assert json.loads(line)["devices"] == 4

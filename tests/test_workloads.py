@@ -188,9 +188,12 @@ def test_fused_xent_matches_autodiff():
 
 
 def test_burnin_default_mesh():
-    assert burnin.default_mesh_shape(8) == (2, 4)
-    assert burnin.default_mesh_shape(4) == (1, 4)
-    assert burnin.default_mesh_shape(1) == (1, 1)
+    # power-of-two sweep (the catalogue's device counts) + the odd cases:
+    # TP capped at 4, DP takes the rest, product always equals n
+    expected = {1: (1, 1), 2: (1, 2), 4: (1, 4), 8: (2, 4), 16: (4, 4)}
+    for n, shape in expected.items():
+        assert burnin.default_mesh_shape(n) == shape, n
+        assert shape[0] * shape[1] == n
     assert burnin.default_mesh_shape(6) == (3, 2)
 
 

@@ -49,6 +49,17 @@ class AcceleratorType:
     num_hosts: int = 1
     host_bounds: Tuple[int, int, int] = (1, 1, 1)
 
+    @property
+    def ici_gbps(self) -> float:
+        """Aggregate per-chip ICI bandwidth (Gbit/s), from the published
+        per-generation specs — a property over ICI_GBPS_BY_GENERATION (one
+        row per generation, consistent across every slice shape) rather
+        than a dataclass field, so the golden vectors shared with the C++
+        twin are untouched. Used only as the optional ceiling for the
+        measured collectives roofline (workloads/collectives.ici_roofline);
+        0.0 for generations the table doesn't record."""
+        return ICI_GBPS_BY_GENERATION.get(self.generation, 0.0)
+
     def label_topology(self) -> str:
         """The slice chip grid (hosts x per-host grid) — what GKE publishes
         as the topology label; equals the per-host grid on 1-host types.
@@ -71,6 +82,17 @@ class AcceleratorType:
 # Generations whose slices tile a 3D torus (z > 1 possible at the slice
 # level); their topology labels carry all three extents.
 TORUS_3D_GENERATIONS = ("v4", "v5p")
+
+# Aggregate per-chip ICI bandwidth, Gbit/s — the published spec-sheet
+# figures (v4/v5p sum all torus links; v5e/v6e their 2D mesh links). These
+# are CATALOGUE ceilings for the measured roofline, not measurements; a
+# busbw reading is judged against them, never substituted by them.
+ICI_GBPS_BY_GENERATION: Dict[str, float] = {
+    "v4": 2400.0,
+    "v5e": 1600.0,
+    "v5p": 4800.0,
+    "v6e": 3584.0,
+}
 
 # Per-host accelerator catalogue. Only per-host shapes matter to the device
 # plugin (multi-host slices are composed of per-host groups over DCN; see

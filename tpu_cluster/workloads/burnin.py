@@ -454,11 +454,51 @@ def standard_config() -> BurninConfig:
                         n_heads=16, seq=512, batch=8)
 
 
+# The measured flash-attention crossover, lifted from the round-5
+# long-sequence ledger directly above (standard_config's docstring): the
+# materialised [B,H,S,S] "xla" path wins through s4096; at s8192 its 4.3 GB
+# f32 score matrix thrashes HBM and the Pallas flash kernel is 3.0x faster.
+# ONE copy of the constant, next to the ledger that justifies it —
+# tests/test_shardbench.py pins that the constant and the ledger prose cite
+# the same seq, so re-measuring the crossover forces both to move together.
+FLASH_CROSSOVER_SEQ = 8192
+
+
+def select_attention(cfg: BurninConfig, platform: str) -> str:
+    """The attention mode the measured crossover table picks for ``cfg``
+    on ``platform`` — the code path that ACTS on the ledger above,
+    replacing its comment-only guidance ("long-context shapes should set
+    attention='flash'").
+
+    - "flash" iff on TPU, at/past ``FLASH_CROSSOVER_SEQ``, with the Pallas
+      kernel's d_head-multiple-of-128 layout satisfied. The kernel is
+      Mosaic-compiled (TPU-only) and measured SLOWER than the xla path at
+      every probed seq below the crossover, so flash is never returned
+      anywhere else — in particular never on CPU.
+    - An explicit "chunked" request is honoured only where its
+      divisibility guard (seq %% attn_block == 0) holds; ``forward()``
+      would raise on the rest, so this helper falls back instead.
+    - Everything else: "xla", the measured winner at short seq.
+    """
+    if (platform == "tpu" and cfg.seq >= FLASH_CROSSOVER_SEQ
+            and (cfg.d_model // cfg.n_heads) % 128 == 0):
+        return "flash"
+    if cfg.attention == "chunked" and cfg.seq % cfg.attn_block == 0:
+        return "chunked"
+    return "xla"
+
+
 def make_mesh(shape: Tuple[int, int], devices=None) -> Mesh:
     devices = devices if devices is not None else jax.devices()
     dp, tp = shape
     if dp * tp > len(devices):
-        raise ValueError(f"mesh {shape} needs {dp*tp} devices, have {len(devices)}")
+        # Name the axis that cannot fit: "model" when TP alone exceeds the
+        # device count (no DP split can save it), "data" otherwise (the
+        # residual dp = n // tp is what overshot).
+        axis = "model" if tp > len(devices) else "data"
+        raise ValueError(
+            f"mesh (data={dp}, model={tp}) needs {dp * tp} devices, have "
+            f"{len(devices)} — the '{axis}' axis is the one to shrink")
     return Mesh(np.array(devices[: dp * tp]).reshape(dp, tp), ("data", "model"))
 
 
@@ -534,7 +574,11 @@ def timed_steps(mesh: Mesh, cfg: BurninConfig, steps: int = 20,
     - FLOPs come from XLA's cost analysis of a single step, times the step
       count (cost analysis counts a while-loop body once regardless of
       trip count, so analyzing the scanned computation would under-report
-      by ``steps``x).
+      by ``steps``x);
+    - on a multi-device mesh the executable-level count is PER-DEVICE
+      (post-SPMD partitioning) and is rescaled to the global step — see
+      the flops_scope comment below; ``flops_scope`` in the result records
+      which case fired so a sharded MFU is auditable.
     """
     param_shardings, params, batch = _global_init(mesh, cfg)
 
@@ -546,10 +590,33 @@ def timed_steps(mesh: Mesh, cfg: BurninConfig, steps: int = 20,
     one = jax.jit(lambda p, b: train_step(p, b, flops_cfg),
                   out_shardings=(param_shardings,
                                  NamedSharding(mesh, P())))
-    cost = one.lower(params, batch).compile().cost_analysis()
+    lowered = one.lower(params, batch)
+    cost = lowered.compile().cost_analysis()
     if isinstance(cost, (list, tuple)):  # older jax returns [dict]
         cost = cost[0] if cost else {}
     flops_per_step = float((cost or {}).get("flops", 0.0))
+    # Executable-level cost analysis prices the POST-SPMD-PARTITIONING
+    # per-device module (measured on this backend: a (2,4) mesh reports
+    # ~1/6 of the (1,1) count for the identical global computation), so on
+    # a multi-device mesh it must be scaled back to the global step or the
+    # sharded MFU under-reports by ~n_devices x. The pre-partitioning
+    # Lowered.cost_analysis() count is mesh-independent and serves as the
+    # scope detector: when the executable count is well below it, the
+    # executable is per-device. Single-device meshes keep the executable
+    # count untouched — bit-identical to the published single-chip rounds.
+    n_dev = int(mesh.devices.size)
+    flops_scope = "global"
+    if n_dev > 1 and flops_per_step:
+        try:
+            gcost = lowered.cost_analysis()
+            if isinstance(gcost, (list, tuple)):
+                gcost = gcost[0] if gcost else {}
+            global_pre = float((gcost or {}).get("flops", 0.0))
+        except Exception:
+            global_pre = 0.0
+        if not global_pre or flops_per_step < 0.75 * global_pre:
+            flops_per_step *= n_dev
+            flops_scope = f"per_device_x{n_dev}"
 
     def compiled_scan(n: int):
         def multi(params, batch):
@@ -601,6 +668,7 @@ def timed_steps(mesh: Mesh, cfg: BurninConfig, steps: int = 20,
         "steps": steps,
         "seconds": timed_span,
         "flops_per_step": flops_per_step,
+        "flops_scope": flops_scope,
         "estimator": est["estimator"],
         "reps": reps,
         "points": [{"steps": steps, "seconds": round(est["lo_s"], 4)},

@@ -24,6 +24,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# jax.shard_map graduated from jax.experimental in 0.5; the 0.4.x line
+# (this container's CPU-virtualmesh CI) only has the experimental spelling.
+try:
+    _shard_map = jax.shard_map
+except AttributeError:  # jax < 0.5
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 
 def make_mesh(n_devices: int = 0, axis: str = "chips") -> Mesh:
     devs = jax.devices()
@@ -40,7 +47,7 @@ def psum_check(n_devices: int = 0, elems_per_device: int = 1 << 16) -> Dict[str,
     mesh = make_mesh(n_devices)
     n = mesh.devices.size
 
-    @partial(jax.shard_map, mesh=mesh, in_specs=P("chips"),
+    @partial(_shard_map, mesh=mesh, in_specs=P("chips"),
              out_specs=P("chips"))
     def allreduce(x):
         return jax.lax.psum(x, "chips")
@@ -108,7 +115,7 @@ def allreduce_bandwidth(n_devices: int = 0, mib: int = 64,
     n = mesh.devices.size
     per_dev = mib * 1024 * 1024 // 4
 
-    @partial(jax.shard_map, mesh=mesh, in_specs=P("chips"),
+    @partial(_shard_map, mesh=mesh, in_specs=P("chips"),
              out_specs=P("chips"))
     def allreduce(x):
         return jax.lax.psum(x, "chips")
@@ -131,6 +138,111 @@ def allreduce_bandwidth(n_devices: int = 0, mib: int = 64,
             "seconds": dt, "busbw_gib_s": busbw / 2**30, "ok": True}
 
 
+def bus_bandwidth(op: str, n_devices: int = 0, mib: float = 64,
+                  iters: int = 8, reps: int = 3) -> Dict[str, Any]:
+    """Timed ``op`` bus bandwidth (nccl-tests busbw convention) with the
+    tunneled-backend discipline of ``burnin.timed_steps``: ``iters``
+    collectives chained in ONE compiled lax.scan with a data-dependent
+    carry (XLA cannot elide or overlap them into nothing), a one-element
+    host fetch as the true sync, and the shared two-point estimator
+    (workloads.timing) cancelling the fetch constant. The older
+    :func:`allreduce_bandwidth` dispatch loop measures the tunnel on
+    remote backends; this measures the interconnect.
+
+    busbw — the algorithm-independent wire rate per device:
+      all_reduce: 2*(n-1)/n * shard_bytes / t
+      all_gather:   (n-1)/n * gathered_bytes / t  =  (n-1) * shard_bytes / t
+
+    The estimator is fed bytes pre-scaled so its ``tflops`` slot reads in
+    GiB/s; the min/median/max spread rides along in the same unit.
+    """
+    if op not in ("all_reduce", "all_gather"):
+        raise ValueError(f"unknown collective op: {op}")
+    mesh = make_mesh(n_devices)
+    n = int(mesh.devices.size)
+    per_dev = max(1, int(mib * 1024 * 1024) // 4)
+    spec = P("chips")
+
+    @partial(_shard_map, mesh=mesh, in_specs=spec, out_specs=spec)
+    def step(x):
+        if op == "all_reduce":
+            # rescale so the chained carry stays O(1) instead of n^iters
+            return jax.lax.psum(x, "chips") * (1.0 / n)
+        g = jax.lax.all_gather(x, "chips", tiled=True)  # [n, per_dev]
+        return g.mean(axis=0, keepdims=True)  # consumes every gathered row
+
+    x = jax.device_put(jnp.ones((n, per_dev), jnp.float32),
+                       NamedSharding(mesh, spec))
+
+    def chained(length: int):
+        def chain(v):
+            def body(c, _):
+                return step(c), None
+            out, _ = jax.lax.scan(body, v, None, length=length)
+            return out
+        jitted = jax.jit(chain)
+        np.asarray(jitted(x)[:1, :1])  # compile + warm-up
+        return jitted
+
+    j_lo, j_hi = chained(iters), chained(3 * iters)
+
+    def run_once(jitted) -> float:
+        t0 = time.perf_counter()
+        np.asarray(jitted(x)[:1, :1])  # the true sync (see module docstring)
+        return time.perf_counter() - t0
+
+    run_once(j_lo), run_once(j_hi)  # excluded warmup pair (cold caches)
+    pairs = [(run_once(j_lo), run_once(j_hi)) for _ in range(reps)]
+    shard_bytes = per_dev * 4
+    if op == "all_reduce":
+        bus_bytes = 2 * (n - 1) / max(n, 1) * shard_bytes
+    else:
+        bus_bytes = (n - 1) * shard_bytes
+    # Pre-scale so paired_two_point's /1e12 yields GiB: "tflops" IS GiB/s.
+    gib = bus_bytes * 1e12 / 2**30
+    from . import timing
+    est = timing.paired_two_point(pairs, gib * 2 * iters, gib * 3 * iters)
+    out: Dict[str, Any] = {
+        "check": f"{op}_busbw", "op": op, "devices": n,
+        "payload_mib": mib, "iters": iters, "reps": reps,
+        "busbw_gib_s": round(est["tflops"], 2),
+        "estimator": est["estimator"],
+    }
+    if "spread" in est:
+        out["busbw_spread"] = est["spread"]
+    if "note" in est:
+        out["note"] = est["note"]
+    return out
+
+
+def ici_roofline(n_devices: int = 0, mib: float = 64, iters: int = 8,
+                 reps: int = 3) -> Dict[str, Any]:
+    """All-reduce + all-gather busbw at gradient-sized payloads, published
+    beside the sharded train-step MFU (bench.py's ``collectives`` section)
+    so a DP scaling loss is attributable — compute-bound (MFU holds, bus
+    idle) vs collective-bound (busbw pinned at the roofline while MFU
+    falls) — instead of mysterious. On TPU, when the catalogue records the
+    generation's aggregate ICI rate, ``link_util`` reports measured/peak
+    for the all-reduce (the op a DP gradient sync actually issues)."""
+    n = int(n_devices or jax.device_count())
+    out: Dict[str, Any] = {"check": "ici_roofline", "devices": n,
+                           "payload_mib": mib}
+    for op in ("all_reduce", "all_gather"):
+        out[op] = bus_bandwidth(op, n_devices=n, mib=mib, iters=iters,
+                                reps=reps)
+    dev = jax.devices()[0]
+    if dev.platform == "tpu":
+        from .. import topology
+        acc = topology.from_device_kind(dev.device_kind)
+        if acc is not None and getattr(acc, "ici_gbps", 0.0):
+            # catalogue rate is Gbit/s aggregate per chip -> GiB/s
+            peak_gib_s = acc.ici_gbps * 1e9 / 8 / 2**30
+            out["ici_peak_gib_s"] = round(peak_gib_s, 1)
+            out["link_util"] = round(
+                out["all_reduce"]["busbw_gib_s"] / peak_gib_s, 3)
+    return out
+
+
 def collective_matrix(n_devices: int = 0) -> Dict[str, Any]:
     """Exercise the full collective family the stack must support: psum,
     all_gather, reduce_scatter (psum_scatter), ppermute — the XLA analogs of
@@ -144,7 +256,7 @@ def collective_matrix(n_devices: int = 0) -> Dict[str, Any]:
 
     results: Dict[str, Any] = {"devices": n}
 
-    @partial(jax.shard_map, mesh=mesh, in_specs=spec, out_specs=spec)
+    @partial(_shard_map, mesh=mesh, in_specs=spec, out_specs=spec)
     def ag(x):
         return jax.lax.all_gather(x, "chips").reshape(1, -1)
 
@@ -154,7 +266,7 @@ def collective_matrix(n_devices: int = 0) -> Dict[str, Any]:
         jnp.all(out == jnp.broadcast_to(jnp.arange(n, dtype=jnp.float32), (n, n)))
     )
 
-    @partial(jax.shard_map, mesh=mesh, in_specs=spec, out_specs=spec)
+    @partial(_shard_map, mesh=mesh, in_specs=spec, out_specs=spec)
     def rs(x):
         # per-shard x is (1, n); scatter the length-n axis across chips
         return jax.lax.psum_scatter(x, "chips", scatter_dimension=1, tiled=True)
@@ -163,7 +275,7 @@ def collective_matrix(n_devices: int = 0) -> Dict[str, Any]:
     out2 = jax.jit(rs)(x2)
     results["reduce_scatter_ok"] = bool(jnp.all(out2 == float(n)))
 
-    @partial(jax.shard_map, mesh=mesh, in_specs=spec, out_specs=spec)
+    @partial(_shard_map, mesh=mesh, in_specs=spec, out_specs=spec)
     def rotate(x):
         perm = [(i, (i + 1) % n) for i in range(n)]
         return jax.lax.ppermute(x, "chips", perm)

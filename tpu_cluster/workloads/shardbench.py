@@ -1,0 +1,144 @@
+"""Sharded train-step bench arms — the multi-chip half of the bench line.
+
+Promotes the sharded train step from a dryrun artifact to a first-class
+bench entry (ROADMAP item 5): MULTICHIP_r05 proved the 2- and 4-process
+``jax.distributed`` bootstraps but published ZERO throughput, and bench.py
+hardcoded ``make_mesh((1, 1))``. This module plans and measures three arms
+over ``burnin.make_mesh``:
+
+  dp            pure data parallel, mesh (n, 1), global batch scaled by n —
+                the arm whose scaling the gradient all-reduce bounds;
+  mp            the default DP x TP factorisation (``default_mesh_shape``),
+                Megatron-style layout from ``burnin.param_specs``;
+  long_context  the default mesh at long seq, attention auto-picked by
+                ``burnin.select_attention`` — the code path that acts on
+                the measured flash crossover (3.0x at s8192) instead of
+                the ledger's comment-only guidance.
+
+Every arm runs ``burnin.timed_steps``: the SAME scan-batched, fetch-synced,
+two-point-delta estimator as the single-chip entries, so per-arm
+``{tflops, tokens_per_s, tflops_spread, note}`` provenance is identical and
+bench.py assembles both sections with one shared helper.
+
+Clusterless: the identical code path runs end-to-end on the CPU virtualmesh
+(``tiny=True`` shrinks the geometry, not the code), labelling itself
+``platform=cpu`` — CI exercises every line without a TPU. The CLI
+(``python -m tpu_cluster.workloads.shardbench``) emits the arms plus the
+collectives ICI roofline as one JSON doc.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import burnin
+
+
+@dataclass(frozen=True)
+class Arm:
+    """One planned sharded measurement: a name, a mesh factorisation and a
+    config whose global batch is already scaled to the mesh's data axis."""
+    name: str
+    mesh_shape: Tuple[int, int]
+    cfg: burnin.BurninConfig
+    steps: int
+    reps: int
+
+
+# Tiny geometry for the clusterless path: big enough that the two-point
+# delta clears the estimator's 1ms noise floor on a CPU virtualmesh (so
+# the published spread is well-formed, which CI asserts), small enough to
+# stay in test-suite time. Dims divisible by 4 so the TP axis of
+# default_mesh_shape always lands on whole shards.
+_TINY = burnin.BurninConfig(vocab=128, d_model=64, d_ff=256, n_heads=2,
+                            seq=32, batch=4)
+
+
+def plan(n_devices: int, tiny: bool) -> List[Arm]:
+    """The arm table for ``n_devices``. ``tiny`` selects the clusterless
+    geometry; otherwise arms use the ledger's standard geometry (f32
+    masters — the conservative headline shape, as single-chip)."""
+    dp_shape = (n_devices, 1)
+    mixed = burnin.default_mesh_shape(n_devices)
+    if tiny:
+        base, steps, reps = _TINY, 4, 2
+        long_cfg = replace(_TINY, seq=4 * _TINY.seq)
+    else:
+        base, steps, reps = burnin.standard_config(), 10, 5
+        # Long-context arm: the ledger's s8192 crossover row (b1 per data
+        # row keeps tokens/step bounded; d_head=256 satisfies the Pallas
+        # kernel's 128-multiple layout so select_attention can pick flash).
+        long_cfg = replace(base, seq=8192, batch=1)
+    return [
+        Arm("dp", dp_shape, replace(base, batch=base.batch * dp_shape[0]),
+            steps, reps),
+        Arm("mp", mixed, replace(base, batch=base.batch * mixed[0]),
+            steps, reps),
+        Arm("long_context", mixed,
+            replace(long_cfg, batch=long_cfg.batch * mixed[0]), steps, reps),
+    ]
+
+
+def measure_arm(arm: Arm, platform: Optional[str] = None) -> Dict[str, Any]:
+    """Run one arm: resolve attention via the crossover helper, build the
+    mesh, and return ``burnin.timed_steps``' raw result annotated with the
+    mesh factorisation and the attention mode that actually ran."""
+    import jax
+
+    platform = platform or jax.devices()[0].platform
+    att = burnin.select_attention(arm.cfg, platform)
+    cfg = replace(arm.cfg, attention=att)
+    mesh = burnin.make_mesh(arm.mesh_shape)
+    out = burnin.timed_steps(mesh, cfg, steps=arm.steps, reps=arm.reps)
+    out["mesh"] = {"data": arm.mesh_shape[0], "model": arm.mesh_shape[1]}
+    out["attention"] = att
+    return out
+
+
+def run_arms(n_devices: Optional[int] = None,
+             tiny: Optional[bool] = None) -> Dict[str, Any]:
+    """Measure every planned arm, per-arm error isolation (one arm failing
+    to compile must not lose the others' numbers — the same contract as
+    bench.py's per-shape try/except). ``tiny`` defaults to the platform:
+    full geometry on TPU, tiny everywhere else."""
+    import jax
+
+    platform = jax.devices()[0].platform
+    n = int(n_devices or jax.device_count())
+    if tiny is None:
+        tiny = platform != "tpu"
+    doc: Dict[str, Any] = {"check": "shardbench", "platform": platform,
+                           "devices": n, "tiny": bool(tiny), "arms": {}}
+    for arm in plan(n, tiny):
+        try:
+            doc["arms"][arm.name] = measure_arm(arm, platform)
+        except Exception as exc:  # per-arm isolation
+            doc["arms"][arm.name] = {
+                "mesh": {"data": arm.mesh_shape[0],
+                         "model": arm.mesh_shape[1]},
+                "error": repr(exc)[:300],
+            }
+    return doc
+
+
+def main() -> Dict[str, Any]:
+    """CLI doc: the sharded arms plus the ICI roofline that explains them
+    (docs/TESTING.md's clusterless recipe runs this on the virtualmesh)."""
+    from . import collectives
+
+    doc = run_arms()
+    tiny = doc["tiny"]
+    try:
+        doc["collectives"] = collectives.ici_roofline(
+            mib=256 if not tiny else 1,
+            iters=8 if not tiny else 2,
+            reps=3 if not tiny else 2)
+    except Exception as exc:
+        doc["collectives"] = {"error": repr(exc)[:300]}
+    return doc
+
+
+if __name__ == "__main__":
+    import json
+    print(json.dumps(main(), indent=2))
