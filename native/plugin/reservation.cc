@@ -39,6 +39,24 @@ bool ParseReservations(const std::string& json_text, ReservationTable* table,
     return false;
   }
   out.version = version;
+  // "cordoned" (ISSUE 18): optional string array of hosts under
+  // maintenance; parsed before gangs so an empty-gangs table still
+  // carries its cordon set. Fails closed as a unit like everything else.
+  minijson::ValuePtr cordoned = doc->Get("cordoned");
+  if (cordoned) {
+    if (!cordoned->is_array()) {
+      *err = "reservations: 'cordoned' is not an array";
+      return false;
+    }
+    for (const auto& v : cordoned->elements()) {
+      if (!v || !v->is_string()) {
+        *err = "reservations: 'cordoned' has a non-string host";
+        return false;
+      }
+      out.cordoned.push_back(v->as_string());
+    }
+    std::sort(out.cordoned.begin(), out.cordoned.end());
+  }
   minijson::ValuePtr gangs = doc->Get("gangs");
   if (!gangs) {  // empty table: nothing admitted
     *table = std::move(out);
@@ -91,6 +109,16 @@ bool CheckAllocation(const ReservationTable& table, const std::string& host,
   std::set<int> want(device_ids.begin(), device_ids.end());
   if (want.size() != device_ids.size()) {
     *reason = "duplicate device ids in allocation request";
+    return false;
+  }
+  // Maintenance cordon beats any reservation still naming the host
+  // (ISSUE 18): during the drain race window the kubelet must not seat
+  // a gang the controller is about to drain. Wording twin of the
+  // Python check_allocation.
+  if (std::binary_search(table.cordoned.begin(), table.cordoned.end(),
+                         host)) {
+    *reason = "host '" + host + "' is cordoned for maintenance; gangs "
+              "are not seated on a cordoned host";
     return false;
   }
   bool host_reserved = false;

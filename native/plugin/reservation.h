@@ -37,6 +37,11 @@ struct ReservationTable {
   int version = 0;
   // gang name -> reservation, insertion-ordered by name (std::map)
   std::map<std::string, GangReservation> gangs;
+  // hosts cordoned for maintenance (ISSUE 18), sorted: an ADDITIVE
+  // schema-v1 field — absent parses as empty. CheckAllocation refuses
+  // any seat on a cordoned host even while a reservation still names it
+  // (the drain race window between cordon and the admission pass).
+  std::vector<std::string> cordoned;
 };
 
 // Parse the reservations.json document. False on malformed JSON, a wrong

@@ -40,7 +40,10 @@ static const char kReservationTableJson[] =
     " \"hosts\": {\"node-a\": [0,1,2,3,4,5,6,7],"
     " \"node-b\": [0,1,2,3,4,5,6,7]}},"
     "\"probe\": {\"accelerator\": \"v5p-16\", \"priority\": 0,"
-    " \"hosts\": {\"node-c\": [0,1,2,3]}}}}";
+    " \"hosts\": {\"node-c\": [0,1,2,3]}},"
+    "\"maint\": {\"accelerator\": \"v5e-8\", \"priority\": 1,"
+    " \"hosts\": {\"node-m\": [0,1,2,3,4,5,6,7]}}},"
+    " \"cordoned\": [\"node-m\", \"node-x\"]}";
 
 struct ReservationCase {
   const char* host;
@@ -63,6 +66,8 @@ static const ReservationCase kReservationVectors[] = {
     {"node-d", "0,1,2,3,4,5,6,7", false, ""},
     {"node-a", "0,0,1,2,3,4,5,6", false, ""},
     {"node-a", "", false, ""},
+    {"node-m", "0,1,2,3,4,5,6,7", false, ""},
+    {"node-x", "0,1,2,3,4,5,6,7", false, ""},
 };
 
 static std::vector<int> ParseIds(const char* csv) {
@@ -90,12 +95,15 @@ static void TestParse() {
   CHECK(tpud::ParseReservations(kReservationTableJson, &table, &err));
   CHECK(err.empty());
   CHECK(table.version == 1);
-  CHECK(table.gangs.size() == 2);
+  CHECK(table.gangs.size() == 3);
   CHECK(table.gangs.at("train-a").accelerator == "v5e-16");
   CHECK(table.gangs.at("train-a").priority == 10);
   CHECK(table.gangs.at("train-a").hosts.size() == 2);
   CHECK(table.gangs.at("probe").hosts.at("node-c") ==
         (std::vector<int>{0, 1, 2, 3}));
+  // the cordoned-host list (ISSUE 18) rides the same document, sorted
+  CHECK(table.cordoned ==
+        (std::vector<std::string>{"node-m", "node-x"}));
   // chip ids are normalised sorted regardless of published order
   tpud::ReservationTable scrambled;
   CHECK(tpud::ParseReservations(
@@ -108,7 +116,15 @@ static void TestParse() {
   CHECK(tpud::ParseReservations("{\"version\": 1, \"gangs\": {}}", &empty,
                                 &err));
   CHECK(empty.gangs.empty());
+  CHECK(empty.cordoned.empty());
   CHECK(tpud::ParseReservations("{\"version\": 1}", &empty, &err));
+  // the cordoned list survives a gangs-absent document (it is parsed
+  // BEFORE the empty-table early return) and normalises sorted
+  tpud::ReservationTable cordons;
+  CHECK(tpud::ParseReservations(
+      "{\"version\": 1, \"cordoned\": [\"h2\", \"h1\"]}", &cordons, &err));
+  CHECK(cordons.gangs.empty());
+  CHECK(cordons.cordoned == (std::vector<std::string>{"h1", "h2"}));
 }
 
 static void TestParseRejects() {
@@ -126,6 +142,11 @@ static void TestParseRejects() {
   // a failed parse leaves the table EMPTY (fail closed at Allocate, never
   // half-loaded)
   CHECK(table.gangs.empty() && table.version == 0);
+  // a malformed cordoned list fails the WHOLE table closed, same unit
+  CHECK(!tpud::ParseReservations(
+      "{\"version\": 1, \"gangs\": {}, \"cordoned\": [1]}", &table, &err));
+  CHECK(err.find("cordoned") != std::string::npos);
+  CHECK(table.gangs.empty() && table.cordoned.empty());
 }
 
 static void TestCheckAllocationVectors() {
@@ -153,6 +174,11 @@ static void TestCheckAllocationVectors() {
   CHECK(reason.find("4 of 8") != std::string::npos);
   CHECK(!tpud::CheckAllocation(table, "node-z", {0}, &gang, &reason));
   CHECK(reason.find("no admitted gang") != std::string::npos);
+  // cordon beats reservation: node-m still has an admitted gang in the
+  // table, but the maintenance cordon refuses the seat by name
+  CHECK(!tpud::CheckAllocation(table, "node-m", {0, 1, 2, 3, 4, 5, 6, 7},
+                               &gang, &reason));
+  CHECK(reason.find("cordoned for maintenance") != std::string::npos);
 }
 
 static void TestTopologyStillAgrees() {

@@ -84,6 +84,7 @@ from fake_apiserver import (FakeApiServer, fleet_store,  # noqa: E402
                             slow_fault_script, standard_fault_script)
 from tpu_cluster import admission  # noqa: E402
 from tpu_cluster import kubeapply  # noqa: E402
+from tpu_cluster import maintenance  # noqa: E402
 from tpu_cluster import spec as specmod  # noqa: E402
 from tpu_cluster import telemetry  # noqa: E402
 from tpu_cluster.render import manifests, operator_bundle  # noqa: E402
@@ -145,6 +146,16 @@ OPERATOR_FLEET_REPAIR_MAX_S = 5.0
 OPERATOR_FLEET_REPAIR_REQUESTS_MAX = 3
 OPERATOR_FLEET_P99_MAX_S = 0.5
 OPERATOR_FLEET_DRIFTS = 25
+# The maintenance column (ISSUE 18): a rolling cordon/drain/upgrade wave
+# over MAINTENANCE_NODES hosts in two groups, with one resident gang
+# riding the wave and one bystander gang submitted mid-wave. The --check
+# contract: the wave converges, at least one gang was drained AND
+# re-admitted, the kubelet seat check accepted ZERO partial gangs at
+# every observation, and concurrent drained gangs never exceeded the
+# budget.
+MAINTENANCE_NODES = 12
+MAINTENANCE_GROUP_SIZE = 6
+MAINTENANCE_BUDGET_MAX_DRAINS = 2
 
 
 def full_stack_groups(spec):
@@ -500,6 +511,104 @@ def gang_arm(latency_s: float) -> dict:
         "partial_allocations": partial_accepted,
         "admissions_total": int(
             tel.metrics.total(telemetry.ADMISSIONS_TOTAL)),
+    }
+
+
+def maintenance_arm(latency_s: float) -> dict:
+    """The rolling-maintenance column (ISSUE 18): a two-group wave over
+    a 12-host fleet with a resident v5e-16 gang. Reports the wave wall,
+    drained/re-admitted gang counts, the max concurrently-drained-gangs
+    audit (gated <= budget), the zero-partial-seats contract, and the
+    bystander queue-wait delta (a gang submitted mid-wave vs the
+    no-wave admission latency)."""
+    ns = "tpu-system"
+    hosts = [f"bench-m-{i:02d}" for i in range(MAINTENANCE_NODES)]
+    hosts_chips = {h: 8 for h in hosts}
+    tel = telemetry.Telemetry()
+    with FakeApiServer(auto_ready=True, latency_s=latency_s) as api:
+        client = kubeapply.Client(api.url, telemetry=tel)
+        for h in hosts:
+            client.apply(admission.node_manifest(h, "v5e-8"))
+        adm = admission.AdmissionController(client, ns, telemetry=tel)
+        # the no-wave baseline the bystander delta is measured against
+        t0 = time.monotonic()
+        client.apply(admission.gang_job_manifest("roll", "v5e-16", ns))
+        adm.step()
+        baseline_wait = time.monotonic() - t0
+        plan = maintenance.plan_waves(
+            [admission.HostCapacity(h, "v5e-8", 8, True) for h in hosts],
+            "v9-bench", group_size=MAINTENANCE_GROUP_SIZE,
+            budget=maintenance.GangDisruptionBudget(
+                max_drained_gangs=MAINTENANCE_BUDGET_MAX_DRAINS))
+        mctrl = maintenance.MaintenanceController(client, ns, plan=plan,
+                                                  telemetry=tel)
+        drained_set: set = set()
+        drains_total = 0
+        readmitted_total = 0
+        partial_total = 0
+        bystander_t0 = None
+        bystander_wait = None
+        complete = False
+        t_wave = time.monotonic()
+        deadline = t_wave + 120
+        while time.monotonic() < deadline:
+            r = adm.step()
+            drains_total += len(r.drained)
+            drained_set.update(r.drained)
+            for g in r.newly_admitted:
+                if g in drained_set:
+                    readmitted_total += 1
+                    drained_set.discard(g)
+            m = mctrl.step()
+            if bystander_t0 is None and any(
+                    m.phases.get(p, 0)
+                    for p in (maintenance.PHASE_CORDONED,
+                              maintenance.PHASE_DRAINED,
+                              maintenance.PHASE_UPGRADED)):
+                # the wave is disrupting: a bystander gang arrives and
+                # must seat on the hosts the wave is NOT holding
+                client.apply(admission.gang_job_manifest(
+                    "bystander", "v5e-16", ns))
+                bystander_t0 = time.monotonic()
+            if (bystander_t0 is not None and bystander_wait is None
+                    and "bystander" in adm.admitted_snapshot()):
+                bystander_wait = time.monotonic() - bystander_t0
+            cm = api.get(f"/api/v1/namespaces/{ns}/configmaps/"
+                         f"{admission.RESERVATION_CONFIGMAP}")
+            if cm is not None:
+                table = admission.parse_table(
+                    json.loads(cm["data"][admission.RESERVATION_KEY]))
+                for host, chips in hosts_chips.items():
+                    for k in range(1, chips):
+                        ok, _ = admission.check_allocation(
+                            table, host, list(range(k)))
+                        partial_total += int(ok)
+            if m.complete:
+                complete = True
+                break
+        wave_wall = time.monotonic() - t_wave
+        # both gangs end up seated on the upgraded fleet
+        final = adm.step()
+        client.close()
+    return {
+        "nodes": MAINTENANCE_NODES,
+        "groups": 2,
+        "budget_max_drained_gangs": MAINTENANCE_BUDGET_MAX_DRAINS,
+        "converged": complete,
+        "wave_wall_s": round(wave_wall, 3),
+        "drained_gangs": drains_total,
+        "readmitted_gangs": readmitted_total,
+        "max_concurrent_drains": mctrl.max_concurrent_drains,
+        "partial_allocations": partial_total,
+        "final_admitted": sorted(final.admitted),
+        "bystander_queue_wait_s": (round(bystander_wait, 4)
+                                   if bystander_wait is not None
+                                   else None),
+        "bystander_wait_delta_s": (round(bystander_wait - baseline_wait,
+                                         4)
+                                   if bystander_wait is not None
+                                   else None),
+        "maintenance_passes": mctrl.passes,
     }
 
 
@@ -866,6 +975,7 @@ def main(argv=None) -> int:
                    trace_out=args.trace_out, collect=collect)
     ssa = ssa_arm(latency_s, args.passes, args.max_inflight)
     gang = gang_arm(latency_s)
+    maint = maintenance_arm(latency_s)
     fleet = fleet_arm(latency_s, args.max_inflight)
     ready_watch = readiness_arm(latency_s, watch=True)
     ready_poll = readiness_arm(latency_s, watch=False)
@@ -936,6 +1046,11 @@ def main(argv=None) -> int:
         # its latency), whole-gang preemption count, and the
         # zero-partial-allocations contract at the kubelet seat check.
         "gang": gang,
+        # Rolling maintenance (ISSUE 18): a two-group cordon/drain/
+        # upgrade wave with a resident gang — wave wall, drained/
+        # re-admitted counts, max concurrent drains (gated <= budget),
+        # zero partial seats, and the bystander queue-wait delta.
+        "maintenance": maint,
         # Fleet scale (ISSUE 11): cold rollout at 1000 synthetic nodes
         # within 2x of the 20-node request count (O(bundle), not
         # O(nodes)), span-derived decision latency for 100 queued gangs,
@@ -1039,6 +1154,25 @@ def main(argv=None) -> int:
                   "race_admitted==1, preemptions>=1, preemptor admitted, "
                   "partial_allocations==0, full_host_groups_admitted==2)",
                   file=sys.stderr)
+            return 1
+        # rolling maintenance (ISSUE 18): the wave must converge with
+        # whole-gang drains only — at least one drain AND re-admission
+        # observed, zero partial seats at every observation, the
+        # concurrent-drain audit within budget, and both gangs (the
+        # wave rider + the mid-wave bystander) seated at the end
+        if not (maint["converged"]
+                and maint["drained_gangs"] >= 1
+                and maint["readmitted_gangs"] >= 1
+                and maint["partial_allocations"] == 0
+                and maint["max_concurrent_drains"]
+                <= MAINTENANCE_BUDGET_MAX_DRAINS
+                and maint["final_admitted"] == ["bystander", "roll"]
+                and maint["bystander_queue_wait_s"] is not None):
+            print(f"bench_rollout: FAIL — maintenance column {maint} "
+                  "(need converged, drained>=1, readmitted>=1, "
+                  "partial_allocations==0, max_concurrent_drains <= "
+                  f"{MAINTENANCE_BUDGET_MAX_DRAINS}, both gangs "
+                  "admitted)", file=sys.stderr)
             return 1
         # fleet scale (ISSUE 11): the sublinear pins — a 50x node-count
         # jump may not even DOUBLE the rollout's request bill, the

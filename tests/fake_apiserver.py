@@ -218,9 +218,11 @@ def _filter_selector(items, query: str):
     return out
 
 
-# Timer-driven node-lifecycle fault kinds (ISSUE 10) — the chaos-script
-# spellings of the FakeApiServer node hooks below.
-_NODE_FAULT_KINDS = ("node_not_ready", "node_ready", "evict_pods")
+# Timer-driven node-lifecycle fault kinds (ISSUE 10; cordon pair added
+# by ISSUE 18) — the chaos-script spellings of the FakeApiServer node
+# hooks below.
+_NODE_FAULT_KINDS = ("node_not_ready", "node_ready", "evict_pods",
+                     "cordon_node", "uncordon_node")
 
 
 # ------------------------------------------------------------------ fleet
@@ -231,6 +233,9 @@ _NODE_FAULT_KINDS = ("node_not_ready", "node_ready", "evict_pods")
 
 FLEET_ACCELERATOR_LABEL = "google.com/tpu.accelerator-type"
 FLEET_TPU_RESOURCE = "google.com/tpu"
+# twin of tpu_cluster/maintenance.py VERSION_LABEL (pinned by
+# tests/test_maintenance.py) — the label set_node_version rewrites
+FLEET_VERSION_LABEL = "tpu-stack.dev/stack-version"
 
 
 def fleet_node(name: str, accelerator: str = "v5e-8", chips: int = 8,
@@ -334,6 +339,14 @@ class ChaosEngine:
                                                # watch DELETED events —
                                                # what the eviction API
                                                # does to a drained node
+      {"cordon_node": "node-a", "at": 1.2}     # set spec.unschedulable
+                                               # (FakeApiServer
+                                               # .set_node_unschedulable)
+                                               # — a surprise cordon the
+                                               # maintenance loop must
+                                               # not fight or seat onto
+      {"uncordon_node": "node-a", "at": 2.2}   # ...and clear it — the
+                                               # recovery half
 
     SLOW-PATH faults (ISSUE 9) — the server that is slow rather than
     failing fast; all four honor ``for``/``count`` like status faults:
@@ -431,6 +444,10 @@ class ChaosEngine:
                 server.set_node_ready(node, ready=False)
             elif kind == "node_ready":
                 server.set_node_ready(node, ready=True)
+            elif kind == "cordon_node":
+                server.set_node_unschedulable(node, True)
+            elif kind == "uncordon_node":
+                server.set_node_unschedulable(node, False)
             else:
                 server.evict_pods(node)
         except KeyError:
@@ -519,6 +536,19 @@ class ChaosEngine:
                 self.fired.append((status, method, path))
                 return ("status", status, headers, body)
         return None
+
+
+def soak_seconds(default: float) -> float:
+    """The soak-duration knob (ISSUE 18): chaos/lockorder soaks run for
+    ``max(default, $TPU_SOAK_SECONDS)`` — tier-1 defaults stay untouched
+    when the env var is unset/invalid, while CI's slow lane (or a
+    developer hunting a rare interleaving) can stretch the same soak to
+    minutes or hours without editing a test."""
+    import os
+    try:
+        return max(default, float(os.environ.get("TPU_SOAK_SECONDS", "0")))
+    except ValueError:
+        return default
 
 
 def standard_fault_script(unit: float = 0.05) -> List[Dict[str, Any]]:
@@ -1948,6 +1978,38 @@ class FakeApiServer:
             conds.append({"type": "Ready",
                           "status": "True" if ready else "False"})
             status["conditions"] = conds
+            self._note_change(path)
+
+    def set_node_unschedulable(self, name: str,
+                               unschedulable: bool = True) -> None:
+        """Cordon/uncordon a Node: round-trips ``spec.unschedulable``
+        through the store with a watch event, exactly like a kubectl
+        cordon PATCH would (ISSUE 18). Raises KeyError for an unknown
+        node."""
+        path = f"/api/v1/nodes/{name}"
+        with self._lock:
+            obj = self.store[path]
+            spec = obj.setdefault("spec", {})
+            if unschedulable:
+                spec["unschedulable"] = True
+            else:
+                spec.pop("unschedulable", None)
+            self._note_change(path)
+
+    def set_node_version(self, name: str, version: str) -> None:
+        """The kubelet hook a simulated device-plugin/libtpu upgrade
+        rides (ISSUE 18): rewrite the Node's stack-version label and
+        kubelet-reported version, emitting a watch event. Raises
+        KeyError for an unknown node."""
+        path = f"/api/v1/nodes/{name}"
+        with self._lock:
+            obj = self.store[path]
+            labels = (obj.setdefault("metadata", {})
+                      .setdefault("labels", {}))
+            labels[FLEET_VERSION_LABEL] = version
+            info = (obj.setdefault("status", {})
+                    .setdefault("nodeInfo", {}))
+            info["kubeletVersion"] = version
             self._note_change(path)
 
     def evict_pods(self, node_name: str) -> List[str]:

@@ -19,7 +19,8 @@ import time
 
 import pytest
 
-from fake_apiserver import FakeApiServer, standard_fault_script
+from fake_apiserver import (FakeApiServer, soak_seconds,
+                            standard_fault_script)
 from tpu_cluster import admission, kubeapply, telemetry
 from tpu_cluster.render import manifests
 from tpu_cluster import spec as specmod
@@ -266,7 +267,9 @@ def test_gang_survives_chaos_soak_with_zero_partial_allocations():
         ctrl = admission.AdmissionController(client, NS)
         partials = 0
         admitted_seen = False
-        deadline = time.monotonic() + 20.0
+        # TPU_SOAK_SECONDS (ISSUE 18) stretches the chaos window for
+        # long-soak runs; the tier-1 default stays 20s
+        deadline = time.monotonic() + soak_seconds(20.0)
         while time.monotonic() < deadline:
             try:
                 ctrl.step()
@@ -787,6 +790,175 @@ def test_fresh_controller_recovers_event_memo_from_annotations():
         api.set_node_ready("node-b", ready=True)
         fresh_pass()                             # ReAdmitted (recovered)
         evs = _gang_events(api, "train")
+        client.close()
+    assert [(e["reason"], e["count"]) for e in evs] == [
+        ("Admitted", 1), ("Drained", 1), ("ReAdmitted", 1)], evs
+
+
+# ------------------------------------------- maintenance cordons (ISSUE 18)
+
+
+def _cordon(client, node, group):
+    client.patch_merge(f"/api/v1/nodes/{node}", {
+        "spec": {"unschedulable": True},
+        "metadata": {"annotations": {
+            admission.MAINTENANCE_ANNOTATION: group}}})
+
+
+def _uncordon(client, node):
+    client.patch_merge(f"/api/v1/nodes/{node}", {
+        "spec": {"unschedulable": False},
+        "metadata": {"annotations": {
+            admission.MAINTENANCE_ANNOTATION: None}}})
+
+
+def test_cordoned_hosts_are_ineligible_and_queue_reason_names_group():
+    """A cordoned host is not an eligible seat, and the queued reason
+    NAMES the wave group the gang is waiting on — `tpuctl queue` must
+    answer WHY a gang is pending during maintenance."""
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        seed_hosts(client, ("node-a", "node-b"))
+        _cordon(client, "node-a", "g/7")
+        submit_gang(client, "waiter")
+        ctrl = admission.AdmissionController(client, NS)
+        result = ctrl.step()
+        assert result.admitted == []
+        assert result.queued == ["waiter"]
+        reason = ctrl.decisions_snapshot()["waiter"].reason
+        assert "waiting on cordoned host group g/7" in reason
+        # the cordon lifts: the SAME gang admits, nothing else changes
+        _uncordon(client, "node-a")
+        assert ctrl.step().admitted == ["waiter"]
+        client.close()
+
+
+def test_published_table_carries_cordoned_hosts_for_the_plugin():
+    """The admission loop publishes the cordon set IN the reservation
+    table, so the C++ Allocate twin refuses seats during the drain race
+    window — and the Python checker agrees."""
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        seed_hosts(client, ("node-a", "node-b", "node-c", "node-d"))
+        submit_gang(client, "stay")
+        ctrl = admission.AdmissionController(client, NS)
+        assert "stay" in ctrl.step().admitted
+        _cordon(client, "node-d", "g/0")
+        ctrl.step()
+        table = published_table(api)
+        assert table.cordoned == ("node-d",)
+        ok, reason = admission.check_allocation(table, "node-d",
+                                                list(range(8)))
+        assert not ok and "cordoned for maintenance" in reason
+        client.close()
+
+
+def test_drain_reasons_compose_maintenance_then_notready():
+    """Satellite 3 (ISSUE 18): a gang drained for maintenance whose
+    host THEN goes NotReady keeps one coherent story — the reason
+    annotation follows the latest cause, and recovery lands exactly ONE
+    ReAdmitted event naming it (the two drain paths compose, they don't
+    double-report)."""
+    from tpu_cluster import events as eventsmod
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY)
+        seed_hosts(client, ("node-a", "node-b"))
+        submit_gang(client, "compose")
+        rec = eventsmod.EventRecorder(client, component="tpu-admission")
+        ctrl = admission.AdmissionController(client, NS, events=rec)
+        ctrl.step()                                       # Admitted
+        _cordon(client, "node-b", "g/1")
+        ctrl.step()                                       # Drained
+        reason = ctrl.decisions_snapshot()["compose"].reason
+        assert reason.startswith(admission.DRAIN_REASON_PREFIX)
+        assert "node-b cordoned for maintenance" in reason
+        # the maintenance-drained host ALSO fails mid-drain: the cause
+        # composes (no second Drained event, latest cause wins)
+        api.set_node_ready("node-b", ready=False)
+        ctrl.step()
+        reason = ctrl.decisions_snapshot()["compose"].reason
+        assert "node-b NotReady" in reason
+        assert "cordoned" not in reason
+        # both conditions clear at once: ONE recovery, naming the
+        # latest cause
+        api.set_node_ready("node-b", ready=True)
+        _uncordon(client, "node-b")
+        ctrl.step()                                       # ReAdmitted
+        ctrl.step()                                       # steady state
+        evs = _gang_events(api, "compose")
+        client.close()
+    assert [(e["reason"], e["count"]) for e in evs] == [
+        ("Admitted", 1), ("Drained", 1), ("ReAdmitted", 1)], evs
+    readmit = [e for e in evs if e["reason"] == "ReAdmitted"][0]
+    assert "host node-b NotReady" in readmit["message"]
+
+
+def test_drain_reasons_compose_notready_then_maintenance():
+    """The mirror composition: a failure-drained gang whose host is
+    THEN cordoned for maintenance re-queues under the maintenance
+    reason, and the single ReAdmitted names the maintenance cordon (the
+    cause active last)."""
+    from tpu_cluster import events as eventsmod
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY)
+        seed_hosts(client, ("node-a", "node-b"))
+        submit_gang(client, "mirror")
+        rec = eventsmod.EventRecorder(client, component="tpu-admission")
+        ctrl = admission.AdmissionController(client, NS, events=rec)
+        ctrl.step()                                       # Admitted
+        api.set_node_ready("node-b", ready=False)
+        ctrl.step()                                       # Drained
+        assert "node-b NotReady" in \
+            ctrl.decisions_snapshot()["mirror"].reason
+        _cordon(client, "node-b", "g/2")
+        ctrl.step()
+        reason = ctrl.decisions_snapshot()["mirror"].reason
+        assert "node-b cordoned for maintenance" in reason
+        # the node recovers but stays cordoned: still queued
+        api.set_node_ready("node-b", ready=True)
+        result = ctrl.step()
+        assert "mirror" in result.queued
+        _uncordon(client, "node-b")
+        ctrl.step()                                       # ReAdmitted
+        evs = _gang_events(api, "mirror")
+        client.close()
+    assert [(e["reason"], e["count"]) for e in evs] == [
+        ("Admitted", 1), ("Drained", 1), ("ReAdmitted", 1)], evs
+    readmit = [e for e in evs if e["reason"] == "ReAdmitted"][0]
+    assert "host node-b maintenance cordon" in readmit["message"]
+
+
+def test_fresh_process_recovery_composes_drain_reasons():
+    """The PR 12 restart-recovery pin extended to composed causes:
+    every pass a FRESH controller (the `--once` shape). The drain-cause
+    memo re-seeds from the live reason annotation, so the composition
+    story — maintenance drain, mid-drain NotReady, one ReAdmitted —
+    survives a controller that remembers nothing."""
+    from tpu_cluster import events as eventsmod
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY)
+        seed_hosts(client, ("node-a", "node-b"))
+        submit_gang(client, "fresh")
+
+        def fresh_pass():
+            rec = eventsmod.EventRecorder(client,
+                                          component="tpu-admission")
+            ctrl = admission.AdmissionController(client, NS, events=rec)
+            ctrl.step()
+            return ctrl
+
+        fresh_pass()                              # Admitted
+        _cordon(client, "node-b", "g/3")
+        fresh_pass()                              # Drained (maintenance)
+        api.set_node_ready("node-b", ready=False)
+        ctrl = fresh_pass()                       # cause -> NotReady
+        assert "node-b NotReady" in \
+            ctrl.decisions_snapshot()["fresh"].reason
+        api.set_node_ready("node-b", ready=True)
+        _uncordon(client, "node-b")
+        fresh_pass()                              # ReAdmitted (recovered)
+        fresh_pass()                              # steady state: nothing
+        evs = _gang_events(api, "fresh")
         client.close()
     assert [(e["reason"], e["count"]) for e in evs] == [
         ("Admitted", 1), ("Drained", 1), ("ReAdmitted", 1)], evs

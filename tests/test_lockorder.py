@@ -22,7 +22,8 @@ import time
 
 import pytest
 
-from fake_apiserver import FakeApiServer, standard_fault_script
+from fake_apiserver import (FakeApiServer, soak_seconds,
+                            standard_fault_script)
 from tpu_cluster import kubeapply, lockorder, telemetry
 from tpu_cluster import spec as specmod
 from tpu_cluster.render import manifests
@@ -211,10 +212,16 @@ def test_soak_graph_is_cycle_free_and_pinned():
                                stage_timeout=60, poll=0.02,
                                max_inflight=8, watch_ready=True)
         # warm re-apply exercises the cache_lock + _ssa_is_noop path on
-        # live state (the shared watcher + cache interplay)
-        kubeapply.apply_groups(client, groups, wait=True,
-                               stage_timeout=60, poll=0.02,
-                               max_inflight=8, watch_ready=True)
+        # live state (the shared watcher + cache interplay); with
+        # TPU_SOAK_SECONDS set (ISSUE 18) keep re-applying that long —
+        # the tier-1 default stays one warm pass
+        soak_end = time.monotonic() + soak_seconds(0.0)
+        while True:
+            kubeapply.apply_groups(client, groups, wait=True,
+                                   stage_timeout=60, poll=0.02,
+                                   max_inflight=8, watch_ready=True)
+            if time.monotonic() >= soak_end:
+                break
         client.close()
     tel.metrics.render()  # exporter path under the monitor too
 
@@ -231,7 +238,7 @@ def test_soak_graph_is_cycle_free_and_pinned():
     flat_files = ("kubeapply.py", "telemetry.py", "verify.py",
                   "lockorder.py", "conlint.py", "admission.py",
                   "informer.py", "muxhttp.py", "events.py", "slo.py",
-                  "metricsdb.py")
+                  "metricsdb.py", "maintenance.py")
     nested = _interesting(edges, flat_files)
     probe = "kubeapply.py:Client._ssa_probe_lock"
     unexpected = {e: s for e, s in nested.items() if e[0] != probe}
@@ -307,6 +314,41 @@ def test_admission_lock_stays_leaf_only():
                 if "admission.py" in e[0]}
     assert outgoing == {}, \
         f"admission lock held across another acquisition: {outgoing}"
+
+
+def test_maintenance_lock_stays_leaf_only():
+    """The maintenance controller's lock discipline (ISSUE 18): wave
+    state under ``_lock``, every node PATCH / state publish / Event
+    emission outside it — so the maintenance lock contributes ZERO
+    outgoing edges to the process graph. (The soak pin's flat_files
+    names maintenance.py too; this drives a full cordon -> drain ->
+    upgrade -> uncordon wave explicitly so the edge set is populated
+    even when run alone.)"""
+    monitor = lockorder.installed()
+    if monitor is None:
+        pytest.skip("lock-order monitor disabled (TPU_LOCKORDER=0)")
+    from tpu_cluster import admission, maintenance
+    tel = telemetry.Telemetry()
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url, telemetry=tel)
+        for n in ("lk-m-a", "lk-m-b"):
+            client.apply(admission.node_manifest(n, "v5e-8"))
+        plan = maintenance.plan_waves(
+            [admission.HostCapacity(n, "v5e-8", 8, True)
+             for n in ("lk-m-a", "lk-m-b")], "v9", group_size=1)
+        ctrl = maintenance.MaintenanceController(client, "tpu-system",
+                                                 plan=plan, telemetry=tel)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if ctrl.step().complete:
+                break
+        assert ctrl.state_snapshot().complete
+        client.close()
+    edges = monitor.snapshot_edges()
+    outgoing = {e: s for e, s in edges.items()
+                if "maintenance.py" in e[0]}
+    assert outgoing == {}, \
+        f"maintenance lock held across another acquisition: {outgoing}"
 
 
 def test_event_recorder_lock_stays_leaf_only():

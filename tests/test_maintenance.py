@@ -1,0 +1,542 @@
+"""Rolling-maintenance scenario suite (ISSUE 18).
+
+The robustness pin this PR exists for: a fleet-wide cordon/drain/
+upgrade wave under the standard chaos script, with a node failing
+mid-drain (the two drain reasons compose) and the controller replaced
+mid-wave (the SIGKILL/`--once` resume shape) — and at EVERY observation
+the kubelet seat check admits zero partial gangs, the wave converges,
+and the gang disruption budget is never exceeded. Plus the declarative
+layer's units (wave planning, state round-trip, budget math, the
+version-label twin pin) and the `tpuctl maintain` / `tpuctl queue`
+surfaces.
+"""
+
+import json
+import time
+
+import pytest
+
+from fake_apiserver import (FLEET_VERSION_LABEL, FakeApiServer,
+                            fleet_store, soak_seconds,
+                            standard_fault_script)
+from tpu_cluster import admission, kubeapply, maintenance, telemetry
+from tpu_cluster import events as eventsmod
+
+NS = "tpu-system"
+FAST_RETRY = kubeapply.RetryPolicy(attempts=8, base_s=0.02, cap_s=0.3)
+
+STATE_PATH = (f"/api/v1/namespaces/{NS}/configmaps/"
+              f"{maintenance.MAINTENANCE_CONFIGMAP}")
+
+
+def seed_hosts(client, names, accelerator="v5e-8"):
+    for n in names:
+        client.apply(admission.node_manifest(n, accelerator))
+
+
+def submit_gang(client, gang, accelerator="v5e-16", priority=0):
+    client.apply(admission.gang_job_manifest(gang, accelerator, NS,
+                                             priority=priority))
+
+
+def published_table(api):
+    cm = api.get(f"/api/v1/namespaces/{NS}/configmaps/"
+                 f"{admission.RESERVATION_CONFIGMAP}")
+    if cm is None:
+        return None
+    raw = (cm.get("data") or {}).get(admission.RESERVATION_KEY) or ""
+    return admission.parse_table(json.loads(raw))
+
+
+def seat_check(table, hosts_chips):
+    """The kubelet seat check from test_admission.py: how many partial
+    device sets would the enforcement accept (must be 0, always)."""
+    partial = 0
+    for host, chips in hosts_chips.items():
+        full = list(range(chips))
+        for k in range(1, chips):
+            ok, _ = admission.check_allocation(table, host, full[:k])
+            if ok:
+                partial += 1
+    return partial
+
+
+def wave_events(api):
+    out = []
+    for p in sorted(api.paths("/events/")):
+        e = api.get(p)
+        if e and eventsmod.event_matches(
+                e, f"ConfigMap/{maintenance.MAINTENANCE_CONFIGMAP}"):
+            out.append(e)
+    return out
+
+
+# ------------------------------------------------------------------ units
+
+
+def test_plan_waves_groups_by_accelerator_and_chunks():
+    hosts = ([admission.HostCapacity(f"e-{i}", "v5e-8", 8, True)
+              for i in range(3)]
+             + [admission.HostCapacity(f"p-{i}", "v5p-8", 4, True)
+                for i in range(2)])
+    plan = maintenance.plan_waves(hosts, "v9", group_size=2)
+    # groups never mix accelerator types: the v5e remainder (1 host)
+    # closes its own group before the v5p hosts start
+    assert [(g.name, g.hosts) for g in plan.groups] == [
+        ("g/0", ("e-0", "e-1")),
+        ("g/1", ("e-2",)),
+        ("g/2", ("p-0", "p-1")),
+    ]
+    with pytest.raises(ValueError):
+        maintenance.plan_waves(hosts, "v9", group_size=0)
+
+
+def test_wave_order_sorts_numeric_suffixes():
+    # "g/2" upgrades before "g/10" — the wave order is numeric, not
+    # lexicographic (a 12-group plan must not run 0,1,10,11,2,...)
+    names = [f"g/{i}" for i in (10, 2, 0, 11)]
+    assert sorted(names, key=maintenance._group_key) == \
+        ["g/0", "g/2", "g/10", "g/11"]
+
+
+def test_state_document_round_trips_canonically():
+    plan = maintenance.plan_waves(
+        [admission.HostCapacity(f"h-{i}", "v5e-8", 8, True)
+         for i in range(4)], "v9", group_size=2,
+        budget=maintenance.GangDisruptionBudget(2, 1))
+    state = maintenance.state_from_plan(plan)
+    state.groups["g/0"].phase = maintenance.PHASE_DRAINED
+    state.groups["g/0"].draining = {"train": "v5e-16"}
+    doc = maintenance.build_state(state)
+    back = maintenance.parse_state(json.loads(json.dumps(doc)))
+    assert maintenance.build_state(back) == doc
+    assert back.budget == maintenance.GangDisruptionBudget(2, 1)
+    assert back.groups["g/0"].draining == {"train": "v5e-16"}
+    # the draining key is omitted when empty (canonical form)
+    assert "draining" not in doc["groups"]["g/1"]
+
+
+def test_parse_state_fails_closed():
+    good = maintenance.build_state(maintenance.state_from_plan(
+        maintenance.plan_waves(
+            [admission.HostCapacity("h-0", "v5e-8", 8, True)], "v9")))
+    for mutate, needle in (
+            (lambda d: d.update(version=2), "version"),
+            (lambda d: d.update(groups="nope"), "groups"),
+            (lambda d: d["groups"]["g/0"].update(phase="zombie"),
+             "phase"),
+            (lambda d: d["groups"]["g/0"].update(hosts=[1]), "hosts"),
+            (lambda d: d["groups"]["g/0"].update(draining="x"),
+             "draining"),
+            (lambda d: d.update(budget="x"), "budget"),
+    ):
+        doc = json.loads(json.dumps(good))
+        mutate(doc)
+        with pytest.raises(ValueError, match=needle):
+            maintenance.parse_state(doc)
+
+
+def test_version_label_and_contract_twin_pins():
+    """The simulated-upgrade label is the SAME string the fake
+    apiserver's kubelet hook rewrites, and the wave-state ConfigMap
+    contract stays greppable (the reservation-table discipline)."""
+    assert maintenance.VERSION_LABEL == FLEET_VERSION_LABEL
+    assert maintenance.MAINTENANCE_CONFIGMAP == "tpu-maintenance-state"
+    assert maintenance.MAINTENANCE_KEY == "state.json"
+    assert admission.MAINTENANCE_ANNOTATION == "tpu-stack.dev/maintenance"
+
+
+# ------------------------------------------------------------ small waves
+
+
+def _drive(adm, mctrl, api, hosts_chips, until, deadline=30.0):
+    """Alternate admission + maintenance passes until ``until(result)``
+    or the deadline; assert zero partial seats at every observation."""
+    end = time.monotonic() + deadline
+    while time.monotonic() < end:
+        try:
+            adm.step()
+            result = mctrl.step()
+        except kubeapply.ApplyError:
+            continue
+        table = published_table(api)
+        if table is not None:
+            assert seat_check(table, hosts_chips) == 0
+        if until(result):
+            return result
+        time.sleep(0.01)
+    raise AssertionError("wave never reached the expected state")
+
+
+def test_wave_rolls_cordon_drain_upgrade_uncordon_and_converges():
+    """The happy-path wave on 4 hosts / 2 groups with one resident
+    gang: every phase transition lands (in order, with its Event), the
+    resident gang drains WHOLE and re-admits, nodes end uncordoned on
+    the target version, and the metrics families tell the same story."""
+    hosts = [f"node-{c}" for c in "abcd"]
+    hosts_chips = {h: 8 for h in hosts}
+    tel = telemetry.Telemetry()
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY,
+                                  telemetry=tel)
+        seed_hosts(client, hosts)
+        submit_gang(client, "train")
+        rec = eventsmod.EventRecorder(client, component="tpu-maintenance",
+                                      telemetry=tel)
+        adm = admission.AdmissionController(client, NS)
+        assert "train" in adm.step().admitted
+        plan = maintenance.plan_from_cluster(client, "v9", group_size=2)
+        assert [g.name for g in plan.groups] == ["g/0", "g/1"]
+        mctrl = maintenance.MaintenanceController(
+            client, NS, plan=plan, telemetry=tel, events=rec)
+        result = _drive(adm, mctrl, api, hosts_chips,
+                        lambda r: r.complete)
+        assert result.wave_completed or result.complete
+        # the fleet converged: uncordoned, annotation cleared, upgraded
+        for h in hosts:
+            node = api.get(f"/api/v1/nodes/{h}")
+            assert not (node.get("spec") or {}).get("unschedulable"), h
+            anns = node["metadata"].get("annotations") or {}
+            assert admission.MAINTENANCE_ANNOTATION not in anns, h
+            assert node["metadata"]["labels"][
+                maintenance.VERSION_LABEL] == "v9"
+        # the gang survived the wave whole (re-admitted, never partial)
+        assert "train" in adm.step().admitted
+        evs = wave_events(api)
+        client.close()
+    # one Event per transition, none duplicated by later passes
+    assert all(e["count"] == 1 for e in evs), evs
+    reasons = [e["reason"] for e in evs]
+    assert reasons.count(maintenance.EVENT_WAVE_COMPLETE) == 1
+    assert reasons[-1] == maintenance.EVENT_WAVE_COMPLETE
+    for group in ("g/0", "g/1"):
+        seq = [e["reason"] for e in evs if group in e["message"]]
+        assert seq == [maintenance.EVENT_CORDON_STARTED,
+                       maintenance.EVENT_GANG_DRAINED,
+                       maintenance.EVENT_UPGRADE_APPLIED,
+                       maintenance.EVENT_UNCORDONED], (group, seq)
+    # the CordonStarted for the gang's group NAMES the drained gang
+    started = [e for e in evs
+               if e["reason"] == maintenance.EVENT_CORDON_STARTED
+               and "train" in e["message"]]
+    assert len(started) >= 1
+    text = tel.metrics.render()
+    assert 'tpu_maintenance_transitions_total{phase="cordoned"}' in text
+    assert 'tpu_maintenance_transitions_total{phase="done"}' in text
+    assert "tpu_maintenance_waves_total 1" in text
+    assert "tpu_maintenance_group_seconds" in text
+
+
+def test_budget_holds_next_group_until_drained_gang_readmits():
+    """The GangDisruptionBudget pin: with max_drained_gangs=1 and two
+    1-host gangs on separate groups, the second group does not start
+    while the first group's gang is still on the books — and the
+    audit counter proves concurrency never exceeded the budget."""
+    hosts = [f"node-{i}" for i in range(4)]
+    hosts_chips = {h: 8 for h in hosts}
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY)
+        seed_hosts(client, hosts)
+        submit_gang(client, "one", accelerator="v5e-8")
+        submit_gang(client, "two", accelerator="v5e-8")
+        adm = admission.AdmissionController(client, NS)
+        assert sorted(adm.step().admitted) == ["one", "two"]
+        plan = maintenance.plan_from_cluster(
+            client, "v9", group_size=1,
+            budget=maintenance.GangDisruptionBudget(
+                max_drained_gangs=1))
+        mctrl = maintenance.MaintenanceController(client, NS, plan=plan)
+        # pass 1: g/0 cordons (draining its resident gang); g/1 holds
+        first = mctrl.step()
+        assert ("g/0", maintenance.PHASE_CORDONED) in first.transitions
+        assert first.blocked_on == "g/1"
+        assert first.draining == 1
+        result = _drive(adm, mctrl, api, hosts_chips,
+                        lambda r: r.complete)
+        assert result.complete
+        assert mctrl.max_concurrent_drains <= 1
+        assert sorted(adm.step().admitted) == ["one", "two"]
+        client.close()
+
+
+def test_min_available_groups_floor_serialises_the_wave():
+    """min_available_groups=1 over two empty groups: only one group may
+    be disrupted at a time even with no gangs anywhere."""
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY)
+        seed_hosts(client, ("node-a", "node-b"))
+        plan = maintenance.plan_from_cluster(
+            client, "v9", group_size=1,
+            budget=maintenance.GangDisruptionBudget(
+                max_drained_gangs=1, min_available_groups=1))
+        mctrl = maintenance.MaintenanceController(client, NS, plan=plan)
+        first = mctrl.step()
+        assert first.phases[maintenance.PHASE_CORDONED] == 1
+        assert first.blocked_on == "g/1"
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            result = mctrl.step()
+            # the floor holds at every observation: at most one group
+            # away from schedulable
+            active = sum(result.phases.get(p, 0)
+                         for p in (maintenance.PHASE_CORDONED,
+                                   maintenance.PHASE_DRAINED,
+                                   maintenance.PHASE_UPGRADED))
+            assert active <= 1, result.phases
+            if result.complete:
+                break
+        assert result.complete
+        client.close()
+
+
+# -------------------------------------------------- restart / bootstrap
+
+
+def test_fresh_process_resume_mid_wave_without_redraining():
+    """The SIGKILL pin: every pass a FRESH MaintenanceController (the
+    `tpuctl maintain run --once` shape). Wave state recovers from the
+    ConfigMap, finished groups stay finished — each group cordons
+    exactly once across the whole wave — and the wave converges."""
+    hosts = [f"node-{c}" for c in "abcd"]
+    hosts_chips = {h: 8 for h in hosts}
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY)
+        seed_hosts(client, hosts)
+        submit_gang(client, "train")
+        adm = admission.AdmissionController(client, NS)
+        assert "train" in adm.step().admitted
+        plan = maintenance.plan_from_cluster(client, "v9", group_size=2)
+
+        def fresh_pass():
+            rec = eventsmod.EventRecorder(client,
+                                          component="tpu-maintenance")
+            return maintenance.MaintenanceController(
+                client, NS, plan=plan, events=rec).step()
+
+        deadline = time.monotonic() + 30
+        result = fresh_pass()
+        while time.monotonic() < deadline and not result.complete:
+            adm.step()
+            result = fresh_pass()
+            table = published_table(api)
+            if table is not None:
+                assert seat_check(table, hosts_chips) == 0
+        assert result.complete, "fresh-process wave never converged"
+        # a recovered controller re-derives nothing it already did:
+        # every wave event landed exactly once
+        evs = wave_events(api)
+        assert all(e["count"] == 1 for e in evs), evs
+        assert [e["reason"] for e in evs].count(
+            maintenance.EVENT_CORDON_STARTED) == 2  # one per group
+        # and a steady-state pass by yet another fresh controller
+        # publishes nothing and transitions nothing
+        quiet = fresh_pass()
+        assert quiet.transitions == [] and not quiet.published
+        assert "train" in adm.step().admitted
+        client.close()
+
+
+def test_unparseable_state_recovers_from_plan_and_republishes():
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY)
+        seed_hosts(client, ("node-a",))
+        client.apply({"apiVersion": "v1", "kind": "ConfigMap",
+                      "metadata": {"name":
+                                   maintenance.MAINTENANCE_CONFIGMAP,
+                                   "namespace": NS},
+                      "data": {maintenance.MAINTENANCE_KEY: "not json"}})
+        plan = maintenance.plan_from_cluster(client, "v9")
+        mctrl = maintenance.MaintenanceController(client, NS, plan=plan)
+        result = mctrl.step()
+        assert result.published, "corrupt state was not repaired"
+        doc = json.loads(api.get(STATE_PATH)["data"][
+            maintenance.MAINTENANCE_KEY])
+        assert maintenance.parse_state(doc).target == "v9"
+        client.close()
+
+
+def test_controller_without_plan_or_state_refuses_to_guess():
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY)
+        mctrl = maintenance.MaintenanceController(client, NS)
+        with pytest.raises(kubeapply.ApplyError, match="no wave plan"):
+            mctrl.step()
+        client.close()
+
+
+def test_resume_without_plan_adopts_published_state():
+    """`tpuctl maintain run` with no --target resumes whatever wave the
+    predecessor published — the crash-restart CLI contract."""
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY)
+        seed_hosts(client, ("node-a", "node-b"))
+        plan = maintenance.plan_from_cluster(client, "v9", group_size=1)
+        maintenance.MaintenanceController(client, NS, plan=plan).step()
+        resumed = maintenance.MaintenanceController(client, NS)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if resumed.step().complete:
+                break
+        snap = resumed.state_snapshot()
+        assert snap is not None and snap.complete
+        assert snap.target == "v9"
+        client.close()
+
+
+# ------------------------------------------------------- the chaos soak
+
+
+def _soak(num_nodes, group_size, deadline_s):
+    """Fleet rolling upgrade under standard chaos + a mid-drain node
+    failure + a controller replacement mid-wave: the acceptance soak."""
+    store = fleet_store(num_nodes, pods_per_node=0)
+    hosts_chips = {f"fleet-{i:04d}": 8 for i in range(num_nodes)}
+    chaos = standard_fault_script(0.03) + [
+        # a host of the FIRST wave group fails mid-drain and recovers:
+        # the failure-drain and maintenance-drain paths compose
+        {"node_not_ready": "fleet-0000", "at": 0.6},
+        {"node_ready": "fleet-0000", "at": 1.2},
+    ]
+    tel = telemetry.Telemetry()
+    with FakeApiServer(auto_ready=True, store=store, chaos=chaos) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY,
+                                  telemetry=tel)
+        submit_gang(client, "soak-a")
+        submit_gang(client, "soak-b")
+        adm = admission.AdmissionController(client, NS)
+        rec = eventsmod.EventRecorder(client, component="tpu-maintenance",
+                                      telemetry=tel, spam_burst=200)
+        plan = maintenance.plan_from_cluster(
+            client, "v9", group_size=group_size,
+            budget=maintenance.GangDisruptionBudget(
+                max_drained_gangs=2, min_available_groups=1))
+        mctrl = maintenance.MaintenanceController(
+            client, NS, plan=plan, telemetry=tel, events=rec)
+        partials = 0
+        max_drains = 0
+        replaced = False
+        complete = False
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            try:
+                adm.step()
+                result = mctrl.step()
+            except kubeapply.ApplyError:
+                continue  # chaos outlasted the retry budget this pass
+            max_drains = max(max_drains, mctrl.max_concurrent_drains)
+            table = published_table(api)
+            if table is not None:
+                partials += seat_check(table, hosts_chips)
+            if not replaced and result.phases.get(
+                    maintenance.PHASE_DONE, 0) >= 1:
+                # SIGKILL mid-wave: drop the controller, start a fresh
+                # one that must resume from the published state
+                mctrl = maintenance.MaintenanceController(
+                    client, NS, plan=plan, telemetry=tel, events=rec)
+                replaced = True
+            if result.complete:
+                complete = True
+                break
+        assert complete, "the rolling wave never converged under chaos"
+        assert partials == 0, \
+            f"{partials} partial gang seat(s) observed during the wave"
+        assert replaced, "the mid-wave controller swap never happened"
+        max_drains = max(max_drains, mctrl.max_concurrent_drains)
+        assert max_drains <= 2, \
+            f"budget exceeded: {max_drains} concurrent drained gangs"
+        # converged fleet: every node uncordoned on the target version
+        for h in hosts_chips:
+            node = api.get(f"/api/v1/nodes/{h}")
+            assert not (node.get("spec") or {}).get("unschedulable"), h
+            assert node["metadata"]["labels"][
+                maintenance.VERSION_LABEL] == "v9"
+        # bounded bystander/victim re-admission: both gangs seated again
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                if sorted(adm.step().admitted) == ["soak-a", "soak-b"]:
+                    break
+            except kubeapply.ApplyError:
+                continue
+        assert sorted(adm.step().admitted) == ["soak-a", "soak-b"]
+        evs = wave_events(api)
+        assert [e["reason"] for e in evs].count(
+            maintenance.EVENT_WAVE_COMPLETE) == 1
+        # the chaos node faults were really injected and counted
+        fired = {k for k, _m, _p in api.chaos.fired_snapshot()}
+        assert {"node_not_ready", "node_ready"} <= fired
+        text = api.fake_metrics_text()
+        assert 'fake_apiserver_chaos_faults_total{kind="node_not_ready"}' \
+            in text
+        client.close()
+
+
+def test_fleet_rolling_upgrade_survives_chaos_soak():
+    """The ISSUE 18 acceptance soak, tier-1 sized: 24 hosts / 3 wave
+    groups under the standard fault script, a mid-drain NotReady, and a
+    mid-wave controller replacement. TPU_SOAK_SECONDS stretches the
+    budget for long runs."""
+    _soak(num_nodes=24, group_size=8, deadline_s=soak_seconds(60.0))
+
+
+@pytest.mark.slow
+def test_fleet_rolling_upgrade_chaos_soak_at_fleet_scale():
+    """The full-fat acceptance soak (`-m slow` / TPU_SOAK_SECONDS): the
+    1000-node fleet fake, 8 wave groups — hours of wall allowed, same
+    pins: zero partials, convergence, budget held."""
+    _soak(num_nodes=1000, group_size=125,
+          deadline_s=soak_seconds(600.0))
+
+
+# ----------------------------------------------------------------- CLI
+
+
+def _run_cli(argv):
+    from tpu_cluster.__main__ import build_parser
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+def test_maintain_cli_plan_run_status_and_queue_cordons(capsys):
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        seed_hosts(client, ("node-a", "node-b"))
+        conn = ["--apiserver", api.url, "--namespace", NS]
+        # status before any wave: rc 1, says so
+        assert _run_cli(["maintain", "status"] + conn) == 1
+        assert "no maintenance wave state" in capsys.readouterr().out
+        # plan is read-only
+        assert _run_cli(["maintain", "plan", "--target", "v9",
+                         "--group-size", "2"] + conn) == 0
+        out = capsys.readouterr().out
+        assert "target version: v9" in out
+        assert "g/0: 2 host(s)" in out
+        assert api.get(STATE_PATH) is None
+        # run --once repeatedly: the fresh-process wave (each pass is
+        # its own controller, resuming the ConfigMap state)
+        assert _run_cli(["maintain", "run", "--once", "--target", "v9",
+                         "--group-size", "2"] + conn) == 0
+        assert "maintenance:" in capsys.readouterr().out
+        for _ in range(10):
+            # --target omitted: resume the published wave
+            assert _run_cli(["maintain", "run", "--once"] + conn) == 0
+            capsys.readouterr()
+            state = maintenance.fetch_state(client, NS)
+            if state is not None and state.complete:
+                break
+        assert maintenance.fetch_state(client, NS).complete
+        assert _run_cli(["maintain", "status"] + conn) == 0
+        out = capsys.readouterr().out
+        assert "complete: yes" in out and "done" in out
+        # `tpuctl queue` surfaces cordon state while a host is held
+        client.patch_merge("/api/v1/nodes/node-a", {
+            "spec": {"unschedulable": True},
+            "metadata": {"annotations": {
+                admission.MAINTENANCE_ANNOTATION: "g/5"}}})
+        assert _run_cli(["queue"] + conn) == 0
+        out = capsys.readouterr().out
+        assert "cordoned for maintenance" in out
+        assert "group g/5" in out and "node-a" in out
+        # the not-found contract holds (rc 1, no cordon footer noise)
+        assert _run_cli(["queue", "nosuch"] + conn) == 1
+        client.close()

@@ -28,7 +28,14 @@ with rendered artifacts and an ordered, readiness-gated apply:
            host failure, reservation table published for the device
            plugin's Allocate enforcement
   queue    list/describe the gang queue (admitted, queued, preempted —
-           with reasons and reserved hosts)
+           with reasons and reserved hosts, plus the cordoned host
+           groups a queued gang may be waiting on)
+  maintain rolling maintenance orchestration (ROADMAP item: robustness):
+           plan cordon/drain/upgrade waves over host groups, drive them
+           under a gang disruption budget (whole-gang drains, never
+           partial), health-gate the uncordon — crash-restartable via
+           wave state persisted in a ConfigMap (`maintain run --once`
+           resumes mid-wave after a SIGKILL)
   events   list or stream (--follow) the Kubernetes Events the stack's
            controllers record (Admitted/Preempted/Drained/ReAdmitted,
            Retrying/RetryExhausted, HedgeFired, WatchResumed ...),
@@ -69,6 +76,7 @@ import yaml
 
 from . import (admission as admissionmod, conlint as conlintmod,
                events as eventsmod, kubeapply, lint as lintmod,
+               maintenance as maintenancemod,
                metricsdb as metricsdbmod, slo as slomod,
                spec as specmod, telemetry, triage, verify)
 from .render import jobs, kubeadm, manifests, nodeprep, operator_bundle
@@ -458,6 +466,7 @@ def cmd_queue(args) -> int:
     assert client is not None
     try:
         views = admissionmod.fetch_queue(client, ns)
+        cordoned = admissionmod.fetch_cordoned(client)
     finally:
         client.close()
     if args.gang:
@@ -472,9 +481,17 @@ def cmd_queue(args) -> int:
     if args.json:
         import dataclasses
         print(json.dumps({"namespace": ns,
-                          "gangs": [dataclasses.asdict(v) for v in views]}))
+                          "gangs": [dataclasses.asdict(v) for v in views],
+                          "cordoned": [{"host": h, "group": g}
+                                       for h, g in cordoned]}))
         return 0
     print(admissionmod.format_queue(views))
+    # cordon state rides the queue listing (ISSUE 18): a queued gang's
+    # "waiting on cordoned host group" reason should be resolvable from
+    # the same screen
+    block = admissionmod.format_cordoned(cordoned)
+    if block:
+        print(block)
     return 0
 
 
@@ -585,6 +602,88 @@ def cmd_admission(args) -> int:
             except OSError as exc:
                 print(f"admission: cannot write metrics: {exc}",
                       file=sys.stderr)
+    return rc
+
+
+def cmd_maintain(args) -> int:
+    """Rolling maintenance orchestration (cordon/drain/upgrade waves):
+    `plan` renders the wave groups a live fleet would get, `status`
+    reads the published wave state, `run` drives the crash-restartable
+    controller (--once for a single CI/scripting pass)."""
+    if not args.apiserver:
+        print("maintain: --apiserver URL required (maintenance acts on "
+              "the cluster)", file=sys.stderr)
+        return 2
+    spec = _load_spec(args.spec)
+    ns = args.namespace or spec.tpu.namespace
+    client = _rest_client(args)
+    assert client is not None
+    rc = 0
+    try:
+        if args.maintain_cmd == "plan":
+            plan = maintenancemod.plan_from_cluster(
+                client, args.target, group_size=args.group_size,
+                budget=maintenancemod.GangDisruptionBudget(
+                    max_drained_gangs=args.budget,
+                    min_available_groups=args.min_available))
+            print(maintenancemod.format_plan(plan))
+        elif args.maintain_cmd == "status":
+            state = maintenancemod.fetch_state(client, ns)
+            print(maintenancemod.format_status(state))
+            if state is None:
+                rc = 1  # the not-found contract, queue-style
+        else:  # run
+            plan = None
+            if args.target:
+                plan = maintenancemod.plan_from_cluster(
+                    client, args.target, group_size=args.group_size,
+                    budget=maintenancemod.GangDisruptionBudget(
+                        max_drained_gangs=args.budget,
+                        min_available_groups=args.min_available))
+            # the recorder needs a Telemetry for the traceparent stamp
+            # (same reasoning as cmd_admission); spans stay unretained —
+            # the forever loop must not grow a pass tree per pass
+            tel = telemetry.Telemetry(retain_spans=False)
+            client.telemetry = tel
+            recorder = (eventsmod.EventRecorder(
+                client, component="tpu-maintenance", telemetry=tel)
+                if args.events else None)
+            ctrl = maintenancemod.MaintenanceController(
+                client, ns, plan=plan, telemetry=tel, events=recorder)
+            if args.once:
+                print(ctrl.step().line())
+            else:
+                print(f"maintain: driving wave in namespace {ns} every "
+                      f"{args.interval:g}s until complete (ctrl-c to "
+                      "stop)")
+                last = ""
+                while True:
+                    try:
+                        result = ctrl.step()
+                    except kubeapply.ApplyError as exc:
+                        # phases persist and desired node state is
+                        # recomputed each pass — the loop is the outer
+                        # retry, nothing is lost
+                        print(f"maintain: pass failed ({exc}); retrying",
+                              file=sys.stderr)
+                    else:
+                        if (result.transitions or result.wave_completed
+                                or result.blocked_on):
+                            line = result.line()
+                            if line != last:  # a held budget repeats
+                                print(line)
+                            last = line
+                        if result.complete:
+                            print("maintain: wave complete")
+                            break
+                    time.sleep(args.interval)
+    except KeyboardInterrupt:
+        print("maintain: stopped")
+    except kubeapply.ApplyError as exc:
+        print(f"maintain: {exc}", file=sys.stderr)
+        rc = 1
+    finally:
+        client.close()
     return rc
 
 
@@ -1261,6 +1360,64 @@ def build_parser() -> argparse.ArgumentParser:
                         "--live; fail-open on bind conflict (warn, "
                         "continue); 0 (default) = off")
     p.set_defaults(fn=cmd_admission)
+
+    p = sub.add_parser(
+        "maintain", help="rolling maintenance orchestration: cordon/"
+                         "drain/upgrade the fleet in wave groups under "
+                         "a gang disruption budget, crash-restartable "
+                         "(wave state persists in a ConfigMap)")
+    msub = p.add_subparsers(dest="maintain_cmd", required=True)
+
+    def _maintain_common(mp, with_plan: bool) -> None:
+        mp.add_argument("--namespace", default="",
+                        help="namespace of the wave-state/reservation "
+                             "ConfigMaps and gang Jobs (default: the "
+                             "spec's TPU namespace)")
+        if with_plan:
+            mp.add_argument("--group-size", type=int, default=1,
+                            help="hosts per wave group (groups never "
+                                 "mix accelerator types; default 1)")
+            mp.add_argument("--budget", type=int, default=1,
+                            help="max concurrently-drained gangs per "
+                                 "accelerator type (default 1)")
+            mp.add_argument("--min-available", type=int, default=0,
+                            help="floor of host groups left fully "
+                                 "schedulable per accelerator type "
+                                 "(default 0)")
+        mp.set_defaults(fn=cmd_maintain)
+
+    mp = msub.add_parser(
+        "plan", help="render the wave groups the live fleet would get "
+                     "(no writes)", parents=[conn])
+    mp.add_argument("--target", required=True,
+                    help="stack version the wave upgrades to")
+    _maintain_common(mp, with_plan=True)
+
+    mp = msub.add_parser(
+        "status", help="read the published wave state (exit 1 when no "
+                       "wave was ever run)", parents=[conn])
+    _maintain_common(mp, with_plan=False)
+
+    mp = msub.add_parser(
+        "run", help="drive the wave: cordon -> drain -> upgrade -> "
+                    "health-gated uncordon per group, budget-gated; "
+                    "resumes the published state when --target is "
+                    "omitted", parents=[conn])
+    mp.add_argument("--target", default="",
+                    help="stack version to upgrade to (starts a fresh "
+                         "plan; omit to resume the published wave)")
+    mp.add_argument("--once", action="store_true",
+                    help="one maintenance pass, print the summary, exit "
+                         "(CI/scripting + crash-restart mode)")
+    mp.add_argument("--interval", type=float, default=1.0,
+                    help="seconds between passes (default 1)")
+    mp.add_argument("--events", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="post one Event per wave transition "
+                         "(CordonStarted/GangDrained/UpgradeApplied/"
+                         "Uncordoned/WaveComplete) on the state "
+                         "ConfigMap — on by default")
+    _maintain_common(mp, with_plan=True)
 
     p = sub.add_parser(
         "events", help="list or stream (--follow) the Kubernetes Events "

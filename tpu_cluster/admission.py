@@ -43,6 +43,7 @@ process-wide acquisition graph (pinned by tests/test_lockorder.py).
 from __future__ import annotations
 
 import json
+import re
 import threading
 import time
 from dataclasses import dataclass, field
@@ -90,6 +91,16 @@ DRAIN_REASON_PREFIX = "reservation drained"
 
 NODES_PATH = "/api/v1/nodes"
 
+# Maintenance orchestration (ISSUE 18): a cordoned Node carries
+# ``spec.unschedulable: true`` plus this annotation naming its wave
+# group ("g/0"). Both halves of the enforcement honor it — arbitrate()
+# never seats a gang on a cordoned host (stickiness breaks, so resident
+# gangs drain whole), and the published reservation table carries the
+# cordoned-host list so the C++ ``Allocate`` check refuses seats during
+# the drain race window. The queue CLI names the wave group a pending
+# gang is waiting on.
+MAINTENANCE_ANNOTATION = "tpu-stack.dev/maintenance"
+
 # Node label carrying the host's accelerator type (the feature-discovery
 # label set; discovery/labels.py TYPE).
 ACCELERATOR_LABEL = "google.com/tpu.accelerator-type"
@@ -124,6 +135,11 @@ class HostCapacity:
     accelerator: str
     chips: int
     ready: bool
+    # maintenance cordon (ISSUE 18): spec.unschedulable OR the
+    # maintenance annotation; ``maintenance`` carries the annotation
+    # value (the wave-group name) when present, "" otherwise
+    cordoned: bool = False
+    maintenance: str = ""
 
 
 @dataclass(frozen=True)
@@ -182,10 +198,29 @@ class PassResult:
 # tpud::ParseReservations.
 
 
-def build_table(reservations: Mapping[str, Reservation]) -> Dict[str, Any]:
+class ReservationTable(Dict[str, Reservation]):
+    """The parsed ``reservations.json``: gang name -> Reservation, plus
+    the cordoned-host set riding the same document (an ADDITIVE
+    schema-v1 field — tables without it parse with an empty set, so old
+    controllers and new plugins interoperate). ``check_allocation``
+    refuses any seat on a cordoned host, twinned with the C++ side."""
+
+    def __init__(self, gangs: Optional[Mapping[str, Reservation]] = None,
+                 cordoned: Sequence[str] = ()) -> None:
+        super().__init__(gangs or {})
+        self.cordoned: Tuple[str, ...] = tuple(sorted(set(cordoned)))
+
+
+def build_table(reservations: Mapping[str, Reservation],
+                cordoned: Optional[Sequence[str]] = None) -> Dict[str, Any]:
     """The ``reservations.json`` document for a set of admitted gangs —
     canonical form (sorted keys, sorted chip ids) so equal states render
-    byte-identical and the publish path can diff cheaply."""
+    byte-identical and the publish path can diff cheaply. ``cordoned``
+    defaults to the table's own cordon set when ``reservations`` is a
+    :class:`ReservationTable` (round-trip stability); the key is OMITTED
+    when empty, so pre-maintenance documents stay byte-identical."""
+    if cordoned is None:
+        cordoned = getattr(reservations, "cordoned", ())
     gangs: Dict[str, Any] = {}
     for name in sorted(reservations):
         res = reservations[name]
@@ -194,10 +229,15 @@ def build_table(reservations: Mapping[str, Reservation]) -> Dict[str, Any]:
             "priority": res.priority,
             "hosts": {h: sorted(ids) for h, ids in res.hosts},
         }
-    return {"version": RESERVATION_SCHEMA_VERSION, "gangs": gangs}
+    doc: Dict[str, Any] = {"version": RESERVATION_SCHEMA_VERSION,
+                           "gangs": gangs}
+    cords = sorted({str(h) for h in cordoned})
+    if cords:
+        doc["cordoned"] = cords
+    return doc
 
 
-def parse_table(doc: Mapping[str, Any]) -> Dict[str, Reservation]:
+def parse_table(doc: Mapping[str, Any]) -> ReservationTable:
     """Parse a reservation document; raises ``ValueError`` on a wrong
     schema version or malformed entries (the C++ twin fails closed the
     same way)."""
@@ -230,7 +270,18 @@ def parse_table(doc: Mapping[str, Any]) -> Dict[str, Reservation]:
             accelerator=str(entry.get("accelerator", "")),
             priority=int(entry.get("priority", 0)),
             hosts=tuple(hosts))
-    return out
+    cordoned_in = doc.get("cordoned")
+    cords: List[str] = []
+    if cordoned_in is not None:
+        if (not isinstance(cordoned_in, Sequence)
+                or isinstance(cordoned_in, str)):
+            raise ValueError("reservations: 'cordoned' is not an array")
+        for h in cordoned_in:
+            if not isinstance(h, str):
+                raise ValueError(
+                    "reservations: 'cordoned' has a non-string host")
+            cords.append(h)
+    return ReservationTable(out, cordoned=cords)
 
 
 def check_allocation(reservations: Mapping[str, Reservation], host: str,
@@ -244,6 +295,13 @@ def check_allocation(reservations: Mapping[str, Reservation], host: str,
     want = set(device_ids)
     if len(want) != len(device_ids):
         return False, "duplicate device ids in allocation request"
+    # maintenance cordon beats any reservation still naming the host:
+    # during the drain race window (host cordoned, admission pass not
+    # yet landed) the kubelet must not seat a gang the controller is
+    # about to drain. Wording is twin-pinned with reservation.cc.
+    if host in getattr(reservations, "cordoned", ()):
+        return False, (f"host '{host}' is cordoned for maintenance; "
+                       "gangs are not seated on a cordoned host")
     host_reserved = False
     for name in sorted(reservations):
         res = reservations[name]
@@ -289,8 +347,13 @@ def host_capacity(node: Mapping[str, Any]) -> Optional[HostCapacity]:
     for cond in status.get("conditions") or []:
         if isinstance(cond, Mapping) and cond.get("type") == "Ready":
             ready = str(cond.get("status")) == "True"
+    spec = node.get("spec") or {}
+    anns = meta.get("annotations") or {}
+    maintenance = str(anns.get(MAINTENANCE_ANNOTATION) or "")
+    cordoned = bool(spec.get("unschedulable")) or bool(maintenance)
     return HostCapacity(name=str(meta.get("name", "")),
-                        accelerator=str(acc), chips=chips, ready=ready)
+                        accelerator=str(acc), chips=chips, ready=ready,
+                        cordoned=cordoned, maintenance=maintenance)
 
 
 def gang_of_job(job: Mapping[str, Any]) -> Optional[GangRequest]:
@@ -383,13 +446,23 @@ def arbitrate(hosts: Sequence[HostCapacity], gangs: Sequence[GangRequest],
             continue
         eligible = sorted(
             h.name for h in hosts
-            if h.ready and h.name not in taken and _host_matches(h, acc))
+            if h.ready and not h.cordoned and h.name not in taken
+            and _host_matches(h, acc))
         need = acc.num_hosts
         if len(eligible) < need:
-            decisions[g.name] = Decision(
-                STATUS_QUEUED,
+            reason = (
                 f"waiting for {need} x {acc.chips_per_host}-chip host(s) "
                 f"for {acc.name}; {len(eligible)} eligible host(s) free")
+            # name the maintenance wave holding capacity back (ISSUE 18
+            # satellite): a gang pending BECAUSE matching hosts are
+            # cordoned should say so, not just "0 eligible"
+            groups = sorted({h.maintenance or h.name for h in hosts
+                             if h.cordoned and _host_matches(h, acc)})
+            if groups:
+                reason += ("; waiting on cordoned host group "
+                           + ", ".join(groups[:4])
+                           + (" ..." if len(groups) > 4 else ""))
+            decisions[g.name] = Decision(STATUS_QUEUED, reason)
             continue
         prev = previous.get(g.name)
         chosen: List[str]
@@ -411,6 +484,18 @@ def arbitrate(hosts: Sequence[HostCapacity], gangs: Sequence[GangRequest],
             STATUS_ADMITTED,
             f"reserved {need} host group(s): {', '.join(sorted(chosen))}")
     return Arbitration(admitted=admitted, decisions=decisions)
+
+
+def _drain_reason(host: str, cause: str) -> str:
+    """The queued-decision reason for a drained gang. Shares
+    :data:`DRAIN_REASON_PREFIX` across BOTH drain causes (failure and
+    maintenance) so a fresh process's event-memo recovery treats either
+    as Drained; the cause wording differs so the operator (and the
+    ReAdmitted event) can tell them apart."""
+    what = ("cordoned for maintenance" if cause == "cordoned"
+            else "NotReady")
+    return (f"{DRAIN_REASON_PREFIX}: host {host} {what}; "
+            "re-queued for re-admission")
 
 
 # --------------------------------------------------------------------------
@@ -465,6 +550,13 @@ class AdmissionController:
         # apart from Admitted (a gang whose last event was Drained/
         # Preempted comes BACK as ReAdmitted)
         self._events_noted: Dict[str, str] = {}  # guarded-by: _lock
+        # drain-cause memo (ISSUE 18): gang -> (host, cause,
+        # conditions-active-last-pass). Keeps a drained gang's queued
+        # reason on the DRAIN_REASON_PREFIX wording while it waits (so a
+        # FRESH process recovers ReAdmitted-not-Admitted from the
+        # annotation) and tracks the LATEST cause when failure-drain and
+        # maintenance-drain compose on the same host.
+        self._drain_cause: Dict[str, Tuple[str, str, Set[str]]] = {}  # guarded-by: _lock
         self._bootstrapped = False  # guarded-by: _lock
         self.passes = 0  # guarded-by: _lock
 
@@ -641,8 +733,31 @@ class AdmissionController:
                 elif status == STATUS_QUEUED and \
                         reason.startswith(DRAIN_REASON_PREFIX):
                     self._events_noted[name] = EVENT_DRAINED
+                    # recover the drain CAUSE too, so the eventual
+                    # ReAdmitted event still names what blocked the
+                    # gang even across a controller restart
+                    m = re.search(r"host (\S+) (NotReady|cordoned)",
+                                  reason)
+                    if m is not None and name not in self._drain_cause:
+                        cause = ("cordoned" if m.group(2) == "cordoned"
+                                 else "NotReady")
+                        self._drain_cause[name] = (m.group(1), cause,
+                                                   {cause})
                 elif status == STATUS_ADMITTED:
                     self._events_noted[name] = EVENT_ADMITTED
+
+    @staticmethod
+    def _host_conditions(host: Optional[HostCapacity]) -> Set[str]:
+        """The drain-relevant conditions active on one host (empty when
+        the Node is gone from the cluster view)."""
+        active: Set[str] = set()
+        if host is None:
+            return active
+        if not host.ready:
+            active.add("NotReady")
+        if host.cordoned:
+            active.add("cordoned")
+        return active
 
     def _reconcile(self, hosts: Sequence[HostCapacity],
                    gangs: Sequence[GangRequest], now: float
@@ -671,19 +786,31 @@ class AdmissionController:
             live = {g.name for g in gangs}
             previous = dict(self._admitted)
             ready_hosts = {h.name for h in hosts if h.ready}
+            cordoned_hosts = {h.name for h in hosts if h.cordoned}
+            host_by_name = {h.name: h for h in hosts}
             outcome = arbitrate(hosts, gangs, previous, self._first_seen)
             # classify transitions against the previous pass
             for name, prev_res in previous.items():
                 if name in outcome.admitted or name not in live:
                     continue
-                lost = [h for h in prev_res.host_names()
-                        if h not in ready_hosts]
-                if lost:
+                lost_ready = [h for h in prev_res.host_names()
+                              if h not in ready_hosts]
+                lost_cordoned = [h for h in prev_res.host_names()
+                                 if h in cordoned_hosts]
+                if lost_ready or lost_cordoned:
                     result.drained.append(name)
+                    # a dead host outranks a cordoned one as the drain
+                    # cause; the sticky memo below flips to the LATEST
+                    # cause if the other condition arrives afterwards
+                    if lost_ready:
+                        chost, cause = lost_ready[0], "NotReady"
+                    else:
+                        chost, cause = lost_cordoned[0], "cordoned"
+                    self._drain_cause[name] = (
+                        chost, cause, self._host_conditions(
+                            host_by_name.get(chost)))
                     outcome.decisions[name] = Decision(
-                        STATUS_QUEUED,
-                        f"{DRAIN_REASON_PREFIX}: host {lost[0]} "
-                        "NotReady; re-queued for re-admission")
+                        STATUS_QUEUED, _drain_reason(chost, cause))
                 else:
                     new_holders = sorted(
                         o.gang for o in outcome.admitted.values()
@@ -696,6 +823,30 @@ class AdmissionController:
                             STATUS_PREEMPTED,
                             "preempted by higher-priority gang "
                             f"'{new_holders[0]}'")
+            # sticky drain reasons (ISSUE 18): while a drained gang
+            # stays queued on its blocking host, its decision keeps the
+            # DRAIN_REASON_PREFIX wording — and flips to the LATEST
+            # cause when failure-drain and maintenance-drain compose (a
+            # cordoned host dying mid-drain reads NotReady, the fresher
+            # fact; vice versa reads cordoned). A condition is "newer"
+            # when it was absent at the previous observation.
+            for name in list(self._drain_cause):
+                if name not in live:
+                    self._drain_cause.pop(name, None)
+                    continue
+                if name in outcome.admitted or name in result.drained:
+                    continue
+                chost, cause, prev_active = self._drain_cause[name]
+                active = self._host_conditions(host_by_name.get(chost))
+                newly = active - prev_active
+                if newly:
+                    cause = sorted(newly)[0]
+                elif active and cause not in active:
+                    cause = sorted(active)[0]
+                self._drain_cause[name] = (chost, cause, active)
+                if active:
+                    outcome.decisions[name] = Decision(
+                        STATUS_QUEUED, _drain_reason(chost, cause))
             # metric facts are COLLECTED under the lock and emitted after
             # it: the admission lock must stay a leaf (never held across
             # a telemetry-lock acquisition — pinned by test_lockorder)
@@ -721,8 +872,10 @@ class AdmissionController:
             # last write; an empty table is only worth a mutation when a
             # non-empty one was published before (the no-gangs hot path
             # must stay request-free)
-            payload = json.dumps(build_table(outcome.admitted),
-                                 sort_keys=True, separators=(",", ":"))
+            payload = json.dumps(
+                build_table(outcome.admitted,
+                            cordoned=sorted(cordoned_hosts)),
+                sort_keys=True, separators=(",", ":"))
             publish: Optional[str] = None
             if payload != self._last_published and (
                     outcome.admitted or self._last_published is not None):
@@ -769,15 +922,29 @@ class AdmissionController:
                             # name what the gang recovered FROM — the
                             # operator-facing half of the story, and
                             # what keeps back-to-back recoveries from
-                            # aggregating into one counted Event
+                            # aggregating into one counted Event. A
+                            # drain recovery names the LATEST cause the
+                            # memo tracked (maintenance cordon vs
+                            # NotReady — they compose, one recovery).
                             cause = ("drain" if prev == EVENT_DRAINED
                                      else "preemption")
-                            message = (f"re-admitted after {cause}: "
-                                       f"{message}")
+                            detail = ""
+                            if (prev == EVENT_DRAINED
+                                    and name in self._drain_cause):
+                                chost, dcause, _act = \
+                                    self._drain_cause[name]
+                                what = ("maintenance cordon"
+                                        if dcause == "cordoned"
+                                        else "NotReady")
+                                detail = f" (host {chost} {what})"
+                            message = (f"re-admitted after {cause}"
+                                       f"{detail}: {message}")
                         emit.append((name, ev_reason, message, "Normal"))
                 for name in list(self._events_noted):
                     if name not in live:
                         self._events_noted.pop(name, None)
+            for name in result.newly_admitted:
+                self._drain_cause.pop(name, None)
         if tel is not None:
             for accelerator, waited in admit_waits:
                 tel.histogram(
@@ -975,6 +1142,36 @@ def describe_gang(views: Sequence[GangView], name: str) -> str:
         return "\n".join(lines)
     known = ", ".join(sorted(v.name for v in views)) or "none"
     return f"gang {name!r} not found (known: {known})"
+
+
+def fetch_cordoned(client: kubeapply.Client) -> List[Tuple[str, str]]:
+    """Cordoned TPU hosts as ``[(host, wave-group-or-'-')]`` — the
+    maintenance state `tpuctl queue` appends under the gang table so a
+    pending gang's "waiting on cordoned host group" reason has a
+    cluster-side answer."""
+    nodes = client.list_collection(NODES_PATH)
+    out: List[Tuple[str, str]] = []
+    for obj in nodes.values():
+        h = host_capacity(obj)
+        if h is not None and h.cordoned:
+            out.append((h.name, h.maintenance or "-"))
+    return sorted(out)
+
+
+def format_cordoned(cordoned: Sequence[Tuple[str, str]]) -> str:
+    """The cordon footer under `tpuctl queue`: one line per wave group
+    naming its cordoned hosts (empty string when nothing is cordoned)."""
+    if not cordoned:
+        return ""
+    by_group: Dict[str, List[str]] = {}
+    for host, group in cordoned:
+        by_group.setdefault(group, []).append(host)
+    lines = ["cordoned for maintenance:"]
+    for group in sorted(by_group):
+        hosts = sorted(by_group[group])
+        shown = ", ".join(hosts[:6]) + (" ..." if len(hosts) > 6 else "")
+        lines.append(f"  group {group}: {len(hosts)} host(s) — {shown}")
+    return "\n".join(lines)
 
 
 # --------------------------------------------------------------------------

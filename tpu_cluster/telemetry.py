@@ -147,6 +147,17 @@ EVENT_EMIT_FAILURES_TOTAL = "tpuctl_event_emit_failures_total"
 UP = "up"
 SCRAPE_DURATION_SECONDS = "tpuctl_scrape_duration_seconds"
 SCRAPE_SAMPLES_TOTAL = "tpuctl_scrape_samples_total"
+# Rolling maintenance (ISSUE 18): the MaintenanceController's families.
+# TRANSITIONS counts every wave-group phase transition (labeled by the
+# phase entered: cordoned/drained/upgraded/done); WAVES counts completed
+# wave plans; DRAINING_GANGS and CORDONED_HOSTS are the live disruption
+# gauges the budget bounds; GROUP_SECONDS is the cordon→done wall per
+# host group (the per-wave latency the bench column reports).
+MAINTENANCE_TRANSITIONS_TOTAL = "tpu_maintenance_transitions_total"
+MAINTENANCE_WAVES_TOTAL = "tpu_maintenance_waves_total"
+MAINTENANCE_DRAINING_GANGS = "tpu_maintenance_draining_gangs"
+MAINTENANCE_CORDONED_HOSTS = "tpu_maintenance_cordoned_hosts"
+MAINTENANCE_GROUP_SECONDS = "tpu_maintenance_group_seconds"
 
 # Fixed default buckets, request-latency shaped (seconds). Shared with
 # the ready-wait histogram: its tail rides the +Inf bucket.
