@@ -14,4 +14,5 @@ exec "${PYTHON:-python3}" -m mypy --strict \
   tpu_cluster/kubeapply.py tpu_cluster/telemetry.py \
   tpu_cluster/conlint.py tpu_cluster/verify.py tpu_cluster/admission.py \
   tpu_cluster/informer.py tpu_cluster/muxhttp.py tpu_cluster/events.py \
-  tpu_cluster/slo.py tpu_cluster/metricsdb.py tpu_cluster/maintenance.py
+  tpu_cluster/slo.py tpu_cluster/metricsdb.py tpu_cluster/maintenance.py \
+  tpu_cluster/contracts.py tpu_cluster/pinlint.py

@@ -1,0 +1,55 @@
+"""Shared contract-pin assertion helper (ISSUE 19).
+
+The twin-pin tests (test_admission's reservation.cc grep, test_telemetry
+and test_operator's metric/trace-name greps, test_trace_correlation's
+slice names) used to each carry their own escaped-quote-aware regex
+over the C++ sources. They now all go through HERE: select a slice of
+the contract registry by name prefix and run the REAL analyzer
+(pinlint's C++ twin diff + enforcer checks) over just that slice —
+the tests and `tpuctl pinlint --strict` can no longer disagree about
+what "pinned" means, because they share the extractor.
+"""
+
+import os
+from typing import Optional, Sequence, Tuple
+
+from tpu_cluster.conlint import Finding
+from tpu_cluster.contracts import Contract, Registry, build_registry
+from tpu_cluster.pinlint import Auditor
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def registry_slice(prefix: str) -> Tuple[Contract, ...]:
+    """The registered contracts whose name starts with ``prefix``
+    (e.g. ``"metric/tpu_operator_"`` or ``"configmap"``)."""
+    subset = tuple(c for c in build_registry().contracts
+                   if c.name.startswith(prefix))
+    assert subset, f"no contracts registered under {prefix!r}"
+    return subset
+
+
+def pin_findings(prefix: str) -> Sequence[Finding]:
+    """Run the analyzer's twin + enforcer checks over one registry
+    slice. NOTE: a prefix must select WHOLE C++ tables (e.g. all of
+    ``metric/``, never half of OperatorMetricNames' rows) — the table
+    diff is ordered and complete by design."""
+    auditor = Auditor(REPO, registry=Registry(list(registry_slice(prefix))))
+    auditor.check_cpp_twins()
+    auditor.check_enforcers()
+    return auditor.findings
+
+
+def assert_twin_pinned(
+        prefix: str,
+        expect_values: Optional[Sequence[str]] = None) -> None:
+    """The one assertion the migrated tests share: the slice's C++
+    twins and enforcer files agree with the registry (zero findings),
+    and — when given — the registry slice spells exactly the live
+    Python constants, in order (so the registry can't drift from the
+    module it claims to mirror either)."""
+    subset = registry_slice(prefix)
+    findings = pin_findings(prefix)
+    assert not findings, "\n".join(f.text() for f in findings)
+    if expect_values is not None:
+        assert tuple(c.value for c in subset) == tuple(expect_values)

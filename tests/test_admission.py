@@ -27,7 +27,6 @@ from tpu_cluster import spec as specmod
 
 NS = "tpu-system"
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-RESERVATION_CC = os.path.join(REPO, "native", "plugin", "reservation.cc")
 PLUGIN_SELFTEST_CC = os.path.join(REPO, "native", "plugin", "selftest.cc")
 TPUD_CC = os.path.join(REPO, "native", "plugin", "tpud.cc")
 
@@ -397,23 +396,19 @@ def _cc(path):
 
 
 def test_reservation_contract_constants_twin_pinned():
-    """Source-grep half of the RetryableStatus-pattern pin: the C++
-    contract literals in reservation.cc must equal the Python constants
-    (the selftest pins the C++ side compiler-only)."""
-    src = _cc(RESERVATION_CC)
-
-    def grep(fn):
-        m = re.search(fn + r"\(\)\s*\{\s*return\s+\"([^\"]+)\"\s*;", src)
-        assert m, f"{fn}() literal not found in reservation.cc"
-        return m.group(1)
-
-    assert grep("ReservationConfigMapName") == \
-        admission.RESERVATION_CONFIGMAP
-    assert grep("ReservationKey") == admission.RESERVATION_KEY
-    assert grep("GangAnnotation") == admission.GANG_ANNOTATION
-    m = re.search(r"ReservationSchemaVersion\(\)\s*\{\s*return\s+(\d+)\s*;",
-                  src)
-    assert m and int(m.group(1)) == admission.RESERVATION_SCHEMA_VERSION
+    """The reservation.cc contract literals must equal the Python
+    constants (the selftest pins the C++ side compiler-only) — now via
+    the registry slices + pinlint's extractor instead of a local grep."""
+    from pin_helpers import assert_twin_pinned
+    assert_twin_pinned("configmap/tpu-gang-reservations",
+                       expect_values=(admission.RESERVATION_CONFIGMAP,))
+    assert_twin_pinned("configmap-key/reservations.json",
+                       expect_values=(admission.RESERVATION_KEY,))
+    assert_twin_pinned("annotation/gang",
+                       expect_values=(admission.GANG_ANNOTATION,))
+    assert_twin_pinned(
+        "schema-version/reservations",
+        expect_values=(str(admission.RESERVATION_SCHEMA_VERSION),))
     # tpud.cc actually consumes the contract (the enforcement point):
     tpud = _cc(TPUD_CC)
     for needle in ("CheckAllocation", "ParseReservations",

@@ -394,6 +394,97 @@ def test_cl04_not_fired_outside_thread_entry_functions():
 
 
 # ---------------------------------------------------------------------------
+# CL05 — blocking I/O lexically inside a `with <lock>:` body
+
+
+BAD_CL05 = """
+    import threading
+
+    class Publisher:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.generation = 0  # guarded-by: _lock
+
+        def publish(self, client, body):
+            with self._lock:
+                client.patch("/cm", body)
+                self.generation += 1
+    """
+
+GOOD_CL05 = """
+    import threading
+
+    class Publisher:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.generation = 0  # guarded-by: _lock
+
+        def publish(self, client, body):
+            client.patch("/cm", body)
+            with self._lock:
+                self.generation += 1
+    """
+
+
+def test_cl05_fires_on_io_under_lock_and_not_on_hoisted():
+    findings = analyze(BAD_CL05)
+    assert rules(findings) == [conlint.RULE_IO_UNDER_LOCK]
+    assert "self._lock" in findings[0].message
+    assert analyze(GOOD_CL05) == []
+
+
+def test_cl05_covers_file_os_and_subprocess_io():
+    # open()/os.replace()/subprocess.* are wire-or-disk too, and a bare
+    # module-level `with state_lock:` counts as a lock by name
+    findings = analyze("""
+        import os
+        import subprocess
+        import threading
+
+        state_lock = threading.Lock()
+
+        def checkpoint(path, tmp):
+            with state_lock:
+                with open(tmp, "w") as f:
+                    f.write("{}")
+                os.replace(tmp, path)
+                subprocess.check_call(["sync"])
+        """)
+    assert rules(findings) == [conlint.RULE_IO_UNDER_LOCK]
+    assert len(findings) == 3
+
+
+def test_cl05_is_lexical_only():
+    # a function DEFINED under the lock runs later, outside it; and a
+    # non-lock context manager is not a lock however it is used
+    assert analyze("""
+        import threading
+
+        class Sched:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def arm(self, client):
+                with self._lock:
+                    def fire():
+                        client.post("/fire")
+                    self.cb = fire
+
+        def snapshot(tmp_file):
+            with tmp_file:
+                tmp_file.write(b"data")
+        """) == []
+
+
+def test_cl05_ignore_pragma_with_justification():
+    src = BAD_CL05.replace(
+        'client.patch("/cm", body)',
+        'client.patch("/cm", body)  '
+        '# conlint: ignore[CL05]')
+    assert analyze(src) == []
+
+
+# ---------------------------------------------------------------------------
 # parse failures surface instead of passing silently
 
 

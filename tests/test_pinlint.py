@@ -1,0 +1,308 @@
+"""Contract pin analyzer tests (tpu_cluster.pinlint + contracts).
+
+Same three layers as test_conlint.py:
+
+- extractor unit tests (brace-matched C++ accessor bodies, comment and
+  escaped-quote handling, the Python constant harvest);
+- one seeded-drift fixture per rule PL01-PL06: a minimal bad input on
+  which exactly that rule fires, paired with the fixed twin on which
+  nothing fires;
+- the acceptance pins: the repo self-audit is zero findings in strict
+  mode, and a deliberately drifted C++ table entry (mutated in a temp
+  copy of native/, the tree untouched) yields a non-zero exit naming
+  BOTH loci.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+
+from tpu_cluster import pinlint
+from tpu_cluster.contracts import (
+    ALL_KINDS, CHAOS_KINDS, Contract, CppPin, Registry, build_registry,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# the registry itself
+
+
+def test_registry_builds_with_unique_names_and_known_kinds():
+    reg = build_registry()
+    assert len(reg.contracts) >= 90
+    assert len({c.name for c in reg.contracts}) == len(reg.contracts)
+    for c in reg.contracts:
+        assert c.kind in ALL_KINDS, c.name
+        assert c.value, c.name
+        assert c.py_file.endswith(".py"), c.name
+    # the twin tables the C++ operator commits to are registered whole
+    tables = reg.cpp_tables()
+    assert ("native/operator/kubeapi.cc", "OperatorMetricNames") in tables
+    assert ("native/operator/kubeapi.cc",
+            "OperatorTraceEventNames") in tables
+    # and the chaos vocabulary is the fake's dispatch surface
+    assert set(CHAOS_KINDS) == reg.values("chaos-kind")
+
+
+def test_registry_json_dump_round_trips():
+    doc = build_registry().to_json()
+    assert doc["version"] == 1
+    parsed = json.loads(json.dumps(doc))
+    assert len(parsed["contracts"]) == len(build_registry().contracts)
+    sample = next(c for c in parsed["contracts"]
+                  if c["name"] == "configmap/tpu-gang-reservations")
+    assert sample["value"] == "tpu-gang-reservations"
+    assert sample["cpp"]["symbol"] == "ReservationConfigMapName"
+
+
+# ---------------------------------------------------------------------------
+# extractors
+
+
+CPP_FIXTURE = textwrap.dedent("""\
+    #include <string>
+    #include <vector>
+
+    // OperandNames() — not a real table, just a comment trap: "ghost"
+    const std::vector<std::string>& Names() {
+      static const auto* n = new std::vector<std::string>{
+          "alpha",          // first
+          "beta_\\"quoted\\"",  // escaped quote stays one row
+          "gamma",
+      };
+      return *n;
+    }
+
+    const char* Key() { return "state.json"; }
+    int Version() { return 3; }
+    """)
+
+
+def test_cpp_table_extraction_skips_comments_and_unescapes():
+    table = pinlint.cpp_string_table(CPP_FIXTURE, "Names")
+    assert [r.value for r in table] == ["alpha", 'beta_"quoted"', "gamma"]
+    assert [CPP_FIXTURE.split("\n")[r.line - 1] for r in table]
+    assert "ghost" not in [r.value for r in table]
+    assert pinlint.cpp_string_table(CPP_FIXTURE, "NoSuch") is None
+
+
+def test_cpp_literal_extraction_with_lines():
+    key = pinlint.cpp_string_literal(CPP_FIXTURE, "Key")
+    assert key.value == "state.json"
+    assert 'return "state.json"' in CPP_FIXTURE.split("\n")[key.line - 1]
+    assert pinlint.cpp_int_literal(CPP_FIXTURE, "Version").value == "3"
+    assert pinlint.cpp_string_literal(CPP_FIXTURE, "Version") is None
+
+
+def test_python_harvest_finds_contract_shaped_constants_only():
+    got = pinlint.harvest_python_constants(textwrap.dedent("""\
+        SOME_ANNOTATION = "tpu-stack.dev/brand-new"
+        EVENT_THING = "ThingHappened"
+        FAMILIES = ("tpu_operator_new_total", "unrelated word")
+        TIMEOUT = "30s"
+        _PRIVATE_ANNOTATION = "tpu-stack.dev/hidden"
+
+        def wire(reg):
+            reg.counter("tpuctl_fresh_total", "help")
+            reg.counter(name, "not a literal")
+        """), "mod.py")
+    values = {v for _a, v, _l in got}
+    assert values == {"tpu-stack.dev/brand-new", "ThingHappened",
+                      "tpu_operator_new_total", "tpuctl_fresh_total"}
+
+
+def test_py_constant_line_resolves_tuple_rows():
+    src = 'X = 1\nNAMES = (\n    "a",\n    "b",\n)\nKEY = "k"\n'
+    assert pinlint.py_constant_line(src, "NAMES[1]") == 4
+    assert pinlint.py_constant_line(src, "KEY") == 6
+    assert pinlint.py_constant_line(src, "MISSING") == 0
+
+
+# ---------------------------------------------------------------------------
+# per-rule seeded drift (minimal registries over a temp repo)
+
+
+def _mini_repo(tmp_path, files):
+    for rel, text in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(text))
+    return str(tmp_path)
+
+
+def _contract(**kw):
+    base = dict(name="annotation/x", kind="annotation",
+                value="tpu-stack.dev/x", py_file="tpu_cluster/mod.py",
+                py_attr="X_ANNOTATION")
+    base.update(kw)
+    return Contract(**base)
+
+
+PY_DECL = 'X_ANNOTATION = "tpu-stack.dev/x"\n'
+
+
+def test_pl01_mismatched_literal_names_both_loci(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "tpu_cluster/mod.py": PY_DECL,
+        "native/x.cc":
+            'const char* XAnn() { return "tpu-stack.dev/DRIFTED"; }\n',
+    })
+    reg = Registry([_contract(cpp=CppPin("native/x.cc", "XAnn"))])
+    auditor = pinlint.Auditor(root, registry=reg)
+    auditor.check_cpp_twins()
+    assert rules(auditor.findings) == [pinlint.RULE_TWIN_MISMATCH]
+    msg = auditor.findings[0].message
+    assert "tpu_cluster/mod.py:1" in msg and "DRIFTED" in msg
+    assert auditor.findings[0].path == "native/x.cc"
+    # fixed twin: spellings agree -> clean
+    (tmp_path / "native" / "x.cc").write_text(
+        'const char* XAnn() { return "tpu-stack.dev/x"; }\n')
+    clean = pinlint.Auditor(root, registry=reg)
+    clean.check_cpp_twins()
+    assert clean.findings == []
+
+
+def test_pl02_missing_accessor(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "tpu_cluster/mod.py": PY_DECL,
+        "native/x.cc": "// accessor deleted\n",
+    })
+    reg = Registry([_contract(cpp=CppPin("native/x.cc", "XAnn"))])
+    auditor = pinlint.Auditor(root, registry=reg)
+    auditor.check_cpp_twins()
+    assert rules(auditor.findings) == [pinlint.RULE_MISSING_TWIN]
+    assert "XAnn" in auditor.findings[0].message
+
+
+def test_pl03_enforcer_must_contain_value(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "tpu_cluster/mod.py": PY_DECL,
+        "native/selftest.cc": "// nothing pinned here\n",
+    })
+    reg = Registry([_contract(enforcers=("native/selftest.cc",))])
+    auditor = pinlint.Auditor(root, registry=reg)
+    auditor.check_enforcers()
+    assert rules(auditor.findings) == [pinlint.RULE_UNENFORCED]
+    (tmp_path / "native" / "selftest.cc").write_text(
+        'Expect(ann == "tpu-stack.dev/x");\n')
+    clean = pinlint.Auditor(root, registry=reg)
+    clean.check_enforcers()
+    assert clean.findings == []
+
+
+def test_pl04_undeclared_constant_in_package(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "tpu_cluster/mod.py":
+            'X_ANNOTATION = "tpu-stack.dev/x"\n'
+            'NEW_ANNOTATION = "tpu-stack.dev/unregistered"\n',
+    })
+    reg = Registry([_contract()])
+    auditor = pinlint.Auditor(root, registry=reg)
+    auditor.check_python_declarations()
+    assert rules(auditor.findings) == [pinlint.RULE_UNDECLARED]
+    assert "tpu-stack.dev/unregistered" in auditor.findings[0].message
+    assert auditor.findings[0].line == 2
+
+
+def test_pl05_docs_claim_checked(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "tpu_cluster/mod.py": PY_DECL,
+        "docs/GUIDE.md": "# guide\nno mention\n",
+    })
+    reg = Registry([_contract(docs=("GUIDE.md",))])
+    auditor = pinlint.Auditor(root, registry=reg)
+    auditor.check_docs()
+    assert rules(auditor.findings) == [pinlint.RULE_DOC_DRIFT]
+    assert pinlint.RULE_DOC_DRIFT in pinlint.WARN_RULES
+    (tmp_path / "docs" / "GUIDE.md").write_text(
+        "# guide\n`tpu-stack.dev/x` does things\n")
+    clean = pinlint.Auditor(root, registry=reg)
+    clean.check_docs()
+    assert clean.findings == []
+
+
+def test_pl06_ci_greps_must_reference_live_names(tmp_path):
+    root = _mini_repo(tmp_path, {
+        "tpu_cluster/mod.py": PY_DECL,
+        ".github/workflows/ci.yaml":
+            "      - run: |\n"
+            "          grep tpu_operator_gone_total out.txt\n"
+            "          python -c 'from tpu_cluster import telemetry; "
+            "telemetry.NO_SUCH_NAME'\n",
+    })
+    reg = Registry([_contract()])
+    auditor = pinlint.Auditor(root, registry=reg)
+    auditor.check_ci()
+    assert rules(auditor.findings) == [pinlint.RULE_CI_DRIFT]
+    msgs = "\n".join(f.message for f in auditor.findings)
+    assert "tpu_operator_gone_total" in msgs
+    assert "NO_SUCH_NAME" in msgs
+
+
+# ---------------------------------------------------------------------------
+# acceptance pins
+
+
+def test_repo_self_audit_strict_clean():
+    findings = pinlint.audit_repo(REPO)
+    assert findings == [], "\n".join(f.text() for f in findings)
+
+
+def test_drifted_cpp_table_is_caught(tmp_path):
+    """The e2e acceptance pin: mutate ONE row of the operator's metric
+    twin table in a temp copy of native/ and the audit must go red with
+    a PL01 naming both the C++ line and the Python declaration — the
+    tree itself is never touched."""
+    native = tmp_path / "native"
+    shutil.copytree(os.path.join(REPO, "native"), native)
+    kubeapi = native / "operator" / "kubeapi.cc"
+    src = kubeapi.read_text()
+    assert '"tpu_operator_objects"' in src
+    kubeapi.write_text(src.replace('"tpu_operator_objects"',
+                                   '"tpu_operator_objectz"', 1))
+    findings = pinlint.audit_repo(REPO, native_root=str(native))
+    drift = [f for f in findings
+             if f.rule == pinlint.RULE_TWIN_MISMATCH]
+    assert drift, "\n".join(f.text() for f in findings)
+    f = drift[0]
+    assert f.path == "native/operator/kubeapi.cc" and f.line > 0
+    assert "tpu_operator_objectz" in f.message
+    assert "tpu_cluster/telemetry.py:" in f.message
+    # and through the CLI: non-zero even without --strict (PL01 is an
+    # error), with both loci in the rendered finding
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_cluster", "pinlint",
+         "--native-root", str(native)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 1, (proc.stdout, proc.stderr)
+    assert "tpu_operator_objectz" in proc.stderr
+    assert "tpu_cluster/telemetry.py:" in proc.stderr
+
+
+def test_cli_strict_clean_dump_and_json():
+    env = dict(os.environ, PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_cluster", "pinlint", "--strict"],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "clean" in proc.stdout
+    dump = subprocess.run(
+        [sys.executable, "-m", "tpu_cluster", "pinlint", "--dump"],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    doc = json.loads(dump.stdout)
+    assert len(doc["contracts"]) >= 90
+    js = subprocess.run(
+        [sys.executable, "-m", "tpu_cluster", "pinlint",
+         "--format", "json"],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    out = json.loads(js.stdout)
+    assert out["ok"] is True and out["findings"] == []

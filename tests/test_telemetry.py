@@ -340,28 +340,14 @@ def test_fake_metrics_endpoint_by_verb_path_status(spec):
 
 
 def test_operator_metric_names_twin_pins_cpp_source():
-    """The metric-name twin table (RetryableStatus pattern): the families
-    kubeapi::OperatorMetricNames() pins in C++ must equal
-    telemetry.OPERATOR_METRIC_NAMES — source-grep so the pin holds with
-    no compiler — AND every family must be emitted by operator_main.cc's
-    Metrics() and re-pinned in selftest.cc."""
-    with open(os.path.join(REPO, "native", "operator", "kubeapi.cc"),
-              encoding="utf-8") as f:
-        src = f.read()
-    m = re.search(r"OperatorMetricNames\(\)\s*\{.*?"
-                  r"new std::vector<std::string>\s*\{(.*?)\};", src, re.S)
-    assert m, "kubeapi.cc OperatorMetricNames() initializer not found"
-    cpp_names = tuple(re.findall(r'"([^"]+)"', m.group(1)))
-    assert cpp_names == telemetry.OPERATOR_METRIC_NAMES
-    with open(os.path.join(REPO, "native", "operator", "operator_main.cc"),
-              encoding="utf-8") as f:
-        main_src = f.read()
-    with open(os.path.join(REPO, "native", "operator", "selftest.cc"),
-              encoding="utf-8") as f:
-        selftest_src = f.read()
-    for name in telemetry.OPERATOR_METRIC_NAMES:
-        assert name in main_src, f"{name} not emitted by operator_main.cc"
-        assert f'"{name}"' in selftest_src, f"{name} not selftest-pinned"
+    """The metric-name twin table (RetryableStatus pattern), now via the
+    contract registry: kubeapi::OperatorMetricNames() must equal
+    telemetry.OPERATOR_METRIC_NAMES row for row, every family must be
+    emitted by operator_main.cc and re-pinned in selftest.cc — all of
+    which the registry slice declares and pinlint's extractor checks."""
+    from pin_helpers import assert_twin_pinned
+    assert_twin_pinned("metric/tpu_operator_",
+                       expect_values=telemetry.OPERATOR_METRIC_NAMES)
     # the table is the verify check's source too: no hand-copied list
     import inspect
 
@@ -373,32 +359,15 @@ def test_operator_metric_names_twin_pins_cpp_source():
 def test_operator_trace_event_names_twin_pins_cpp_source():
     """The trace-slice twin table (same pattern as the metric names):
     kubeapi::OperatorTraceEventNames() must equal
-    telemetry.OPERATOR_TRACE_EVENTS, every pinned slice must be emitted
-    by operator_main.cc and re-pinned in selftest.cc, and the
-    traceparent annotation string must twin too."""
-    with open(os.path.join(REPO, "native", "operator", "kubeapi.cc"),
-              encoding="utf-8") as f:
-        src = f.read()
-    m = re.search(r"OperatorTraceEventNames\(\)\s*\{.*?"
-                  r"new std::vector<std::string>\s*\{(.*?)\};", src, re.S)
-    assert m, "kubeapi.cc OperatorTraceEventNames() initializer not found"
-    cpp_names = tuple(re.findall(r'"([^"]+)"', m.group(1)))
-    assert cpp_names == telemetry.OPERATOR_TRACE_EVENTS
-    with open(os.path.join(REPO, "native", "operator",
-                           "operator_main.cc"), encoding="utf-8") as f:
-        main_src = f.read()
-    with open(os.path.join(REPO, "native", "operator", "selftest.cc"),
-              encoding="utf-8") as f:
-        selftest_src = f.read()
-    for name in telemetry.OPERATOR_TRACE_EVENTS:
-        assert f'"{name}"' in main_src, \
-            f"{name} not emitted by operator_main.cc"
-        assert f'"{name}"' in selftest_src, f"{name} not selftest-pinned"
-    # the traceparent annotation twin (kubeapply re-exports telemetry's)
-    ann = re.search(r'TraceparentAnnotation\(\)\s*\{.*?return\s+"([^"]+)"',
-                    src, re.S)
-    assert ann, "kubeapi.cc TraceparentAnnotation() not found"
-    assert ann.group(1) == telemetry.TRACEPARENT_ANNOTATION
+    telemetry.OPERATOR_TRACE_EVENTS with operator_main.cc/selftest.cc
+    enforcement, and the traceparent annotation string must twin too —
+    both registry slices, one shared checker."""
+    from pin_helpers import assert_twin_pinned
+    assert_twin_pinned("trace/",
+                       expect_values=telemetry.OPERATOR_TRACE_EVENTS)
+    assert_twin_pinned("annotation/traceparent",
+                       expect_values=(telemetry.TRACEPARENT_ANNOTATION,))
+    # kubeapply re-exports telemetry's spelling
     assert kubeapply.TRACEPARENT_ANNOTATION == \
         telemetry.TRACEPARENT_ANNOTATION
 

@@ -210,9 +210,13 @@ def test_operator_reconcile_slice_carries_cli_trace_id(native_build,
     op_doc = json.load(open(op_trace))
     telemetry.validate_chrome_trace(op_doc)
     names = {e["name"] for e in op_doc["traceEvents"]}
-    assert {"reconcile-pass", "apply-object", "ready-wait"} <= names
+    # the single-pass slices, spelled via the pinned twin table
+    # (OPERATOR_TRACE_EVENTS[:3] = reconcile-pass, apply-object,
+    # ready-wait; the registry + pinlint keep it equal to the C++ side)
+    assert set(telemetry.OPERATOR_TRACE_EVENTS[:3]) <= names
+    apply_slice = telemetry.OPERATOR_TRACE_EVENTS[1]
     applies = [e for e in op_doc["traceEvents"]
-               if e["name"] == "apply-object"]
+               if e["name"] == apply_slice]
     assert any(e["args"].get("trace_id") == tel.tracer.trace_id
                for e in applies), \
         "no operator apply slice carries the CLI rollout's trace id"

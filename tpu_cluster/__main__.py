@@ -17,8 +17,11 @@ with rendered artifacts and an ordered, readiness-gated apply:
            sticky merge-patch fallback for pre-SSA apiservers
   conlint  concurrency lint over the Python sources themselves —
            '# guarded-by:' lock annotations enforced statically (rules
-           CL01-CL04), the dev-side twin of the runtime lock-order
+           CL01-CL05), the dev-side twin of the runtime lock-order
            monitor tier-1 runs under
+  pinlint  cross-language contract pin analyzer — diffs the contract
+           registry (tpu_cluster/contracts.py) against the C++ accessor
+           tables, enforcer files, docs and CI (rules PL01-PL06)
   delete   remove everything a spec renders, reverse order
            (helm uninstall analog, reference README.md kind-script flow)
   admission
@@ -450,6 +453,23 @@ def cmd_conlint(args) -> int:
     if args.format != "table":
         argv += ["--format", args.format]
     return conlintmod.main(argv)
+
+
+def cmd_pinlint(args) -> int:
+    """Contract pin audit (dev surface): the registry-vs-C++/docs/CI
+    differ — `tpuctl pinlint --strict` is the CI gate, `--dump` prints
+    the registry itself."""
+    from . import pinlint as pinlintmod
+    argv = []
+    if args.strict:
+        argv.append("--strict")
+    if args.dump:
+        argv.append("--dump")
+    if args.format != "table":
+        argv += ["--format", args.format]
+    if args.native_root:
+        argv += ["--native-root", args.native_root]
+    return pinlintmod.main(argv)
 
 
 def cmd_queue(args) -> int:
@@ -1292,7 +1312,7 @@ def build_parser() -> argparse.ArgumentParser:
         "conlint", help="concurrency lint: enforce '# guarded-by:' lock "
                         "annotations, thread-shared-state hygiene and "
                         "explicit cross-thread span parents over Python "
-                        "sources (rules CL01-CL04)")
+                        "sources (rules CL01-CL05)")
     p.add_argument("paths", nargs="*",
                    help="files/directories (default: the tpu_cluster "
                         "package + tests/fake_apiserver.py)")
@@ -1300,6 +1320,23 @@ def build_parser() -> argparse.ArgumentParser:
                    help="findings as lines (default) or one JSON "
                         "document")
     p.set_defaults(fn=cmd_conlint)
+
+    p = sub.add_parser(
+        "pinlint", help="contract pin analyzer: diff the machine-readable "
+                        "contract registry against the C++ twin accessors, "
+                        "enforcer files, docs and CI greps (rules "
+                        "PL01-PL06)")
+    p.add_argument("--strict", action="store_true",
+                   help="fail on docs/CI drift warnings too (the CI mode)")
+    p.add_argument("--dump", action="store_true",
+                   help="print the contract registry as JSON and exit")
+    p.add_argument("--format", choices=("table", "json"), default="table",
+                   help="findings as lines (default) or one JSON "
+                        "document")
+    p.add_argument("--native-root", default="",
+                   help="override where native/ sources are read from "
+                        "(drift tests)")
+    p.set_defaults(fn=cmd_pinlint)
 
     p = sub.add_parser(
         "queue", help="list/describe the gang-admission queue "

@@ -61,6 +61,20 @@ RULES:
         per-thread span stack does not cross threads, so an implicit
         parent silently reparents the span to a new root (the telemetry
         rule PR 6 enforced only by convention).
+  CL05  blocking I/O lexically inside a ``with <lock>:`` body — a
+        request through a client/session/socket attribute, ``urlopen``,
+        a ``subprocess`` call, ``open()``/``os.replace``-style file
+        traffic — the "leaf-only locks, I/O outside" discipline every
+        controller module documents (admission/maintenance/events hold
+        ``_lock`` for state transitions only and do LIST/PATCH wire
+        traffic outside it). I/O under a lock turns every waiter's
+        latency into the server's tail latency and is how lock-order
+        deadlocks recruit their second lock. A ``with`` counts as a
+        lock body when its context expression's final segment is
+        lock-ish (ends in ``lock``) or is a known Lock/RLock/Condition
+        attribute in this file. Deliberate I/O-under-lock (a connection
+        mux serializing writes on its OWN socket) uses the ignore
+        pragma with a justification.
 
 SCOPE AND LIMITS (deliberate, Clang-TSA-shaped):
 
@@ -104,10 +118,11 @@ RULE_UNGUARDED = "CL01"
 RULE_UNKNOWN_LOCK = "CL02"
 RULE_UNANNOTATED_SHARED = "CL03"
 RULE_SPAN_PARENT = "CL04"
+RULE_IO_UNDER_LOCK = "CL05"
 RULE_PARSE = "CL00"  # unparseable input (kept out of the rule docs)
 
 ALL_RULES = (RULE_UNGUARDED, RULE_UNKNOWN_LOCK, RULE_UNANNOTATED_SHARED,
-             RULE_SPAN_PARENT)
+             RULE_SPAN_PARENT, RULE_IO_UNDER_LOCK)
 
 _GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][\w.]*)")
 _OWNED_RE = re.compile(r"#\s*thread-owned\b")
@@ -134,6 +149,30 @@ _SYNC_CALLS = _LOCKISH | frozenset({
 # Functions whose first positional callable argument (or target= kwarg)
 # runs on another thread.
 _SPAWN_NAMES = frozenset({"Thread", "Timer", "ThreadPoolExecutor"})
+
+# --- CL05's definition of "blocking I/O" -----------------------------
+# A call counts when its RECEIVER's final segment (leading underscores
+# stripped) names a wire/socket object: `self._client.get(...)`,
+# `api.patch_merge(...)`, `self._sock.sendall(...)`. Receiver-based on
+# purpose — terminal names like `get`/`list`/`run` are far too generic
+# to classify alone.
+_IO_RECEIVERS = frozenset({
+    "client", "api", "http", "session", "sock", "socket", "conn",
+    "connection", "subprocess", "shutil",
+})
+# Terminal call names that are I/O regardless of receiver (socket verbs
+# and the unambiguous subprocess/urllib entry points).
+_IO_TERMINALS = frozenset({
+    "urlopen", "urlretrieve", "sendall", "recv", "recv_into", "accept",
+    "connect", "getresponse", "makefile", "create_connection",
+    "check_call", "check_output", "Popen",
+})
+# os.<name> calls that hit the filesystem (the atomic-write/journal
+# vocabulary this repo uses); only flagged with receiver text `os`.
+_OS_IO_TERMINALS = frozenset({
+    "replace", "rename", "unlink", "remove", "fsync", "makedirs",
+    "mkdir", "rmdir", "mkstemp", "fdopen", "truncate", "write", "open",
+})
 
 _CTOR_NAMES = ("__init__", "__post_init__", "__new__")
 
@@ -396,6 +435,12 @@ class _Analyzer:
                 reqs = _func_requires(n, self.ann)
                 if reqs:
                     self.requires_funcs[n.name] = reqs
+        # file-level final-segment names known to BE locks (CL05):
+        # Lock/RLock/Condition attributes plus Condition aliases
+        self.lock_names: Set[str] = set()
+        for cls in self.classes:
+            self.lock_names |= cls.lock_attrs
+            self.lock_names |= set(cls.aliases)
 
     # ------------------------------------------------------------- helpers
 
@@ -626,6 +671,94 @@ class _Analyzer:
                     "capture the parent span before spawning and pass "
                     "parent=...")
 
+    # --------------------------------------------------------------- CL05
+
+    def _lockish_with_item(self, expr: ast.expr) -> Optional[str]:
+        """The context expression's dotted text when it names a lock —
+        final segment ends in ``lock`` (``self._lock``, ``cache_lock``,
+        ``tracer.lock``) or is a known Lock/Condition attribute of a
+        class in this file; None for everything else (files, sockets,
+        span scopes, ExitStack...)."""
+        text = _expr_text(expr)
+        if text is None:
+            return None
+        last = text.split(".")[-1]
+        if last.lower().endswith("lock") or last in self.lock_names:
+            return text
+        return None
+
+    def _io_call_desc(self, node: ast.Call) -> Optional[str]:
+        """Short description when ``node`` is blocking I/O by CL05's
+        definition, else None."""
+        func = node.func
+        if isinstance(func, ast.Name):
+            if func.id == "open" or func.id in _IO_TERMINALS:
+                return f"{func.id}()"
+            return None
+        if not isinstance(func, ast.Attribute):
+            return None
+        recv = _expr_text(func.value)
+        if func.attr in _IO_TERMINALS:
+            return f"{recv or '...'}.{func.attr}()"
+        if recv is None:
+            return None
+        if recv == "os":
+            return (f"os.{func.attr}()"
+                    if func.attr in _OS_IO_TERMINALS else None)
+        if recv.split(".")[-1].lstrip("_").lower() in _IO_RECEIVERS:
+            return f"{recv}.{func.attr}()"
+        return None
+
+    def check_io_under_lock(self) -> None:
+        for fn in ast.walk(self.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._io_walk(list(fn.body), None)
+
+    def _io_walk(self, stmts: Sequence[ast.stmt],
+                 lock: Optional[Tuple[str, int]]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # separate scope, visited as its own unit
+            inner = lock
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._io_check_exprs(item.context_expr, lock)
+                    text = self._lockish_with_item(item.context_expr)
+                    if text is not None and inner is None:
+                        inner = (text, stmt.lineno)
+                self._io_walk(stmt.body, inner)
+                continue
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.stmt):
+                    self._io_walk([child], lock)
+                elif isinstance(child, ast.expr):
+                    self._io_check_exprs(child, lock)
+                else:
+                    for sub in ast.iter_child_nodes(child):
+                        if isinstance(sub, ast.stmt):
+                            self._io_walk([sub], lock)
+                        elif isinstance(sub, ast.expr):
+                            self._io_check_exprs(sub, lock)
+
+    def _io_check_exprs(self, expr: ast.expr,
+                        lock: Optional[Tuple[str, int]]) -> None:
+        if lock is None:
+            return
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            desc = self._io_call_desc(node)
+            if desc is None:
+                continue
+            self._emit(
+                RULE_IO_UNDER_LOCK, node.lineno,
+                f"blocking I/O {desc} inside 'with {lock[0]}:' "
+                f"(line {lock[1]}): locks are for state transitions, "
+                "not wire/disk traffic",
+                "hoist the I/O out of the lock body and publish its "
+                "result under the lock")
+
     # ---------------------------------------------------------------- run
 
     def run(self) -> List[Finding]:
@@ -633,6 +766,7 @@ class _Analyzer:
         self.check_shared_mutables()
         self.check_guarded_access()
         self.check_span_parents()
+        self.check_io_under_lock()
         self.findings.sort(key=lambda f: (f.path, f.line, f.rule))
         return self.findings
 
@@ -688,7 +822,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     ap = argparse.ArgumentParser(
         prog="concurrency_lint",
-        description="guarded-by concurrency lint (rules CL01-CL04); "
+        description="guarded-by concurrency lint (rules CL01-CL05); "
                     "see tpu_cluster/conlint.py for the annotation "
                     "grammar")
     ap.add_argument("paths", nargs="*",

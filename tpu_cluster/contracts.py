@@ -1,0 +1,382 @@
+"""The contract registry: every cross-process constant, declared once.
+
+The stack's correctness story leans on names that must agree across
+processes that never link against each other — metric family names the
+C++ operator's ``/metrics`` endpoint emits and the Python scrape
+pipeline parses, Chrome-trace slice names three producers must spell
+identically for one merged timeline, annotation keys the CLI stamps and
+the operator/plugin read back, Event reasons the controllers post and
+the runbooks grep for, ConfigMap names two languages LIST and PATCH,
+chaos kinds the fault scripts and the soak tests share. Until now each
+of those contracts was guarded by a bespoke source-grep test (the
+"pinned three ways" pattern): linear in hand-written regexes, and
+silently blind to every NEW constant nobody remembered to pin.
+
+This module is the fix's declarative half: one machine-readable table
+of :class:`Contract` records, each naming
+
+- the canonical **value** and its Python declaration locus (the
+  constants themselves still live in their owning modules —
+  ``telemetry.OPERATOR_METRIC_NAMES``, ``admission.GANG_ANNOTATION`` —
+  and the registry IMPORTS them, so the spelling has exactly one
+  source);
+- the **C++ twin** accessor, when one exists (``kubeapi::
+  OperatorMetricNames()``, ``reservation.cc``'s contract functions),
+  which :mod:`tpu_cluster.pinlint` statically extracts and diffs;
+- the **enforcement files** that must mention the value verbatim
+  (``operator_main.cc`` must emit every pinned family, ``selftest.cc``
+  must re-pin it compiler-only, ``tfd_main.cc`` must publish every
+  feature label);
+- the **docs** that claim coverage (GUIDE's contract tables, TESTING's
+  chaos-kind vocabulary).
+
+The checking half is :mod:`tpu_cluster.pinlint` (``tpuctl pinlint``):
+it diffs this registry against the extracted C++ side, harvests
+contract-shaped constants from the Python sources to catch UNDECLARED
+ones, and cross-checks docs and CI. ``tpuctl pinlint --dump`` prints
+the registry as JSON for external tooling.
+
+Adding a contract = adding the constant to its owning module plus one
+``Contract`` entry here; pinlint's harvest fails CI until the entry
+exists, which is what "pinned by construction" means.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# Contract kinds (the registry's vocabulary; pinlint reports them and
+# `--dump` consumers filter on them).
+KIND_METRIC_FAMILY = "metric-family"
+KIND_TRACE_SLICE = "trace-slice"
+KIND_ANNOTATION = "annotation"
+KIND_LABEL = "label"
+KIND_EVENT_REASON = "event-reason"
+KIND_EVENT_TYPE = "event-type"
+KIND_CONFIGMAP = "configmap"
+KIND_CONFIGMAP_KEY = "configmap-key"
+KIND_SCHEMA_VERSION = "schema-version"
+KIND_PHASE = "phase"
+KIND_STATUS = "status"
+KIND_CHAOS_KIND = "chaos-kind"
+KIND_FIELD_MANAGER = "field-manager"
+KIND_RESOURCE = "resource"
+
+ALL_KINDS: Tuple[str, ...] = (
+    KIND_METRIC_FAMILY, KIND_TRACE_SLICE, KIND_ANNOTATION, KIND_LABEL,
+    KIND_EVENT_REASON, KIND_EVENT_TYPE, KIND_CONFIGMAP,
+    KIND_CONFIGMAP_KEY, KIND_SCHEMA_VERSION, KIND_PHASE, KIND_STATUS,
+    KIND_CHAOS_KIND, KIND_FIELD_MANAGER, KIND_RESOURCE)
+
+# The chaos-script fault kinds (docs/TESTING.md "Chaos engine"). The
+# request-fault kinds are spelled as script DICT KEYS in
+# tests/fake_apiserver.py (``{"drop": 2}``), the node-lifecycle kinds as
+# the ``_NODE_FAULT_KINDS`` tuple — pinlint extracts that tuple and
+# checks it against this registry, and checks every kind here appears
+# verbatim in the fake's source. Declared HERE (not imported) because
+# the package must not import test code; the cross-check is what keeps
+# the two spellings equal.
+CHAOS_REQUEST_KINDS: Tuple[str, ...] = (
+    "drop", "stall", "trickle", "truncate", "garbage", "flap")
+CHAOS_NODE_KINDS: Tuple[str, ...] = (
+    "node_not_ready", "node_ready", "evict_pods",
+    "cordon_node", "uncordon_node")
+CHAOS_KINDS: Tuple[str, ...] = CHAOS_REQUEST_KINDS + CHAOS_NODE_KINDS
+
+# Repo-relative path of the chaos engine's source (the harvest/extract
+# target for the chaos-kind contracts above).
+FAKE_APISERVER_PATH = "tests/fake_apiserver.py"
+
+
+@dataclass(frozen=True)
+class CppPin:
+    """A statically-extractable C++ accessor that must return (or
+    tabulate) a contract value.
+
+    ``file`` is repo-relative; ``symbol`` is the accessor function name
+    (``OperatorMetricNames``). ``index`` >= 0 marks one row of a string
+    TABLE (``new std::vector<std::string>{...}``) — pinlint compares
+    whole tables ordered. ``integer`` marks a ``return <int>;``
+    accessor."""
+
+    file: str
+    symbol: str
+    index: int = -1
+    integer: bool = False
+
+
+@dataclass(frozen=True)
+class Contract:
+    """One registered cross-process constant."""
+
+    name: str                 # unique registry id: "<kind>/<value-ish>"
+    kind: str
+    value: str                # canonical spelling (ints via str())
+    py_file: str              # repo-relative declaring source
+    py_attr: str              # "NAME" or "NAME[i]" ("" = literal/dict key)
+    cpp: Optional[CppPin] = None
+    # repo-relative files that must contain `value` verbatim
+    enforcers: Tuple[str, ...] = ()
+    # docs/ files that must mention `value` (coverage claims)
+    docs: Tuple[str, ...] = ()
+
+    def to_dict(self) -> Dict[str, object]:
+        out: Dict[str, object] = {
+            "name": self.name, "kind": self.kind, "value": self.value,
+            "py_file": self.py_file, "py_attr": self.py_attr,
+            "enforcers": list(self.enforcers), "docs": list(self.docs),
+        }
+        if self.cpp is not None:
+            out["cpp"] = {"file": self.cpp.file, "symbol": self.cpp.symbol,
+                          "index": self.cpp.index,
+                          "integer": self.cpp.integer}
+        return out
+
+
+class Registry:
+    """The assembled contract table, with the lookups pinlint needs."""
+
+    def __init__(self, contracts: Sequence[Contract]) -> None:
+        self.contracts: Tuple[Contract, ...] = tuple(contracts)
+        self._by_name: Dict[str, Contract] = {}
+        for c in self.contracts:
+            if c.name in self._by_name:
+                raise ValueError(f"duplicate contract name: {c.name}")
+            self._by_name[c.name] = c
+
+    def get(self, name: str) -> Contract:
+        return self._by_name[name]
+
+    def values(self, kind: Optional[str] = None) -> frozenset[str]:
+        return frozenset(c.value for c in self.contracts
+                         if kind is None or c.kind == kind)
+
+    def by_kind(self, kind: str) -> List[Contract]:
+        return [c for c in self.contracts if c.kind == kind]
+
+    def cpp_tables(self) -> Dict[Tuple[str, str], List[Contract]]:
+        """{(cpp file, symbol): ordered table rows} for every
+        table-pinned contract group (index >= 0)."""
+        out: Dict[Tuple[str, str], List[Contract]] = {}
+        for c in self.contracts:
+            if c.cpp is not None and c.cpp.index >= 0:
+                out.setdefault((c.cpp.file, c.cpp.symbol), []).append(c)
+        for rows in out.values():
+            rows.sort(key=lambda c: c.cpp.index if c.cpp else 0)
+        return out
+
+    def cpp_literals(self) -> List[Contract]:
+        """Contracts pinned to a single-literal C++ accessor."""
+        return [c for c in self.contracts
+                if c.cpp is not None and c.cpp.index < 0]
+
+    def to_json(self) -> Dict[str, object]:
+        return {"version": 1,
+                "contracts": [c.to_dict() for c in self.contracts]}
+
+
+def _rel(module: object) -> str:
+    """Repo-relative source path of a tpu_cluster module."""
+    path = getattr(module, "__file__", None)
+    assert isinstance(path, str)
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.relpath(os.path.abspath(path), pkg_root)
+
+
+_OPERATOR_SOURCES: Tuple[str, ...] = (
+    "native/operator/operator_main.cc", "native/operator/selftest.cc")
+
+
+def build_registry() -> Registry:
+    """Assemble the registry from the LIVE module constants (imports are
+    local so the registry can be built without dragging the whole
+    package in at import time)."""
+    from tpu_cluster.render import operator_bundle
+    from tpu_cluster import admission, events, kubeapply, maintenance, \
+        telemetry
+    from tpu_cluster.discovery import labels as dlabels
+
+    out: List[Contract] = []
+    tele_f = _rel(telemetry)
+    adm_f = _rel(admission)
+    maint_f = _rel(maintenance)
+
+    # ---- metric families: the C++ operator's twin table (ordered) ----
+    for i, fam in enumerate(telemetry.OPERATOR_METRIC_NAMES):
+        out.append(Contract(
+            name=f"metric/{fam}", kind=KIND_METRIC_FAMILY, value=fam,
+            py_file=tele_f, py_attr=f"OPERATOR_METRIC_NAMES[{i}]",
+            cpp=CppPin("native/operator/kubeapi.cc",
+                       "OperatorMetricNames", index=i),
+            enforcers=_OPERATOR_SOURCES, docs=("GUIDE.md",)))
+
+    # ---- metric families: the Python client/controller constants ----
+    # (every module-level UPPER_CASE str in telemetry.py whose value is
+    # family-shaped; harvesting from the module keeps a new constant
+    # registered the moment it is declared there)
+    for attr in sorted(vars(telemetry)):
+        if not attr.isupper() or attr.startswith("_"):
+            continue
+        val = getattr(telemetry, attr)
+        if not isinstance(val, str):
+            continue
+        if attr in ("TRACEPARENT_ANNOTATION",):
+            continue  # registered below with its C++ pin
+        if not re.fullmatch(r"[a-z_:][a-z0-9_:]*", val):
+            continue  # not family-shaped (Prometheus name grammar)
+        out.append(Contract(
+            name=f"metric/{val}", kind=KIND_METRIC_FAMILY, value=val,
+            py_file=tele_f, py_attr=attr, docs=("GUIDE.md",)))
+
+    # ---- trace slices -----------------------------------------------
+    for i, slice_name in enumerate(telemetry.OPERATOR_TRACE_EVENTS):
+        out.append(Contract(
+            name=f"trace/{slice_name}", kind=KIND_TRACE_SLICE,
+            value=slice_name, py_file=tele_f,
+            py_attr=f"OPERATOR_TRACE_EVENTS[{i}]",
+            cpp=CppPin("native/operator/kubeapi.cc",
+                       "OperatorTraceEventNames", index=i),
+            enforcers=_OPERATOR_SOURCES, docs=("GUIDE.md",)))
+
+    # ---- annotations / labels ---------------------------------------
+    out.append(Contract(
+        name="annotation/traceparent", kind=KIND_ANNOTATION,
+        value=telemetry.TRACEPARENT_ANNOTATION, py_file=tele_f,
+        py_attr="TRACEPARENT_ANNOTATION",
+        cpp=CppPin("native/operator/kubeapi.cc", "TraceparentAnnotation"),
+        enforcers=("native/operator/selftest.cc",), docs=("GUIDE.md",)))
+    out.append(Contract(
+        name="annotation/gang", kind=KIND_ANNOTATION,
+        value=admission.GANG_ANNOTATION, py_file=adm_f,
+        py_attr="GANG_ANNOTATION",
+        cpp=CppPin("native/plugin/reservation.cc", "GangAnnotation"),
+        enforcers=("native/plugin/selftest.cc",), docs=("GUIDE.md",)))
+    for attr in ("GANG_ACCELERATOR_ANNOTATION", "GANG_PRIORITY_ANNOTATION",
+                 "GANG_STATUS_ANNOTATION", "GANG_REASON_ANNOTATION",
+                 "MAINTENANCE_ANNOTATION"):
+        out.append(Contract(
+            name=f"annotation/{getattr(admission, attr)}",
+            kind=KIND_ANNOTATION, value=getattr(admission, attr),
+            py_file=adm_f, py_attr=attr, docs=("GUIDE.md",)))
+    out.append(Contract(
+        name="annotation/lint-allow", kind=KIND_ANNOTATION,
+        value="tpu-stack.dev/lint-allow",
+        py_file="tpu_cluster/lint.py", py_attr="LINT_ALLOW_ANNOTATION",
+        docs=("GUIDE.md",)))
+    out.append(Contract(
+        name="label/stack-version", kind=KIND_LABEL,
+        value=maintenance.VERSION_LABEL, py_file=maint_f,
+        py_attr="VERSION_LABEL",
+        enforcers=(FAKE_APISERVER_PATH,), docs=("GUIDE.md",)))
+    # feature-discovery labels: Python labeler <-> native tfd_main.cc
+    for attr in ("PRESENT", "TYPE", "GENERATION", "TOPOLOGY", "COUNT",
+                 "ICI_DOMAIN"):
+        out.append(Contract(
+            name=f"label/{getattr(dlabels, attr)}", kind=KIND_LABEL,
+            value=getattr(dlabels, attr), py_file=_rel(dlabels),
+            py_attr=attr,
+            enforcers=("native/discovery/tfd_main.cc",),
+            docs=("GUIDE.md",)))
+    out.append(Contract(
+        name="resource/tpu", kind=KIND_RESOURCE,
+        value=admission.TPU_RESOURCE, py_file=adm_f,
+        py_attr="TPU_RESOURCE", docs=("GUIDE.md",)))
+
+    # ---- event reasons ----------------------------------------------
+    for module, mod_file in ((admission, adm_f), (maintenance, maint_f)):
+        for attr in sorted(vars(module)):
+            if attr.startswith("EVENT_"):
+                val = getattr(module, attr)
+                assert isinstance(val, str)
+                out.append(Contract(
+                    name=f"event-reason/{val}", kind=KIND_EVENT_REASON,
+                    value=val, py_file=mod_file, py_attr=attr,
+                    docs=("GUIDE.md",)))
+    for attr in ("EVENT_TYPE_NORMAL", "EVENT_TYPE_WARNING"):
+        out.append(Contract(
+            name=f"event-type/{getattr(events, attr)}",
+            kind=KIND_EVENT_TYPE, value=getattr(events, attr),
+            py_file=_rel(events), py_attr=attr, docs=("GUIDE.md",)))
+
+    # ---- ConfigMaps and their keys / schema versions ----------------
+    out.append(Contract(
+        name="configmap/tpu-gang-reservations", kind=KIND_CONFIGMAP,
+        value=admission.RESERVATION_CONFIGMAP, py_file=adm_f,
+        py_attr="RESERVATION_CONFIGMAP",
+        cpp=CppPin("native/plugin/reservation.cc",
+                   "ReservationConfigMapName"),
+        enforcers=("native/plugin/selftest.cc",), docs=("GUIDE.md",)))
+    out.append(Contract(
+        name="configmap-key/reservations.json", kind=KIND_CONFIGMAP_KEY,
+        value=admission.RESERVATION_KEY, py_file=adm_f,
+        py_attr="RESERVATION_KEY",
+        cpp=CppPin("native/plugin/reservation.cc", "ReservationKey"),
+        enforcers=("native/plugin/selftest.cc",), docs=("GUIDE.md",)))
+    out.append(Contract(
+        name="schema-version/reservations", kind=KIND_SCHEMA_VERSION,
+        value=str(admission.RESERVATION_SCHEMA_VERSION), py_file=adm_f,
+        py_attr="RESERVATION_SCHEMA_VERSION",
+        cpp=CppPin("native/plugin/reservation.cc",
+                   "ReservationSchemaVersion", integer=True)))
+    out.append(Contract(
+        name="configmap/tpu-maintenance-state", kind=KIND_CONFIGMAP,
+        value=maintenance.MAINTENANCE_CONFIGMAP, py_file=maint_f,
+        py_attr="MAINTENANCE_CONFIGMAP", docs=("GUIDE.md",)))
+    out.append(Contract(
+        name="configmap-key/state.json", kind=KIND_CONFIGMAP_KEY,
+        value=maintenance.MAINTENANCE_KEY, py_file=maint_f,
+        py_attr="MAINTENANCE_KEY"))
+    out.append(Contract(
+        name="schema-version/maintenance", kind=KIND_SCHEMA_VERSION,
+        value=str(maintenance.MAINTENANCE_SCHEMA_VERSION),
+        py_file=maint_f, py_attr="MAINTENANCE_SCHEMA_VERSION"))
+    out.append(Contract(
+        name="configmap/tpu-operator-bundle", kind=KIND_CONFIGMAP,
+        value=operator_bundle.BUNDLE_CONFIGMAP,
+        py_file=_rel(operator_bundle), py_attr="BUNDLE_CONFIGMAP",
+        enforcers=(
+            "deploy/chart/tpu-stack/templates/50-operator.yaml",),
+        docs=("GUIDE.md",)))
+
+    # ---- field managers ---------------------------------------------
+    out.append(Contract(
+        name="field-manager/tpuctl", kind=KIND_FIELD_MANAGER,
+        value=kubeapply.FIELD_MANAGER, py_file=_rel(kubeapply),
+        py_attr="FIELD_MANAGER", docs=("GUIDE.md",)))
+    out.append(Contract(
+        name="field-manager/tpu-operator", kind=KIND_FIELD_MANAGER,
+        value=kubeapply.OPERATOR_FIELD_MANAGER, py_file=_rel(kubeapply),
+        py_attr="OPERATOR_FIELD_MANAGER",
+        cpp=CppPin("native/operator/kubeapi.cc", "FieldManager"),
+        enforcers=("native/operator/selftest.cc",), docs=("GUIDE.md",)))
+
+    # ---- gang statuses / maintenance phases -------------------------
+    for attr in ("STATUS_ADMITTED", "STATUS_QUEUED", "STATUS_PREEMPTED"):
+        out.append(Contract(
+            name=f"status/{getattr(admission, attr)}", kind=KIND_STATUS,
+            value=getattr(admission, attr), py_file=adm_f, py_attr=attr,
+            docs=("GUIDE.md",)))
+    for i, phase in enumerate(maintenance.PHASES):
+        out.append(Contract(
+            name=f"phase/{phase}", kind=KIND_PHASE, value=phase,
+            py_file=maint_f, py_attr=f"PHASES[{i}]", docs=("GUIDE.md",)))
+    # rollout phases (`tpuctl top` timings order; a distinct vocabulary
+    # from the maintenance wave phases above)
+    for i, phase in enumerate(telemetry.PHASE_NAMES):
+        out.append(Contract(
+            name=f"phase/rollout/{phase}", kind=KIND_PHASE, value=phase,
+            py_file=tele_f, py_attr=f"PHASE_NAMES[{i}]",
+            docs=("GUIDE.md",)))
+
+    # ---- chaos kinds ------------------------------------------------
+    for kind_name in CHAOS_KINDS:
+        out.append(Contract(
+            name=f"chaos/{kind_name}", kind=KIND_CHAOS_KIND,
+            value=kind_name, py_file="tpu_cluster/contracts.py",
+            py_attr="CHAOS_KINDS",
+            enforcers=(FAKE_APISERVER_PATH,), docs=("TESTING.md",)))
+
+    return Registry(out)
