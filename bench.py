@@ -564,6 +564,24 @@ def main() -> int:
                     reps=3 if platform == "tpu" else 2)
             except Exception as exc:  # noqa: BLE001 — keep the line
                 doc["collectives"] = {"error": repr(exc)[:300]}
+        # Serving line (ISSUE 20): continuous batching vs the static-
+        # batch control arm over identical open-loop traffic against
+        # the tiny serving engine — clusterless on every platform. The
+        # README row quotes tokens_ratio (the iteration-level-admission
+        # win) next to both arms' p99.
+        try:
+            from tpu_cluster.workloads import serving
+            cb = serving.bench_arm(static=False)
+            st = serving.bench_arm(static=True)
+            doc["serving"] = {
+                "slots": 4,
+                "continuous": cb,
+                "static": st,
+                "tokens_ratio": round(
+                    cb["tokens_per_s"] / max(1e-9, st["tokens_per_s"]), 3),
+            }
+        except Exception as exc:  # noqa: BLE001 — keep the line
+            doc["serving"] = {"error": repr(exc)[:300]}
         # Scrape last, inside the window, holding a known-size device
         # allocation so the live-array HBM accounting (runtime_metrics
         # degradation ladder) has a real value to report even on runtimes

@@ -83,11 +83,16 @@ sys.path.insert(0, os.path.join(REPO, "tests"))
 from fake_apiserver import (FakeApiServer, fleet_store,  # noqa: E402
                             slow_fault_script, standard_fault_script)
 from tpu_cluster import admission  # noqa: E402
+from tpu_cluster import autoscale  # noqa: E402
+from tpu_cluster import events as eventsmod  # noqa: E402
 from tpu_cluster import kubeapply  # noqa: E402
 from tpu_cluster import maintenance  # noqa: E402
+from tpu_cluster import metricsdb  # noqa: E402
 from tpu_cluster import spec as specmod  # noqa: E402
 from tpu_cluster import telemetry  # noqa: E402
 from tpu_cluster.render import manifests, operator_bundle  # noqa: E402
+from tpu_cluster.workloads import runtime_metrics  # noqa: E402
+from tpu_cluster.workloads import serving as servingmod  # noqa: E402
 
 REQUEST_RATIO_TARGET = 3.0
 SPEEDUP_TARGET = 2.0
@@ -156,6 +161,19 @@ OPERATOR_FLEET_DRIFTS = 25
 MAINTENANCE_NODES = 12
 MAINTENANCE_GROUP_SIZE = 6
 MAINTENANCE_BUDGET_MAX_DRAINS = 2
+# The serving column (ISSUE 20): the continuous-batching engine vs the
+# static-batch control arm over the SAME tiny bf16 transformer and the
+# SAME open-loop request burst — the only variable is the admission
+# policy — plus the metrics→replicas scale-out reaction mini-sim
+# (synthetic overload window → autoscaler decision → gang-admitted
+# replica). The --check contract: CB tokens/s strictly above static at
+# equal-or-better p99, every request served (no deadline kills, no
+# rejects), the reaction time reported, zero partial seats while
+# scaling, and exactly one ScaledUp event.
+SERVING_SLOTS = 4
+SERVING_REQUESTS = 16
+SERVING_DEADLINE_S = 120.0
+SERVING_SCALEOUT_HOSTS = 3
 
 
 def full_stack_groups(spec):
@@ -612,6 +630,100 @@ def maintenance_arm(latency_s: float) -> dict:
     }
 
 
+def serving_scaleout_arm(latency_s: float) -> dict:
+    """The metrics→replicas reaction mini-sim: the autoscaler watches a
+    synthetic overload window (duty pinned at 95%) and must converge
+    replica 0, decide the scale-out, and get replica 1 gang-admitted —
+    with the kubelet seat check auditing zero partial allocations at
+    every observation. ``reaction_s`` is the controller's own
+    overload-observed → scale-decided span sample."""
+    ns = "tpu-system"
+    job = "bench-serving"
+    hosts = [f"bench-s-{i}" for i in range(SERVING_SCALEOUT_HOSTS)]
+    tel = telemetry.Telemetry()
+    with FakeApiServer(auto_ready=True, latency_s=latency_s) as api:
+        client = kubeapply.Client(api.url, telemetry=tel)
+        for h in hosts:
+            client.apply(admission.node_manifest(h, "v5e-8"))
+        adm = admission.AdmissionController(client, ns, telemetry=tel)
+        tsdb = metricsdb.TSDB()
+        rec = eventsmod.EventRecorder(client, component="tpu-autoscale",
+                                      telemetry=tel)
+        ctrl = autoscale.AutoscaleController(
+            client, ns, job=job, accelerator="v5e-8",
+            policy=autoscale.AutoscalePolicy(cooldown_s=0.0),
+            tsdb=tsdb, telemetry=tel, events=rec)
+
+        def overload() -> None:
+            now = tsdb.now()
+            tsdb.append(telemetry.UP, {"job": job + "-0"}, 1.0, ts=now)
+            tsdb.append(runtime_metrics.DUTY_CYCLE_PERCENT,
+                        {"job": job + "-0"}, 95.0, ts=now)
+
+        partial = 0
+        reaction = None
+        admitted_wall = None
+        t0 = time.monotonic()
+        for _ in range(10):
+            overload()
+            r = ctrl.step()
+            if reaction is None and r.reaction_s is not None:
+                reaction = r.reaction_s
+            adm.step()
+            cm = api.get(f"/api/v1/namespaces/{ns}/configmaps/"
+                         f"{admission.RESERVATION_CONFIGMAP}")
+            if cm is not None:
+                table = admission.parse_table(
+                    json.loads(cm["data"][admission.RESERVATION_KEY]))
+                for host in hosts:
+                    for k in range(1, 8):
+                        ok, _ = admission.check_allocation(
+                            table, host, list(range(k)))
+                        partial += int(ok)
+            if (admitted_wall is None
+                    and f"{job}/1" in adm.admitted_snapshot()):
+                admitted_wall = time.monotonic() - t0
+                break
+        scaled_up = sum(
+            1 for ev in client.list_collection(
+                f"/api/v1/namespaces/{ns}/events").values()
+            if ev.get("reason") == autoscale.EVENT_SCALED_UP)
+        state = autoscale.fetch_state(client, ns)
+        client.close()
+    return {
+        "hosts": SERVING_SCALEOUT_HOSTS,
+        "replicas": state.replicas if state is not None else None,
+        "reaction_s": (round(reaction, 4)
+                       if reaction is not None else None),
+        "admitted_wall_s": (round(admitted_wall, 4)
+                            if admitted_wall is not None else None),
+        "partial_allocations": partial,
+        "scaled_up_events": scaled_up,
+    }
+
+
+def serving_arm(latency_s: float) -> dict:
+    """The serving column: continuous batching vs the static-batch
+    control arm over identical open-loop traffic (the shared
+    ``serving.bench_arm`` replay), then the scale-out reaction
+    mini-sim."""
+    cb = servingmod.bench_arm(static=False, slots=SERVING_SLOTS,
+                              requests=SERVING_REQUESTS,
+                              deadline_s=SERVING_DEADLINE_S)
+    static = servingmod.bench_arm(static=True, slots=SERVING_SLOTS,
+                                  requests=SERVING_REQUESTS,
+                                  deadline_s=SERVING_DEADLINE_S)
+    return {
+        "slots": SERVING_SLOTS,
+        "requests": SERVING_REQUESTS,
+        "continuous": cb,
+        "static": static,
+        "tokens_ratio": round(cb["tokens_per_s"]
+                              / max(1e-9, static["tokens_per_s"]), 3),
+        "scaleout": serving_scaleout_arm(latency_s),
+    }
+
+
 def _fleet_rollout(num_nodes: int, latency_s: float,
                    max_inflight: int) -> dict:
     """One cold full-bundle install against a fake seeded with a
@@ -976,6 +1088,7 @@ def main(argv=None) -> int:
     ssa = ssa_arm(latency_s, args.passes, args.max_inflight)
     gang = gang_arm(latency_s)
     maint = maintenance_arm(latency_s)
+    serving = serving_arm(latency_s)
     fleet = fleet_arm(latency_s, args.max_inflight)
     ready_watch = readiness_arm(latency_s, watch=True)
     ready_poll = readiness_arm(latency_s, watch=False)
@@ -1051,6 +1164,12 @@ def main(argv=None) -> int:
         # re-admitted counts, max concurrent drains (gated <= budget),
         # zero partial seats, and the bystander queue-wait delta.
         "maintenance": maint,
+        # Serving (ISSUE 20): continuous batching vs the static-batch
+        # control arm over identical traffic — tokens/s, p50/p99
+        # latency, batch occupancy — plus the scale-out reaction
+        # mini-sim (overload observed → replica gang-admitted, zero
+        # partial seats, exactly one ScaledUp).
+        "serving": serving,
         # Fleet scale (ISSUE 11): cold rollout at 1000 synthetic nodes
         # within 2x of the 20-node request count (O(bundle), not
         # O(nodes)), span-derived decision latency for 100 queued gangs,
@@ -1173,6 +1292,30 @@ def main(argv=None) -> int:
                   "partial_allocations==0, max_concurrent_drains <= "
                   f"{MAINTENANCE_BUDGET_MAX_DRAINS}, both gangs "
                   "admitted)", file=sys.stderr)
+            return 1
+        # serving (ISSUE 20): continuous batching must BEAT the
+        # static-batch control arm on tokens/s at equal-or-better p99
+        # over identical traffic, with every request served in both
+        # arms (a CB win bought by shedding load would be a lie); the
+        # scale-out sim must report a reaction time, admit the new
+        # replica whole (zero partial seats), and emit EXACTLY one
+        # ScaledUp event for the one decision
+        cb, st = serving["continuous"], serving["static"]
+        sc = serving["scaleout"]
+        if not (cb["tokens_per_s"] > st["tokens_per_s"]
+                and cb["p99_ms"] <= st["p99_ms"]
+                and cb["ok"] == SERVING_REQUESTS
+                and st["ok"] == SERVING_REQUESTS
+                and sc["reaction_s"] is not None
+                and sc["admitted_wall_s"] is not None
+                and sc["replicas"] == 2
+                and sc["partial_allocations"] == 0
+                and sc["scaled_up_events"] == 1):
+            print(f"bench_rollout: FAIL — serving column {serving} "
+                  "(need cb tokens/s > static at p99 <=, all "
+                  f"{SERVING_REQUESTS} ok in both arms, reaction "
+                  "reported, replicas==2, partial_allocations==0, "
+                  "scaled_up_events==1)", file=sys.stderr)
             return 1
         # fleet scale (ISSUE 11): the sublinear pins — a 50x node-count
         # jump may not even DOUBLE the rollout's request bill, the

@@ -235,6 +235,20 @@ def render(doc: dict, name: str) -> str:
                      "device-query / vector-add / matmul / psum on hardware "
                      "(the reference's pasted verification outputs, "
                      "executed)"))
+    srv = doc.get("serving") or {}
+    if "error" in srv:
+        rows.append(("Serving: continuous vs static batching", "error",
+                     srv["error"]))
+    elif srv:
+        cb, st = srv.get("continuous") or {}, srv.get("static") or {}
+        rows.append((
+            "Serving: continuous vs static batching",
+            f"**{srv.get('tokens_ratio')}x tokens/s**",
+            f"CB {cb.get('tokens_per_s')} tok/s at p99 "
+            f"{cb.get('p99_ms')} ms vs static {st.get('tokens_per_s')} "
+            f"tok/s at p99 {st.get('p99_ms')} ms; mean occupancy "
+            f"{cb.get('occupancy')} of {srv.get('slots')} slots "
+            "(iteration-level admission, identical open-loop traffic)"))
     scrape = doc.get("metrics_scrape") or {}
     if scrape.get("ok"):
         vals = []

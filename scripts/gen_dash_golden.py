@@ -69,6 +69,17 @@ def build() -> metricsdb.TSDB:
                     {"job": "fake", "reason": "Admitted"},
                     1.0 + (1.0 if i >= 20 else 0.0), ts=ts,
                     mtype="counter")
+        # serving replica (ISSUE 20): a decode ramp-up feeding the
+        # serving panel — tokens accelerate mid-window, queue drains
+        tsdb.append("up", {"job": "serving-0"}, 1.0, ts=ts,
+                    mtype="gauge")
+        tsdb.append("tpu_serving_tokens_total", {"job": "serving-0"},
+                    200.0 + i * 8.0 + 12.0 * min(max(i - 14, 0), 10),
+                    ts=ts, mtype="counter")
+        tsdb.append("tpu_serving_queue_depth", {"job": "serving-0"},
+                    float(max(0, 6 - i // 4)), ts=ts, mtype="gauge")
+        tsdb.append("tpu_autoscale_replicas", {"job": "autoscale"},
+                    1.0 if i < 18 else 2.0, ts=ts, mtype="gauge")
     # TYPE lines ride ingest normally; dumped types matter for replay
     return tsdb
 

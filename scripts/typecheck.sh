@@ -15,4 +15,6 @@ exec "${PYTHON:-python3}" -m mypy --strict \
   tpu_cluster/conlint.py tpu_cluster/verify.py tpu_cluster/admission.py \
   tpu_cluster/informer.py tpu_cluster/muxhttp.py tpu_cluster/events.py \
   tpu_cluster/slo.py tpu_cluster/metricsdb.py tpu_cluster/maintenance.py \
-  tpu_cluster/contracts.py tpu_cluster/pinlint.py
+  tpu_cluster/contracts.py tpu_cluster/pinlint.py \
+  tpu_cluster/autoscale.py tpu_cluster/workloads/serving.py \
+  tpu_cluster/workloads/loadgen.py

@@ -1,0 +1,432 @@
+"""Autoscaler suite (ISSUE 20): the pure decision law (hysteresis,
+cooldown, fail-open, the pinned flapping soak), the fail-closed state
+round-trip, the controller's gang-arbitrated scale-out / drain-whole
+scale-in against the fake apiserver, the fresh-process resume with no
+duplicate scale Events, and the chaos soak (NotReady replica mid-scale
++ controller swap mid-decision, zero partial seats at every
+observation).
+"""
+
+import json
+import time
+
+from fake_apiserver import FakeApiServer, soak_seconds, \
+    standard_fault_script
+from tpu_cluster import admission, autoscale, kubeapply, metricsdb, \
+    telemetry
+from tpu_cluster import events as eventsmod
+from tpu_cluster.workloads import runtime_metrics
+
+NS = "tpu-system"
+FAST_RETRY = kubeapply.RetryPolicy(attempts=8, base_s=0.02, cap_s=0.3)
+STATE_PATH = (f"/api/v1/namespaces/{NS}/configmaps/"
+              f"{autoscale.AUTOSCALE_CONFIGMAP}")
+
+POLICY = autoscale.AutoscalePolicy(min_replicas=1, max_replicas=4,
+                                   duty_high=75.0, duty_low=25.0,
+                                   queue_high=4.0, window_s=30.0,
+                                   cooldown_s=60.0)
+
+
+def view(duty=None, queue=None, total=1, up=1):
+    return autoscale.MetricsView(targets_total=total, targets_up=up,
+                                 duty_percent=duty, queue_depth=queue)
+
+
+def feed(tsdb, job, duty, queue=0.0, up=1.0):
+    now = tsdb.now()
+    tsdb.append(telemetry.UP, {"job": job}, up, ts=now)
+    tsdb.append(runtime_metrics.DUTY_CYCLE_PERCENT, {"job": job}, duty,
+                ts=now)
+    tsdb.append(telemetry.SERVING_QUEUE_DEPTH, {"job": job}, queue,
+                ts=now)
+
+
+def scale_events(api):
+    """(reason, count) over the autoscaler's Events, aggregation-aware."""
+    out = []
+    for p in sorted(api.paths("/events/")):
+        e = api.get(p)
+        if e and eventsmod.event_matches(
+                e, f"ConfigMap/{autoscale.AUTOSCALE_CONFIGMAP}"):
+            out.append((e["reason"], int(e.get("count", 1))))
+    return out
+
+
+# ------------------------------------------------------- the pure law
+
+
+def test_decide_scales_up_past_duty_high():
+    d = autoscale.decide(view(duty=80.0), 1, POLICY, 0.0, 0.0)
+    assert (d.verdict, d.desired) == (autoscale.VERDICT_UP, 2)
+    assert "duty 80%" in d.reason
+
+
+def test_decide_scales_up_on_queue_pressure_alone():
+    # queue pressure catches saturation before duty crosses its bar
+    d = autoscale.decide(view(duty=50.0, queue=8.0), 2, POLICY, 0.0, 0.0)
+    assert (d.verdict, d.desired) == (autoscale.VERDICT_UP, 3)
+    assert "queue/replica" in d.reason
+
+
+def test_decide_holds_inside_hysteresis_band():
+    d = autoscale.decide(view(duty=50.0, queue=1.0), 2, POLICY, 0.0, 0.0)
+    assert (d.verdict, d.desired) == (autoscale.VERDICT_HOLD, 2)
+
+
+def test_decide_scales_down_only_with_evidence_of_idleness():
+    idle = autoscale.decide(view(duty=10.0, queue=0.0), 2, POLICY,
+                            0.0, 0.0)
+    assert (idle.verdict, idle.desired) == (autoscale.VERDICT_DOWN, 1)
+    # duty None is BLINDNESS, not idleness: hold, never shrink
+    blind = autoscale.decide(view(duty=None, queue=0.0), 3, POLICY,
+                             0.0, 0.0)
+    assert blind.verdict == autoscale.VERDICT_HOLD
+
+
+def test_decide_respects_min_and_max_replicas():
+    floor = autoscale.decide(view(duty=5.0, queue=0.0), 1, POLICY,
+                             0.0, 0.0)
+    assert (floor.verdict, floor.desired) == (autoscale.VERDICT_HOLD, 1)
+    ceil = autoscale.decide(view(duty=99.0), 4, POLICY, 0.0, 0.0)
+    assert (ceil.verdict, ceil.desired) == (autoscale.VERDICT_BLOCKED, 4)
+    assert "max_replicas" in ceil.reason
+
+
+def test_decide_cooldown_locks_both_directions():
+    up = autoscale.decide(view(duty=90.0), 2, POLICY, 100.0, 150.0)
+    assert up.verdict == autoscale.VERDICT_HOLD
+    assert "cooldown" in up.reason and "50s left" in up.reason
+    down = autoscale.decide(view(duty=5.0, queue=0.0), 2, POLICY,
+                            100.0, 150.0)
+    assert down.verdict == autoscale.VERDICT_HOLD
+    assert "cooldown" in down.reason
+    # the lockout expires exactly at cooldown_until
+    after = autoscale.decide(view(duty=90.0), 2, POLICY, 150.0, 150.0)
+    assert after.verdict == autoscale.VERDICT_UP
+
+
+def test_decide_fails_open_when_all_targets_down():
+    d = autoscale.decide(view(duty=None, queue=None, total=2, up=0),
+                         3, POLICY, 0.0, 0.0)
+    assert (d.verdict, d.desired) == (autoscale.VERDICT_HOLD, 3)
+    assert "fail-open" in d.reason
+    # zero CONFIGURED targets is not blindness — the band rules apply
+    d = autoscale.decide(view(duty=30.0, total=0, up=0), 1, POLICY,
+                         0.0, 0.0)
+    assert d.reason == "within hysteresis band"
+
+
+def test_flapping_metric_soak_decision_sequence_pinned():
+    """A metric flapping across the band every 10s must be absorbed by
+    the cooldown: exactly one scale per cooldown window, the decision
+    sequence pinned verbatim."""
+    replicas, cooldown_until = 1, 0.0
+    verdicts = []
+    for tick in range(8):
+        now = tick * 10.0
+        duty = 90.0 if tick % 2 == 0 else 10.0
+        d = autoscale.decide(view(duty=duty, queue=0.0), replicas,
+                             POLICY, now, cooldown_until)
+        verdicts.append(d.verdict)
+        if d.verdict in (autoscale.VERDICT_UP, autoscale.VERDICT_DOWN):
+            replicas = d.desired
+            cooldown_until = now + POLICY.cooldown_s
+    assert verdicts == ["up", "hold", "hold", "hold", "hold", "hold",
+                        "up", "hold"]
+    assert replicas == 2 + 1  # two scale-ups in 80s of flapping, not 4
+
+
+# ------------------------------------------------------------- state
+
+
+def test_state_round_trips_canonically():
+    state = autoscale.ScaleState(job="serving", accelerator="v5e-8",
+                                 replicas=3, cooldown_until=123.5,
+                                 last_blocked="at max")
+    doc = autoscale.build_state(state)
+    assert doc["version"] == autoscale.AUTOSCALE_SCHEMA_VERSION
+    assert autoscale.parse_state(doc) == state
+    # canonical payload: sorted keys, no whitespace — byte-stable
+    payload = json.dumps(doc, sort_keys=True, separators=(",", ":"))
+    assert autoscale.parse_state(json.loads(payload)) == state
+
+
+def test_parse_state_fails_closed():
+    import pytest
+    good = autoscale.build_state(autoscale.ScaleState(
+        job="serving", accelerator="v5e-8", replicas=1))
+    for mutation in ({"version": 99}, {"job": ""}, {"replicas": -1},
+                     {"replicas": "many"}):
+        with pytest.raises(ValueError):
+            autoscale.parse_state({**good, **mutation})
+    with pytest.raises(ValueError):
+        autoscale.parse_state(["not", "a", "mapping"])
+
+
+def test_observe_keeps_missing_series_none():
+    tsdb = metricsdb.TSDB()
+    v = autoscale.observe(tsdb, 30.0)
+    assert (v.targets_total, v.duty_percent, v.queue_depth) \
+        == (0, None, None)
+    feed(tsdb, "serving-0", duty=80.0, queue=3.0)
+    feed(tsdb, "serving-1", duty=40.0, queue=2.0)
+    v = autoscale.observe(tsdb, 30.0)
+    assert (v.targets_total, v.targets_up) == (2, 2)
+    assert v.duty_percent == 60.0  # mean across replicas
+    assert v.queue_depth == 5.0    # summed across replicas
+
+
+def test_replica_manifest_is_gang_job_with_replica_annotation():
+    m = autoscale.replica_manifest("serving", 1, "v5e-8", NS)
+    anns = m["metadata"]["annotations"]
+    assert m["metadata"]["name"] == "serving-1"
+    assert anns[autoscale.SERVING_REPLICA_ANNOTATION] == "serving"
+    assert anns[admission.GANG_ANNOTATION] == "serving/1"
+    assert autoscale.replica_index("serving", "serving-1") == 1
+    assert autoscale.replica_index("serving", "other-1") is None
+
+
+# -------------------------------------------------------- controller
+
+
+def seed_hosts(client, n, accelerator="v5e-8"):
+    for i in range(n):
+        client.apply(admission.node_manifest(f"as-{i}", accelerator))
+
+
+def make_controller(client, tsdb, tel=None, events=None, **policy_kw):
+    policy = autoscale.AutoscalePolicy(**{
+        "min_replicas": 1, "max_replicas": 4, "cooldown_s": 0.0,
+        **policy_kw})
+    return autoscale.AutoscaleController(
+        client, NS, job="serving", accelerator="v5e-8", policy=policy,
+        tsdb=tsdb, telemetry=tel, events=events)
+
+
+def test_scale_out_waits_for_gang_arbitration_then_scales():
+    tel = telemetry.Telemetry()
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url, telemetry=tel)
+        seed_hosts(client, 3)
+        adm = admission.AdmissionController(client, NS, telemetry=tel)
+        tsdb = metricsdb.TSDB()
+        rec = eventsmod.EventRecorder(client, component="tpu-autoscale",
+                                      telemetry=tel)
+        ctrl = make_controller(client, tsdb, tel=tel, events=rec)
+        feed(tsdb, "serving-0", duty=95.0)
+        # pass 1: overloaded, but replica 0 does not exist yet — the
+        # gang gate blocks the scale and converges what is owed
+        r1 = ctrl.step()
+        assert r1.verdict == autoscale.VERDICT_BLOCKED
+        assert "awaiting gang arbitration" in r1.reason
+        assert r1.applied == ["serving-0"]
+        adm.step()  # seats serving-0
+        # pass 2: the owed gang is admitted; NOW the scale-out lands
+        feed(tsdb, "serving-0", duty=95.0)
+        r2 = ctrl.step()
+        assert (r2.verdict, r2.replicas) == (autoscale.VERDICT_UP, 2)
+        assert r2.applied == ["serving-1"]
+        assert r2.reaction_s is not None and r2.reaction_s >= 0.0
+        adm.step()
+        assert "serving/1" in adm.admitted_snapshot()
+        assert scale_events(api) == [
+            (autoscale.EVENT_SCALE_BLOCKED, 1),
+            (autoscale.EVENT_SCALED_UP, 1)]
+        # the published state is the fresh process's resume point
+        state = autoscale.fetch_state(client, NS)
+        assert state is not None and state.replicas == 2
+        client.close()
+
+
+def test_fresh_process_resumes_without_duplicate_scale_events():
+    tel = telemetry.Telemetry()
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url, telemetry=tel)
+        seed_hosts(client, 3)
+        adm = admission.AdmissionController(client, NS, telemetry=tel)
+        tsdb = metricsdb.TSDB()
+        rec = eventsmod.EventRecorder(client, component="tpu-autoscale",
+                                      telemetry=tel)
+        first = make_controller(client, tsdb, tel=tel, events=rec)
+        feed(tsdb, "serving-0", duty=95.0)
+        first.step()
+        adm.step()
+        feed(tsdb, "serving-0", duty=95.0)
+        assert first.step().replicas == 2
+        adm.step()
+        events_before = scale_events(api)
+        # a FRESH controller (the --once shape) with calm metrics must
+        # adopt replicas=2 from the ConfigMap and re-decide NOTHING
+        calm = metricsdb.TSDB()
+        feed(calm, "serving-0", duty=50.0)
+        feed(calm, "serving-1", duty=50.0)
+        resumed = make_controller(client, calm, tel=tel, events=rec)
+        r = resumed.step()
+        assert (r.verdict, r.replicas) == (autoscale.VERDICT_HOLD, 2)
+        assert r.applied == [] and r.deleted == []
+        assert not r.published  # canonical state already on the wire
+        assert scale_events(api) == events_before
+        client.close()
+
+
+def test_scale_in_drains_whole_replica_only():
+    tel = telemetry.Telemetry()
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url, telemetry=tel)
+        seed_hosts(client, 3)
+        adm = admission.AdmissionController(client, NS, telemetry=tel)
+        tsdb = metricsdb.TSDB()
+        rec = eventsmod.EventRecorder(client, component="tpu-autoscale",
+                                      telemetry=tel)
+        ctrl = make_controller(client, tsdb, tel=tel, events=rec)
+        feed(tsdb, "serving-0", duty=95.0)
+        ctrl.step()
+        adm.step()
+        feed(tsdb, "serving-0", duty=95.0)
+        assert ctrl.step().replicas == 2
+        adm.step()
+        # both replicas idle WITH evidence -> drain replica 1 whole.
+        # The 30s window still holds serving-0's overload samples, so
+        # keep feeding idle until the windowed mean sinks past duty_low
+        # (the same decay a real calm fleet would show).
+        for _ in range(8):
+            feed(tsdb, "serving-0", duty=5.0)
+            feed(tsdb, "serving-1", duty=5.0)
+        r = ctrl.step()
+        assert (r.verdict, r.replicas) == (autoscale.VERDICT_DOWN, 1)
+        assert r.deleted == ["serving-1"]
+        jobs = client.list_collection(
+            f"/apis/batch/v1/namespaces/{NS}/jobs")
+        assert "serving-1" not in jobs and "serving-0" in jobs
+        adm.step()
+        snapshot = adm.admitted_snapshot()
+        assert "serving/1" not in snapshot and "serving/0" in snapshot
+        assert (autoscale.EVENT_SCALED_DOWN, 1) in scale_events(api)
+        client.close()
+
+
+def test_fail_open_pass_still_converges_jobs():
+    """All targets down: the verdict is hold, but the level-triggered
+    Job convergence still heals a lost replica write."""
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        seed_hosts(client, 2)
+        tsdb = metricsdb.TSDB()
+        feed(tsdb, "serving-0", duty=95.0, up=0.0)  # exporter down
+        ctrl = make_controller(client, tsdb)
+        r = ctrl.step()
+        assert r.verdict == autoscale.VERDICT_HOLD
+        assert "fail-open" in r.reason
+        assert r.applied == ["serving-0"]  # owed replica still healed
+        client.close()
+
+
+# ---------------------------------------------------- the chaos soak
+
+
+def seat_check(api, hosts_chips):
+    cm = api.get(f"/api/v1/namespaces/{NS}/configmaps/"
+                 f"{admission.RESERVATION_CONFIGMAP}")
+    if cm is None:
+        return 0
+    table = admission.parse_table(
+        json.loads(cm["data"][admission.RESERVATION_KEY]))
+    partial = 0
+    for host, chips in hosts_chips.items():
+        for k in range(1, chips):
+            ok, _ = admission.check_allocation(table, host,
+                                               list(range(k)))
+            partial += int(ok)
+    return partial
+
+
+def test_autoscale_chaos_soak_zero_partial_seats():
+    """The acceptance soak: scale 1→4 under the standard fault script
+    with a replica's node flapping NotReady mid-scale and the
+    controller replaced mid-decision — zero partial seats at every
+    observation, one ScaledUp per transition (no duplicates across the
+    swap), and the fleet converged at max_replicas."""
+    hosts_chips = {f"as-{i}": 8 for i in range(4)}
+    chaos = standard_fault_script(0.03) + [
+        {"node_not_ready": "as-0", "at": 0.5},
+        {"node_ready": "as-0", "at": 1.1},
+    ]
+    tel = telemetry.Telemetry()
+    with FakeApiServer(auto_ready=True, chaos=chaos) as api:
+        client = kubeapply.Client(api.url, retry=FAST_RETRY,
+                                  telemetry=tel)
+        seed_hosts(client, 4)
+        adm = admission.AdmissionController(client, NS, telemetry=tel)
+        rec = eventsmod.EventRecorder(client, component="tpu-autoscale",
+                                      telemetry=tel, spam_burst=200)
+        tsdb = metricsdb.TSDB()
+        ctrl = make_controller(client, tsdb, tel=tel, events=rec)
+        partials = 0
+        swapped = False
+        blocked_at_max = False
+        deadline = time.monotonic() + soak_seconds(30.0)
+        while time.monotonic() < deadline:
+            for i in range(4):
+                feed(tsdb, f"serving-{i}", duty=95.0, queue=6.0)
+            try:
+                r = ctrl.step()
+                adm.step()
+            except kubeapply.ApplyError:
+                continue  # chaos outlasted the retry budget this pass
+            partials += seat_check(api, hosts_chips)
+            if not swapped and r.replicas >= 2:
+                # SIGKILL mid-decision: a fresh controller must resume
+                # from the ConfigMap, not re-decide from scratch
+                ctrl = make_controller(client, tsdb, tel=tel,
+                                       events=rec)
+                swapped = True
+            if r.verdict == autoscale.VERDICT_BLOCKED \
+                    and "max_replicas" in r.reason:
+                blocked_at_max = True
+                break
+        assert blocked_at_max, "never converged to max under overload"
+        assert swapped, "the mid-scale controller swap never happened"
+        assert partials == 0, f"{partials} partial seat(s) observed"
+        state = autoscale.fetch_state(client, NS)
+        assert state is not None and state.replicas == 4
+        # exactly one ScaledUp per transition (1→2, 2→3, 3→4): the
+        # resumed controller emitted no duplicates
+        ups = sum(c for reason, c in scale_events(api)
+                  if reason == autoscale.EVENT_SCALED_UP)
+        assert ups == 3, scale_events(api)
+        # the chaos node flap really fired
+        fired = {k for k, _m, _p in api.chaos.fired_snapshot()}
+        assert "node_not_ready" in fired
+        client.close()
+
+
+# --------------------------------------------------------------- CLI
+
+
+def _run_cli(argv):
+    from tpu_cluster.__main__ import build_parser
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+def test_autoscale_cli_status_and_once_passes(capsys):
+    with FakeApiServer(auto_ready=True) as api:
+        client = kubeapply.Client(api.url)
+        seed_hosts(client, 2)
+        conn = ["--apiserver", api.url, "--namespace", NS]
+        assert _run_cli(["autoscale", "status"] + conn) == 1
+        assert "no published state" in capsys.readouterr().out
+        # --once without targets: fail-open-free hold (no metrics is no
+        # EVIDENCE), state bootstrapped at min_replicas and published
+        assert _run_cli(["autoscale", "run", "--once",
+                         "--cooldown", "0"] + conn) == 0
+        out = capsys.readouterr().out
+        assert "autoscale: replicas 1" in out
+        assert "state published" in out
+        state = autoscale.fetch_state(client, NS)
+        assert state is not None and state.replicas == 1
+        assert _run_cli(["autoscale", "status"] + conn) == 0
+        out = capsys.readouterr().out
+        assert "job serving (v5e-8), 1 replica(s)" in out
+        client.close()

@@ -1307,5 +1307,20 @@ def test_bench_rollout_json_line_meets_targets():
         assert opf["drift_to_repaired_s"] <= 5.0, opf
         assert opf["reconcile_slices"] >= 1, opf
         assert opf["reconcile_p99_s"] <= 0.5, opf
+    # the serving column (ISSUE 20): continuous batching beats the
+    # static-batch control arm on tokens/s at no-worse p99 under
+    # identical open-loop traffic, every request served; the scale-out
+    # leg reports a reaction time, lands exactly one ScaledUp Event,
+    # and the seat audit saw zero partial host groups
+    srv = doc["serving"]
+    cb, st = srv["continuous"], srv["static"]
+    assert cb["tokens_per_s"] > st["tokens_per_s"], srv
+    assert cb["p99_ms"] <= st["p99_ms"], srv
+    assert cb["ok"] == st["ok"] == srv["requests"], srv
+    assert cb["iterations"] < st["iterations"], srv
+    sc = srv["scaleout"]
+    assert sc["replicas"] == 2 and sc["scaled_up_events"] == 1, sc
+    assert sc["reaction_s"] is not None and sc["admitted_wall_s"] is not None
+    assert sc["partial_allocations"] == 0, sc
     # the recorded line for the round artifacts / triage summary
     print(f"BENCH_ROLLOUT {json.dumps(doc, separators=(',', ':'))}")

@@ -1,0 +1,263 @@
+"""Serving-path suite (ISSUE 20): the continuous-batching engine's
+iteration-level semantics, the static-batch control arm it is measured
+against, the open-loop load generator, and the HTTP frontend.
+
+The engine pins drive :meth:`InferenceEngine.step` directly (no engine
+thread) so every admission/eviction interleaving is deterministic; the
+CB-vs-static comparison counts decode ITERATIONS for identical traffic
+— a wall-clock-free statement of the throughput win the bench column
+gates.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+from tpu_cluster import telemetry
+from tpu_cluster.workloads import loadgen, serving
+
+TINY = dict(vocab=32, d_model=16, d_ff=32, n_heads=2, seq=16)
+
+
+def tiny_engine(clock=time.monotonic, tel=None, **kw):
+    merged = {**TINY, "slots": 2, **kw}
+    return serving.InferenceEngine(serving.ServingConfig(**merged),
+                                   telemetry=tel, clock=clock)
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ----------------------------------------------------------- admission
+
+
+def test_submit_rejects_bad_requests_immediately():
+    eng = tiny_engine(tel=telemetry.Telemetry())
+    too_long = tuple(range(TINY["seq"]))
+    for req in (eng.submit(too_long),
+                eng.submit((), max_new_tokens=4),
+                eng.submit((1, 2), max_new_tokens=0)):
+        assert req.status == serving.STATUS_REJECTED
+        assert req.done.is_set()
+    # the engine never saw them
+    assert eng.queue_depth() == 0
+    counts = eng.telemetry.metrics.render()
+    assert 'tpu_serving_requests_total{code="503"} 3' in counts
+
+
+def test_submit_rejects_when_queue_full():
+    eng = tiny_engine(max_queue=1)
+    first = eng.submit((1, 2), max_new_tokens=2)
+    second = eng.submit((1, 2), max_new_tokens=2)
+    assert first.status == ""  # queued, in flight
+    assert second.status == serving.STATUS_REJECTED
+    assert eng.queue_depth() == 1
+
+
+def test_continuous_batching_admits_into_running_batch():
+    eng = tiny_engine(slots=2)
+    a = eng.submit((1, 2), max_new_tokens=8)
+    assert eng.step() == 1  # a decoding alone
+    b = eng.submit((3, 4), max_new_tokens=2)
+    assert eng.step() == 2  # b seated MID-BATCH, no barrier
+    assert a.tokens and b.tokens
+    eng.drain()
+    assert a.status == serving.STATUS_OK and len(a.tokens) == 8
+    assert b.status == serving.STATUS_OK and len(b.tokens) == 2
+
+
+def test_mid_batch_eviction_frees_slot_for_queued_request():
+    eng = tiny_engine(slots=2, tel=telemetry.Telemetry())
+    short = eng.submit((1, 2), max_new_tokens=2)
+    long = eng.submit((3, 4), max_new_tokens=10)
+    waiter = eng.submit((5, 6), max_new_tokens=2)  # queued: no free slot
+    assert eng.step() == 2
+    assert eng.step() == 2  # short finishes HERE, slot evicted mid-batch
+    assert short.status == serving.STATUS_OK
+    assert eng.step() == 2  # waiter seated while long still decodes
+    assert waiter.admitted_ts is not None
+    assert long.status == ""  # still in flight when waiter was admitted
+    eng.drain()
+    assert waiter.status == serving.STATUS_OK
+    assert long.status == serving.STATUS_OK
+    text = eng.telemetry.metrics.render()
+    assert 'tpu_serving_evictions_total{cause="done"} 3' in text
+
+
+def test_static_batching_barrier_holds_admission():
+    eng = tiny_engine(slots=2, static_batching=True)
+    a = eng.submit((1, 2), max_new_tokens=6)
+    assert eng.step() == 1  # batch = {a}
+    b = eng.submit((3, 4), max_new_tokens=2)
+    # the barrier: b waits for the WHOLE batch even with a slot free
+    while a.status == "":
+        assert eng.step() == 1
+    assert b.admitted_ts is None
+    eng.drain()
+    assert b.status == serving.STATUS_OK
+    assert b.admitted_ts >= a.finished_ts
+
+
+def test_cb_needs_fewer_iterations_than_static_for_same_traffic():
+    """The throughput pin, wall-clock-free: identical requests with
+    divergent lengths cost continuous batching strictly fewer decode
+    iterations (each a same-cost jitted forward) than the static-batch
+    control arm, at identical decoded-token totals."""
+    lengths = [2, 8, 2, 8, 2, 8]
+    runs = {}
+    for static in (False, True):
+        eng = tiny_engine(slots=2, static_batching=static)
+        reqs = [eng.submit((1, 2, 3), max_new_tokens=n) for n in lengths]
+        eng.drain()
+        assert all(r.status == serving.STATUS_OK for r in reqs)
+        assert [len(r.tokens) for r in reqs] == lengths
+        runs[static] = (eng.iterations, eng.decoded_tokens)
+    assert runs[False][1] == runs[True][1] == sum(lengths)
+    assert runs[False][0] < runs[True][0], runs
+
+
+# ----------------------------------------------------------- deadlines
+
+
+def test_deadline_evicts_seated_request_mid_batch():
+    clock = FakeClock()
+    eng = tiny_engine(slots=2, clock=clock)
+    keeper = eng.submit((1, 2), max_new_tokens=10, deadline_s=100.0)
+    doomed = eng.submit((3, 4), max_new_tokens=10, deadline_s=0.5)
+    assert eng.step() == 2
+    clock.t += 1.0  # doomed's deadline passes while it is SEATED
+    assert eng.step() == 2
+    assert doomed.status == serving.STATUS_DEADLINE
+    assert doomed.done.is_set()
+    assert keeper.status == ""  # unharmed neighbour
+    eng.drain()
+    assert keeper.status == serving.STATUS_OK
+
+
+def test_expired_queue_entry_dropped_at_admission():
+    clock = FakeClock()
+    eng = tiny_engine(slots=1, clock=clock)
+    stale = eng.submit((1, 2), max_new_tokens=4, deadline_s=0.5)
+    clock.t += 1.0
+    assert eng.step() == 0  # dropped before ever seating
+    assert stale.status == serving.STATUS_DEADLINE
+    assert stale.admitted_ts is None
+
+
+# ------------------------------------------------------------- loadgen
+
+
+def test_arrival_times_follow_stepped_profile():
+    steps = [loadgen.Step(qps=2.0, duration_s=1.0),
+             loadgen.Step(qps=4.0, duration_s=0.5)]
+    assert loadgen.arrival_times(steps) == [0.0, 0.5, 1.0, 1.25]
+    assert loadgen.arrival_times([loadgen.Step(0.0, 5.0)]) == []
+
+
+def test_quantile_is_exact_on_raw_samples():
+    assert loadgen.quantile([], 0.5) == 0.0
+    assert loadgen.quantile([7.0], 0.99) == 7.0
+    assert loadgen.quantile([1.0, 2.0, 3.0, 4.0], 0.5) == 2.5
+    assert loadgen.quantile([1.0, 2.0, 3.0, 4.0], 0.0) == 1.0
+    assert loadgen.quantile([1.0, 2.0, 3.0, 4.0], 1.0) == 4.0
+
+
+def test_open_loop_dispatch_never_waits_on_completions():
+    """A slow server must NOT throttle the offered load: five requests
+    against a 0.2s-blocking sender complete in ~one service time, not
+    five serialized ones."""
+    def slow_sender(prompt, want, deadline_s):
+        time.sleep(0.2)
+        return ("ok", want)
+
+    gen = loadgen.LoadGenerator([slow_sender],
+                                [loadgen.Step(qps=10.0, duration_s=0.5)],
+                                pace=False)
+    report = gen.run()
+    assert report.ok == 5
+    assert report.wall_s < 0.8, report.wall_s  # not 5 x 0.2 serial
+
+
+def test_hedge_rescues_slow_replica_and_is_counted():
+    stuck = threading.Event()
+
+    def slow(prompt, want, deadline_s):
+        stuck.wait(timeout=5.0)
+        return ("ok", 1)
+
+    def fast(prompt, want, deadline_s):
+        return ("ok", 2)
+
+    gen = loadgen.LoadGenerator(
+        [slow, fast], [loadgen.Step(qps=1.0, duration_s=1.0)],
+        hedge_after_s=0.05, pace=False, deadline_s=5.0)
+    report = gen.run()
+    stuck.set()
+    assert report.hedges_fired == 1
+    assert len(report.outcomes) == 1
+    out = report.outcomes[0]
+    assert (out.replica, out.hedged, out.tokens) == (1, True, 2)
+
+
+def test_hedge_not_fired_when_primary_is_fast():
+    def fast(prompt, want, deadline_s):
+        return ("ok", want)
+
+    gen = loadgen.LoadGenerator(
+        [fast, fast], [loadgen.Step(qps=4.0, duration_s=1.0)],
+        hedge_after_s=0.5, pace=False)
+    report = gen.run()
+    assert report.ok == 4 and report.hedges_fired == 0
+
+
+def test_report_counts_sender_exceptions_as_errors():
+    def broken(prompt, want, deadline_s):
+        raise RuntimeError("boom")
+
+    report = loadgen.LoadGenerator(
+        [broken], [loadgen.Step(qps=2.0, duration_s=1.0)],
+        pace=False).run()
+    assert report.errors == 2 and report.ok == 0
+    assert report.summary()["errors"] == 2
+
+
+# ------------------------------------------------------- HTTP frontend
+
+
+def test_http_frontend_round_trip_with_metrics_scrape():
+    eng = tiny_engine(slots=2, tel=telemetry.Telemetry())
+    with serving.ServingServer(eng) as srv:
+        send = loadgen.http_sender(srv.url)
+        status, ntok = send((1, 2, 3), 4, 10.0)
+        assert (status, ntok) == (serving.STATUS_OK, 4)
+        # over-long prompt -> 503 body carried back through the sender
+        status, ntok = send(tuple(range(TINY["seq"])), 4, 10.0)
+        assert (status, ntok) == (serving.STATUS_REJECTED, 0)
+        with urllib.request.urlopen(srv.url + "/healthz",
+                                    timeout=10) as resp:
+            assert json.loads(resp.read().decode()) == {"ok": True}
+        # the scrape endpoint the autoscaler targets
+        with urllib.request.urlopen(srv.metrics_url, timeout=10) as resp:
+            text = resp.read().decode()
+        assert "tpu_serving_tokens_total 4" in text
+        assert 'tpu_serving_requests_total{code="200"} 1' in text
+        assert 'tpu_serving_requests_total{code="503"} 1' in text
+        assert "tpu_serving_batch_slots 2" in text
+
+
+def test_bench_arm_summary_shape():
+    """The shared bench replay (bench.py serving line + the
+    bench_rollout serving column) reports every gated field and serves
+    every request."""
+    out = serving.bench_arm(static=False, slots=2, requests=4)
+    assert out["ok"] == 4 and out["deadline"] == 0
+    assert out["rejected"] == 0 and out["errors"] == 0
+    assert out["tokens_per_s"] > 0
+    assert out["p99_ms"] >= out["p50_ms"] > 0
+    assert out["iterations"] >= 1 and out["occupancy"] > 0

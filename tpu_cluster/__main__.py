@@ -77,7 +77,8 @@ from typing import Dict
 
 import yaml
 
-from . import (admission as admissionmod, conlint as conlintmod,
+from . import (admission as admissionmod, autoscale as autoscalemod,
+               conlint as conlintmod,
                events as eventsmod, kubeapply, lint as lintmod,
                maintenance as maintenancemod,
                metricsdb as metricsdbmod, slo as slomod,
@@ -701,6 +702,90 @@ def cmd_maintain(args) -> int:
         print("maintain: stopped")
     except kubeapply.ApplyError as exc:
         print(f"maintain: {exc}", file=sys.stderr)
+        rc = 1
+    finally:
+        client.close()
+    return rc
+
+
+def cmd_autoscale(args) -> int:
+    """Metrics-driven serving autoscaler (HPA analog for gang-scheduled
+    replicas): `run` scrapes the replica targets and converges the
+    gang-annotated serving Jobs toward the windowed-load decision
+    (--once for a single crash-restartable CI/scripting pass), `status`
+    reads the published autoscale state."""
+    if not args.apiserver:
+        print("autoscale: --apiserver URL required (the autoscaler "
+              "acts on the cluster)", file=sys.stderr)
+        return 2
+    spec = _load_spec(args.spec)
+    ns = args.namespace or spec.tpu.namespace
+    client = _rest_client(args)
+    assert client is not None
+    rc = 0
+    try:
+        if args.autoscale_cmd == "status":
+            state = autoscalemod.fetch_state(client, ns)
+            print(autoscalemod.format_status(state))
+            if state is None:
+                rc = 1  # the not-found contract, queue-style
+        else:  # run
+            try:
+                targets = _parse_targets(args.targets)
+                policy = autoscalemod.AutoscalePolicy(
+                    min_replicas=args.min_replicas,
+                    max_replicas=args.max_replicas,
+                    duty_high=args.duty_high, duty_low=args.duty_low,
+                    queue_high=args.queue_high, window_s=args.window,
+                    cooldown_s=args.cooldown)
+                policy.validate()
+            except ValueError as exc:
+                print(f"autoscale: {exc}", file=sys.stderr)
+                return 2
+            # the recorder needs a Telemetry for the traceparent stamp;
+            # spans stay unretained (same reasoning as cmd_maintain)
+            tel = telemetry.Telemetry(retain_spans=False)
+            client.telemetry = tel
+            recorder = (eventsmod.EventRecorder(
+                client, component="tpu-autoscale", telemetry=tel)
+                if args.events else None)
+            ctrl = autoscalemod.AutoscaleController(
+                client, ns, job=args.job, accelerator=args.accelerator,
+                policy=policy, targets=targets, telemetry=tel,
+                events=recorder)
+            if args.once:
+                # a fresh process has an empty TSDB: take the warm-up
+                # scrapes the decision window needs, then one pass
+                # (step() itself scrapes once more)
+                for _ in range(max(0, args.scrape_passes - 1)):
+                    if ctrl.scrape is not None:
+                        ctrl.scrape.scrape_once()
+                    time.sleep(args.scrape_interval)
+                print(ctrl.step().line())
+            else:
+                print(f"autoscale: driving {args.job} in namespace "
+                      f"{ns} every {args.interval:g}s (ctrl-c to stop)")
+                last = ""
+                while True:
+                    try:
+                        result = ctrl.step()
+                    except kubeapply.ApplyError as exc:
+                        # state persists and Job convergence is
+                        # level-triggered — the loop is the outer retry
+                        print(f"autoscale: pass failed ({exc}); "
+                              "retrying", file=sys.stderr)
+                    else:
+                        line = result.line()
+                        if (result.verdict != autoscalemod.VERDICT_HOLD
+                                or result.applied or result.deleted
+                                or line != last):
+                            print(line)
+                        last = line
+                    time.sleep(args.interval)
+    except KeyboardInterrupt:
+        print("autoscale: stopped")
+    except kubeapply.ApplyError as exc:
+        print(f"autoscale: {exc}", file=sys.stderr)
         rc = 1
     finally:
         client.close()
@@ -1455,6 +1540,78 @@ def build_parser() -> argparse.ArgumentParser:
                          "Uncordoned/WaveComplete) on the state "
                          "ConfigMap — on by default")
     _maintain_common(mp, with_plan=True)
+
+    p = sub.add_parser(
+        "autoscale", help="metrics-driven serving autoscaler: scrape "
+                          "replica /metrics, window duty-cycle + queue "
+                          "depth, and scale the gang-annotated serving "
+                          "Jobs through admission (scale-out = new "
+                          "gang, scale-in = drain-whole), "
+                          "crash-restartable (state persists in a "
+                          "ConfigMap)")
+    asub = p.add_subparsers(dest="autoscale_cmd", required=True)
+
+    asp = asub.add_parser(
+        "status", help="read the published autoscale state (exit 1 "
+                       "when the autoscaler never ran)", parents=[conn])
+    asp.add_argument("--namespace", default="",
+                    help="namespace of the autoscale-state ConfigMap "
+                         "(default: the spec's TPU namespace)")
+    asp.set_defaults(fn=cmd_autoscale)
+
+    asp = asub.add_parser(
+        "run", help="drive the metrics->replicas loop: scrape, decide "
+                    "(hysteresis + cooldown, fail-open on scrape "
+                    "blindness), converge the replica Jobs",
+        parents=[conn])
+    asp.add_argument("--namespace", default="",
+                    help="namespace of the serving Jobs and the "
+                         "autoscale-state ConfigMap (default: the "
+                         "spec's TPU namespace)")
+    asp.add_argument("--job", default="serving",
+                    help="base name of the serving deployment; replica "
+                         "Jobs are <job>-0..<job>-N (default: serving)")
+    asp.add_argument("--accelerator", default="v5e-8",
+                    help="slice type each replica gang requests "
+                         "(default: v5e-8)")
+    asp.add_argument("--targets", action="append", default=[],
+                    metavar="JOB=URL",
+                    help="replica metrics endpoint (repeatable): a "
+                         "ServingServer's --metrics-port exposition "
+                         "URL")
+    asp.add_argument("--min-replicas", type=int, default=1)
+    asp.add_argument("--max-replicas", type=int, default=4)
+    asp.add_argument("--duty-high", type=float, default=75.0,
+                    help="windowed tpu_duty_cycle_percent above which "
+                         "the fleet scales out (default 75)")
+    asp.add_argument("--duty-low", type=float, default=25.0,
+                    help="windowed duty below which (with an idle "
+                         "queue) the fleet scales in (default 25)")
+    asp.add_argument("--queue-high", type=float, default=4.0,
+                    help="queued requests per replica that also trigger "
+                         "scale-out (default 4)")
+    asp.add_argument("--window", type=float, default=30.0,
+                    help="metric window seconds (default 30)")
+    asp.add_argument("--cooldown", type=float, default=60.0,
+                    help="wall-clock lockout after every scale "
+                         "(default 60; persists across restarts)")
+    asp.add_argument("--once", action="store_true",
+                    help="warm-up scrapes + one pass, print the "
+                         "summary, exit (CI/scripting + crash-restart "
+                         "mode)")
+    asp.add_argument("--scrape-passes", type=int, default=2,
+                    help="scrapes before the --once decision "
+                         "(default 2)")
+    asp.add_argument("--scrape-interval", type=float, default=0.05,
+                    help="seconds between --once warm-up scrapes "
+                         "(default 0.05)")
+    asp.add_argument("--interval", type=float, default=1.0,
+                    help="seconds between passes (default 1)")
+    asp.add_argument("--events", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="post ScaledUp/ScaledDown/ScaleBlocked Events "
+                         "on the state ConfigMap — on by default")
+    asp.set_defaults(fn=cmd_autoscale)
 
     p = sub.add_parser(
         "events", help="list or stream (--follow) the Kubernetes Events "

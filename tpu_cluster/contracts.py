@@ -195,14 +195,17 @@ def build_registry() -> Registry:
     local so the registry can be built without dragging the whole
     package in at import time)."""
     from tpu_cluster.render import operator_bundle
-    from tpu_cluster import admission, events, kubeapply, maintenance, \
-        telemetry
+    from tpu_cluster import admission, autoscale, events, kubeapply, \
+        maintenance, telemetry
     from tpu_cluster.discovery import labels as dlabels
+    from tpu_cluster.workloads import runtime_metrics, serving
 
     out: List[Contract] = []
     tele_f = _rel(telemetry)
     adm_f = _rel(admission)
     maint_f = _rel(maintenance)
+    auto_f = _rel(autoscale)
+    rtm_f = _rel(runtime_metrics)
 
     # ---- metric families: the C++ operator's twin table (ordered) ----
     for i, fam in enumerate(telemetry.OPERATOR_METRIC_NAMES):
@@ -230,6 +233,17 @@ def build_registry() -> Registry:
         out.append(Contract(
             name=f"metric/{val}", kind=KIND_METRIC_FAMILY, value=val,
             py_file=tele_f, py_attr=attr, docs=("GUIDE.md",)))
+
+    # ---- metric families: the runtime-metrics file exporter ---------
+    # (relayed by the C++ exporter sidecar and consumed by the
+    # autoscaler's scrape path — cross-process twice over)
+    for attr in ("DUTY_CYCLE_PERCENT", "TENSORCORE_UTILIZATION_PERCENT"):
+        val = getattr(runtime_metrics, attr)
+        assert isinstance(val, str)
+        out.append(Contract(
+            name=f"metric/{val}", kind=KIND_METRIC_FAMILY, value=val,
+            py_file=rtm_f, py_attr=attr,
+            docs=("GUIDE.md", "TESTING.md")))
 
     # ---- trace slices -----------------------------------------------
     for i, slice_name in enumerate(telemetry.OPERATOR_TRACE_EVENTS):
@@ -286,7 +300,8 @@ def build_registry() -> Registry:
         py_attr="TPU_RESOURCE", docs=("GUIDE.md",)))
 
     # ---- event reasons ----------------------------------------------
-    for module, mod_file in ((admission, adm_f), (maintenance, maint_f)):
+    for module, mod_file in ((admission, adm_f), (maintenance, maint_f),
+                             (autoscale, auto_f)):
         for attr in sorted(vars(module)):
             if attr.startswith("EVENT_"):
                 val = getattr(module, attr)
@@ -334,6 +349,22 @@ def build_registry() -> Registry:
         value=str(maintenance.MAINTENANCE_SCHEMA_VERSION),
         py_file=maint_f, py_attr="MAINTENANCE_SCHEMA_VERSION"))
     out.append(Contract(
+        name="configmap/tpu-autoscale-state", kind=KIND_CONFIGMAP,
+        value=autoscale.AUTOSCALE_CONFIGMAP, py_file=auto_f,
+        py_attr="AUTOSCALE_CONFIGMAP", docs=("GUIDE.md",)))
+    out.append(Contract(
+        name="configmap-key/autoscale.json", kind=KIND_CONFIGMAP_KEY,
+        value=autoscale.AUTOSCALE_KEY, py_file=auto_f,
+        py_attr="AUTOSCALE_KEY"))
+    out.append(Contract(
+        name="schema-version/autoscale", kind=KIND_SCHEMA_VERSION,
+        value=str(autoscale.AUTOSCALE_SCHEMA_VERSION),
+        py_file=auto_f, py_attr="AUTOSCALE_SCHEMA_VERSION"))
+    out.append(Contract(
+        name="annotation/serving-replica", kind=KIND_ANNOTATION,
+        value=autoscale.SERVING_REPLICA_ANNOTATION, py_file=auto_f,
+        py_attr="SERVING_REPLICA_ANNOTATION", docs=("GUIDE.md",)))
+    out.append(Contract(
         name="configmap/tpu-operator-bundle", kind=KIND_CONFIGMAP,
         value=operator_bundle.BUNDLE_CONFIGMAP,
         py_file=_rel(operator_bundle), py_attr="BUNDLE_CONFIGMAP",
@@ -359,6 +390,15 @@ def build_registry() -> Registry:
             name=f"status/{getattr(admission, attr)}", kind=KIND_STATUS,
             value=getattr(admission, attr), py_file=adm_f, py_attr=attr,
             docs=("GUIDE.md",)))
+    # serving terminal statuses: the frontend's HTTP response-body
+    # vocabulary ("status" in the /v1/generate JSON) that the load
+    # generator's sender parses back out — cross-process over the wire
+    serv_f = _rel(serving)
+    for attr in ("STATUS_OK", "STATUS_DEADLINE", "STATUS_REJECTED"):
+        out.append(Contract(
+            name=f"status/serving/{getattr(serving, attr)}",
+            kind=KIND_STATUS, value=getattr(serving, attr),
+            py_file=serv_f, py_attr=attr, docs=("GUIDE.md",)))
     for i, phase in enumerate(maintenance.PHASES):
         out.append(Contract(
             name=f"phase/{phase}", kind=KIND_PHASE, value=phase,

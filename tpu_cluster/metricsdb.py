@@ -1091,6 +1091,29 @@ def render_dash(tsdb: TSDB, window_s: float = 60.0,
             else f"{'-':>8}"
         lines.append(f"{job:<14} {up:>2} {req:8.1f} {err:8.1f} "
                      f"{p99_text}  {spark}")
+    # serving panel: fleet-wide decoded-token rate (sparkline summed
+    # across replicas), live queue depth, and the autoscaler's desired
+    # replica count — present only once the serving path has series
+    # (a batch-only cluster keeps the classic frame).
+    serving_jobs = tsdb.label_values(_telemetry.SERVING_TOKENS_TOTAL,
+                                     "job")
+    if serving_jobs or tsdb.has_series(_telemetry.AUTOSCALE_REPLICAS):
+        slots = [0.0] * _DASH_SLOTS
+        for job in serving_jobs:
+            for i, v in enumerate(_slot_rates(
+                    tsdb, _telemetry.SERVING_TOKENS_TOTAL, job,
+                    window_s, t)):
+                slots[i] += v
+        tok = aggregate(tsdb.rate(_telemetry.SERVING_TOKENS_TOTAL,
+                                  window_s, now=t), "sum")
+        queue = aggregate(tsdb.latest(_telemetry.SERVING_QUEUE_DEPTH,
+                                      now=t), "sum")
+        reps = tsdb.latest(_telemetry.AUTOSCALE_REPLICAS, now=t)
+        reps_text = str(int(round(aggregate(reps, "max")))) \
+            if reps else "-"
+        lines.append(f"serving ({window_s:g}s): tok/s {tok:.1f} "
+                     f"{sparkline(slots)} | queue {queue:g} | "
+                     f"replicas {reps_text}")
     by_reason: Dict[str, float] = {}
     for family in _DASH_EVENT_FAMILIES:
         for pairs, inc in tsdb.increase(family, window_s,

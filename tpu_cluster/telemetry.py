@@ -158,6 +158,32 @@ MAINTENANCE_WAVES_TOTAL = "tpu_maintenance_waves_total"
 MAINTENANCE_DRAINING_GANGS = "tpu_maintenance_draining_gangs"
 MAINTENANCE_CORDONED_HOSTS = "tpu_maintenance_cordoned_hosts"
 MAINTENANCE_GROUP_SECONDS = "tpu_maintenance_group_seconds"
+# Continuous-batching serving (ISSUE 20): the inference operand's
+# families, per replica on its MetricsServer scrape. QUEUE_DEPTH is the
+# admission queue the autoscaler watches; BATCH_SLOTS / BATCH_OCCUPANCY
+# are the decode batch's configured vs currently-seated slots (occupancy
+# is the continuous-batching win the bench column reports);
+# TOKENS_TOTAL counts decoded tokens (tokens/s via rate());
+# REQUESTS_TOTAL is code-labeled like the apiserver counters;
+# PHASE_SECONDS is the per-phase latency histogram (queue|prefill|
+# decode) and REQUEST_SECONDS the end-to-end wall; EVICTIONS counts
+# mid-batch slot evictions labeled by cause (done|deadline).
+SERVING_QUEUE_DEPTH = "tpu_serving_queue_depth"
+SERVING_BATCH_SLOTS = "tpu_serving_batch_slots"
+SERVING_BATCH_OCCUPANCY = "tpu_serving_batch_occupancy"
+SERVING_TOKENS_TOTAL = "tpu_serving_tokens_total"
+SERVING_REQUESTS_TOTAL = "tpu_serving_requests_total"
+SERVING_PHASE_SECONDS = "tpu_serving_phase_seconds"
+SERVING_REQUEST_SECONDS = "tpu_serving_request_seconds"
+SERVING_EVICTIONS_TOTAL = "tpu_serving_evictions_total"
+# Metrics-driven autoscaling (ISSUE 20): the HPA-analog controller's
+# families. REPLICAS is the desired replica count it converges the
+# serving Jobs to; DECISIONS counts every pass's verdict (labeled
+# up|down|hold|blocked); REACTION_SECONDS is the overload-observed to
+# scale-decision wall (the bench's scale-out reaction time).
+AUTOSCALE_REPLICAS = "tpu_autoscale_replicas"
+AUTOSCALE_DECISIONS_TOTAL = "tpu_autoscale_decisions_total"
+AUTOSCALE_REACTION_SECONDS = "tpu_autoscale_reaction_seconds"
 
 # Fixed default buckets, request-latency shaped (seconds). Shared with
 # the ready-wait histogram: its tail rides the +Inf bucket.

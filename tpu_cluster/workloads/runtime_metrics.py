@@ -70,6 +70,15 @@ from typing import Deque, Dict, List, Optional
 DEFAULT_PATH = "/run/tpu/metrics.prom"   # legacy single-writer path
 DEFAULT_DIR = "/run/tpu/metrics.d"       # multi-writer drop-dir
 
+# The exporter-relayed family names other processes lean on: the
+# autoscaler windows DUTY_CYCLE_PERCENT for its scale decisions and the
+# bench line reads TENSORCORE_UTILIZATION_PERCENT as MFU-as-a-gauge.
+# Declared as constants (not only f-string literals) so the contract
+# registry can pin them — tpu_cluster/contracts.py registers both and
+# `tpuctl pinlint --strict` keeps the spellings from drifting.
+DUTY_CYCLE_PERCENT = "tpu_duty_cycle_percent"
+TENSORCORE_UTILIZATION_PERCENT = "tpu_tensorcore_utilization_percent"
+
 
 def writer_id() -> str:
     """Stable per-writer filename stem: hostname (the pod name inside a
@@ -362,15 +371,15 @@ def collect_lines(now: Optional[float] = None) -> List[str]:
         # Prometheus parsers reject the scrape for duplicate HELP. The
         # actual window rides its own gauge below.
         lines += [
-            "# HELP tpu_duty_cycle_percent fraction of wall-time the owning "
+            f"# HELP {DUTY_CYCLE_PERCENT} fraction of wall-time the owning "
             "workload had device execution in flight, over the trailing "
             "window published as tpu_metrics_window_seconds "
             "(process-scoped: one value, every local chip)",
-            "# TYPE tpu_duty_cycle_percent gauge",
+            f"# TYPE {DUTY_CYCLE_PERCENT} gauge",
         ]
         for d in devices:
             lines.append(
-                f'tpu_duty_cycle_percent{{chip="{d.id}"}} {duty:.1f}')
+                f'{DUTY_CYCLE_PERCENT}{{chip="{d.id}"}} {duty:.1f}')
     tc = None
     if _active_tensorcore is not None:
         acc = _resolve_accelerator(devices)
@@ -379,17 +388,17 @@ def collect_lines(now: Optional[float] = None) -> List[str]:
                                             acc.peak_bf16_tflops)
     if tc is not None:
         lines += [
-            "# HELP tpu_tensorcore_utilization_percent achieved model "
+            f"# HELP {TENSORCORE_UTILIZATION_PERCENT} achieved model "
             "FLOP rate vs the per-chip bf16 peak (MFU, as a percentage) "
             "over the trailing window published as "
             "tpu_metrics_window_seconds",
-            "# TYPE tpu_tensorcore_utilization_percent gauge",
+            f"# TYPE {TENSORCORE_UTILIZATION_PERCENT} gauge",
         ]
         for d in devices:
             # %.4g keeps a measured-but-tiny rate (CPU-mesh CI) nonzero
             # instead of rounding it to an absent-looking 0.0
             lines.append(
-                f'tpu_tensorcore_utilization_percent{{chip="{d.id}"}} '
+                f'{TENSORCORE_UTILIZATION_PERCENT}{{chip="{d.id}"}} '
                 f'{tc:.4g}')
     lines += [
         "# HELP tpu_process_devices local devices owned by the writer",
